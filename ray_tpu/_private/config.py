@@ -1,0 +1,114 @@
+"""Typed runtime config flags with environment override.
+
+Re-design of the reference's RAY_CONFIG X-macro flag system (reference:
+src/ray/common/ray_config_def.h — 209 typed flags, env override RAY_<name>,
+serialized to every process). Here: a declarative table, `RAY_TPU_<NAME>`
+env override, and dict (de)serialization so the head node can push one
+consistent config to every daemon it spawns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    # Default arena size; reference sizes plasma from system memory
+    # (reference: src/ray/common/ray_config_def.h object_store_memory).
+    object_store_memory: int = 256 * 1024 * 1024
+    object_store_table_capacity: int = 65536
+    # Objects <= this many bytes are inlined in task replies instead of
+    # going through shm (reference: ray_config_def.h
+    # max_direct_call_object_size = 100KB).
+    max_inline_object_size: int = 100 * 1024
+    # Chunk size for node-to-node object transfer (reference:
+    # ray_config_def.h:355 object_manager_default_chunk_size = 5 MiB).
+    object_transfer_chunk_size: int = 5 * 1024 * 1024
+
+    # --- scheduling ---
+    # Top-k fraction for the hybrid scheduling policy (reference:
+    # raylet/scheduling/policy/hybrid_scheduling_policy.h:107-124).
+    scheduler_top_k_fraction: float = 0.2
+    scheduler_spread_threshold: float = 0.5
+    # Worker pool (reference: raylet/worker_pool.cc prestart logic).
+    num_workers_soft_limit: int = -1  # -1: default to node CPU count
+    worker_startup_timeout_s: float = 60.0
+    worker_lease_timeout_s: float = 30.0
+    # Leased-worker reuse window, amortizes scheduling like the reference's
+    # worker lease reuse (reference: direct_task_transport.cc OnWorkerIdle).
+    idle_worker_keep_s: float = 2.0
+
+    # --- health / failure detection ---
+    # (reference: ray_config_def.h:813-819 health check knobs)
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 5.0
+    num_heartbeats_timeout: int = 5
+
+    # --- tasks ---
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    # Lineage: max bytes of task specs pinned for object reconstruction
+    # (reference: task_manager.cc lineage pinning).
+    max_lineage_bytes: int = 64 * 1024 * 1024
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_call_timeout_s: float = 120.0
+
+    # --- gcs ---
+    gcs_pubsub_max_buffer: int = 10000
+    task_events_max_buffer: int = 100000
+
+    # --- misc ---
+    temp_dir: str = field(default_factory=lambda: os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu"))
+    log_to_driver: bool = True
+
+    def __post_init__(self):
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                setattr(self, f.name, _parse(env, f.type))
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_json(cls, payload: str) -> "Config":
+        cfg = cls()
+        for k, v in json.loads(payload).items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        return cfg
+
+
+def _parse(value: str, typ: Any):
+    name = typ if isinstance(typ, str) else getattr(typ, "__name__", str(typ))
+    if name == "bool":
+        return value.lower() in ("1", "true", "yes")
+    if name == "int":
+        return int(value)
+    if name == "float":
+        return float(value)
+    return value
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,136 @@
+"""Tests for the native shared-memory object store.
+
+Parity model: reference plasma store tests
+(reference: src/ray/object_manager/plasma/test/).
+"""
+
+import multiprocessing
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import (
+    ObjectStoreClient,
+    ObjectStoreFullError,
+)
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = str(tmp_path / "arena")
+    s = ObjectStoreClient(path, create=True, size=16 * 1024 * 1024, table_capacity=1024)
+    yield s
+    s.close()
+
+
+def test_put_get_roundtrip(store):
+    oid = ObjectID.from_random()
+    store.put_raw(oid, b"hello world", meta=b"M")
+    meta, data = store.get_buffer(oid)
+    assert meta == b"M"
+    assert bytes(data) == b"hello world"
+    store.release(oid)
+
+
+def test_zero_copy_numpy(store):
+    oid = ObjectID.from_random()
+    arr = np.arange(1000, dtype=np.float32)
+    buf = store.create(oid, arr.nbytes)
+    np.frombuffer(buf, dtype=np.float32)[:] = arr
+    store.seal(oid)
+    meta, data = store.get_buffer(oid)
+    out = np.frombuffer(data, dtype=np.float32)
+    np.testing.assert_array_equal(out, arr)
+    store.release(oid)
+
+
+def test_missing_object(store):
+    assert store.get_buffer(ObjectID.from_random()) is None
+    assert not store.contains(ObjectID.from_random())
+
+
+def test_unsealed_not_visible(store):
+    oid = ObjectID.from_random()
+    store.create(oid, 10)
+    assert store.get_buffer(oid) is None
+    assert not store.contains(oid)
+    store.seal(oid)
+    assert store.contains(oid)
+
+
+def test_delete_and_reuse_space(store):
+    oids = []
+    for _ in range(10):
+        oid = ObjectID.from_random()
+        store.put_raw(oid, b"x" * 100_000)
+        oids.append(oid)
+    stats = store.stats()
+    assert stats["num_objects"] == 10
+    for oid in oids:
+        assert store.delete(oid)
+    assert store.stats()["num_objects"] == 0
+    # Space is reusable.
+    big = ObjectID.from_random()
+    store.put_raw(big, b"y" * 1_000_000)
+    assert store.contains(big)
+
+
+def test_lru_eviction(store):
+    # Fill the 16MB store with 1MB objects; unreferenced ones get evicted.
+    oids = []
+    for _ in range(30):
+        oid = ObjectID.from_random()
+        store.put_raw(oid, b"z" * (1024 * 1024))
+        oids.append(oid)
+    assert store.stats()["num_evictions"] > 0
+    # Most recent object is present.
+    assert store.contains(oids[-1])
+    # Oldest got evicted.
+    assert not store.contains(oids[0])
+
+
+def test_pinned_objects_not_evicted(store):
+    pinned = ObjectID.from_random()
+    store.put_raw(pinned, b"p" * (1024 * 1024))
+    assert store.get_buffer(pinned) is not None  # hold a reference
+    with pytest.raises(ObjectStoreFullError):
+        # Pinned object survives; the rest of the arena (~15MB usable)
+        # can't fit this in one piece.
+        big = ObjectID.from_random()
+        store.put_raw(big, b"q" * (16 * 1024 * 1024))
+    assert store.contains(pinned)
+
+
+def _child_reader(path, oid_bytes, q):
+    s = ObjectStoreClient(path)
+    got = s.get_buffer(ObjectID(oid_bytes))
+    q.put(bytes(got[1]) if got else None)
+    s.close()
+
+
+def test_cross_process_read(store, tmp_path):
+    oid = ObjectID.from_random()
+    store.put_raw(oid, b"shared-data")
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_reader, args=(store.path, oid.binary(), q))
+    p.start()
+    assert q.get(timeout=30) == b"shared-data"
+    p.join(timeout=10)
+
+
+def test_abort(store):
+    oid = ObjectID.from_random()
+    store.create(oid, 1000)
+    store.abort(oid)
+    assert store.get_buffer(oid) is None
+    assert store.stats()["num_objects"] == 0
+
+
+def test_list_objects(store):
+    oids = {ObjectID.from_random() for _ in range(5)}
+    for oid in oids:
+        store.put_raw(oid, b"v")
+    assert set(store.list_objects()) == oids
