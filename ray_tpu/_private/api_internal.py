@@ -1,0 +1,367 @@
+"""ObjectRef / RemoteFunction / ActorClass plumbing behind the public API.
+
+Parity: reference python/ray/_private/worker.py (global worker),
+remote_function.py:257 (_remote), actor.py (ActorClass/ActorHandle/
+ActorMethod), _private/ray_option_utils.py (options validation).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.common import Address, TaskSpec, normalize_resources
+from ray_tpu._private.ids import ActorID, ObjectID
+
+_core_worker = None
+_lock = threading.RLock()
+
+
+def set_core_worker(cw) -> None:
+    global _core_worker
+    with _lock:
+        _core_worker = cw
+
+
+def get_core_worker():
+    if _core_worker is None:
+        raise exc.RayTpuError(
+            "ray_tpu is not initialized; call ray_tpu.init() first")
+    return _core_worker
+
+
+def core_worker_or_none():
+    return _core_worker
+
+
+class ObjectRef:
+    """A reference to an object owned by some worker (reference:
+    python/ray ObjectRef; owner address travels with the ref as in
+    src/ray/protobuf/common.proto ObjectReference)."""
+
+    __slots__ = ("id", "owner", "_registered")
+
+    def __init__(self, oid: ObjectID, owner: Address | None, _register: bool = True):
+        self.id = oid
+        self.owner = owner
+        self._registered = False
+        cw = _core_worker
+        if _register and cw is not None:
+            cw.add_local_ref(oid.hex())
+            self._registered = True
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __del__(self):
+        if self._registered and _core_worker is not None:
+            try:
+                _core_worker.remove_local_ref(self.id.hex())
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Nested-ref serialization: reconstructs on the far side without
+        # owner-side borrow accounting (round-1 simplification; the owner
+        # must keep the object alive, e.g. by holding the ref).
+        return (_rebuild_object_ref,
+                (self.id.binary(), self.owner.to_wire() if self.owner else None))
+
+    # Allow `await ref` patterns later; for now block via global get.
+    def future(self):
+        raise NotImplementedError
+
+
+def _rebuild_object_ref(id_bytes, owner_wire):
+    return ObjectRef(ObjectID(id_bytes),
+                     Address.from_wire(owner_wire) if owner_wire else None,
+                     _register=False)
+
+
+_OPTION_DEFAULTS = {
+    "num_cpus": None,
+    "num_gpus": None,
+    "num_tpus": None,
+    "resources": None,
+    "num_returns": 1,
+    "max_retries": 3,
+    "retry_exceptions": False,
+    "name": None,
+    "max_restarts": 0,
+    "max_task_retries": 0,
+    "max_concurrency": 1,
+    "scheduling_strategy": None,
+    "placement_group": None,
+    "placement_group_bundle_index": -1,
+    "lifetime": None,
+    "namespace": None,
+    "get_if_exists": False,
+    "runtime_env": None,
+    "memory": None,
+    "accelerator_type": None,
+}
+
+
+def _validate_options(opts: dict, for_actor: bool) -> dict:
+    out = dict(_OPTION_DEFAULTS)
+    for k, v in opts.items():
+        if k not in _OPTION_DEFAULTS:
+            raise ValueError(f"unknown option {k!r}")
+        out[k] = v
+    if out["lifetime"] not in (None, "detached", "non_detached"):
+        raise ValueError("lifetime must be None, 'detached', or 'non_detached'")
+    if not for_actor and out["max_restarts"]:
+        raise ValueError("max_restarts is an actor option")
+    return out
+
+
+def _build_resources(opts: dict, default_cpus: float) -> dict:
+    res = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        res["CPU"] = opts["num_cpus"]
+    elif "CPU" not in res:
+        res["CPU"] = default_cpus
+    if opts.get("num_gpus") is not None:
+        res["GPU"] = opts["num_gpus"]
+    if opts.get("num_tpus") is not None:
+        res["TPU"] = opts["num_tpus"]
+    if opts.get("memory") is not None:
+        res["memory"] = opts["memory"]
+    if opts.get("accelerator_type"):
+        res[f"accelerator_type:{opts['accelerator_type']}"] = 0.001
+    return normalize_resources(res)
+
+
+def _wire_strategy(opts: dict):
+    """Convert a SchedulingStrategy option to wire form."""
+    strategy = opts.get("scheduling_strategy")
+    pg_id = ""
+    bundle_index = opts.get("placement_group_bundle_index", -1)
+    if opts.get("placement_group") is not None:
+        pg = opts["placement_group"]
+        pg_id = pg.id.hex() if hasattr(pg, "id") else str(pg)
+    if strategy is None:
+        return None, pg_id, bundle_index
+    if isinstance(strategy, str):
+        if strategy == "SPREAD":
+            return ["spread"], pg_id, bundle_index
+        if strategy == "DEFAULT":
+            return None, pg_id, bundle_index
+        raise ValueError(f"unknown scheduling strategy {strategy!r}")
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return ["node_affinity", strategy.node_id, strategy.soft], pg_id, bundle_index
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        return None, pg.id.hex(), strategy.placement_group_bundle_index
+    raise ValueError(f"unsupported scheduling strategy {strategy!r}")
+
+
+class RemoteFunction:
+    def __init__(self, fn, opts: dict):
+        self._fn = fn
+        self._opts = _validate_options(opts, for_actor=False)
+        # Registration cache keyed per job: decorated module-level functions
+        # outlive clusters (tests start many), so one cached key would point
+        # at a GCS that no longer exists.
+        self._func_keys: dict[str, str] = {}
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = {k: v for k, v in self._opts.items() if v != _OPTION_DEFAULTS[k]}
+        merged.update(opts)
+        rf = RemoteFunction(self._fn, merged)
+        rf._func_keys = self._func_keys
+        return rf
+
+    def remote(self, *args, **kwargs):
+        cw = get_core_worker()
+        func_key = self._func_keys.get(cw.job_id)
+        if func_key is None:
+            func_key = self._func_keys[cw.job_id] = cw.register_function(self._fn)
+        wire_args, kwargs_keys, _deps = cw.serialize_args(args, kwargs)
+        strategy, pg_id, bundle_index = _wire_strategy(self._opts)
+        task_id = cw.next_task_id()
+        spec = TaskSpec(
+            task_id=task_id.hex(),
+            job_id=cw.job_id,
+            name=self._opts["name"] or getattr(self._fn, "__name__", "anonymous"),
+            func_key=func_key,
+            args=wire_args,
+            kwargs_keys=kwargs_keys,
+            num_returns=self._opts["num_returns"],
+            resources=_build_resources(self._opts, default_cpus=1.0),
+            max_retries=self._opts["max_retries"],
+            retry_exceptions=bool(self._opts["retry_exceptions"]),
+            owner=cw.address.to_wire(),
+            strategy=strategy,
+            placement_group=pg_id,
+            pg_bundle_index=bundle_index,
+            runtime_env=self._opts["runtime_env"],
+        )
+        returns = cw.submit_task(spec)
+        refs = [ObjectRef(oid, cw.address) for oid in returns]
+        if self._opts["num_returns"] == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__!r} cannot be called directly; "
+            f"use .remote()")
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **opts):
+        m = ActorMethod(self._handle, self._method_name,
+                        opts.get("num_returns", self._num_returns))
+        return m
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._method_name!r} cannot be called directly; "
+            f"use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+
+    @property
+    def _id_hex(self) -> str:
+        return self._actor_id.hex()
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def _submit_method(self, method_name: str, args, kwargs, num_returns: int):
+        cw = get_core_worker()
+        wire_args, kwargs_keys, _ = cw.serialize_args(args, kwargs)
+        task_id = cw.next_task_id()
+        spec = TaskSpec(
+            task_id=task_id.hex(),
+            job_id=cw.job_id,
+            name=f"{self._class_name}.{method_name}",
+            func_key="",
+            args=wire_args,
+            kwargs_keys=kwargs_keys,
+            num_returns=num_returns,
+            resources={},
+            max_retries=0,
+            owner=cw.address.to_wire(),
+            actor_id=self._actor_id.hex(),
+        )
+        returns = cw.submit_actor_task(self._actor_id.hex(), spec,
+                                       self._max_task_retries)
+        refs = [ObjectRef(oid, cw.address) for oid in returns]
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (_rebuild_actor_handle,
+                (self._actor_id.binary(), self._class_name, self._max_task_retries))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+
+def _rebuild_actor_handle(id_bytes, class_name, max_task_retries):
+    return ActorHandle(ActorID(id_bytes), class_name, max_task_retries)
+
+
+class ActorClass:
+    def __init__(self, cls, opts: dict):
+        self._cls = cls
+        self._opts = _validate_options(opts, for_actor=True)
+        self._class_keys: dict[str, str] = {}  # per-job, see RemoteFunction
+
+    def options(self, **opts) -> "ActorClass":
+        merged = {k: v for k, v in self._opts.items() if v != _OPTION_DEFAULTS[k]}
+        merged.update(opts)
+        ac = ActorClass(self._cls, merged)
+        ac._class_keys = self._class_keys
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        cw = get_core_worker()
+        class_key = self._class_keys.get(cw.job_id)
+        if class_key is None:
+            class_key = self._class_keys[cw.job_id] = cw.register_function(self._cls)
+        actor_id = ActorID.from_random()
+        wire_args, kwargs_keys, _ = cw.serialize_args(args, kwargs)
+        strategy, pg_id, bundle_index = _wire_strategy(self._opts)
+        task_id = cw.next_task_id()
+        spec = TaskSpec(
+            task_id=task_id.hex(),
+            job_id=cw.job_id,
+            name=f"{self._cls.__name__}.__init__",
+            func_key=class_key,
+            args=wire_args,
+            kwargs_keys=kwargs_keys,
+            num_returns=0,
+            resources=_build_resources(self._opts, default_cpus=1.0),
+            owner=cw.address.to_wire(),
+            actor_id=actor_id.hex(),
+            actor_creation=True,
+            max_restarts=self._opts["max_restarts"],
+            max_task_retries=self._opts["max_task_retries"],
+            strategy=strategy,
+            placement_group=pg_id,
+            pg_bundle_index=bundle_index,
+            runtime_env=self._opts["runtime_env"],
+        )
+        resp = cw.create_actor(
+            spec,
+            name=self._opts["name"] or "",
+            namespace=self._opts["namespace"] or "default",
+            class_name=self._cls.__name__,
+            detached=self._opts["lifetime"] == "detached",
+            get_if_exists=self._opts["get_if_exists"])
+        if not resp.get("ok"):
+            raise exc.RayTpuError(resp.get("reason", "actor registration failed"))
+        if resp.get("existing"):
+            return ActorHandle(ActorID.from_hex(resp["actor_id"]),
+                               self._cls.__name__, self._opts["max_task_retries"])
+        return ActorHandle(actor_id, self._cls.__name__,
+                           self._opts["max_task_retries"])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__!r} cannot be instantiated "
+            f"directly; use .remote()")
+
+
+def make_remote(obj, opts: dict):
+    if isinstance(obj, type):
+        return ActorClass(obj, opts)
+    if callable(obj):
+        return RemoteFunction(obj, opts)
+    raise TypeError("@ray_tpu.remote requires a function or class")

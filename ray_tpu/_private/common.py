@@ -1,0 +1,146 @@
+"""Shared runtime structures: task specs, resources, addresses.
+
+Parity: reference src/ray/common/task/task_spec.h (TaskSpecification),
+src/ray/common/scheduling/resource_set.h (fixed-point resource math — here
+plain floats with an epsilon), and the owner address embedded in object refs
+(reference: src/ray/protobuf/common.proto Address).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+RESOURCE_EPS = 1e-9
+
+# Well-known resource names. TPU is first-class: a node exposes `TPU` chips
+# and slice-topology labels so gang placement can target ICI-connected hosts
+# (reference only knows TPU via autodetect: python/ray/_private/accelerator.py:155).
+CPU = "CPU"
+GPU = "GPU"
+TPU = "TPU"
+MEMORY = "memory"
+OBJECT_STORE_MEMORY = "object_store_memory"
+
+
+def normalize_resources(res: dict[str, float] | None) -> dict[str, float]:
+    out = {}
+    for k, v in (res or {}).items():
+        if v is None:
+            continue
+        v = float(v)
+        if v < 0:
+            raise ValueError(f"resource {k} must be >= 0, got {v}")
+        if v > 0:
+            out[k] = v
+    return out
+
+
+def resources_fit(available: dict[str, float], demand: dict[str, float]) -> bool:
+    return all(available.get(k, 0.0) + RESOURCE_EPS >= v for k, v in demand.items())
+
+
+def subtract_resources(available: dict[str, float], demand: dict[str, float]) -> None:
+    for k, v in demand.items():
+        available[k] = available.get(k, 0.0) - v
+
+
+def add_resources(available: dict[str, float], demand: dict[str, float]) -> None:
+    for k, v in demand.items():
+        available[k] = available.get(k, 0.0) + v
+
+
+@dataclass
+class Address:
+    """Network address of a worker/raylet/gcs endpoint."""
+
+    host: str
+    port: int
+    worker_id: str = ""   # hex; empty for daemons
+    node_id: str = ""     # hex
+
+    def to_wire(self):
+        return [self.host, self.port, self.worker_id, self.node_id]
+
+    @classmethod
+    def from_wire(cls, w):
+        return cls(w[0], w[1], w[2], w[3])
+
+    def key(self):
+        return (self.host, self.port)
+
+
+@dataclass
+class TaskSpec:
+    """Wire form of a task invocation (reference: TaskSpecification).
+
+    func_key: GCS function-table key (functions are registered once per job
+    and fetched by workers on first use — reference:
+    python/ray/_private/function_manager.py).
+    args: list of wire-args; each is ["v", meta, data] inline value or
+    ["r", object_id, owner_addr] reference.
+    """
+
+    task_id: str                      # hex
+    job_id: str
+    name: str
+    func_key: str
+    args: list = field(default_factory=list)
+    kwargs_keys: list = field(default_factory=list)  # last len(kwargs_keys) args are kwargs
+    num_returns: int = 1
+    resources: dict = field(default_factory=dict)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    owner: list | None = None         # Address.to_wire()
+    # actor fields
+    actor_id: str = ""                # set for actor tasks
+    actor_creation: bool = False
+    actor_seq: int = -1               # per-caller ordering for actor tasks
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    # scheduling
+    strategy: list | None = None      # e.g. ["spread"], ["node_affinity", node_id, soft]
+    placement_group: str = ""         # pg id hex
+    pg_bundle_index: int = -1
+    runtime_env: dict | None = None
+
+    def to_wire(self):
+        return [
+            self.task_id, self.job_id, self.name, self.func_key, self.args,
+            self.kwargs_keys, self.num_returns, self.resources, self.max_retries,
+            self.retry_exceptions, self.owner, self.actor_id, self.actor_creation,
+            self.actor_seq, self.max_restarts, self.max_task_retries, self.strategy,
+            self.placement_group, self.pg_bundle_index, self.runtime_env,
+        ]
+
+    @classmethod
+    def from_wire(cls, w):
+        return cls(*w)
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    host: str
+    raylet_port: int
+    total_resources: dict
+    available_resources: dict
+    labels: dict = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    store_path: str = ""
+    is_head: bool = False
+
+    def to_wire(self):
+        return {
+            "node_id": self.node_id,
+            "host": self.host,
+            "raylet_port": self.raylet_port,
+            "total_resources": self.total_resources,
+            "available_resources": self.available_resources,
+            "labels": self.labels,
+            "alive": self.alive,
+            "store_path": self.store_path,
+            "is_head": self.is_head,
+        }

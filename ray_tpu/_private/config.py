@@ -67,7 +67,7 @@ class Config:
     task_events_max_buffer: int = 100000
 
     # --- misc ---
-    temp_dir: str = field(default_factory=lambda: os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu"))
+    temp_dir: str = "/tmp/ray_tpu"  # override via RAY_TPU_TEMP_DIR
     log_to_driver: bool = True
 
     def __post_init__(self):

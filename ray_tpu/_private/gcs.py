@@ -1,0 +1,685 @@
+"""GCS: the cluster control plane.
+
+Re-design of the reference's gcs_server (reference:
+src/ray/gcs/gcs_server/gcs_server.h:79 and the manager classes it owns:
+gcs_node_manager, gcs_actor_manager.cc, gcs_placement_group_manager,
+gcs_job_manager, gcs_kv_manager, gcs_health_check_manager.h:39,
+gcs_task_manager). One asyncio process owns all cluster metadata:
+
+- node table + heartbeat-based failure detection
+- actor directory, actor scheduling, restart-on-death (ReconstructActor
+  analog, reference: gcs_actor_manager.h:504)
+- placement groups with 2-phase prepare/commit reservation across raylets
+  (reference: gcs_placement_group_scheduler.cc)
+- namespaced KV store (function table, named actors, serve config live here)
+- long-poll-free pubsub: subscribers hold an open connection, GCS pushes
+  notify frames (reference: src/ray/pubsub/ + pubsub_handler)
+- job table and task-event buffer for the state API
+
+Persistence is pluggable-in-principle (in-memory only this round; the
+reference's Redis-backed gcs_table_storage is the model for adding it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import defaultdict, deque
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import (
+    NodeInfo,
+    add_resources,
+    normalize_resources,
+    resources_fit,
+    subtract_resources,
+)
+from ray_tpu._private.config import Config
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (reference: src/ray/protobuf/gcs.proto ActorTableData)
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+
+
+class GcsServer:
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config()
+        self.nodes: dict[str, NodeInfo] = {}
+        self.node_conns: dict[str, rpc.Connection] = {}
+        self.kv: dict[str, dict[bytes, bytes]] = defaultdict(dict)
+        self.actors: dict[str, dict] = {}
+        self.named_actors: dict[tuple[str, str], str] = {}
+        self.jobs: dict[str, dict] = {}
+        self.placement_groups: dict[str, dict] = {}
+        self.task_events: deque = deque(maxlen=self.config.task_events_max_buffer)
+        self.subscribers: dict[str, set[rpc.Connection]] = defaultdict(set)
+        self._server = rpc.RpcServer(self._handlers(), name="gcs")
+        self._health_task: asyncio.Task | None = None
+        self._actor_seq = 0
+        self.start_time = time.time()
+
+    def _handlers(self):
+        return {
+            "RegisterNode": self.handle_register_node,
+            "Heartbeat": self.handle_heartbeat,
+            "GetAllNodes": self.handle_get_all_nodes,
+            "DrainNode": self.handle_drain_node,
+            "NotifyNodeDead": self.handle_notify_node_dead,
+            "KVPut": self.handle_kv_put,
+            "KVGet": self.handle_kv_get,
+            "KVDel": self.handle_kv_del,
+            "KVKeys": self.handle_kv_keys,
+            "KVExists": self.handle_kv_exists,
+            "RegisterActor": self.handle_register_actor,
+            "ActorReady": self.handle_actor_ready,
+            "ReportActorDeath": self.handle_report_actor_death,
+            "GetActorInfo": self.handle_get_actor_info,
+            "GetNamedActor": self.handle_get_named_actor,
+            "ListActors": self.handle_list_actors,
+            "KillActor": self.handle_kill_actor,
+            "RegisterJob": self.handle_register_job,
+            "FinishJob": self.handle_finish_job,
+            "ListJobs": self.handle_list_jobs,
+            "CreatePlacementGroup": self.handle_create_pg,
+            "RemovePlacementGroup": self.handle_remove_pg,
+            "GetPlacementGroup": self.handle_get_pg,
+            "ListPlacementGroups": self.handle_list_pgs,
+            "Subscribe": self.handle_subscribe,
+            "Publish": self.handle_publish,
+            "AddTaskEvents": self.handle_add_task_events,
+            "ListTaskEvents": self.handle_list_task_events,
+            "GetClusterStatus": self.handle_get_cluster_status,
+            "GetConfig": self.handle_get_config,
+        }
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        addr = await self._server.start(host, port)
+        self._health_task = asyncio.create_task(self._health_check_loop())
+        logger.info("GCS listening on %s:%s", *addr)
+        return addr
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self._server.stop()
+
+    # ---------- pubsub ----------
+
+    async def handle_subscribe(self, conn, payload):
+        for channel in payload["channels"]:
+            self.subscribers[channel].add(conn)
+            conn.on_close(lambda ch=channel: self.subscribers[ch].discard(conn))
+        return {"ok": True}
+
+    async def handle_publish(self, conn, payload):
+        await self.publish(payload["channel"], payload["message"])
+        return {"ok": True}
+
+    async def publish(self, channel: str, message):
+        dead = []
+        for conn in list(self.subscribers.get(channel, ())):
+            try:
+                await conn.notify("Publish", {"channel": channel, "message": message})
+            except Exception:
+                dead.append(conn)
+        for conn in dead:
+            self.subscribers[channel].discard(conn)
+
+    # ---------- nodes ----------
+
+    async def handle_register_node(self, conn, payload):
+        info = NodeInfo(
+            node_id=payload["node_id"],
+            host=payload["host"],
+            raylet_port=payload["raylet_port"],
+            total_resources=normalize_resources(payload["total_resources"]),
+            available_resources=normalize_resources(payload["total_resources"]),
+            labels=payload.get("labels") or {},
+            store_path=payload.get("store_path", ""),
+            is_head=payload.get("is_head", False),
+        )
+        self.nodes[info.node_id] = info
+        self.node_conns[info.node_id] = conn
+        conn.on_close(lambda: asyncio.ensure_future(self._on_node_conn_lost(info.node_id)))
+        await self.publish("NODE", {"event": "alive", "node": info.to_wire()})
+        logger.info("node %s registered (%s:%s)", info.node_id[:8], info.host, info.raylet_port)
+        return {"ok": True, "config": self.config.to_json()}
+
+    async def handle_heartbeat(self, conn, payload):
+        node = self.nodes.get(payload["node_id"])
+        if node is None or not node.alive:
+            return {"ok": False, "reason": "unknown or dead node"}
+        node.last_heartbeat = time.monotonic()
+        node.available_resources = payload.get("available_resources", node.available_resources)
+        # Reply piggy-backs the cluster resource view so raylets can make
+        # spillback decisions (replaces the reference's ray_syncer gossip,
+        # reference: src/ray/common/ray_syncer/ray_syncer.h).
+        return {"ok": True, "cluster": self._cluster_view()}
+
+    def _cluster_view(self):
+        return {
+            nid: {
+                "host": n.host,
+                "raylet_port": n.raylet_port,
+                "available_resources": n.available_resources,
+                "total_resources": n.total_resources,
+                "labels": n.labels,
+            }
+            for nid, n in self.nodes.items()
+            if n.alive
+        }
+
+    async def handle_get_all_nodes(self, conn, payload):
+        return {"nodes": [n.to_wire() for n in self.nodes.values()]}
+
+    async def handle_drain_node(self, conn, payload):
+        node_id = payload["node_id"]
+        nconn = self.node_conns.get(node_id)
+        if nconn is not None:
+            try:
+                await nconn.call("Drain", {}, timeout=self.config.rpc_call_timeout_s)
+            except Exception:
+                pass
+        return {"ok": True}
+
+    async def handle_notify_node_dead(self, conn, payload):
+        await self._mark_node_dead(payload["node_id"], payload.get("reason", "reported dead"))
+        return {"ok": True}
+
+    async def _on_node_conn_lost(self, node_id: str):
+        # Connection loss is a strong death signal; health check loop would
+        # also catch it via missed heartbeats.
+        await self._mark_node_dead(node_id, "raylet connection lost")
+
+    async def _mark_node_dead(self, node_id: str, reason: str):
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        node.available_resources = {}
+        self.node_conns.pop(node_id, None)
+        logger.warning("node %s dead: %s", node_id[:8], reason)
+        await self.publish("NODE", {"event": "dead", "node_id": node_id, "reason": reason})
+        # Actor fault tolerance: restart or kill actors that lived there
+        # (reference: gcs_actor_manager.cc OnNodeDead).
+        for actor_id, a in list(self.actors.items()):
+            if a.get("node_id") == node_id and a["state"] in (ACTOR_ALIVE, ACTOR_PENDING):
+                await self._on_actor_worker_death(
+                    actor_id, f"node {node_id[:8]} died: {reason}")
+        for pg_id, pg in self.placement_groups.items():
+            if pg["state"] == PG_CREATED and any(
+                    b.get("node_id") == node_id for b in pg["bundles"]):
+                asyncio.ensure_future(self._schedule_pg(pg_id))
+
+    async def _health_check_loop(self):
+        # reference: gcs_health_check_manager.h:39 — gRPC health checks with
+        # knobs from ray_config_def.h:813-819. Here: heartbeat staleness.
+        period = self.config.health_check_period_s
+        timeout = period * self.config.num_heartbeats_timeout
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and not node.is_head and now - node.last_heartbeat > timeout:
+                    await self._mark_node_dead(node.node_id, "heartbeat timeout")
+
+    # ---------- KV ----------
+
+    async def handle_kv_put(self, conn, payload):
+        ns = payload.get("ns", "")
+        table = self.kv[ns]
+        key = payload["key"]
+        if not payload.get("overwrite", True) and key in table:
+            return {"added": False}
+        table[key] = payload["value"]
+        return {"added": True}
+
+    async def handle_kv_get(self, conn, payload):
+        return {"value": self.kv[payload.get("ns", "")].get(payload["key"])}
+
+    async def handle_kv_del(self, conn, payload):
+        existed = self.kv[payload.get("ns", "")].pop(payload["key"], None) is not None
+        return {"deleted": existed}
+
+    async def handle_kv_keys(self, conn, payload):
+        prefix = payload.get("prefix", b"")
+        return {"keys": [k for k in self.kv[payload.get("ns", "")] if k.startswith(prefix)]}
+
+    async def handle_kv_exists(self, conn, payload):
+        return {"exists": payload["key"] in self.kv[payload.get("ns", "")]}
+
+    # ---------- actors ----------
+
+    async def handle_register_actor(self, conn, payload):
+        """Register + schedule an actor (reference: gcs_actor_manager.cc
+        RegisterActor → GcsActorScheduler)."""
+        actor_id = payload["actor_id"]
+        spec = payload["spec"]
+        name = payload.get("name") or ""
+        namespace = payload.get("namespace") or "default"
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing["state"] != ACTOR_DEAD:
+                    if payload.get("get_if_exists"):
+                        return {"ok": True, "existing": True, "actor_id": self.named_actors[key]}
+                    return {"ok": False,
+                            "reason": f"actor name {name!r} already taken in {namespace!r}"}
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "job_id": payload.get("job_id", ""),
+            "name": name,
+            "namespace": namespace,
+            "class_name": payload.get("class_name", ""),
+            "state": ACTOR_PENDING,
+            "spec": spec,
+            "resources": normalize_resources(payload.get("resources")),
+            "max_restarts": payload.get("max_restarts", 0),
+            "restarts": 0,
+            "node_id": None,
+            "address": None,
+            "detached": payload.get("detached", False),
+            "owner": payload.get("owner"),
+            "death_cause": None,
+            "strategy": payload.get("strategy"),
+            "placement_group": payload.get("placement_group", ""),
+            "pg_bundle_index": payload.get("pg_bundle_index", -1),
+        }
+        asyncio.ensure_future(self._schedule_actor(actor_id))
+        return {"ok": True}
+
+    def _pick_node_for(self, resources: dict, strategy=None,
+                       pg_id: str = "", bundle_index: int = -1) -> str | None:
+        """Node selection for actors/PGs at the GCS (raylets do their own
+        hybrid policy for tasks). Mirrors the reference's GcsActorScheduler
+        falling back onto raylet scheduling."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if strategy and strategy[0] == "node_affinity":
+            target, soft = strategy[1], strategy[2]
+            node = self.nodes.get(target)
+            if node is not None and node.alive:
+                return target
+            if not soft:
+                return None
+        if pg_id:
+            pg = self.placement_groups.get(pg_id)
+            if not pg or pg["state"] != PG_CREATED:
+                return None
+            bundles = pg["bundles"]
+            if bundle_index >= 0:
+                return bundles[bundle_index].get("node_id")
+            for b in bundles:
+                node = self.nodes.get(b.get("node_id") or "")
+                if node and node.alive and resources_fit(b["available"], resources):
+                    return b["node_id"]
+            return None
+        candidates = [n for n in alive if resources_fit(n.available_resources, resources)]
+        if not candidates:
+            # Fall back to nodes that could EVER fit (total resources) —
+            # the raylet will queue the lease until resources free up.
+            candidates = [n for n in alive if resources_fit(n.total_resources, resources)]
+        if not candidates:
+            return None
+        if strategy and strategy[0] == "spread":
+            candidates.sort(key=lambda n: sum(
+                n.total_resources.get(k, 0) - n.available_resources.get(k, 0)
+                for k in ("CPU", "TPU", "GPU")))
+            return candidates[0].node_id
+        # Default: pack onto the most-utilized node that fits (hybrid-ish).
+        candidates.sort(key=lambda n: -sum(
+            n.total_resources.get(k, 0) - n.available_resources.get(k, 0)
+            for k in ("CPU", "TPU", "GPU")))
+        return candidates[0].node_id
+
+    async def _schedule_actor(self, actor_id: str, delay: float = 0.0):
+        if delay:
+            await asyncio.sleep(delay)
+        a = self.actors.get(actor_id)
+        if a is None or a["state"] == ACTOR_DEAD:
+            return
+        node_id = self._pick_node_for(
+            a["resources"], a.get("strategy"), a.get("placement_group", ""),
+            a.get("pg_bundle_index", -1))
+        if node_id is None or node_id not in self.node_conns:
+            # No feasible node right now; retry (autoscaler demand signal).
+            asyncio.ensure_future(self._schedule_actor(actor_id, delay=0.5))
+            return
+        a["node_id"] = node_id
+        try:
+            resp = await self.node_conns[node_id].call(
+                "CreateActor",
+                {"actor_id": actor_id, "spec": a["spec"], "resources": a["resources"],
+                 "placement_group": a.get("placement_group", ""),
+                 "pg_bundle_index": a.get("pg_bundle_index", -1)},
+                timeout=self.config.rpc_call_timeout_s)
+            if not resp.get("ok"):
+                await self._on_actor_worker_death(actor_id, resp.get("reason", "creation failed"))
+        except Exception as e:
+            await self._on_actor_worker_death(actor_id, f"creation rpc failed: {e}")
+
+    async def handle_actor_ready(self, conn, payload):
+        a = self.actors.get(payload["actor_id"])
+        if a is None:
+            return {"ok": False}
+        a["state"] = ACTOR_ALIVE
+        a["address"] = payload["address"]
+        await self.publish("ACTOR", {"actor_id": a["actor_id"], "state": ACTOR_ALIVE,
+                                     "address": a["address"]})
+        return {"ok": True}
+
+    async def handle_report_actor_death(self, conn, payload):
+        await self._on_actor_worker_death(payload["actor_id"],
+                                          payload.get("reason", "worker died"),
+                                          intended=payload.get("intended", False))
+        return {"ok": True}
+
+    async def _on_actor_worker_death(self, actor_id: str, reason: str, intended: bool = False):
+        """reference: gcs_actor_manager.h:504 ReconstructActor — restart with
+        backoff while restarts remain, else mark DEAD and notify callers."""
+        a = self.actors.get(actor_id)
+        if a is None or a["state"] == ACTOR_DEAD:
+            return
+        can_restart = (not intended) and (
+            a["max_restarts"] == -1 or a["restarts"] < a["max_restarts"])
+        if can_restart:
+            a["restarts"] += 1
+            a["state"] = ACTOR_RESTARTING
+            a["address"] = None
+            await self.publish("ACTOR", {"actor_id": actor_id, "state": ACTOR_RESTARTING,
+                                         "reason": reason})
+            asyncio.ensure_future(self._schedule_actor(actor_id))
+        else:
+            a["state"] = ACTOR_DEAD
+            a["address"] = None
+            a["death_cause"] = reason
+            self.named_actors.pop((a["namespace"], a["name"]), None)
+            await self.publish("ACTOR", {"actor_id": actor_id, "state": ACTOR_DEAD,
+                                         "reason": reason})
+
+    async def handle_get_actor_info(self, conn, payload):
+        a = self.actors.get(payload["actor_id"])
+        if a is None:
+            return {"found": False}
+        return {"found": True, "state": a["state"], "address": a["address"],
+                "death_cause": a["death_cause"], "restarts": a["restarts"],
+                "class_name": a["class_name"], "name": a["name"]}
+
+    async def handle_get_named_actor(self, conn, payload):
+        key = (payload.get("namespace") or "default", payload["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None or actor_id not in self.actors:
+            return {"found": False}
+        a = self.actors[actor_id]
+        return {"found": True, "actor_id": actor_id, "state": a["state"],
+                "address": a["address"], "spec_meta": a["spec"].get("meta")
+                if isinstance(a["spec"], dict) else None}
+
+    async def handle_list_actors(self, conn, payload):
+        return {"actors": [
+            {k: a[k] for k in ("actor_id", "job_id", "name", "namespace", "class_name",
+                               "state", "node_id", "restarts", "resources")}
+            for a in self.actors.values()]}
+
+    async def handle_kill_actor(self, conn, payload):
+        actor_id = payload["actor_id"]
+        a = self.actors.get(actor_id)
+        if a is None:
+            return {"ok": False}
+        no_restart = payload.get("no_restart", True)
+        if no_restart:
+            a["max_restarts"] = a["restarts"]  # exhaust restarts
+        addr = a.get("address")
+        node_id = a.get("node_id")
+        if node_id in self.node_conns:
+            try:
+                await self.node_conns[node_id].call(
+                    "KillActorWorker", {"actor_id": actor_id, "address": addr})
+            except Exception:
+                pass
+        if a["state"] != ACTOR_DEAD and no_restart:
+            await self._on_actor_worker_death(actor_id, "killed via kill()", intended=True)
+        return {"ok": True}
+
+    # ---------- jobs ----------
+
+    async def handle_register_job(self, conn, payload):
+        self.jobs[payload["job_id"]] = {
+            "job_id": payload["job_id"],
+            "driver_address": payload.get("driver_address"),
+            "start_time": time.time(),
+            "end_time": None,
+            "status": "RUNNING",
+            "entrypoint": payload.get("entrypoint", ""),
+        }
+        return {"ok": True}
+
+    async def handle_finish_job(self, conn, payload):
+        job = self.jobs.get(payload["job_id"])
+        if job:
+            job["status"] = payload.get("status", "SUCCEEDED")
+            job["end_time"] = time.time()
+        return {"ok": True}
+
+    async def handle_list_jobs(self, conn, payload):
+        return {"jobs": list(self.jobs.values())}
+
+    # ---------- placement groups ----------
+
+    async def handle_create_pg(self, conn, payload):
+        pg_id = payload["pg_id"]
+        bundles = [{"resources": normalize_resources(b), "node_id": None, "available": {}}
+                   for b in payload["bundles"]]
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id,
+            "name": payload.get("name", ""),
+            "strategy": payload.get("strategy", "PACK"),
+            "bundles": bundles,
+            "state": PG_PENDING,
+            "job_id": payload.get("job_id", ""),
+        }
+        asyncio.ensure_future(self._schedule_pg(pg_id))
+        return {"ok": True}
+
+    async def _schedule_pg(self, pg_id: str, delay: float = 0.0):
+        """2-phase bundle reservation (reference:
+        gcs_placement_group_scheduler.cc Prepare/Commit) with PACK / SPREAD /
+        STRICT_PACK / STRICT_SPREAD and the TPU-first STRICT_ICI strategy:
+        all bundles must land on nodes of one ICI-connected slice (same
+        `tpu-slice` label), the gang-lease unit for multi-host TPU pods."""
+        if delay:
+            await asyncio.sleep(delay)
+        pg = self.placement_groups.get(pg_id)
+        if pg is None or pg["state"] != PG_PENDING:
+            return
+        placement = self._pack_bundles(pg)
+        if placement is None:
+            asyncio.ensure_future(self._schedule_pg(pg_id, delay=0.5))
+            return
+        # Prepare on all nodes.
+        prepared = []
+        ok = True
+        for idx, node_id in placement:
+            nconn = self.node_conns.get(node_id)
+            if nconn is None:
+                ok = False
+                break
+            try:
+                resp = await nconn.call("PreparePGBundle", {
+                    "pg_id": pg_id, "bundle_index": idx,
+                    "resources": pg["bundles"][idx]["resources"]})
+                if not resp.get("ok"):
+                    ok = False
+                    break
+                prepared.append((idx, node_id))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for idx, node_id in prepared:
+                nconn = self.node_conns.get(node_id)
+                if nconn:
+                    try:
+                        await nconn.call("ReturnPGBundle", {"pg_id": pg_id, "bundle_index": idx})
+                    except Exception:
+                        pass
+            asyncio.ensure_future(self._schedule_pg(pg_id, delay=0.5))
+            return
+        for idx, node_id in placement:
+            try:
+                await self.node_conns[node_id].call(
+                    "CommitPGBundle", {"pg_id": pg_id, "bundle_index": idx})
+            except Exception:
+                pass
+            pg["bundles"][idx]["node_id"] = node_id
+            pg["bundles"][idx]["available"] = dict(pg["bundles"][idx]["resources"])
+        pg["state"] = PG_CREATED
+        await self.publish("PG", {"pg_id": pg_id, "state": PG_CREATED,
+                                  "bundles": [(b["node_id"]) for b in pg["bundles"]]})
+
+    def _pack_bundles(self, pg) -> list[tuple[int, str]] | None:
+        """Returns [(bundle_index, node_id)] or None if infeasible now."""
+        strategy = pg["strategy"]
+        alive = [n for n in self.nodes.values() if n.alive]
+        if strategy == "STRICT_ICI":
+            # Group nodes by slice label; try each slice as a unit.
+            slices: dict[str, list[NodeInfo]] = defaultdict(list)
+            for n in alive:
+                label = n.labels.get("tpu-slice")
+                if label:
+                    slices[label].append(n)
+            for nodes in slices.values():
+                placement = self._fit_bundles(pg["bundles"], nodes, spread=False)
+                if placement is not None:
+                    return placement
+            return None
+        if strategy in ("SPREAD", "STRICT_SPREAD"):
+            placement = self._fit_bundles(pg["bundles"], alive, spread=True,
+                                          strict=strategy == "STRICT_SPREAD")
+            return placement
+        if strategy == "STRICT_PACK":
+            for n in sorted(alive, key=lambda n: -sum(n.available_resources.values())):
+                placement = self._fit_bundles(pg["bundles"], [n], spread=False)
+                if placement is not None:
+                    return placement
+            return None
+        return self._fit_bundles(pg["bundles"], alive, spread=False)
+
+    def _fit_bundles(self, bundles, nodes, spread: bool, strict: bool = False):
+        avail = {n.node_id: dict(n.available_resources) for n in nodes}
+        order = list(nodes)
+        placement = []
+        used_nodes = set()
+        for idx, b in enumerate(bundles):
+            res = b["resources"]
+            placed = False
+            if spread:
+                order.sort(key=lambda n: len([1 for i, nid in placement if nid == n.node_id]))
+            for n in order:
+                if strict and n.node_id in used_nodes:
+                    continue
+                if resources_fit(avail[n.node_id], res):
+                    subtract_resources(avail[n.node_id], res)
+                    placement.append((idx, n.node_id))
+                    used_nodes.add(n.node_id)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return placement
+
+    async def handle_remove_pg(self, conn, payload):
+        pg = self.placement_groups.get(payload["pg_id"])
+        if pg is None:
+            return {"ok": False}
+        for idx, b in enumerate(pg["bundles"]):
+            node_id = b.get("node_id")
+            if node_id and node_id in self.node_conns:
+                try:
+                    await self.node_conns[node_id].call(
+                        "ReturnPGBundle", {"pg_id": pg["pg_id"], "bundle_index": idx})
+                except Exception:
+                    pass
+        pg["state"] = PG_REMOVED
+        return {"ok": True}
+
+    async def handle_get_pg(self, conn, payload):
+        pg = self.placement_groups.get(payload["pg_id"])
+        if pg is None:
+            return {"found": False}
+        return {"found": True, "state": pg["state"],
+                "bundles": [{"node_id": b["node_id"], "resources": b["resources"]}
+                            for b in pg["bundles"]],
+                "strategy": pg["strategy"], "name": pg["name"]}
+
+    async def handle_list_pgs(self, conn, payload):
+        return {"placement_groups": [
+            {"pg_id": pg["pg_id"], "name": pg["name"], "state": pg["state"],
+             "strategy": pg["strategy"],
+             "bundles": [{"node_id": b["node_id"], "resources": b["resources"]}
+                         for b in pg["bundles"]]}
+            for pg in self.placement_groups.values()]}
+
+    # ---------- task events / status ----------
+
+    async def handle_add_task_events(self, conn, payload):
+        self.task_events.extend(payload["events"])
+        return {"ok": True}
+
+    async def handle_list_task_events(self, conn, payload):
+        limit = payload.get("limit", 1000)
+        events = list(self.task_events)[-limit:]
+        return {"events": events}
+
+    async def handle_get_cluster_status(self, conn, payload):
+        return {
+            "nodes": [n.to_wire() for n in self.nodes.values()],
+            "actors": len([a for a in self.actors.values() if a["state"] == ACTOR_ALIVE]),
+            "placement_groups": len([p for p in self.placement_groups.values()
+                                     if p["state"] == PG_CREATED]),
+            "uptime_s": time.time() - self.start_time,
+        }
+
+    async def handle_get_config(self, conn, payload):
+        return {"config": self.config.to_json()}
+
+
+def main():
+    """Entrypoint: `python -m ray_tpu._private.gcs --port=... `"""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--config", default="")
+    parser.add_argument("--ready-fd", type=int, default=-1)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[gcs] %(asctime)s %(levelname)s %(message)s")
+
+    async def run():
+        config = Config.from_json(args.config) if args.config else Config()
+        server = GcsServer(config)
+        host, port = await server.start(args.host, args.port)
+        if args.ready_fd >= 0:
+            import os
+            os.write(args.ready_fd, f"{host}:{port}\n".encode())
+            os.close(args.ready_fd)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

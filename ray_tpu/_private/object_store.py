@@ -178,7 +178,10 @@ class ObjectStoreClient:
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.store_contains(self._handle, object_id.binary()))
 
-    def delete(self, object_id: ObjectID, force: bool = True) -> bool:
+    def delete(self, object_id: ObjectID, force: bool = False) -> bool:
+        """force=True frees even while readers hold references — only the
+        owner-driven distributed-refcount GC path may use it (a forced free
+        under a live zero-copy view recycles memory mid-read)."""
         return self._lib.store_delete(self._handle, object_id.binary(), 1 if force else 0) == OK
 
     def abort(self, object_id: ObjectID) -> None:
@@ -203,7 +206,12 @@ class ObjectStoreClient:
 
     def close(self) -> None:
         if self._handle:
-            self._view.release()
-            self._mm.close()
+            try:
+                self._view.release()
+                self._mm.close()
+            except BufferError:
+                # Zero-copy views handed to callers are still alive; leave
+                # the mapping open (the OS reclaims it at process exit).
+                pass
             self._lib.store_detach(self._handle)
             self._handle = None

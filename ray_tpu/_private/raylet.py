@@ -1,0 +1,750 @@
+"""Raylet: per-node daemon — worker pool, leases, local scheduling, object pulls.
+
+Re-design of the reference's raylet (reference: src/ray/raylet/raylet.h:37,
+node_manager.cc — lease handler at :1778 HandleRequestWorkerLease, PG
+prepare/commit at :1832/:1848, drain at :1940; worker_pool.cc — runtime-env
+keyed worker cache + prestart; local_task_manager.cc; and the object-manager
+pull/push path, src/ray/object_manager/pull_manager.h:52 / push_manager.h:30).
+
+One asyncio process per node:
+- owns the node's shm object-store arena (creates it at startup)
+- spawns/recycles worker processes; grants worker *leases* to task owners,
+  who then push tasks directly to the leased worker (the reference's
+  direct task transport — the raylet never sits in the data path)
+- two-level scheduling: grants locally when resources fit, otherwise answers
+  with a spillback hint from the GCS-fed cluster view (reference:
+  raylet/scheduling/policy/hybrid_scheduling_policy.h top-k policy)
+- placement-group bundle reservation (prepare/commit) with dedicated pools
+- serves object chunks to peer raylets and pulls remote objects into the
+  local store on behalf of its workers (5 MiB chunks, reference:
+  ray_config_def.h:355)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from collections import defaultdict, deque
+
+from ray_tpu._private import rpc
+from ray_tpu._private.common import (
+    add_resources,
+    normalize_resources,
+    resources_fit,
+    subtract_resources,
+)
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullError
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    def __init__(self, proc: subprocess.Popen, worker_id: str):
+        self.proc = proc
+        self.worker_id = worker_id
+        self.conn: rpc.Connection | None = None   # worker -> raylet channel
+        self.address: tuple[str, int] | None = None  # worker's own rpc server
+        self.registered = asyncio.Event()
+        self.leased = False
+        self.lease_id: str | None = None
+        self.lease_resources: dict = {}
+        self.lease_pg: tuple[str, int] | None = None
+        self.actor_id: str | None = None
+        self.idle_since = time.monotonic()
+        self.dead = False
+
+
+class Raylet:
+    def __init__(self, gcs_host: str, gcs_port: int, *,
+                 resources: dict | None = None, labels: dict | None = None,
+                 session_dir: str, node_id: str | None = None,
+                 is_head: bool = False, config: Config | None = None):
+        self.config = config or Config()
+        self.gcs_host = gcs_host
+        self.gcs_port = gcs_port
+        self.node_id = node_id or NodeID.from_random().hex()
+        self.is_head = is_head
+        self.session_dir = session_dir
+        self.labels = labels or {}
+        if resources is None:
+            resources = {"CPU": float(os.cpu_count() or 1)}
+        self.total_resources = normalize_resources(resources)
+        self.available = dict(self.total_resources)
+        self.store_path = os.path.join(session_dir, f"store-{self.node_id[:12]}")
+        self.store: ObjectStoreClient | None = None
+        self.workers: dict[str, WorkerHandle] = {}
+        self.idle_workers: deque[WorkerHandle] = deque()
+        self.pending_leases: deque = deque()
+        self.pg_bundles: dict[tuple[str, int], dict] = {}  # (pg_id, idx) -> pools
+        self.cluster_view: dict = {}
+        self.gcs_conn: rpc.Connection | None = None
+        self.server = rpc.RpcServer(self._handlers(), name=f"raylet-{self.node_id[:8]}")
+        self.host = "127.0.0.1"
+        self.port: int | None = None
+        self.draining = False
+        self._peer_conns: dict[tuple[str, int], rpc.Connection] = {}
+        self._pull_locks: dict[str, asyncio.Lock] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._lease_seq = 0
+        self._num_leases_granted = 0
+
+    def _handlers(self):
+        return {
+            # worker-facing
+            "RegisterWorker": self.handle_register_worker,
+            "RequestWorkerLease": self.handle_request_worker_lease,
+            "ReturnWorker": self.handle_return_worker,
+            "PullObject": self.handle_pull_object,
+            "FreeObjects": self.handle_free_objects,
+            "GetNodeInfo": self.handle_get_node_info,
+            "ReportWorkerDeath": self.handle_report_worker_death,
+            # peer-raylet-facing
+            "FetchChunk": self.handle_fetch_chunk,
+            "ObjectInfo": self.handle_object_info,
+            # gcs-facing
+            "CreateActor": self.handle_create_actor,
+            "KillActorWorker": self.handle_kill_actor_worker,
+            "PreparePGBundle": self.handle_prepare_pg_bundle,
+            "CommitPGBundle": self.handle_commit_pg_bundle,
+            "ReturnPGBundle": self.handle_return_pg_bundle,
+            "Drain": self.handle_drain,
+            "GetState": self.handle_get_state,
+        }
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = await self.server.start(host, port)
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.store = ObjectStoreClient(
+            self.store_path, create=True,
+            size=int(self.total_resources.get(
+                "object_store_memory", self.config.object_store_memory)),
+            table_capacity=self.config.object_store_table_capacity)
+        # The GCS issues calls (CreateActor, PG prepare/commit, Drain) back
+        # over this same connection, so it gets the full handler table.
+        self.gcs_conn = await rpc.connect_retry(
+            self.gcs_host, self.gcs_port,
+            handlers={**self._handlers(), "Publish": self._on_publish},
+            name=f"raylet-{self.node_id[:8]}->gcs",
+            timeout=self.config.rpc_connect_timeout_s)
+        resp = await self.gcs_conn.call("RegisterNode", {
+            "node_id": self.node_id,
+            "host": self.host,
+            "raylet_port": self.port,
+            "total_resources": self.total_resources,
+            "labels": self.labels,
+            "store_path": self.store_path,
+            "is_head": self.is_head,
+        })
+        if resp.get("config"):
+            self.config = Config.from_json(resp["config"])
+        await self.gcs_conn.call("Subscribe", {"channels": ["NODE"]})
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        self._tasks.append(asyncio.create_task(self._reap_loop()))
+        logger.info("raylet %s on %s:%s resources=%s", self.node_id[:8], self.host,
+                    self.port, self.total_resources)
+        return self.host, self.port
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        for w in list(self.workers.values()):
+            self._kill_worker(w)
+        await self.server.stop()
+        if self.gcs_conn:
+            await self.gcs_conn.close()
+        if self.store:
+            self.store.close()
+
+    # ---------- gcs sync ----------
+
+    async def _heartbeat_loop(self):
+        period = min(0.2, self.config.health_check_period_s)
+        while True:
+            try:
+                resp = await self.gcs_conn.call("Heartbeat", {
+                    "node_id": self.node_id,
+                    "available_resources": self.available,
+                }, timeout=self.config.health_check_timeout_s)
+                if resp.get("ok"):
+                    self.cluster_view = resp.get("cluster", {})
+            except rpc.ConnectionLost:
+                logger.error("lost GCS connection; raylet %s exiting", self.node_id[:8])
+                os._exit(1)
+            except Exception:
+                pass
+            await asyncio.sleep(period)
+
+    async def _on_publish(self, conn, payload):
+        if payload.get("channel") == "NODE" and payload["message"].get("event") == "dead":
+            # Drop cached peer connection to the dead node.
+            msg = payload["message"]
+            view = self.cluster_view.pop(msg.get("node_id", ""), None)
+            if view:
+                self._peer_conns.pop((view["host"], view["raylet_port"]), None)
+
+    async def _reap_loop(self):
+        """Detect worker process deaths (reference: raylet notices worker
+        socket disconnects; here we poll the child PIDs)."""
+        while True:
+            await asyncio.sleep(0.1)
+            now = time.monotonic()
+            for w in list(self.workers.values()):
+                if w.dead:
+                    continue
+                if w.proc.poll() is not None:
+                    await self._on_worker_death(w, f"worker process exited "
+                                                   f"with code {w.proc.returncode}")
+            # Trim idle workers beyond the soft limit / idle timeout.
+            soft = self.config.num_workers_soft_limit
+            if soft < 0:
+                soft = max(2, int(self.total_resources.get("CPU", 2)))
+            while len(self.idle_workers) > soft:
+                w = self.idle_workers.popleft()
+                self._kill_worker(w)
+            for w in list(self.idle_workers):
+                if now - w.idle_since > 60.0 and len(self.idle_workers) > 1:
+                    self.idle_workers.remove(w)
+                    self._kill_worker(w)
+
+    async def _on_worker_death(self, w: WorkerHandle, reason: str):
+        w.dead = True
+        self.workers.pop(w.worker_id, None)
+        if w in self.idle_workers:
+            self.idle_workers.remove(w)
+        if w.leased:
+            self._release_lease_resources(w)
+        if w.actor_id:
+            try:
+                await self.gcs_conn.call("ReportActorDeath", {
+                    "actor_id": w.actor_id, "reason": reason})
+            except Exception:
+                pass
+        logger.warning("worker %s died: %s", w.worker_id[:8], reason)
+        self._pump_pending_leases()
+
+    # ---------- worker pool ----------
+
+    def _spawn_worker(self) -> WorkerHandle:
+        from ray_tpu._private.ids import WorkerID
+
+        worker_id = WorkerID.from_random().hex()
+        env = dict(os.environ)
+        env.update({
+            "RAY_TPU_WORKER_ID": worker_id,
+            "RAY_TPU_NODE_ID": self.node_id,
+            "RAY_TPU_RAYLET_HOST": self.host,
+            "RAY_TPU_RAYLET_PORT": str(self.port),
+            "RAY_TPU_GCS_HOST": self.gcs_host,
+            "RAY_TPU_GCS_PORT": str(self.gcs_port),
+            "RAY_TPU_STORE_PATH": self.store_path,
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+        })
+        log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:12]}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        log_file = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker"],
+            env=env, stdout=log_file, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        log_file.close()
+        w = WorkerHandle(proc, worker_id)
+        self.workers[worker_id] = w
+        return w
+
+    def _kill_worker(self, w: WorkerHandle):
+        w.dead = True
+        self.workers.pop(w.worker_id, None)
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
+
+    async def handle_register_worker(self, conn, payload):
+        w = self.workers.get(payload["worker_id"])
+        if w is None:
+            # Driver-side core workers also register so the raylet can track
+            # them, but they are not pool workers.
+            return {"ok": True, "pooled": False, "store_path": self.store_path,
+                    "node_id": self.node_id}
+        w.conn = conn
+        w.address = (payload["host"], payload["port"])
+        conn.on_close(lambda: asyncio.ensure_future(
+            self._on_worker_death(w, "worker connection lost")) if not w.dead else None)
+        w.registered.set()
+        if not w.leased and w.actor_id is None:
+            w.idle_since = time.monotonic()
+            self.idle_workers.append(w)
+        self._pump_pending_leases()
+        return {"ok": True, "pooled": True, "store_path": self.store_path,
+                "node_id": self.node_id}
+
+    async def _get_ready_worker(self) -> WorkerHandle | None:
+        while self.idle_workers:
+            w = self.idle_workers.popleft()
+            if not w.dead and w.proc.poll() is None:
+                return w
+        w = self._spawn_worker()
+        try:
+            await asyncio.wait_for(w.registered.wait(),
+                                   self.config.worker_startup_timeout_s)
+        except asyncio.TimeoutError:
+            self._kill_worker(w)
+            return None
+        if w in self.idle_workers:
+            self.idle_workers.remove(w)
+        return w
+
+    # ---------- leases / scheduling ----------
+
+    def _bundle_pool(self, pg_id: str, index: int):
+        if index >= 0:
+            return self.pg_bundles.get((pg_id, index))
+        # index -1: any bundle of this pg on this node
+        for (pid, _idx), pool in self.pg_bundles.items():
+            if pid == pg_id:
+                return pool
+        return None
+
+    def _try_acquire(self, resources: dict, pg_id: str, bundle_index: int) -> bool:
+        if pg_id:
+            pool = self._bundle_pool(pg_id, bundle_index)
+            if pool is None or not pool["committed"]:
+                return False
+            if not resources_fit(pool["available"], resources):
+                return False
+            subtract_resources(pool["available"], resources)
+            return True
+        if not resources_fit(self.available, resources):
+            return False
+        subtract_resources(self.available, resources)
+        return True
+
+    def _release_lease_resources(self, w: WorkerHandle):
+        if w.lease_pg is not None:
+            pool = self.pg_bundles.get(w.lease_pg)
+            if pool is not None:
+                add_resources(pool["available"], w.lease_resources)
+        else:
+            add_resources(self.available, w.lease_resources)
+        w.leased = False
+        w.lease_id = None
+        w.lease_resources = {}
+        w.lease_pg = None
+
+    def _pick_spillback(self, resources: dict) -> dict | None:
+        """Hybrid policy tail: among alive peers that fit the demand, pick
+        the best-utilized (pack) candidate (reference: top-k hybrid policy,
+        hybrid_scheduling_policy.h:107-124 — we take k=1 of the sorted list
+        since the cluster view is already fresh)."""
+        candidates = []
+        for nid, info in self.cluster_view.items():
+            if nid == self.node_id:
+                continue
+            if resources_fit(info.get("available_resources", {}), resources):
+                util = sum(info["total_resources"].get(k, 0)
+                           - info["available_resources"].get(k, 0)
+                           for k in ("CPU", "TPU", "GPU"))
+                candidates.append((util, nid, info))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: -c[0])
+        _, nid, info = candidates[0]
+        return {"node_id": nid, "host": info["host"], "port": info["raylet_port"]}
+
+    async def handle_request_worker_lease(self, conn, payload):
+        """Grant a worker lease, spill back, or queue (reference:
+        node_manager.cc:1778 HandleRequestWorkerLease)."""
+        resources = normalize_resources(payload.get("resources"))
+        strategy = payload.get("strategy")
+        pg_id = payload.get("placement_group", "")
+        bundle_index = payload.get("pg_bundle_index", -1)
+        if self.draining:
+            spill = self._pick_spillback(resources)
+            if spill:
+                return {"spillback": spill}
+            return {"error": "node draining"}
+
+        allow_spill = not (strategy and strategy[0] == "node_affinity") and not pg_id
+        hops = payload.get("hops", 0)
+        is_spread = bool(strategy and strategy[0] == "spread") and hops == 0
+        locally_feasible = pg_id or resources_fit(self.total_resources, resources)
+        if (not allow_spill or not is_spread) \
+                and self._try_acquire(resources, pg_id, bundle_index):
+            return await self._grant_lease(resources, pg_id, bundle_index)
+        if allow_spill:
+            # Prefer a peer with capacity available right now; for SPREAD,
+            # prefer spilling even when we could run locally (one hop max,
+            # so spilled requests settle instead of ping-ponging).
+            spill = self._pick_spillback(resources)
+            if spill is not None and (
+                    is_spread or not resources_fit(self.available, resources)):
+                return {"spillback": spill}
+            if is_spread:
+                # No better peer: run locally if possible.
+                if self._try_acquire(resources, pg_id, bundle_index):
+                    return await self._grant_lease(resources, pg_id, bundle_index)
+            if not locally_feasible:
+                # This node can never run it; hand off to any peer whose
+                # TOTAL capacity fits (it will queue there), else error.
+                for nid, info in self.cluster_view.items():
+                    if nid != self.node_id and resources_fit(
+                            info.get("total_resources", {}), resources):
+                        return {"spillback": {"node_id": nid, "host": info["host"],
+                                              "port": info["raylet_port"]}}
+                return {"error": f"infeasible resource demand {resources} "
+                                 f"(no node in cluster fits)", "infeasible": True}
+        elif not locally_feasible:
+            return {"error": f"infeasible resource demand {resources} "
+                             f"(node total {self.total_resources})",
+                    "infeasible": True}
+        # Queue until resources free up.
+        fut = asyncio.get_running_loop().create_future()
+        self.pending_leases.append((resources, pg_id, bundle_index, fut))
+        try:
+            return await asyncio.wait_for(fut, self.config.worker_lease_timeout_s)
+        except asyncio.TimeoutError:
+            try:
+                self.pending_leases.remove((resources, pg_id, bundle_index, fut))
+            except ValueError:
+                pass
+            spill = self._pick_spillback(resources)
+            if spill:
+                return {"spillback": spill}
+            return {"error": "lease timeout: insufficient resources", "retry": True}
+
+    async def _grant_lease(self, resources, pg_id, bundle_index):
+        w = await self._get_ready_worker()
+        if w is None:
+            # Couldn't start a worker: give resources back, report error.
+            if pg_id:
+                pool = self._bundle_pool(pg_id, bundle_index)
+                if pool:
+                    add_resources(pool["available"], resources)
+            else:
+                add_resources(self.available, resources)
+            return {"error": "worker startup failed"}
+        self._lease_seq += 1
+        self._num_leases_granted += 1
+        lease_id = f"{self.node_id[:8]}-{self._lease_seq}"
+        w.leased = True
+        w.lease_id = lease_id
+        w.lease_resources = resources
+        w.lease_pg = (pg_id, bundle_index) if pg_id else None
+        if w.lease_pg is not None and w.lease_pg not in self.pg_bundles:
+            # -1 wildcard matched some bundle; find which pool we debited
+            for key, pool in self.pg_bundles.items():
+                if key[0] == pg_id:
+                    w.lease_pg = key
+                    break
+        return {"granted": True, "lease_id": lease_id,
+                "worker_id": w.worker_id,
+                "worker_host": w.address[0], "worker_port": w.address[1],
+                "node_id": self.node_id}
+
+    async def handle_return_worker(self, conn, payload):
+        lease_id = payload["lease_id"]
+        for w in self.workers.values():
+            if w.lease_id == lease_id:
+                self._release_lease_resources(w)
+                if payload.get("kill"):
+                    self._kill_worker(w)
+                else:
+                    w.idle_since = time.monotonic()
+                    self.idle_workers.append(w)
+                break
+        self._pump_pending_leases()
+        return {"ok": True}
+
+    def _pump_pending_leases(self):
+        granted = []
+        for item in list(self.pending_leases):
+            resources, pg_id, bundle_index, fut = item
+            if fut.done():
+                self.pending_leases.remove(item)
+                continue
+            if self._try_acquire(resources, pg_id, bundle_index):
+                self.pending_leases.remove(item)
+                granted.append(item)
+        for resources, pg_id, bundle_index, fut in granted:
+            async def grant(resources=resources, pg_id=pg_id,
+                            bundle_index=bundle_index, fut=fut):
+                result = await self._grant_lease(resources, pg_id, bundle_index)
+                if not fut.done():
+                    fut.set_result(result)
+            asyncio.ensure_future(grant())
+
+    # ---------- actors ----------
+
+    async def handle_create_actor(self, conn, payload):
+        resources = normalize_resources(payload.get("resources"))
+        pg_id = payload.get("placement_group", "")
+        bundle_index = payload.get("pg_bundle_index", -1)
+        if not self._try_acquire(resources, pg_id, bundle_index):
+            if pg_id or resources_fit(self.total_resources, resources):
+                # Feasible later: wait for resources like a queued lease.
+                fut = asyncio.get_running_loop().create_future()
+                self.pending_leases.append((resources, pg_id, bundle_index, fut))
+                try:
+                    grant = await asyncio.wait_for(
+                        fut, self.config.worker_lease_timeout_s)
+                except asyncio.TimeoutError:
+                    return {"ok": False, "reason": "timeout acquiring actor resources"}
+                if not grant.get("granted"):
+                    return {"ok": False, "reason": grant.get("error", "no worker")}
+                w = self.workers.get(grant["worker_id"])
+                return await self._assign_actor(w, payload, resources)
+            return {"ok": False, "reason": f"infeasible actor resources {resources}"}
+        w = await self._get_ready_worker()
+        if w is None:
+            add_resources(self.available, resources)
+            return {"ok": False, "reason": "worker startup failed"}
+        w.leased = True
+        w.lease_resources = resources
+        w.lease_pg = (pg_id, bundle_index) if pg_id else None
+        return await self._assign_actor(w, payload, resources)
+
+    async def _assign_actor(self, w: WorkerHandle | None, payload, resources):
+        if w is None:
+            return {"ok": False, "reason": "no worker"}
+        w.actor_id = payload["actor_id"]
+        w.lease_id = None
+        try:
+            resp = await w.conn.call("AssignActor", {"spec": payload["spec"]},
+                                     timeout=self.config.rpc_call_timeout_s)
+            if not resp.get("ok"):
+                return {"ok": False, "reason": resp.get("reason", "assign failed")}
+        except Exception as e:
+            return {"ok": False, "reason": f"assign rpc failed: {e}"}
+        return {"ok": True}
+
+    async def handle_kill_actor_worker(self, conn, payload):
+        actor_id = payload["actor_id"]
+        for w in list(self.workers.values()):
+            if w.actor_id == actor_id:
+                self._release_lease_resources(w)
+                self._kill_worker(w)
+                self._pump_pending_leases()
+                return {"ok": True}
+        return {"ok": False}
+
+    # ---------- placement group bundles ----------
+
+    async def handle_prepare_pg_bundle(self, conn, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        resources = normalize_resources(payload["resources"])
+        if key in self.pg_bundles:
+            return {"ok": True}
+        if not resources_fit(self.available, resources):
+            return {"ok": False, "reason": "insufficient resources"}
+        subtract_resources(self.available, resources)
+        self.pg_bundles[key] = {"resources": resources,
+                                "available": dict(resources), "committed": False}
+        return {"ok": True}
+
+    async def handle_commit_pg_bundle(self, conn, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        pool = self.pg_bundles.get(key)
+        if pool is None:
+            return {"ok": False}
+        pool["committed"] = True
+        self._pump_pending_leases()
+        return {"ok": True}
+
+    async def handle_return_pg_bundle(self, conn, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        pool = self.pg_bundles.pop(key, None)
+        if pool is not None:
+            # Kill workers still leased against this bundle.
+            for w in list(self.workers.values()):
+                if w.lease_pg == key:
+                    self._kill_worker(w)
+            add_resources(self.available, pool["resources"])
+            self._pump_pending_leases()
+        return {"ok": True}
+
+    # ---------- objects ----------
+
+    async def handle_object_info(self, conn, payload):
+        oid = ObjectID.from_hex(payload["object_id"])
+        got = self.store.get_buffer(oid)
+        if got is None:
+            return {"found": False}
+        meta, data = got
+        size = len(data)
+        self.store.release(oid)
+        return {"found": True, "meta_size": len(meta), "data_size": size}
+
+    async def handle_fetch_chunk(self, conn, payload):
+        """Serve a chunk of a local object to a peer raylet (reference:
+        push_manager.h:30 streams chunks over the ObjectManager service)."""
+        oid = ObjectID.from_hex(payload["object_id"])
+        got = self.store.get_buffer(oid)
+        if got is None:
+            return {"found": False}
+        meta, data = got
+        try:
+            off = payload["offset"]
+            n = payload["size"]
+            # Chunk space covers meta + data concatenated.
+            whole = bytes(meta) + bytes(data[max(0, off - len(meta)):
+                                             max(0, off - len(meta)) + n]) \
+                if off >= len(meta) else None
+            if off < len(meta):
+                combined = bytes(meta) + bytes(data)
+                chunk = combined[off: off + n]
+            else:
+                chunk = bytes(data[off - len(meta): off - len(meta) + n])
+            return {"found": True, "meta_size": len(meta),
+                    "total_size": len(meta) + len(data), "chunk": chunk}
+        finally:
+            self.store.release(oid)
+
+    async def _peer_conn(self, host: str, port: int) -> rpc.Connection:
+        key = (host, port)
+        conn = self._peer_conns.get(key)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(host, port, name=f"raylet-peer-{port}")
+            self._peer_conns[key] = conn
+        return conn
+
+    async def handle_pull_object(self, conn, payload):
+        """Pull an object from a remote node into the local store
+        (reference: pull_manager.h:52)."""
+        oid_hex = payload["object_id"]
+        oid = ObjectID.from_hex(oid_hex)
+        if self.store.contains(oid):
+            return {"ok": True}
+        lock = self._pull_locks.setdefault(oid_hex, asyncio.Lock())
+        async with lock:
+            if self.store.contains(oid):
+                return {"ok": True}
+            locations = payload.get("locations") or []
+            last_err = "no locations"
+            for nid in locations:
+                info = self.cluster_view.get(nid)
+                if info is None:
+                    continue
+                try:
+                    peer = await self._peer_conn(info["host"], info["raylet_port"])
+                    ok = await self._pull_from(peer, oid)
+                    if ok:
+                        self._pull_locks.pop(oid_hex, None)
+                        return {"ok": True}
+                    last_err = f"object not on node {nid[:8]}"
+                except Exception as e:
+                    last_err = str(e)
+            self._pull_locks.pop(oid_hex, None)
+            return {"ok": False, "reason": last_err}
+
+    async def _pull_from(self, peer: rpc.Connection, oid: ObjectID) -> bool:
+        chunk_size = self.config.object_transfer_chunk_size
+        first = await peer.call("FetchChunk", {
+            "object_id": oid.hex(), "offset": 0, "size": chunk_size})
+        if not first.get("found"):
+            return False
+        total = first["total_size"]
+        meta_size = first["meta_size"]
+        chunks = [first["chunk"]]
+        got = len(first["chunk"])
+        while got < total:
+            nxt = await peer.call("FetchChunk", {
+                "object_id": oid.hex(), "offset": got, "size": chunk_size})
+            if not nxt.get("found"):
+                return False
+            chunks.append(nxt["chunk"])
+            got += len(nxt["chunk"])
+        try:
+            buf = self.store.create(oid, total, meta_size)
+        except ObjectStoreFullError:
+            return False
+        off = 0
+        for c in chunks:
+            buf[off: off + len(c)] = c
+            off += len(c)
+        self.store.seal(oid)
+        return True
+
+    async def handle_free_objects(self, conn, payload):
+        for oid_hex in payload["object_ids"]:
+            self.store.delete(ObjectID.from_hex(oid_hex), force=True)
+        return {"ok": True}
+
+    async def handle_get_node_info(self, conn, payload):
+        return {"node_id": self.node_id, "store_path": self.store_path,
+                "host": self.host, "port": self.port,
+                "total_resources": self.total_resources,
+                "available_resources": self.available,
+                "num_workers": len(self.workers),
+                "labels": self.labels}
+
+    async def handle_report_worker_death(self, conn, payload):
+        w = self.workers.get(payload["worker_id"])
+        if w is not None:
+            await self._on_worker_death(w, payload.get("reason", "reported"))
+        return {"ok": True}
+
+    async def handle_drain(self, conn, payload):
+        """reference: node_manager.cc:1940 HandleDrainRaylet."""
+        self.draining = True
+        return {"ok": True}
+
+    async def handle_get_state(self, conn, payload):
+        return {
+            "node_id": self.node_id,
+            "available": self.available,
+            "total": self.total_resources,
+            "num_workers": len(self.workers),
+            "idle_workers": len(self.idle_workers),
+            "pending_leases": len(self.pending_leases),
+            "leases_granted": self._num_leases_granted,
+            "pg_bundles": [list(k) for k in self.pg_bundles],
+            "store": self.store.stats() if self.store else {},
+            "draining": self.draining,
+        }
+
+
+def main():
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--node-id", default="")
+    parser.add_argument("--head", action="store_true")
+    parser.add_argument("--ready-fd", type=int, default=-1)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="[raylet] %(asctime)s %(levelname)s %(message)s")
+
+    async def run():
+        raylet = Raylet(
+            args.gcs_host, args.gcs_port,
+            resources=json.loads(args.resources) or None,
+            labels=json.loads(args.labels),
+            session_dir=args.session_dir,
+            node_id=args.node_id or None,
+            is_head=args.head)
+        host, port = await raylet.start(args.host, args.port)
+        if args.ready_fd >= 0:
+            os.write(args.ready_fd, f"{host}:{port}:{raylet.node_id}\n".encode())
+            os.close(args.ready_fd)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,244 @@
+"""Symmetric msgpack-framed RPC over asyncio TCP.
+
+Re-design of the reference's gRPC layer (reference: src/ray/rpc/grpc_server.h,
+grpc_client.h, client_call.h). The reference generates typed stubs from 24
+proto files; here a single symmetric `Connection` carries length-prefixed
+msgpack frames and either side can issue calls — which is exactly what the
+worker↔raylet and owner↔worker channels need (the reference gets the same
+effect with paired gRPC services on both ends).
+
+Frame: 4-byte big-endian length + msgpack [msg_type, seq, method, payload].
+msg_type: 0=request, 1=response-ok, 2=response-error, 3=one-way notify.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import traceback
+from typing import Awaitable, Callable
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+MSG_REQUEST = 0
+MSG_RESPONSE = 1
+MSG_ERROR = 2
+MSG_NOTIFY = 3
+
+_MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes):
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+class Connection:
+    """One bidirectional RPC channel. Both peers may call() and serve handlers."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handlers: dict[str, Callable] | None = None, name: str = "conn"):
+        self.reader = reader
+        self.writer = writer
+        self.handlers = handlers or {}
+        self.name = name
+        self._seq = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._close_callbacks: list[Callable[[], None]] = []
+        self._recv_task: asyncio.Task | None = None
+        self._send_lock = asyncio.Lock()
+
+    def start(self) -> None:
+        self._recv_task = asyncio.create_task(self._recv_loop())
+
+    def on_close(self, cb: Callable[[], None]) -> None:
+        self._close_callbacks.append(cb)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def peername(self):
+        try:
+            return self.writer.get_extra_info("peername")
+        except Exception:
+            return None
+
+    async def _send(self, frame: list) -> None:
+        data = pack(frame)
+        async with self._send_lock:
+            self.writer.write(len(data).to_bytes(4, "big"))
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def call(self, method: str, payload=None, timeout: float | None = None):
+        if self._closed:
+            raise ConnectionLost(f"{self.name}: connection closed")
+        self._seq += 1
+        seq = self._seq
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        try:
+            await self._send([MSG_REQUEST, seq, method, payload])
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(seq, None)
+
+    async def notify(self, method: str, payload=None) -> None:
+        if self._closed:
+            raise ConnectionLost(f"{self.name}: connection closed")
+        await self._send([MSG_NOTIFY, 0, method, payload])
+
+    async def _recv_loop(self) -> None:
+        try:
+            while True:
+                header = await self.reader.readexactly(4)
+                length = int.from_bytes(header, "big")
+                if length > _MAX_FRAME:
+                    raise RpcError(f"frame too large: {length}")
+                body = await self.reader.readexactly(length)
+                msg_type, seq, method, payload = unpack(body)
+                if msg_type == MSG_REQUEST:
+                    asyncio.create_task(self._dispatch(seq, method, payload))
+                elif msg_type == MSG_NOTIFY:
+                    asyncio.create_task(self._dispatch(None, method, payload))
+                elif msg_type in (MSG_RESPONSE, MSG_ERROR):
+                    fut = self._pending.get(seq)
+                    if fut is not None and not fut.done():
+                        if msg_type == MSG_RESPONSE:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(RpcError(payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError, OSError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("%s: recv loop error", self.name)
+        finally:
+            await self._shutdown()
+
+    async def _dispatch(self, seq, method: str, payload) -> None:
+        handler = self.handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for {method!r}")
+            result = handler(self, payload)
+            if isinstance(result, Awaitable):
+                result = await result
+            if seq is not None:
+                await self._send([MSG_RESPONSE, seq, method, result])
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if seq is not None:
+                try:
+                    await self._send([MSG_ERROR, seq, method,
+                                      f"{e}\n{traceback.format_exc()}"])
+                except Exception:
+                    pass
+            else:
+                logger.exception("%s: error in notify handler %s", self.name, method)
+
+    async def _shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"{self.name}: connection lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        for cb in self._close_callbacks:
+            try:
+                cb()
+            except Exception:
+                logger.exception("close callback failed")
+
+    async def close(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self._shutdown()
+
+
+class RpcServer:
+    """Accepts connections; each gets the shared handler table."""
+
+    def __init__(self, handlers: dict[str, Callable], name: str = "server",
+                 on_connect: Callable[[Connection], None] | None = None):
+        self.handlers = handlers
+        self.name = name
+        self.on_connect = on_connect
+        self._server: asyncio.AbstractServer | None = None
+        self.connections: set[Connection] = set()
+        self.port: int | None = None
+        self.host: str | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = await asyncio.start_server(self._accept, host, port)
+        sock = self._server.sockets[0]
+        self.host, self.port = sock.getsockname()[:2]
+        return self.host, self.port
+
+    async def _accept(self, reader, writer):
+        conn = Connection(reader, writer, self.handlers, name=f"{self.name}-peer")
+        self.connections.add(conn)
+        conn.on_close(lambda: self.connections.discard(conn))
+        conn.start()
+        if self.on_connect:
+            self.on_connect(conn)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(host: str, port: int, handlers: dict[str, Callable] | None = None,
+                  name: str = "client", timeout: float = 10.0) -> Connection:
+    reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
+    conn = Connection(reader, writer, handlers or {}, name=name)
+    conn.start()
+    return conn
+
+
+async def connect_retry(host: str, port: int, handlers=None, name: str = "client",
+                        timeout: float = 10.0) -> Connection:
+    """Retry connect until `timeout` — used during daemon bring-up races."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    delay = 0.05
+    while True:
+        try:
+            return await connect(host, port, handlers, name, timeout=min(2.0, timeout))
+        except (ConnectionRefusedError, OSError, asyncio.TimeoutError):
+            if loop.time() > deadline:
+                raise
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 1.0)

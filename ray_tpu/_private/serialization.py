@@ -1,0 +1,111 @@
+"""Object serialization for the ray_tpu object store.
+
+Re-design of the reference's serialization stack
+(reference: python/ray/_private/serialization.py — cloudpickle + Arrow-aware
+zero-copy numpy). Here: pickle protocol 5 with out-of-band buffers laid out
+64-byte-aligned in the shm payload, so deserialization reconstructs numpy
+arrays as views directly into the shared mapping (no copy) — the buffer can
+then feed jax.device_put for a single host→HBM DMA.
+
+Layout of a stored object:
+  meta  = msgpack([kind, pkl_size, [(buf_offset, buf_size), ...]])
+  data  = pickle_bytes | pad | buf0 | pad | buf1 | ...
+"""
+
+from __future__ import annotations
+
+import pickle
+import traceback
+
+import msgpack
+
+try:
+    import cloudpickle
+except ImportError:  # cloudpickle ships with ray/torch images; fall back.
+    cloudpickle = None
+
+KIND_PYTHON = 0
+KIND_EXCEPTION = 1
+KIND_RAW = 2
+KIND_ACTOR_HANDLE = 3
+
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def dumps_func(fn) -> bytes:
+    """Serialize a function/class definition (needs cloudpickle for closures)."""
+    if cloudpickle is not None:
+        return cloudpickle.dumps(fn)
+    return pickle.dumps(fn)
+
+
+def loads_func(data: bytes):
+    return pickle.loads(data)
+
+
+class SerializedObject:
+    __slots__ = ("meta", "inband", "buffers", "total_size")
+
+    def __init__(self, meta: bytes, inband: bytes, buffers):
+        self.meta = meta
+        self.inband = inband
+        self.buffers = buffers
+        off = _align(len(inband))
+        for b in buffers:
+            off = _align(off + b.raw().nbytes)
+        self.total_size = off if buffers else len(inband)
+
+    def write_to(self, out: memoryview) -> None:
+        out[: len(self.inband)] = self.inband
+        off = _align(len(self.inband))
+        for b in self.buffers:
+            raw = b.raw()
+            out[off: off + raw.nbytes] = raw.cast("B") if raw.format != "B" or raw.ndim != 1 else raw
+            off = _align(off + raw.nbytes)
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_to(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value, kind: int = KIND_PYTHON) -> SerializedObject:
+    buffers: list[pickle.PickleBuffer] = []
+    try:
+        inband = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    except Exception:
+        if cloudpickle is None:
+            raise
+        buffers = []
+        inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    offsets = []
+    off = _align(len(inband))
+    for b in buffers:
+        n = b.raw().nbytes
+        offsets.append((off, n))
+        off = _align(off + n)
+    meta = msgpack.packb([kind, len(inband), offsets])
+    return SerializedObject(meta, inband, buffers)
+
+
+def serialize_exception(exc: BaseException) -> SerializedObject:
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        return serialize((exc, tb), kind=KIND_EXCEPTION)
+    except Exception:
+        # Unpicklable exception: degrade to type name + traceback text.
+        return serialize((RuntimeError(f"{type(exc).__name__}: {exc}"), tb),
+                         kind=KIND_EXCEPTION)
+
+
+def deserialize(meta: bytes, data):
+    """data: bytes or memoryview over the payload. Returns (kind, value)."""
+    kind, pkl_size, offsets = msgpack.unpackb(meta)
+    view = data if isinstance(data, memoryview) else memoryview(data)
+    bufs = [view[o: o + n] for o, n in offsets]
+    value = pickle.loads(view[:pkl_size], buffers=bufs)
+    return kind, value

@@ -1,0 +1,1142 @@
+"""CoreWorker: per-process runtime — ownership, task submission, execution.
+
+Re-design of the reference's core_worker library + Cython binding
+(reference: src/ray/core_worker/core_worker.cc — SubmitTask:1878,
+CreateActor:1948, SubmitActorTask:2182, Get:1353, Put:1141, ExecuteTask:2565;
+reference_count.cc ownership/borrowing; task_manager.cc retries + lineage;
+object_recovery_manager.h:96 lineage reconstruction; transport:
+direct_task_transport.cc lease pool + PushNormalTask:588,
+direct_actor_task_submitter.h:68 ordered per-actor queues;
+python/ray/_raylet.pyx task_execution_handler:1981).
+
+Every process that touches the cluster embeds one CoreWorker:
+- the *driver* (ray_tpu.init()) for submitting work and owning results
+- pool *workers* spawned by raylets for executing tasks / hosting actors
+
+Threading model: all network IO runs on a dedicated asyncio loop thread;
+task execution runs on the process main thread (workers) so blocking user
+code never stalls RPC. Public methods are thread-safe wrappers that post
+coroutines to the loop (the reference gets the same split with C++ io
+threads + the Python main loop in _raylet.pyx:3044 run_task_loop).
+
+Ownership model (reference: reference_count.cc): the submitting process is
+the *owner* of result objects. The owner stores small results inline in its
+in-process memory store, tracks shm locations of large results, serves
+`GetObjectStatus` long-polls to other processes, and reconstructs lost
+task-produced objects by resubmitting their creating task (lineage).
+Differences from the reference this round: borrowed-reference accounting for
+*nested* (serialized-inside-arguments) refs pins the object for the job
+lifetime instead of running the full borrower protocol.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import os
+import queue as _queue
+import threading
+import time
+import traceback
+from collections import defaultdict
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc, serialization
+from ray_tpu._private.common import Address, TaskSpec, normalize_resources
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullError
+
+logger = logging.getLogger(__name__)
+
+OBJ_PENDING = "pending"
+OBJ_READY = "ready"
+OBJ_FAILED = "failed"
+
+
+class _OwnedObject:
+    __slots__ = ("state", "inline", "locations", "lineage_task", "error",
+                 "ready_event", "local_refs", "submitted_refs", "size")
+
+    def __init__(self):
+        self.state = OBJ_PENDING
+        self.inline = None          # (meta: bytes, data: bytes) for small values
+        self.locations: set[str] = set()
+        self.lineage_task: str | None = None  # creating task id (hex)
+        self.error = None           # (meta, data) serialized exception
+        self.ready_event: asyncio.Event | None = None
+        self.local_refs = 0
+        self.submitted_refs = 0     # pending tasks that take this as an arg
+        self.size = 0
+
+
+class _PendingTask:
+    __slots__ = ("spec", "retries_left", "constructor_like", "futures", "pushed_to")
+
+    def __init__(self, spec: TaskSpec, retries_left: int):
+        self.spec = spec
+        self.retries_left = retries_left
+        self.futures: list[asyncio.Future] = []
+        self.pushed_to: str | None = None
+
+
+class _LeaseSlot:
+    __slots__ = ("conn", "lease_id", "worker_id", "node_id", "raylet", "busy",
+                 "idle_since")
+
+    def __init__(self, conn, lease_id, worker_id, node_id, raylet):
+        self.conn = conn
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.raylet = raylet
+        self.busy = False
+        self.idle_since = time.monotonic()
+
+
+def _shape_key(resources: dict) -> str:
+    return repr(sorted(resources.items()))
+
+
+class CoreWorker:
+    def __init__(self, *, gcs_host: str, gcs_port: int, raylet_host: str,
+                 raylet_port: int, store_path: str, node_id: str,
+                 is_driver: bool, job_id: str | None = None,
+                 worker_id: str | None = None, config: Config | None = None):
+        self.config = config or Config()
+        self.gcs_host, self.gcs_port = gcs_host, gcs_port
+        self.raylet_host, self.raylet_port = raylet_host, raylet_port
+        self.node_id = node_id
+        self.is_driver = is_driver
+        self.worker_id = worker_id or WorkerID.from_random().hex()
+        self.job_id = job_id or JobID.from_random().hex()
+        self.store = ObjectStoreClient(store_path)
+        self.objects: dict[str, _OwnedObject] = {}
+        self.pending_tasks: dict[str, _PendingTask] = {}
+        self.lineage: dict[str, TaskSpec] = {}
+        self._lineage_bytes = 0
+        self.actor_handles_state: dict[str, dict] = {}  # actor_id -> conn/seq/queue
+        self._fn_cache: dict[str, object] = {}
+        self._put_index = 0
+        self._task_index = 0
+        self._current_task_id = TaskID.from_random()
+        # Pinned shm reads: objects whose zero-copy buffers escaped to user
+        # code; we hold the shm ref for process lifetime (see module docs).
+        self._pinned_reads: set[str] = set()
+        # executor
+        self._exec_queue: _queue.Queue = _queue.Queue()
+        self._actor_instance = None
+        self._actor_id: str | None = None
+        self._actor_callers: dict[str, dict] = {}
+        self._shutdown = False
+        # loop thread
+        self.loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(target=self._run_loop, daemon=True,
+                                             name="ray_tpu-io")
+        self._loop_ready = threading.Event()
+        self._loop_thread.start()
+        self._loop_ready.wait()
+        # Connections (established in async_init)
+        self.gcs: rpc.Connection | None = None
+        self.raylet: rpc.Connection | None = None
+        self.server: rpc.RpcServer | None = None
+        self.address: Address | None = None
+        self._leases: dict[str, list[_LeaseSlot]] = defaultdict(list)
+        self._lease_requests_in_flight: dict[str, int] = defaultdict(int)
+        self._queues: dict[str, list] = defaultdict(list)  # shape -> [task_id]
+        self._task_events: list = []
+        self._run(self._async_init())
+
+    # ---------- plumbing ----------
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self._loop_ready.set()
+        self.loop.run_forever()
+
+    def _run(self, coro, timeout: float | None = None):
+        """Run a coroutine on the IO loop from any thread."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def _spawn(self, coro):
+        asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    async def _async_init(self):
+        self.server = rpc.RpcServer({
+            "PushTask": self._handle_push_task,
+            "ActorCall": self._handle_actor_call,
+            "AssignActor": self._handle_assign_actor,
+            "GetObjectStatus": self._handle_get_object_status,
+            "CancelTask": self._handle_cancel_task,
+            "Exit": self._handle_exit,
+            "Ping": lambda conn, p: {"ok": True},
+        }, name=f"worker-{self.worker_id[:8]}")
+        host, port = await self.server.start("127.0.0.1", 0)
+        self.address = Address(host, port, self.worker_id, self.node_id)
+        self.gcs = await rpc.connect_retry(
+            self.gcs_host, self.gcs_port,
+            handlers={"Publish": self._on_gcs_publish},
+            name=f"w{self.worker_id[:8]}->gcs",
+            timeout=self.config.rpc_connect_timeout_s)
+        await self.gcs.call("Subscribe", {"channels": ["ACTOR"]})
+        # The raylet pushes AssignActor/Exit over this same connection, so
+        # it carries the worker's full handler table.
+        self.raylet = await rpc.connect_retry(
+            self.raylet_host, self.raylet_port, handlers=self.server.handlers,
+            name=f"w{self.worker_id[:8]}->raylet",
+            timeout=self.config.rpc_connect_timeout_s)
+        await self.raylet.call("RegisterWorker", {
+            "worker_id": self.worker_id, "host": host, "port": port})
+        if not self.is_driver:
+            # Pool workers die with their raylet (reference: workers exit on
+            # raylet socket disconnect), so a dead node leaves no orphans
+            # racing against retried tasks.
+            self.raylet.on_close(
+                lambda: (not self._shutdown) and os._exit(1))
+        if self.is_driver:
+            await self.gcs.call("RegisterJob", {
+                "job_id": self.job_id, "driver_address": self.address.to_wire(),
+                "entrypoint": " ".join(os.sys.argv)})
+        asyncio.ensure_future(self._flush_task_events_loop())
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._run(self._async_shutdown(), timeout=5)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._loop_thread.join(timeout=2)
+        try:
+            self.store.close()
+        except Exception:
+            pass
+
+    async def _async_shutdown(self):
+        if self.is_driver and self.gcs and not self.gcs.closed:
+            try:
+                await self.gcs.call("FinishJob", {"job_id": self.job_id}, timeout=2)
+            except Exception:
+                pass
+        for slots in self._leases.values():
+            for s in slots:
+                try:
+                    await s.raylet.call("ReturnWorker", {"lease_id": s.lease_id}, timeout=2)
+                except Exception:
+                    pass
+        if self.server:
+            await self.server.stop()
+        for c in (self.gcs, self.raylet):
+            if c:
+                await c.close()
+        # Cancel stragglers (event flusher, recv loops of cached conns) so
+        # loop teardown is silent.
+        for t in asyncio.all_tasks():
+            if t is not asyncio.current_task():
+                t.cancel()
+
+    # ---------- events ----------
+
+    def _record_task_event(self, task_id: str, name: str, state: str, **extra):
+        self._task_events.append({
+            "task_id": task_id, "name": name, "state": state,
+            "node_id": self.node_id, "worker_id": self.worker_id,
+            "job_id": self.job_id, "ts": time.time(), **extra})
+
+    async def _flush_task_events_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            if self._task_events and self.gcs and not self.gcs.closed:
+                batch, self._task_events = self._task_events, []
+                try:
+                    await self.gcs.call("AddTaskEvents", {"events": batch}, timeout=5)
+                except Exception:
+                    pass
+
+    # ---------- put / get / wait ----------
+
+    def put(self, value) -> "tuple[ObjectID, Address]":
+        self._put_index += 1
+        oid = ObjectID.for_put(self._current_task_id, self._put_index)
+        sobj = serialization.serialize(value)
+        self._run(self._store_owned(oid, sobj))
+        return oid, self.address
+
+    async def _store_owned(self, oid: ObjectID, sobj: serialization.SerializedObject,
+                           lineage_task: str | None = None):
+        o = self.objects.setdefault(oid.hex(), _OwnedObject())
+        o.size = sobj.total_size
+        if sobj.total_size <= self.config.max_inline_object_size:
+            o.inline = (sobj.meta, sobj.to_bytes())
+        else:
+            await self._write_to_store(oid, sobj)
+            o.locations.add(self.node_id)
+        o.lineage_task = lineage_task
+        o.state = OBJ_READY
+        if o.ready_event:
+            o.ready_event.set()
+
+    async def _write_to_store(self, oid: ObjectID, sobj):
+        try:
+            if not self.store.contains(oid):
+                meta = sobj.meta
+                buf = self.store.create(oid, len(meta) + sobj.total_size, len(meta))
+                buf[: len(meta)] = meta
+                sobj.write_to(buf[len(meta):])
+                self.store.seal(oid)
+        except ObjectStoreFullError:
+            raise
+        except Exception as e:
+            if "already exists" not in str(e):
+                raise
+
+    def get(self, refs: list, timeout: float | None = None):
+        """refs: list of (ObjectID, owner Address). Returns list of values."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for oid, owner in refs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            meta, data, pin = self._run(
+                self._fetch_object(oid, owner, remaining),
+                None if remaining is None else remaining + 5)
+            kind, value = serialization.deserialize(meta, data)
+            if pin is not None and _has_buffers(meta):
+                self._pinned_reads.add(oid.hex())
+            elif pin is not None:
+                self.store.release(oid)
+            if kind == serialization.KIND_EXCEPTION:
+                cause, tb = value
+                raise exc.TaskError(cause, tb)
+            out.append(value)
+        return out
+
+    async def _fetch_object(self, oid: ObjectID, owner: Address,
+                            timeout: float | None):
+        """Returns (meta, data, pinned_oid|None)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        oid_hex = oid.hex()
+        poll = 0.0005
+        while True:
+            o = self.objects.get(oid_hex)
+            if o is not None and o.state == OBJ_FAILED:
+                return o.error[0], o.error[1], None
+            if o is not None and o.state == OBJ_READY and o.inline is not None:
+                return o.inline[0], o.inline[1], None
+            got = self.store.get_buffer(oid)
+            if got is not None:
+                return got[0], got[1], oid_hex
+            if o is not None and o.state == OBJ_READY and o.locations:
+                ok = await self._pull_to_local(oid_hex, list(o.locations))
+                if ok:
+                    continue
+                # All copies lost → lineage reconstruction
+                recovered = await self._try_reconstruct(oid_hex)
+                if not recovered:
+                    raise exc.ObjectLostError(oid_hex)
+                continue
+            if o is None or o.state == OBJ_PENDING:
+                if owner is not None and owner.worker_id != self.worker_id:
+                    status = await self._poll_owner(oid, owner)
+                    if status is not None:
+                        meta, data = status
+                        return meta, data, None
+                    # else: became available in store / keep looping
+                else:
+                    # We own it and it is pending: wait for task completion.
+                    if o is None:
+                        raise exc.ObjectLostError(
+                            oid_hex, f"object {oid_hex} is not owned by this "
+                                     "process and no owner address is known")
+                    if o.ready_event is None:
+                        o.ready_event = asyncio.Event()
+                    try:
+                        wait_t = 0.5 if deadline is None else \
+                            min(0.5, max(0.001, deadline - time.monotonic()))
+                        await asyncio.wait_for(o.ready_event.wait(), wait_t)
+                    except asyncio.TimeoutError:
+                        pass
+            if deadline is not None and time.monotonic() > deadline:
+                raise exc.GetTimeoutError(f"timed out getting {oid_hex}")
+            await asyncio.sleep(poll)
+            poll = min(poll * 2, 0.02)
+
+    async def _poll_owner(self, oid: ObjectID, owner: Address):
+        """Long-poll the owner for object status. Returns (meta, data) for
+        inline values, or None if we should retry via the store."""
+        try:
+            conn = await self._owner_conn(owner)
+            resp = await conn.call("GetObjectStatus",
+                                   {"object_id": oid.hex(), "wait_s": 2.0},
+                                   timeout=self.config.rpc_call_timeout_s)
+        except (rpc.RpcError, OSError) as e:
+            raise exc.OwnerDiedError(
+                oid.hex(), f"owner of {oid.hex()} unreachable: {e}")
+        status = resp["status"]
+        if status == "inline":
+            return bytes(resp["meta"]), bytes(resp["data"])
+        if status == "stored":
+            ok = await self._pull_to_local(oid.hex(), resp["locations"])
+            return None
+        if status == "failed":
+            return bytes(resp["meta"]), bytes(resp["data"])
+        if status == "unknown":
+            raise exc.ObjectLostError(oid.hex(),
+                                      f"owner does not know object {oid.hex()}")
+        return None  # pending
+
+    _owner_conns: dict = {}
+
+    async def _owner_conn(self, owner: Address) -> rpc.Connection:
+        key = owner.key()
+        conn = self._owner_conns.get(key)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(owner.host, owner.port,
+                                     name=f"w{self.worker_id[:6]}->owner")
+            self._owner_conns[key] = conn
+        return conn
+
+    async def _pull_to_local(self, oid_hex: str, locations: list[str]) -> bool:
+        resp = await self.raylet.call("PullObject", {
+            "object_id": oid_hex, "locations": locations},
+            timeout=self.config.rpc_call_timeout_s)
+        return bool(resp.get("ok"))
+
+    async def _try_reconstruct(self, oid_hex: str) -> bool:
+        """Lineage reconstruction (reference: object_recovery_manager.h:96
+        ReconstructObject → resubmit the creating task)."""
+        o = self.objects.get(oid_hex)
+        if o is None or not o.lineage_task:
+            return False
+        spec = self.lineage.get(o.lineage_task)
+        if spec is None:
+            return False
+        logger.warning("reconstructing %s via task %s", oid_hex[:12], spec.name)
+        o.state = OBJ_PENDING
+        o.locations.clear()
+        pt = _PendingTask(spec, retries_left=1)
+        self.pending_tasks[spec.task_id] = pt
+        self._enqueue_task(pt)
+        # Wait for re-execution.
+        if o.ready_event is None:
+            o.ready_event = asyncio.Event()
+        o.ready_event.clear()
+        try:
+            await asyncio.wait_for(o.ready_event.wait(),
+                                   self.config.rpc_call_timeout_s)
+        except asyncio.TimeoutError:
+            return False
+        return o.state == OBJ_READY
+
+    def wait(self, refs: list, num_returns: int = 1, timeout: float | None = None):
+        """Returns (ready, not_ready) index lists."""
+        return self._run(self._wait_async(refs, num_returns, timeout))
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: list[int] = []
+        while True:
+            ready = []
+            for i, (oid, owner) in enumerate(refs):
+                if await self._is_ready(oid, owner):
+                    ready.append(i)
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            await asyncio.sleep(0.005)
+        not_ready = [i for i in range(len(refs)) if i not in ready]
+        return ready, not_ready
+
+    async def _is_ready(self, oid: ObjectID, owner: Address) -> bool:
+        o = self.objects.get(oid.hex())
+        if o is not None:
+            return o.state in (OBJ_READY, OBJ_FAILED)
+        if self.store.contains(oid):
+            return True
+        if owner is not None and owner.worker_id != self.worker_id:
+            try:
+                conn = await self._owner_conn(owner)
+                resp = await conn.call("GetObjectStatus",
+                                       {"object_id": oid.hex(), "wait_s": 0},
+                                       timeout=5.0)
+                return resp["status"] in ("inline", "stored", "failed")
+            except Exception:
+                return False
+        return False
+
+    # ---------- ref counting ----------
+
+    def add_local_ref(self, oid_hex: str):
+        o = self.objects.get(oid_hex)
+        if o is not None:
+            o.local_refs += 1
+
+    def remove_local_ref(self, oid_hex: str):
+        if self._shutdown:
+            return
+        try:
+            self.loop.call_soon_threadsafe(self._remove_local_ref_impl, oid_hex)
+        except RuntimeError:
+            pass
+
+    def _remove_local_ref_impl(self, oid_hex: str):
+        o = self.objects.get(oid_hex)
+        if o is None:
+            return
+        o.local_refs -= 1
+        if o.local_refs <= 0 and o.submitted_refs <= 0:
+            self._free_object(oid_hex)
+
+    def _free_object(self, oid_hex: str):
+        o = self.objects.pop(oid_hex, None)
+        if o is None:
+            return
+        if o.locations:
+            self._spawn(self.raylet.call("FreeObjects", {"object_ids": [oid_hex]}))
+        if o.lineage_task:
+            spec = self.lineage.pop(o.lineage_task, None)
+            if spec is not None:
+                self._lineage_bytes -= len(str(spec.args))
+
+    # ---------- function table ----------
+
+    def register_function(self, fn) -> str:
+        blob = serialization.dumps_func(fn)
+        key = self.job_id + ":" + hashlib.sha1(blob).hexdigest()
+        if key not in self._fn_cache:
+            self._fn_cache[key] = fn
+            self._run(self.gcs.call("KVPut", {
+                "ns": "fn", "key": key.encode(), "value": blob, "overwrite": False}))
+        return key
+
+    async def _fetch_function(self, key: str):
+        if key in self._fn_cache:
+            return self._fn_cache[key]
+        deadline = time.monotonic() + self.config.rpc_call_timeout_s
+        while True:
+            resp = await self.gcs.call("KVGet", {"ns": "fn", "key": key.encode()})
+            if resp["value"] is not None:
+                fn = serialization.loads_func(resp["value"])
+                self._fn_cache[key] = fn
+                return fn
+            if time.monotonic() > deadline:
+                raise exc.RayTpuError(f"function {key} not found in GCS")
+            await asyncio.sleep(0.05)
+
+    # ---------- task submission (owner side) ----------
+
+    def next_task_id(self) -> TaskID:
+        self._task_index += 1
+        h = hashlib.sha1(
+            self._current_task_id.binary() + self._task_index.to_bytes(8, "big"))
+        return TaskID(h.digest()[:TaskID.SIZE])
+
+    def serialize_args(self, args: tuple, kwargs: dict):
+        """Build wire args; returns (wire_args, kwargs_keys, dep_ids)."""
+        from ray_tpu._private.api_internal import ObjectRef  # cycle-free import
+
+        wire = []
+        deps = []
+        items = list(args) + list(kwargs.values())
+        for a in items:
+            if isinstance(a, ObjectRef):
+                wire.append(["r", a.id.hex(), a.owner.to_wire() if a.owner else None])
+                deps.append(a.id.hex())
+                o = self.objects.get(a.id.hex())
+                if o is not None:
+                    o.submitted_refs += 1
+            else:
+                sobj = serialization.serialize(a)
+                if sobj.total_size > self.config.max_inline_object_size:
+                    # Large arg: promote to a put object passed by reference
+                    # (reference: same promotion in submit path).
+                    oid, owner = self.put(a)
+                    wire.append(["r", oid.hex(), owner.to_wire()])
+                    deps.append(oid.hex())
+                    o = self.objects.get(oid.hex())
+                    if o is not None:
+                        o.submitted_refs += 1
+                else:
+                    wire.append(["v", sobj.meta, sobj.to_bytes()])
+        return wire, list(kwargs.keys()), deps
+
+    def submit_task(self, spec: TaskSpec) -> list[ObjectID]:
+        """Submit; returns the return-object IDs (owner = this worker)."""
+        returns = [ObjectID.for_task_return(TaskID.from_hex(spec.task_id), i + 1)
+                   for i in range(spec.num_returns)]
+        pt = _PendingTask(spec, retries_left=spec.max_retries)
+        for oid in returns:
+            o = self.objects.setdefault(oid.hex(), _OwnedObject())
+            o.lineage_task = spec.task_id
+        self.pending_tasks[spec.task_id] = pt
+        self._record_task_event(spec.task_id, spec.name, "PENDING")
+        self.loop.call_soon_threadsafe(self._enqueue_task, pt)
+        return returns
+
+    def _enqueue_task(self, pt: _PendingTask):
+        shape = _shape_key(pt.spec.resources) + repr(pt.spec.strategy) + pt.spec.placement_group
+        self._queues[shape].append(pt.spec.task_id)
+        self._spawn(self._pump_queue(shape, pt.spec))
+
+    async def _pump_queue(self, shape: str, template_spec: TaskSpec):
+        """Ensure enough leased workers for the queue; dispatch tasks.
+        Lease pipelining mirrors direct_task_transport.cc
+        RequestNewWorkerIfNeeded:346 / OnWorkerIdle:191."""
+        q = self._queues[shape]
+        slots = self._leases[shape]
+        # Dispatch to idle slots first.
+        for s in slots:
+            if not q:
+                return
+            if not s.busy and not s.conn.closed:
+                task_id = q.pop(0)
+                pt = self.pending_tasks.get(task_id)
+                if pt is not None:
+                    s.busy = True
+                    asyncio.ensure_future(self._push_task(s, pt, shape))
+        want = len(q)
+        in_flight = self._lease_requests_in_flight[shape]
+        max_new = min(want - in_flight, 32)
+        for _ in range(max(0, max_new)):
+            self._lease_requests_in_flight[shape] += 1
+            asyncio.ensure_future(self._request_lease(shape, template_spec))
+
+    async def _request_lease(self, shape: str, spec: TaskSpec):
+        try:
+            raylet_conn = self.raylet
+            _hop = 0
+            while _hop < 8:  # follow spillback redirects
+                _hop += 1
+                try:
+                    resp = await raylet_conn.call("RequestWorkerLease", {
+                        "resources": spec.resources,
+                        "strategy": spec.strategy,
+                        "placement_group": spec.placement_group,
+                        "pg_bundle_index": spec.pg_bundle_index,
+                        "hops": _hop - 1,
+                    }, timeout=self.config.worker_lease_timeout_s + 10)
+                except (rpc.RpcError, asyncio.TimeoutError, OSError):
+                    # The raylet we were negotiating with died (node failure
+                    # mid-lease). Fall back to the local raylet and retry
+                    # while there is still queued work.
+                    if not self._queues[shape]:
+                        return
+                    await asyncio.sleep(0.5)
+                    raylet_conn = self.raylet
+                    _hop = 0
+                    continue
+                if resp.get("granted"):
+                    try:
+                        conn = await rpc.connect(
+                            resp["worker_host"], resp["worker_port"],
+                            name=f"owner->{resp['worker_id'][:6]}")
+                    except OSError:
+                        # Leased worker already gone; release and retry.
+                        try:
+                            await raylet_conn.call(
+                                "ReturnWorker",
+                                {"lease_id": resp["lease_id"], "kill": True})
+                        except Exception:
+                            pass
+                        raylet_conn = self.raylet
+                        _hop = 0
+                        continue
+                    slot = _LeaseSlot(conn, resp["lease_id"], resp["worker_id"],
+                                      resp["node_id"], raylet_conn)
+                    self._leases[shape].append(slot)
+                    await self._on_slot_idle(slot, shape)
+                    return
+                if resp.get("spillback"):
+                    sb = resp["spillback"]
+                    raylet_conn = await self._raylet_conn(sb["host"], sb["port"])
+                    continue
+                if resp.get("retry"):
+                    await asyncio.sleep(0.2)
+                    continue
+                if resp.get("infeasible"):
+                    # Reference semantics: infeasible tasks stay PENDING —
+                    # the autoscaler (or a test adding a node) may satisfy
+                    # them later. Back off and retry from the local raylet.
+                    if not self._queues[shape]:
+                        return
+                    logger.warning("task demand currently infeasible: %s; "
+                                   "waiting for cluster resources",
+                                   resp.get("error"))
+                    await asyncio.sleep(1.0)
+                    raylet_conn = self.raylet
+                    _hop = 0
+                    continue
+                logger.debug("lease failed: %s", resp.get("error"))
+                self._fail_queued_infeasible(shape, resp.get("error", "lease failed"))
+                return
+        finally:
+            self._lease_requests_in_flight[shape] -= 1
+
+    def _fail_queued_infeasible(self, shape: str, reason: str):
+        q = self._queues[shape]
+        while q:
+            task_id = q.pop(0)
+            pt = self.pending_tasks.pop(task_id, None)
+            if pt is not None:
+                err = serialization.serialize_exception(
+                    exc.RayTpuError(f"task unschedulable: {reason}"))
+                self._complete_task_error(pt, err)
+
+    _raylet_conns: dict = {}
+
+    async def _raylet_conn(self, host, port):
+        key = (host, port)
+        conn = self._raylet_conns.get(key)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(host, port, name="owner->raylet")
+            self._raylet_conns[key] = conn
+        return conn
+
+    async def _on_slot_idle(self, slot: _LeaseSlot, shape: str):
+        q = self._queues[shape]
+        if q:
+            task_id = q.pop(0)
+            pt = self.pending_tasks.get(task_id)
+            if pt is not None:
+                slot.busy = True
+                await self._push_task(slot, pt, shape)
+                return
+        # No work: return lease after a grace period (lease reuse window).
+        slot.busy = False
+        slot.idle_since = time.monotonic()
+        await asyncio.sleep(self.config.idle_worker_keep_s)
+        if not slot.busy and slot in self._leases[shape] and not q:
+            self._leases[shape].remove(slot)
+            try:
+                await slot.raylet.call("ReturnWorker", {"lease_id": slot.lease_id})
+            except Exception:
+                pass
+            await slot.conn.close()
+
+    async def _push_task(self, slot: _LeaseSlot, pt: _PendingTask, shape: str):
+        spec = pt.spec
+        pt.pushed_to = slot.node_id
+        self._record_task_event(spec.task_id, spec.name, "RUNNING",
+                                target_node=slot.node_id)
+        try:
+            resp = await slot.conn.call("PushTask", {"spec": spec.to_wire()},
+                                        timeout=self.config.rpc_call_timeout_s)
+        except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
+            # Worker died or connection lost → retry or fail.
+            if slot in self._leases[shape]:
+                self._leases[shape].remove(slot)
+            await self._handle_worker_failure(pt, shape, str(e))
+            return
+        await self._complete_task(pt, resp, slot.node_id)
+        asyncio.ensure_future(self._on_slot_idle(slot, shape))
+
+    async def _handle_worker_failure(self, pt: _PendingTask, shape: str, reason: str):
+        if pt.retries_left != 0:
+            pt.retries_left -= 1
+            logger.warning("task %s failed (%s); retrying (%s left)",
+                           pt.spec.name, reason, pt.retries_left)
+            self._record_task_event(pt.spec.task_id, pt.spec.name, "RETRYING")
+            self._enqueue_task(pt)
+        else:
+            err = serialization.serialize_exception(
+                exc.WorkerCrashedError(f"worker died running {pt.spec.name}: {reason}"))
+            self._complete_task_error(pt, err)
+
+    def _complete_task_error(self, pt: _PendingTask, err):
+        self.pending_tasks.pop(pt.spec.task_id, None)
+        self._record_task_event(pt.spec.task_id, pt.spec.name, "FAILED")
+        task_id = TaskID.from_hex(pt.spec.task_id)
+        for i in range(pt.spec.num_returns):
+            oid = ObjectID.for_task_return(task_id, i + 1)
+            o = self.objects.setdefault(oid.hex(), _OwnedObject())
+            o.state = OBJ_FAILED
+            o.error = (err.meta, err.to_bytes())
+            if o.ready_event:
+                o.ready_event.set()
+        self._release_submitted_refs(pt.spec)
+
+    async def _complete_task(self, pt: _PendingTask, resp: dict, node_id: str):
+        spec = pt.spec
+        if resp.get("status") == "error" and resp.get("retryable") \
+                and pt.retries_left != 0 and spec.retry_exceptions:
+            pt.retries_left -= 1
+            self._enqueue_task(pt)
+            return
+        self.pending_tasks.pop(spec.task_id, None)
+        task_id = TaskID.from_hex(spec.task_id)
+        if resp.get("status") == "error":
+            self._record_task_event(spec.task_id, spec.name, "FAILED")
+            err_meta, err_data = resp["error"]
+            for i in range(spec.num_returns):
+                oid = ObjectID.for_task_return(task_id, i + 1)
+                o = self.objects.setdefault(oid.hex(), _OwnedObject())
+                o.state = OBJ_FAILED
+                o.error = (bytes(err_meta), bytes(err_data))
+                if o.ready_event:
+                    o.ready_event.set()
+        else:
+            self._record_task_event(spec.task_id, spec.name, "FINISHED")
+            # Keep lineage for reconstruction (bounded).
+            if self._lineage_bytes < self.config.max_lineage_bytes:
+                self.lineage[spec.task_id] = spec
+                self._lineage_bytes += len(str(spec.args))
+            for i, result in enumerate(resp["results"]):
+                oid = ObjectID.for_task_return(task_id, i + 1)
+                o = self.objects.setdefault(oid.hex(), _OwnedObject())
+                if result[0] == "v":
+                    o.inline = (bytes(result[1]), bytes(result[2]))
+                    o.size = len(o.inline[1])
+                else:  # ["s", node_id, size]
+                    o.locations.add(result[1])
+                    o.size = result[2]
+                o.state = OBJ_READY
+                o.lineage_task = spec.task_id
+                if o.ready_event:
+                    o.ready_event.set()
+        self._release_submitted_refs(spec)
+
+    def _release_submitted_refs(self, spec: TaskSpec):
+        for a in spec.args:
+            if a[0] == "r":
+                o = self.objects.get(a[1])
+                if o is not None:
+                    o.submitted_refs -= 1
+                    if o.submitted_refs <= 0 and o.local_refs <= 0:
+                        self._free_object(a[1])
+
+    # ---------- owner-side status service ----------
+
+    async def _handle_get_object_status(self, conn, payload):
+        oid_hex = payload["object_id"]
+        wait_s = payload.get("wait_s", 0)
+        o = self.objects.get(oid_hex)
+        if o is not None and o.state == OBJ_PENDING and wait_s > 0:
+            if o.ready_event is None:
+                o.ready_event = asyncio.Event()
+            try:
+                await asyncio.wait_for(o.ready_event.wait(), wait_s)
+            except asyncio.TimeoutError:
+                pass
+        o = self.objects.get(oid_hex)
+        if o is None:
+            # Maybe it's in our local store anyway (borrowed object).
+            if self.store.contains(ObjectID.from_hex(oid_hex)):
+                return {"status": "stored", "locations": [self.node_id]}
+            return {"status": "unknown"}
+        if o.state == OBJ_FAILED:
+            return {"status": "failed", "meta": o.error[0], "data": o.error[1]}
+        if o.state == OBJ_PENDING:
+            return {"status": "pending"}
+        if o.inline is not None:
+            return {"status": "inline", "meta": o.inline[0], "data": o.inline[1]}
+        return {"status": "stored", "locations": sorted(o.locations)}
+
+    # ---------- execution (worker side) ----------
+
+    async def _handle_push_task(self, conn, payload):
+        spec = TaskSpec.from_wire(payload["spec"])
+        fut = asyncio.get_running_loop().create_future()
+        self._exec_queue.put((spec, fut))
+        return await fut
+
+    async def _handle_cancel_task(self, conn, payload):
+        return {"ok": False, "reason": "running-task cancel not supported yet"}
+
+    async def _handle_exit(self, conn, payload):
+        self.loop.call_soon(lambda: os._exit(0))
+        return {"ok": True}
+
+    def execution_loop(self):
+        """Main thread of a pool worker: executes tasks sequentially
+        (reference: _raylet.pyx:3044 run_task_loop)."""
+        while not self._shutdown:
+            try:
+                item = self._exec_queue.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            if item is None:
+                break
+            spec, fut = item
+            result = self._execute_task(spec)
+            self.loop.call_soon_threadsafe(
+                lambda f=fut, r=result: (not f.done()) and f.set_result(r))
+
+    def _resolve_args(self, spec: TaskSpec):
+        from ray_tpu._private.api_internal import ObjectRef
+
+        values = []
+        for a in spec.args:
+            if a[0] == "v":
+                _, value = serialization.deserialize(bytes(a[1]), bytes(a[2]))
+                values.append(value)
+            else:
+                oid = ObjectID.from_hex(a[1])
+                owner = Address.from_wire(a[2]) if a[2] else None
+                values.append(self.get([(oid, owner)])[0])
+        nkw = len(spec.kwargs_keys)
+        if nkw:
+            pos, kw_vals = values[:-nkw], values[-nkw:]
+            kwargs = dict(zip(spec.kwargs_keys, kw_vals))
+        else:
+            pos, kwargs = values, {}
+        return pos, kwargs
+
+    def _execute_task(self, spec: TaskSpec) -> dict:
+        prev_task_id = self._current_task_id
+        self._current_task_id = TaskID.from_hex(spec.task_id)
+        try:
+            if spec.actor_creation:
+                cls = self._run(self._fetch_function(spec.func_key))
+                args, kwargs = self._resolve_args(spec)
+                self._actor_instance = cls(*args, **kwargs)
+                return {"status": "ok", "results": []}
+            if spec.actor_id:
+                fn = getattr(self._actor_instance, spec.name.split(".")[-1])
+                args, kwargs = self._resolve_args(spec)
+                result = fn(*args, **kwargs)
+            else:
+                fn = self._run(self._fetch_function(spec.func_key))
+                args, kwargs = self._resolve_args(spec)
+                result = fn(*args, **kwargs)
+            return {"status": "ok",
+                    "results": self._package_results(spec, result)}
+        except Exception as e:
+            tb = traceback.format_exc()
+            err = serialization.serialize_exception(e)
+            return {"status": "error", "error": [err.meta, err.to_bytes()],
+                    "retryable": not isinstance(e, exc.RayTpuError)}
+        finally:
+            self._current_task_id = prev_task_id
+
+    def _package_results(self, spec: TaskSpec, result) -> list:
+        if spec.num_returns == 0:
+            return []
+        if spec.num_returns == 1:
+            results = [result]
+        else:
+            results = list(result)
+            if len(results) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns={spec.num_returns} "
+                    f"but returned {len(results)} values")
+        out = []
+        task_id = TaskID.from_hex(spec.task_id)
+        for i, value in enumerate(results):
+            sobj = serialization.serialize(value)
+            if sobj.total_size <= self.config.max_inline_object_size:
+                out.append(["v", sobj.meta, sobj.to_bytes()])
+            else:
+                oid = ObjectID.for_task_return(task_id, i + 1)
+                self._run(self._write_to_store_safe(oid, sobj))
+                out.append(["s", self.node_id, sobj.total_size])
+        return out
+
+    async def _write_to_store_safe(self, oid, sobj):
+        await self._write_to_store(oid, sobj)
+
+    # ---------- actors: worker side ----------
+
+    async def _handle_assign_actor(self, conn, payload):
+        spec = TaskSpec.from_wire(payload["spec"])
+        self._actor_id = spec.actor_id
+        fut = asyncio.get_running_loop().create_future()
+        self._exec_queue.put((spec, fut))
+        result = await fut
+        if result["status"] != "ok":
+            err = result.get("error")
+            reason = "actor constructor failed"
+            try:
+                _, (cause, tb) = serialization.deserialize(
+                    bytes(err[0]), bytes(err[1]))
+                reason = f"{type(cause).__name__}: {cause}\n{tb}"
+            except Exception:
+                pass
+            await self.gcs.call("ReportActorDeath", {
+                "actor_id": spec.actor_id, "reason": reason, "intended": True})
+            self.loop.call_later(0.2, lambda: os._exit(1))
+            return {"ok": False, "reason": reason}
+        await self.gcs.call("ActorReady", {
+            "actor_id": spec.actor_id, "address": self.address.to_wire()})
+        return {"ok": True}
+
+    async def _handle_actor_call(self, conn, payload):
+        """Ordered per-caller actor task execution (reference:
+        direct_actor_task_submitter.h:68 client seq-nos + server
+        actor_scheduling_queue)."""
+        spec = TaskSpec.from_wire(payload["spec"])
+        caller = payload["caller_id"]
+        state = self._actor_callers.setdefault(
+            caller, {"next_seq": 0, "buffer": {}})
+        fut = asyncio.get_running_loop().create_future()
+        state["buffer"][spec.actor_seq] = (spec, fut)
+        while state["next_seq"] in state["buffer"]:
+            seq = state["next_seq"]
+            s, f = state["buffer"].pop(seq)
+            state["next_seq"] += 1
+            self._exec_queue.put((s, f))
+        return await fut
+
+    # ---------- actors: caller side ----------
+
+    def create_actor(self, spec: TaskSpec, *, name: str, namespace: str,
+                     class_name: str, detached: bool, get_if_exists: bool = False):
+        return self._run(self.gcs.call("RegisterActor", {
+            "actor_id": spec.actor_id,
+            "job_id": self.job_id,
+            "spec": spec.to_wire(),
+            "name": name, "namespace": namespace,
+            "class_name": class_name,
+            "resources": spec.resources,
+            "max_restarts": spec.max_restarts,
+            "detached": detached,
+            "get_if_exists": get_if_exists,
+            "owner": self.address.to_wire(),
+            "strategy": spec.strategy,
+            "placement_group": spec.placement_group,
+            "pg_bundle_index": spec.pg_bundle_index,
+        }))
+
+    async def _on_gcs_publish(self, conn, payload):
+        if payload.get("channel") != "ACTOR":
+            return
+        msg = payload["message"]
+        st = self.actor_handles_state.get(msg["actor_id"])
+        if st is None:
+            return
+        if msg["state"] == "ALIVE":
+            st["address"] = msg["address"]
+            st["conn"] = None
+            ev = st.get("alive_event")
+            if ev:
+                ev.set()
+        elif msg["state"] in ("DEAD", "RESTARTING"):
+            st["address"] = None
+            st["conn"] = None
+            if msg["state"] == "DEAD":
+                st["dead"] = True
+                st["death_reason"] = msg.get("reason", "")
+                ev = st.get("alive_event")
+                if ev:
+                    ev.set()
+
+    def _actor_state(self, actor_id: str):
+        return self.actor_handles_state.setdefault(
+            actor_id, {"address": None, "conn": None, "seq": 0, "dead": False,
+                       "death_reason": "", "alive_event": None})
+
+    def submit_actor_task(self, actor_id: str, spec: TaskSpec,
+                          max_task_retries: int = 0) -> list[ObjectID]:
+        st = self._actor_state(actor_id)
+        spec.actor_seq = st["seq"]
+        st["seq"] += 1
+        returns = [ObjectID.for_task_return(TaskID.from_hex(spec.task_id), i + 1)
+                   for i in range(spec.num_returns)]
+        for oid in returns:
+            self.objects.setdefault(oid.hex(), _OwnedObject())
+        self._spawn(self._submit_actor_task_async(actor_id, spec, max_task_retries))
+        return returns
+
+    async def _actor_conn(self, actor_id: str, st) -> rpc.Connection:
+        while True:
+            if st["dead"]:
+                raise exc.ActorDiedError(
+                    f"actor {actor_id[:8]} is dead: {st['death_reason']}")
+            if st["address"] is None:
+                resp = await self.gcs.call("GetActorInfo", {"actor_id": actor_id})
+                if not resp.get("found"):
+                    raise exc.ActorDiedError(f"actor {actor_id[:8]} not found")
+                if resp["state"] == "ALIVE":
+                    st["address"] = resp["address"]
+                elif resp["state"] == "DEAD":
+                    st["dead"] = True
+                    st["death_reason"] = resp.get("death_cause") or ""
+                    continue
+                else:
+                    if st["alive_event"] is None:
+                        st["alive_event"] = asyncio.Event()
+                    st["alive_event"].clear()
+                    try:
+                        await asyncio.wait_for(st["alive_event"].wait(), 1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+            if st["conn"] is None or st["conn"].closed:
+                addr = Address.from_wire(st["address"])
+                st["conn"] = await rpc.connect(addr.host, addr.port,
+                                               name=f"->actor{actor_id[:6]}")
+            return st["conn"]
+
+    async def _submit_actor_task_async(self, actor_id: str, spec: TaskSpec,
+                                       max_task_retries: int):
+        attempts = max_task_retries + 1
+        last_reason = ""
+        for _ in range(max(1, attempts)):
+            st = self._actor_state(actor_id)
+            try:
+                conn = await self._actor_conn(actor_id, st)
+                resp = await conn.call("ActorCall", {
+                    "spec": spec.to_wire(), "caller_id": self.worker_id},
+                    timeout=None)
+                pt = _PendingTask(spec, 0)
+                await self._complete_task(pt, resp, "")
+                return
+            except exc.ActorDiedError as e:
+                last_reason = str(e)
+                break
+            except (rpc.RpcError, OSError, asyncio.TimeoutError) as e:
+                last_reason = str(e)
+                st["conn"] = None
+                st["address"] = None
+                await asyncio.sleep(0.2)
+                continue
+        err = serialization.serialize_exception(
+            exc.ActorDiedError(f"actor task {spec.name} failed: {last_reason}"))
+        pt = _PendingTask(spec, 0)
+        self._complete_task_error(pt, err)
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        st = self._actor_state(actor_id)
+        st["dead"] = st["dead"] or no_restart
+        return self._run(self.gcs.call("KillActor", {
+            "actor_id": actor_id, "no_restart": no_restart}))
+
+
+def _has_buffers(meta: bytes) -> bool:
+    import msgpack
+
+    try:
+        _, _, offsets = msgpack.unpackb(meta)
+        return bool(offsets)
+    except Exception:
+        return False
+
+
+# ---------------- pool worker process entrypoint ----------------
+
+
+def main():
+    logging.basicConfig(level=logging.INFO,
+                        format="[worker] %(asctime)s %(levelname)s %(message)s")
+    env = os.environ
+    cw = CoreWorker(
+        gcs_host=env["RAY_TPU_GCS_HOST"], gcs_port=int(env["RAY_TPU_GCS_PORT"]),
+        raylet_host=env["RAY_TPU_RAYLET_HOST"],
+        raylet_port=int(env["RAY_TPU_RAYLET_PORT"]),
+        store_path=env["RAY_TPU_STORE_PATH"], node_id=env["RAY_TPU_NODE_ID"],
+        is_driver=False, worker_id=env["RAY_TPU_WORKER_ID"])
+    # Make the worker's core worker available to executing user code
+    # (ray_tpu.get/put/remote work inside tasks).
+    from ray_tpu._private import api_internal
+
+    api_internal.set_core_worker(cw)
+    try:
+        cw.execution_loop()
+    finally:
+        cw.shutdown()
+
+
+if __name__ == "__main__":
+    main()

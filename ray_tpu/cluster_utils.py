@@ -1,0 +1,83 @@
+"""Fake multi-node cluster on one machine — THE key test harness.
+
+Parity: reference python/ray/cluster_utils.py:108 (Cluster) — add_node:174
+spawns extra raylets (own object store, own resources) against one GCS;
+remove_node:247 SIGKILLs a raylet for failure testing. This is what makes
+spillback scheduling, cross-node object transfer, and node-death recovery
+testable without a real cluster (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import time
+
+import ray_tpu
+from ray_tpu._private.config import Config
+from ray_tpu._private.node import NodeHandle, RuntimeNode
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: dict | None = None,
+                 connect: bool = False, config: Config | None = None):
+        self._node = RuntimeNode(config)
+        self.gcs_address: str | None = None
+        self.head_node: NodeHandle | None = None
+        self.connected = False
+        if initialize_head:
+            host, port = self._node.start_gcs()
+            self.gcs_address = f"{host}:{port}"
+            self.head_node = self.add_node(**(head_node_args or {}), _head=True)
+            if connect:
+                self.connect()
+
+    def add_node(self, resources: dict | None = None, num_cpus: float | None = None,
+                 labels: dict | None = None, _head: bool = False) -> NodeHandle:
+        if self.gcs_address is None:
+            host, port = self._node.start_gcs()
+            self.gcs_address = f"{host}:{port}"
+            _head = True
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res.setdefault("CPU", num_cpus)
+        handle = self._node.start_raylet(resources=res or None, labels=labels,
+                                         is_head=_head)
+        if _head and self.head_node is None:
+            self.head_node = handle
+        return handle
+
+    def remove_node(self, node: NodeHandle, allow_graceful: bool = False) -> None:
+        node.kill()
+        if node in self._node.nodes:
+            self._node.nodes.remove(node)
+
+    def connect(self):
+        assert self.head_node is not None
+        ray_tpu.init(
+            address=self.gcs_address,
+            _head_raylet=(self.head_node.host, self.head_node.port),
+            _store_path=self.head_node.store_path,
+            _node_id=self.head_node.node_id,
+            config=self._node.config)
+        self.connected = True
+        return self
+
+    def wait_for_nodes(self, num_nodes: int | None = None, timeout: float = 30.0):
+        """Block until all started raylets are registered and alive in GCS."""
+        want = num_nodes if num_nodes is not None else len(self._node.nodes)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                alive = [n for n in ray_tpu.nodes() if n["alive"]]
+                if len(alive) >= want:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.05)
+        raise TimeoutError(f"cluster did not reach {want} alive nodes")
+
+    def shutdown(self):
+        if self.connected:
+            ray_tpu.shutdown()
+            self.connected = False
+        self._node.shutdown()

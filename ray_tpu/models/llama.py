@@ -1,0 +1,244 @@
+"""Llama-family decoder, TPU-first.
+
+The reference trains LLMs only through external torch engines (its release
+gates fine-tune GPT-J/vicuna via DeepSpeed/FSDP — reference:
+release/release_tests.yaml:879,:891); the model itself is not part of the
+framework. Here the flagship decoder IS part of the framework: flax.linen
+modules whose parameter names line up with
+`ray_tpu.parallel.TRANSFORMER_RULES` so TP/FSDP shardings apply by rule,
+attention goes through the Pallas flash kernel (`ray_tpu.ops`), and
+sequence parallelism swaps in ring attention under `shard_map`.
+
+Conventions: activations (batch, seq, d_model), attention internals
+(batch, heads, seq, head_dim), bfloat16 params optional, f32 RMSNorm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention, mha_reference, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # attention impl: "flash" (pallas), "ring" (sequence-parallel, inside
+    # shard_map over axis sp), "reference" (plain jnp)
+    attention: str = "flash"
+    remat: bool = True
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+LLAMA2_7B = LlamaConfig()
+LLAMA2_13B = LlamaConfig(d_model=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                         d_ff=13824)
+LLAMA3_8B = LlamaConfig(vocab_size=128256, d_model=4096, n_layers=32,
+                        n_heads=32, n_kv_heads=8, d_ff=14336,
+                        rope_theta=500000.0, max_seq_len=8192)
+TINY = LlamaConfig(vocab_size=256, d_model=128, n_layers=2, n_heads=4,
+                   n_kv_heads=4, d_ff=256, max_seq_len=256,
+                   dtype=jnp.float32, attention="reference", remat=False)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                       # (max_len, head_dim/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin, positions):
+    """x: (B, H, S, D). positions: (B, S) or (S,)."""
+    if positions.ndim == 1:
+        cos_p = cos[positions][None, None]
+        sin_p = sin[positions][None, None]
+    else:
+        cos_p = cos[positions][:, None]
+        sin_p = sin[positions][:, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos_p - x2 * sin_p,
+                           x2 * cos_p + x1 * sin_p], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                                  + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        dense = functools.partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                                  param_dtype=cfg.dtype)
+        q = dense(Hq * Dh, name="q_proj")(x).reshape(B, S, Hq, Dh)
+        k = dense(Hkv * Dh, name="k_proj")(x).reshape(B, S, Hkv, Dh)
+        v = dense(Hkv * Dh, name="v_proj")(x).reshape(B, S, Hkv, Dh)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # (B,H,S,D)
+
+        cos, sin = rope_frequencies(Dh, cfg.max_seq_len, cfg.rope_theta)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        new_cache = None
+        if kv_cache is not None:
+            # Decode step: append to cache (S == new tokens, typically 1).
+            ck, cv, cache_len = kv_cache
+            k = jax.lax.dynamic_update_slice_in_dim(ck, k, cache_len, axis=2)
+            v = jax.lax.dynamic_update_slice_in_dim(cv, v, cache_len, axis=2)
+            new_cache = (k, v, cache_len + S)
+
+        if Hkv != Hq:  # GQA: repeat kv heads
+            rep = Hq // Hkv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+
+        if kv_cache is not None:
+            # Decode attention over the cache with position masking.
+            total = k.shape[2]
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) / jnp.sqrt(Dh)
+            kpos = jnp.arange(total)[None, None, None, :]
+            qpos = positions[:, None, :, None] if positions.ndim == 2 \
+                else positions[None, None, :, None]
+            s = jnp.where(kpos <= qpos, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                             v.astype(jnp.float32)).astype(cfg.dtype)
+        elif cfg.attention == "flash":
+            out = flash_attention(q, k, v, None, True)
+        elif cfg.attention == "ring":
+            out = ring_attention(q, k, v, axis="sp", causal=True)
+        else:
+            out = mha_reference(q, k, v, causal=True)
+
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, Hq * Dh)
+        out = dense(cfg.d_model, name="o_proj")(out)
+        if kv_cache is not None:
+            return out, new_cache
+        return out
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = functools.partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                                  param_dtype=cfg.dtype)
+        gate = dense(cfg.d_ff, name="gate_proj")(x)
+        up = dense(cfg.d_ff, name="up_proj")(x)
+        return dense(cfg.d_model, name="down_proj")(nn.silu(gate) * up)
+
+
+class DecoderLayer(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None):
+        cfg = self.cfg
+        h = RMSNorm(cfg.rms_eps, name="input_norm")(x)
+        if kv_cache is not None:
+            attn, new_cache = Attention(cfg, name="attn")(h, positions, kv_cache)
+        else:
+            attn = Attention(cfg, name="attn")(h, positions)
+            new_cache = None
+        x = x + attn
+        h = RMSNorm(cfg.rms_eps, name="post_attn_norm")(x)
+        x = x + MLP(cfg, name="mlp")(h)
+        if kv_cache is not None:
+            return x, new_cache
+        return x
+
+
+class LlamaModel(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, kv_caches=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=cfg.dtype, name="embed")
+        x = embed(tokens)
+        layer_cls = DecoderLayer
+        if cfg.remat and kv_caches is None:
+            layer_cls = nn.remat(DecoderLayer, policy=jax.checkpoint_policies.nothing_saveable)
+        new_caches = []
+        for i in range(cfg.n_layers):
+            layer = layer_cls(cfg, name=f"layers_{i}")
+            if kv_caches is not None:
+                x, c = layer(x, positions, kv_caches[i])
+                new_caches.append(c)
+            else:
+                x = layer(x, positions)
+        x = RMSNorm(cfg.rms_eps, name="norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(cfg.dtype))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=cfg.dtype, name="lm_head")(x)
+        logits = logits.astype(jnp.float32)
+        if kv_caches is not None:
+            return logits, new_caches
+        return logits
+
+
+def init_kv_caches(cfg: LlamaConfig, batch: int, max_len: int):
+    Dh = cfg.head_dim
+    return [(jnp.zeros((batch, cfg.n_kv_heads, max_len, Dh), cfg.dtype),
+             jnp.zeros((batch, cfg.n_kv_heads, max_len, Dh), cfg.dtype), 0)
+            for _ in range(cfg.n_layers)]
+
+
+def cross_entropy_loss(logits, targets, mask=None):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1)
+    return nll.mean()
+
+
+def count_flops_per_token(cfg: LlamaConfig) -> float:
+    """Approximate forward+backward FLOPs per token (6·N + attention)."""
+    n = (cfg.vocab_size * cfg.d_model * (1 if cfg.tie_embeddings else 2)
+         + cfg.n_layers * (
+             cfg.d_model * cfg.head_dim * (cfg.n_heads + 2 * cfg.n_kv_heads)
+             + cfg.n_heads * cfg.head_dim * cfg.d_model
+             + 3 * cfg.d_model * cfg.d_ff))
+    return 6.0 * n

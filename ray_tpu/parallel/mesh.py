@@ -1,0 +1,100 @@
+"""Device-mesh construction for SPMD parallelism.
+
+The reference has no native notion of a device mesh — its parallelism is
+orchestration of torch engines (SURVEY.md §2.4). Here the mesh is the
+foundation: every training/inference program runs under one
+`jax.sharding.Mesh` whose named axes carry the parallelism taxonomy:
+
+  dp    data parallel (replicated params, sharded batch)
+  fsdp  fully-sharded data parallel (ZeRO: params/opt-state sharded too)
+  tp    tensor parallel (Megatron-style intra-layer sharding)
+  pp    pipeline parallel (stage axis, ppermute microbatch schedule)
+  sp    sequence/context parallel (ring attention / Ulysses)
+  ep    expert parallel (MoE expert sharding + ragged all-to-all)
+
+Axis sizes multiply to the device count. On TPU pods the mesh should be
+built with ICI-contiguous axis ordering (innermost axes get the
+fastest-wraparound ICI dimension); `jax.experimental.mesh_utils` handles
+the physical layout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+@dataclass
+class MeshConfig:
+    """Logical mesh shape. -1 for at most one axis: absorb remaining devices."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolved(self, num_devices: int) -> dict[str, int]:
+        sizes = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+                 "sp": self.sp, "ep": self.ep, "tp": self.tp}
+        unknown = [k for k, v in sizes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        known = int(np.prod([v for v in sizes.values() if v != -1]))
+        if unknown:
+            if num_devices % known != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes {known}")
+            sizes[unknown[0]] = num_devices // known
+        total = int(np.prod(list(sizes.values())))
+        if total != num_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices, have {num_devices}")
+        return sizes
+
+
+def make_mesh(config: MeshConfig | dict | None = None,
+              devices=None) -> Mesh:
+    """Build a Mesh with the standard axis names over the given devices."""
+    if devices is None:
+        devices = jax.devices()
+    if config is None:
+        config = MeshConfig(dp=len(devices))
+    if isinstance(config, dict):
+        config = MeshConfig(**config)
+    sizes = config.resolved(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=np.asarray(devices))
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def data_axes() -> tuple[str, ...]:
+    """Axes the global batch is sharded over."""
+    return ("dp", "fsdp")
+
+
+def mesh_axis_size(mesh: Mesh, axes: tuple[str, ...]) -> int:
+    return int(np.prod([mesh.shape[a] for a in axes]))
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> None:
+    """Multi-host rendezvous: `jax.distributed.initialize` (replaces the
+    reference's torch.distributed/NCCL bootstrap in Train,
+    reference: python/ray/train/torch/config.py:63)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)

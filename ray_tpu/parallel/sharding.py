@@ -1,0 +1,111 @@
+"""Sharding rules: map parameter pytrees to PartitionSpecs.
+
+The reference reaches TP/ZeRO only through external engines
+(SURVEY.md §2.4 — FSDP via torch, DeepSpeed configs); here sharding is
+native: regex rules over pytree paths produce `PartitionSpec`s, GSPMD
+inserts the collectives. ZeRO-3 "falls out": sharding params and optimizer
+state over ('fsdp',) is exactly sharded-DP, no wrapper engine needed.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+
+def path_str(path) -> str:
+    """Stringify a jax tree path: ('layers', 0, 'attn', 'q') → 'layers/0/attn/q'."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins.
+
+    Mirrors the t5x/flax partitioning idiom (public pattern; not in the
+    reference, which has no native sharding system).
+    """
+
+    def __init__(self, rules: list[tuple[str, PartitionSpec]],
+                 default: PartitionSpec = P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def spec_for(self, path, leaf=None) -> PartitionSpec:
+        s = path_str(path)
+        for pat, spec in self.rules:
+            if pat.search(s):
+                return _clip_spec(spec, leaf)
+        return _clip_spec(self.default, leaf)
+
+    def tree_specs(self, tree) -> Any:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.spec_for(path, leaf), tree)
+
+    def tree_shardings(self, mesh: Mesh, tree) -> Any:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: NamedSharding(mesh, self.spec_for(path, leaf)),
+            tree)
+
+
+def _clip_spec(spec: PartitionSpec, leaf) -> PartitionSpec:
+    """Drop trailing spec entries that exceed the leaf's rank."""
+    if leaf is None or not hasattr(leaf, "ndim"):
+        return spec
+    entries = tuple(spec)
+    if len(entries) <= leaf.ndim:
+        return spec
+    return PartitionSpec(*entries[: leaf.ndim])
+
+
+# Default rule set for transformer decoders (llama-style naming in
+# ray_tpu.models): TP shards attention heads + MLP hidden, FSDP shards the
+# other dimension of each matrix (ZeRO), embeddings shard vocab over tp.
+TRANSFORMER_RULES = ShardingRules([
+    (r"embed/embedding", P("tp", "fsdp")),
+    (r"(q_proj|k_proj|v_proj)/kernel", P("fsdp", "tp")),
+    (r"o_proj/kernel", P("tp", "fsdp")),
+    (r"(gate_proj|up_proj)/kernel", P("fsdp", "tp")),
+    (r"down_proj/kernel", P("tp", "fsdp")),
+    (r"lm_head/kernel", P("fsdp", "tp")),
+    (r"(norm|ln|scale|bias)", P()),
+], default=P())
+
+
+def batch_spec(extra_dims: int = 1) -> PartitionSpec:
+    """Global-batch sharding: batch over (dp, fsdp), sequence over sp."""
+    return P(("dp", "fsdp"), "sp", *([None] * (extra_dims - 1)))
+
+
+def shard_tree(mesh: Mesh, tree, rules: ShardingRules):
+    """Device-put a pytree with rule-derived shardings."""
+    shardings = rules.tree_shardings(mesh, tree)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)
+
+
+def with_rules_constraint(tree, rules: ShardingRules):
+    """Apply with_sharding_constraint per rule inside jit."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jax.lax.with_sharding_constraint(
+            leaf, rules.spec_for(path, leaf)),
+        tree)
+
+
+def num_params(tree) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "shape"))

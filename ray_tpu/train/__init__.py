@@ -1,0 +1,23 @@
+from ray_tpu.train import session
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.spmd import (
+    TrainState,
+    init_sharded_state,
+    make_train_step,
+    shard_train_step,
+    state_specs_from_rules,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result
+
+__all__ = [
+    "JaxTrainer", "Result", "ScalingConfig", "RunConfig", "CheckpointConfig",
+    "FailureConfig", "Checkpoint", "CheckpointManager", "session",
+    "TrainState", "make_train_step", "shard_train_step", "init_sharded_state",
+    "state_specs_from_rules",
+]

@@ -1,0 +1,125 @@
+"""Checkpoint abstraction over orbax.
+
+Parity: reference python/ray/air/checkpoint.py (dir/dict Checkpoint) +
+train/_internal/storage.py (persistent storage). TPU-native: pytrees are
+written with orbax (async-capable, sharding-aware restore for SPMD states).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any
+
+try:
+    import orbax.checkpoint as ocp
+
+    _HAS_ORBAX = True
+except Exception:  # pragma: no cover
+    _HAS_ORBAX = False
+
+import jax
+
+
+class Checkpoint:
+    """A directory-backed checkpoint with optional pytree payload."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_pytree(cls, tree: Any, path: str | None = None,
+                    metrics: dict | None = None) -> "Checkpoint":
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        save_pytree(tree, os.path.join(path, "state"))
+        if metrics is not None:
+            with open(os.path.join(path, "metrics.json"), "w") as f:
+                json.dump(metrics, f)
+        return cls(path)
+
+    def to_pytree(self, template: Any | None = None) -> Any:
+        return restore_pytree(os.path.join(self.path, "state"), template)
+
+    def metrics(self) -> dict:
+        p = os.path.join(self.path, "metrics.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    if _HAS_ORBAX:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, tree)
+    else:  # pragma: no cover
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "tree.pkl"), "wb") as f:
+            pickle.dump(jax.device_get(tree), f)
+
+
+def restore_pytree(path: str, template: Any | None = None) -> Any:
+    path = os.path.abspath(path)
+    if _HAS_ORBAX:
+        ckptr = ocp.PyTreeCheckpointer()
+        if template is not None:
+            return ckptr.restore(path, item=template)
+        return ckptr.restore(path)
+    else:  # pragma: no cover
+        import pickle
+
+        with open(os.path.join(path, "tree.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+class CheckpointManager:
+    """Keeps the latest-k checkpoints under a run directory
+    (parity: train checkpoint manager + Tune trial checkpointing)."""
+
+    def __init__(self, root: str, num_to_keep: int | None = None):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self._index = 0
+
+    def save(self, tree: Any, metrics: dict | None = None) -> Checkpoint:
+        self._index += 1
+        path = os.path.join(self.root, f"checkpoint_{self._index:06d}")
+        ckpt = Checkpoint.from_pytree(tree, path, metrics)
+        self._gc()
+        return ckpt
+
+    def latest(self) -> Checkpoint | None:
+        cs = self.list()
+        return cs[-1] if cs else None
+
+    def list(self) -> list[Checkpoint]:
+        names = sorted(n for n in os.listdir(self.root)
+                       if n.startswith("checkpoint_"))
+        return [Checkpoint(os.path.join(self.root, n)) for n in names]
+
+    def _gc(self) -> None:
+        if self.num_to_keep is None:
+            return
+        cs = self.list()
+        while len(cs) > self.num_to_keep:
+            shutil.rmtree(cs.pop(0).path, ignore_errors=True)

@@ -1,0 +1,66 @@
+"""Train/AIR-style configuration dataclasses.
+
+Parity: reference python/ray/air/config.py — ScalingConfig:94,
+RunConfig:723, CheckpointConfig:574, FailureConfig:523. TPU-first change:
+ScalingConfig speaks chips/hosts and ICI topology instead of GPUs, and
+carries the SPMD mesh shape (which the reference cannot express at all).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers, what resources, and (TPU-first) the mesh.
+
+    num_workers: worker processes (one per TPU host for multi-host SPMD).
+    use_tpu: schedule each worker with `tpu_chips_per_worker` TPU chips.
+    mesh: logical mesh axis sizes for the in-worker SPMD program
+      (dp/fsdp/tp/pp/sp/ep), passed to ray_tpu.parallel.make_mesh.
+    placement_strategy: PACK/SPREAD/STRICT_PACK/STRICT_SPREAD/STRICT_ICI —
+      STRICT_ICI gang-places all workers on one ICI-connected slice.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpu_chips_per_worker: int = 4
+    resources_per_worker: dict | None = None
+    mesh: dict | None = None
+    placement_strategy: str = "PACK"
+    trainer_resources: dict | None = None
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker or {})
+        if self.use_tpu:
+            res.setdefault("TPU", float(self.tpu_chips_per_worker))
+            res.setdefault("CPU", 1.0)
+        else:
+            res.setdefault("CPU", 1.0)
+        return res
+
+    def as_placement_group_bundles(self) -> list[dict]:
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = True
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    verbose: int = 1

@@ -1,0 +1,86 @@
+"""Per-worker training session: report() / rank info / gradient sync.
+
+Parity: reference python/ray/train/_internal/session.py:132 (_TrainSession;
+session.report streams metrics+checkpoints to the trainer) and
+train/train_loop_utils.py (prepare_model/prepare_data_loader — here the
+TPU-native equivalents are mesh/sharding helpers plus a host-plane gradient
+allreduce for multi-process data parallelism).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+_local = threading.local()
+
+
+@dataclass
+class _Session:
+    rank: int
+    world_size: int
+    report_queue: "queue.Queue"
+    collective_group: str | None = None
+
+
+def _set_session(s: _Session | None) -> None:
+    _local.session = s
+
+
+def _get_session() -> _Session:
+    s = getattr(_local, "session", None)
+    if s is None:
+        raise RuntimeError(
+            "No active train session: this API must be called inside "
+            "train_loop_per_worker")
+    return s
+
+
+def report(metrics: dict, checkpoint=None) -> None:
+    """Stream metrics (and optionally a Checkpoint) to the trainer."""
+    s = _get_session()
+    payload = {"metrics": dict(metrics), "rank": s.rank}
+    if checkpoint is not None:
+        payload["checkpoint_path"] = checkpoint.path
+    s.report_queue.put(payload)
+
+
+def get_world_rank() -> int:
+    return _get_session().rank
+
+
+def get_world_size() -> int:
+    return _get_session().world_size
+
+
+def get_local_rank() -> int:
+    return _get_session().rank  # one worker per host in this topology
+
+
+def set_collective_group(name: str) -> None:
+    _get_session().collective_group = name
+
+
+def allreduce_gradients(grads, group_name: str | None = None):
+    """Host-plane gradient mean across train workers (the CPU/DP path —
+    the reference's gloo DDP equivalent). On a TPU pod, prefer compiling
+    dp into the mesh instead; this exists for multi-process CPU training
+    and cross-slice DCN averaging."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.util.collective import allreduce
+
+    s = _get_session()
+    group = group_name or s.collective_group
+    if group is None or s.world_size == 1:
+        return grads
+    flat, treedef = jax.tree_util.tree_flatten(grads)
+    out = []
+    for g in flat:
+        arr = np.asarray(g, dtype=np.float32)
+        red = allreduce(arr, group_name=group) / s.world_size
+        out.append(red.astype(np.asarray(g).dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,108 @@
+"""SPMD training-step construction: pjit over a named mesh.
+
+This replaces the reference's torch DDP/FSDP inner loop (reference:
+python/ray/train/torch/train_loop_utils.py:74 prepare_model — DDP wrapper;
+:24,:91 FSDP) with one compiled program: shardings come from rules
+(ZeRO/TP), XLA inserts the collectives, the optimizer update runs sharded.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.sharding import ShardingRules, TRANSFORMER_RULES
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.params, s.opt_state, s.step), None),
+    lambda _, c: TrainState(*c))
+
+
+def make_train_step(loss_fn: Callable, optimizer: optax.GradientTransformation):
+    """loss_fn(params, batch) -> scalar loss. Returns step(state, batch)."""
+
+    def train_step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(new_params, new_opt, state.step + 1), {
+            "loss": loss, "step": state.step + 1}
+
+    return train_step
+
+
+def shard_train_step(train_step: Callable, mesh: Mesh, state_specs,
+                     batch_spec) -> Callable:
+    """jit the step with input/output shardings pinned to the mesh."""
+    state_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), state_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    batch_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), batch_spec,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.jit(
+        train_step,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=(state_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,))
+
+
+def state_specs_from_rules(state: TrainState, rules: ShardingRules):
+    """PartitionSpecs for TrainState: params by rules; optimizer state
+    inherits each param's spec (ZeRO — optimizer shards like its param);
+    scalars replicated."""
+    param_specs = rules.tree_specs(state.params)
+
+    param_spec_map = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]:
+        param_spec_map[_shape_key(leaf)] = rules.spec_for(path, leaf)
+
+    def opt_spec(path, leaf):
+        if hasattr(leaf, "shape") and leaf.ndim > 0:
+            return param_spec_map.get(_shape_key(leaf), P())
+        return P()
+
+    opt_specs = jax.tree_util.tree_map_with_path(opt_spec, state.opt_state)
+    return TrainState(param_specs, opt_specs, P())
+
+
+def _shape_key(leaf):
+    return tuple(leaf.shape) if hasattr(leaf, "shape") else ()
+
+
+def init_sharded_state(mesh: Mesh, init_fn: Callable, rules: ShardingRules,
+                       optimizer: optax.GradientTransformation,
+                       *init_args) -> tuple[TrainState, Any]:
+    """Initialize params/opt-state directly with sharded layouts (params are
+    created on-device already partitioned — no host round-trip)."""
+
+    def build():
+        params = init_fn(*init_args)
+        opt_state = optimizer.init(params)
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+    abstract = jax.eval_shape(build)
+    specs = state_specs_from_rules(abstract, rules)
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    state = jax.jit(build, out_shardings=shardings)()
+    return state, specs

@@ -1,0 +1,117 @@
+"""JaxTrainer: the Train entry point.
+
+Parity: reference python/ray/train/data_parallel_trainer.py:59
+(DataParallelTrainer.fit → BackendExecutor → WorkerGroup → per-worker
+session) and base_trainer.py:608 (fit). The torch backend's
+`dist.init_process_group(nccl)` (reference: train/torch/config.py:63)
+becomes: (a) a host-plane collective group for multi-process DP, and
+(b) on TPU pods, `jax.distributed.initialize` coordinator env wiring so
+every worker joins one multi-host SPMD program.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+@dataclass
+class Result:
+    """Parity: ray.air.result.Result."""
+
+    metrics: dict
+    checkpoint: Checkpoint | None
+    error: str | None
+    metrics_history: list = field(default_factory=list)
+
+    @property
+    def best_checkpoint(self):
+        return self.checkpoint
+
+
+class JaxTrainer:
+    """Runs `train_loop_per_worker` on a gang of workers.
+
+    collective_backend: "cpu" (host-plane allreduce group, the gloo-DDP
+    analog) or "xla" (workers form one multi-host jax.distributed world;
+    each worker then compiles the SPMD step over the global mesh) or None.
+    """
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: dict | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 collective_backend: str | None = "cpu"):
+        self._train_loop = train_loop_per_worker
+        self._config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.collective_backend = collective_backend
+
+    def fit(self) -> Result:
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        while True:
+            try:
+                return self._fit_once()
+            except exc.RayTpuError as e:
+                attempt += 1
+                if attempt > max_failures:
+                    raise
+                time.sleep(1.0)
+
+    def _fit_once(self) -> Result:
+        run_id = uuid.uuid4().hex[:8]
+        group = WorkerGroup(self.scaling_config)
+        try:
+            if self.collective_backend and self.scaling_config.num_workers > 1:
+                group_name = f"train:{run_id}"
+                group.run_on_all("setup_collective", group_name,
+                                 self.collective_backend)
+                cfg = dict(self._config)
+                cfg["_collective_group"] = group_name
+            else:
+                cfg = dict(self._config)
+            blob = serialization.dumps_func(self._train_loop)
+            group.run_on_all("run", blob, cfg)
+            return self._drive(group)
+        finally:
+            group.shutdown()
+
+    def _drive(self, group: WorkerGroup) -> Result:
+        """Poll workers, surface rank-0 reports (reference:
+        TrainingIterator in data_parallel_trainer.py:429)."""
+        history: list[dict] = []
+        last_ckpt: Checkpoint | None = None
+        done = [False] * len(group.workers)
+        error: str | None = None
+        final_metrics: dict = {}
+        while not all(done):
+            polls = ray_tpu.get(
+                [w.poll.remote() for w in group.workers], timeout=300)
+            for i, p in enumerate(polls):
+                for rep in p["reports"]:
+                    if rep["rank"] == 0:
+                        history.append(rep["metrics"])
+                        final_metrics = rep["metrics"]
+                        if rep.get("checkpoint_path"):
+                            last_ckpt = Checkpoint(rep["checkpoint_path"])
+                if p["done"]:
+                    done[i] = True
+                    if p["error"] and error is None:
+                        error = f"worker {i}: {p['error']}"
+            if error:
+                raise exc.RayTpuError(f"training failed: {error}")
+            if not all(done):
+                time.sleep(0.05)
+        return Result(metrics=final_metrics, checkpoint=last_ckpt,
+                      error=None, metrics_history=history)

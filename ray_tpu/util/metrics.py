@@ -1,0 +1,114 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Parity: reference python/ray/util/metrics.py:19. The reference exports via
+OpenCensus → node metrics agent → Prometheus; here metrics publish to the
+GCS KV (namespace "metrics") so any process (dashboard-lite, tests, a
+Prometheus bridge) can scrape one place.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+
+from ray_tpu._private.api_internal import core_worker_or_none
+
+_registry_lock = threading.Lock()
+_registry: dict[str, "Metric"] = {}
+_last_flush = [0.0]
+_FLUSH_INTERVAL_S = 1.0
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: tuple[str, ...] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict[str, str] = {}
+        self._values: dict[tuple, float] = {}
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tag_tuple(self, tags: dict[str, str] | None) -> tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+    def _flush_maybe(self):
+        now = time.monotonic()
+        if now - _last_flush[0] < _FLUSH_INTERVAL_S:
+            return
+        _last_flush[0] = now
+        cw = core_worker_or_none()
+        if cw is None or cw.gcs is None or cw.gcs.closed:
+            return
+        with _registry_lock:
+            snapshot = {name: m.snapshot() for name, m in _registry.items()}
+        try:
+            cw._spawn(cw.gcs.call("KVPut", {
+                "ns": "metrics",
+                "key": f"worker:{cw.worker_id}".encode(),
+                "value": json.dumps(snapshot).encode()}))
+        except Exception:
+            pass
+
+    def snapshot(self) -> dict:
+        return {"type": type(self).__name__, "description": self.description,
+                "values": {json.dumps(k): v for k, v in self._values.items()}}
+
+
+class Counter(Metric):
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        key = self._tag_tuple(tags)
+        self._values[key] = self._values.get(key, 0.0) + value
+        self._flush_maybe()
+
+
+class Gauge(Metric):
+    def set(self, value: float, tags: dict | None = None):
+        self._values[self._tag_tuple(tags)] = value
+        self._flush_maybe()
+
+
+class Histogram(Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: list[float] | None = None,
+                 tag_keys: tuple[str, ...] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or [0.1, 1, 10, 100, 1000])
+        self._counts: dict[tuple, list[int]] = {}
+
+    def observe(self, value: float, tags: dict | None = None):
+        key = self._tag_tuple(tags)
+        counts = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
+        counts[bisect.bisect_left(self.boundaries, value)] += 1
+        self._values[key] = value  # last observation
+        self._flush_maybe()
+
+    def snapshot(self) -> dict:
+        base = super().snapshot()
+        base["boundaries"] = self.boundaries
+        base["counts"] = {json.dumps(k): v for k, v in self._counts.items()}
+        return base
+
+
+def get_metrics_snapshot() -> dict:
+    """Read all published metrics from the GCS (one entry per worker)."""
+    from ray_tpu._private.api_internal import get_core_worker
+
+    cw = get_core_worker()
+    keys = cw._run(cw.gcs.call("KVKeys", {"ns": "metrics", "prefix": b""}))["keys"]
+    out = {}
+    for k in keys:
+        v = cw._run(cw.gcs.call("KVGet", {"ns": "metrics", "key": k}))["value"]
+        if v:
+            out[k.decode()] = json.loads(v)
+    return out

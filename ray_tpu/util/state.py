@@ -1,0 +1,79 @@
+"""State observability API: list/summarize cluster entities.
+
+Parity: reference python/ray/experimental/state/api.py (`ray list
+tasks/actors/objects/nodes/...`, `ray summary`), backed by the GCS task
+manager (reference: gcs_task_manager.cc) and node/actor tables.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from ray_tpu._private.api_internal import get_core_worker
+
+
+def list_nodes() -> list[dict]:
+    cw = get_core_worker()
+    return cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"]
+
+
+def list_actors() -> list[dict]:
+    cw = get_core_worker()
+    return cw._run(cw.gcs.call("ListActors", {}))["actors"]
+
+
+def list_jobs() -> list[dict]:
+    cw = get_core_worker()
+    return cw._run(cw.gcs.call("ListJobs", {}))["jobs"]
+
+
+def list_placement_groups() -> list[dict]:
+    cw = get_core_worker()
+    return cw._run(cw.gcs.call("ListPlacementGroups", {}))["placement_groups"]
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    """Latest known state per task, from the GCS task-event buffer."""
+    cw = get_core_worker()
+    events = cw._run(cw.gcs.call("ListTaskEvents", {"limit": limit * 4}))["events"]
+    latest: dict[str, dict] = {}
+    for e in events:
+        latest[e["task_id"]] = e
+    return list(latest.values())[-limit:]
+
+
+def list_objects() -> list[dict]:
+    """Objects owned by the calling process (cluster-wide listing requires
+    per-raylet scans; see `summarize_objects`)."""
+    cw = get_core_worker()
+    out = []
+    for oid_hex, o in cw.objects.items():
+        out.append({
+            "object_id": oid_hex,
+            "state": o.state,
+            "size": o.size,
+            "locations": sorted(o.locations),
+            "inline": o.inline is not None,
+            "local_refs": o.local_refs,
+            "submitted_refs": o.submitted_refs,
+        })
+    return out
+
+
+def summarize_tasks() -> dict:
+    by_state = Counter()
+    by_name = Counter()
+    for t in list_tasks(limit=100000):
+        by_state[t["state"]] += 1
+        by_name[t["name"]] += 1
+    return {"by_state": dict(by_state), "by_name": dict(by_name)}
+
+
+def summarize_actors() -> dict:
+    by_state = Counter(a["state"] for a in list_actors())
+    return {"by_state": dict(by_state)}
+
+
+def cluster_status() -> dict:
+    cw = get_core_worker()
+    return cw._run(cw.gcs.call("GetClusterStatus", {}))

@@ -63,9 +63,11 @@ enum : int {
 struct ObjectEntry {
   uint8_t id[kIdSize];
   uint32_t state;
-  uint64_t offset;     // data offset from arena base
-  uint64_t size;       // allocated payload size
-  uint64_t meta_size;  // leading metadata bytes within payload
+  uint64_t offset;      // data offset from arena base
+  uint64_t size;        // payload size visible to readers
+  uint64_t alloc_size;  // actual heap bytes reserved (>= size; the
+                        // allocator may absorb an unsplittable remainder)
+  uint64_t meta_size;   // leading metadata bytes within payload
   int32_t refcount;
   uint32_t _pad;
   uint64_t lru_tick;
@@ -115,10 +117,13 @@ static uint64_t hash_id(const uint8_t* id) {
 static void lock(Store* s) {
   int rc = pthread_mutex_lock(&s->hdr->mutex);
   if (rc == EOWNERDEAD) {
-    // A worker died holding the lock; state under the lock is protected by
-    // each operation being small + idempotent enough for our use. Mark
-    // consistent and continue (reference handles worker death by raylet
-    // disconnect cleanup; here the robust mutex is the survival mechanism).
+    // A worker died holding the lock. Marking consistent lets survivors
+    // continue, but free-list splicing is multi-step: a death mid-splice
+    // can leave a corrupt chain. KNOWN LIMITATION — crash consistency
+    // needs a redo log or an allocation journal (the reference sidesteps
+    // this by funneling all mutations through the single raylet-hosted
+    // store thread). Until then the raylet treats repeated allocator
+    // faults as grounds to recreate the arena.
     pthread_mutex_consistent(&s->hdr->mutex);
   }
 }
@@ -154,7 +159,9 @@ static ObjectEntry* table_find(Store* s, const uint8_t* id, ObjectEntry** insert
 
 // ---- heap ----
 
-static uint64_t heap_alloc(Store* s, uint64_t size) {
+// Allocates >= size bytes; writes the ACTUAL reserved byte count (which the
+// caller must pass back to heap_free) to *actual.
+static uint64_t heap_alloc(Store* s, uint64_t size, uint64_t* actual) {
   size = align_up(size < kAlign ? kAlign : size, kAlign);
   uint64_t prev_off = kNullOffset;
   uint64_t off = s->hdr->free_head;
@@ -180,6 +187,7 @@ static uint64_t heap_alloc(Store* s, uint64_t size) {
         reinterpret_cast<FreeBlock*>(s->base + prev_off)->next = next;
       }
       s->hdr->bytes_in_use += size;
+      *actual = size;
       return off;
     }
     prev_off = off;
@@ -189,7 +197,7 @@ static uint64_t heap_alloc(Store* s, uint64_t size) {
 }
 
 static void heap_free(Store* s, uint64_t off, uint64_t size) {
-  size = align_up(size < kAlign ? kAlign : size, kAlign);
+  // `size` is the exact reserved size returned by heap_alloc via *actual.
   s->hdr->bytes_in_use -= size;
   // Insert address-ordered, coalescing with neighbors.
   uint64_t prev_off = kNullOffset;
@@ -224,6 +232,9 @@ static void heap_free(Store* s, uint64_t off, uint64_t size) {
 
 // Evict LRU sealed refcount==0 objects until `needed` bytes could plausibly
 // be allocated. Returns number of objects evicted.
+// PERF: O(table_capacity) scan per victim under the global lock; an
+// intrusive LRU list (reference: eviction_policy.h) is the planned upgrade
+// if eviction shows up in node-level profiles.
 static int evict_lru(Store* s, uint64_t needed) {
   int evicted = 0;
   for (;;) {
@@ -245,7 +256,7 @@ static int evict_lru(Store* s, uint64_t needed) {
       }
     }
     if (!victim) return evicted;
-    heap_free(s, victim->offset, victim->size);
+    heap_free(s, victim->offset, victim->alloc_size);
     victim->state = kStateTombstone;
     s->hdr->num_objects--;
     s->hdr->num_evictions++;
@@ -278,6 +289,12 @@ void* store_create_arena(const char* path, uint64_t arena_size, uint32_t table_c
 
   uint64_t table_off = align_up(sizeof(StoreHeader), kAlign);
   uint64_t heap_off = align_up(table_off + (uint64_t)table_capacity * sizeof(ObjectEntry), kAlign);
+  if (heap_off + kAlign > arena_size) {
+    // Arena too small for header + table + any heap at all.
+    munmap(mem, arena_size);
+    delete s;
+    return nullptr;
+  }
 
   s->hdr->magic = kMagic;
   s->hdr->arena_size = arena_size;
@@ -354,10 +371,11 @@ int store_create(void* handle, const uint8_t* id, uint64_t data_size, uint64_t m
     unlock(s);
     return kErrTableFull;
   }
-  uint64_t off = heap_alloc(s, data_size);
+  uint64_t actual = 0;
+  uint64_t off = heap_alloc(s, data_size, &actual);
   if (off == kNullOffset) {
     evict_lru(s, data_size);
-    off = heap_alloc(s, data_size);
+    off = heap_alloc(s, data_size, &actual);
   }
   if (off == kNullOffset) {
     unlock(s);
@@ -367,6 +385,7 @@ int store_create(void* handle, const uint8_t* id, uint64_t data_size, uint64_t m
   slot->state = kStateCreated;
   slot->offset = off;
   slot->size = data_size;
+  slot->alloc_size = actual;
   slot->meta_size = meta_size;
   slot->refcount = 1;  // creator holds a ref until seal+release
   slot->lru_tick = ++s->hdr->lru_tick;
@@ -455,7 +474,7 @@ int store_delete(void* handle, const uint8_t* id, int force) {
     unlock(s);
     return kErrInUse;
   }
-  heap_free(s, e->offset, e->size);
+  heap_free(s, e->offset, e->alloc_size);
   e->state = kStateTombstone;
   s->hdr->num_objects--;
   unlock(s);
@@ -471,7 +490,7 @@ int store_abort(void* handle, const uint8_t* id) {
     unlock(s);
     return kErrNotFound;
   }
-  heap_free(s, e->offset, e->size);
+  heap_free(s, e->offset, e->alloc_size);
   e->state = kStateTombstone;
   s->hdr->num_objects--;
   unlock(s);

@@ -1,0 +1,71 @@
+"""Shared fixtures (parity: reference python/ray/tests/conftest.py
+ray_start_regular:410 / ray_start_cluster:491 fixture tiers).
+
+JAX-dependent tests run against a virtual 8-device CPU mesh — the "fake
+backend" for SPMD logic (SURVEY.md §4 rebuild guidance).
+"""
+
+import os
+
+# Must be set before jax import (any test importing jax sees 8 CPU devices).
+# Hard overrides: the machine env pins JAX_PLATFORMS to the real TPU tunnel,
+# but tests always run on the virtual CPU mesh.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import pytest  # noqa: E402
+
+try:
+    import jax
+
+    # The machine image force-registers the 'axon' TPU platform via config
+    # (env JAX_PLATFORMS is ignored); override back to the CPU fake backend.
+    jax.config.update("jax_platforms", "cpu")
+    # Deterministic, tight-tolerance numerics for kernel-correctness tests
+    # on the CPU fake backend (default CPU matmul precision is loose).
+    jax.config.update("jax_default_matmul_precision", "highest")
+except ImportError:
+    pass
+
+import ray_tpu  # noqa: E402
+from ray_tpu._private.config import Config  # noqa: E402
+
+
+def _fast_config() -> Config:
+    cfg = Config()
+    cfg.health_check_period_s = 0.2
+    cfg.num_heartbeats_timeout = 5
+    cfg.worker_lease_timeout_s = 10.0
+    cfg.object_store_memory = 64 * 1024 * 1024
+    return cfg
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Single-node cluster, 4 CPUs."""
+    ray_tpu.init(num_cpus=4, config=_fast_config())
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Bare Cluster factory; test adds nodes itself."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=False, config=_fast_config())
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster_head():
+    """Cluster with a 2-CPU head node, connected."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, connect=True,
+                      head_node_args={"num_cpus": 2}, config=_fast_config())
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,150 @@
+"""Actor tests (parity: reference python/ray/tests/test_actor.py family)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def fail(self):
+        raise RuntimeError("actor method failed")
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.value.remote()) == 6
+
+
+def test_actor_constructor_args(ray_start_regular):
+    c = Counter.remote(start=100)
+    assert ray_tpu.get(c.value.remote()) == 100
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    # Ordered execution: results must be 1..20 in submission order.
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_method_exception(ray_start_regular):
+    c = Counter.remote()
+    with pytest.raises(exc.TaskError, match="actor method failed"):
+        ray_tpu.get(c.fail.remote())
+    # Actor still alive afterwards.
+    assert ray_tpu.get(c.incr.remote()) == 1
+
+
+def test_two_actors_independent(ray_start_regular):
+    a, b = Counter.remote(), Counter.remote(start=10)
+    ray_tpu.get([a.incr.remote(), b.incr.remote()])
+    assert ray_tpu.get(a.value.remote()) == 1
+    assert ray_tpu.get(b.value.remote()) == 11
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote(start=7)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.value.remote()) == 7
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="ga", get_if_exists=True).remote(start=1)
+    b = Counter.options(name="ga", get_if_exists=True).remote(start=999)
+    ray_tpu.get(a.incr.remote())
+    assert ray_tpu.get(b.value.remote()) == 2  # same actor
+
+
+def test_duplicate_name_rejected(ray_start_regular):
+    Counter.options(name="dup").remote()
+    time.sleep(0.1)
+    with pytest.raises(exc.RayTpuError, match="already taken"):
+        Counter.options(name="dup").remote()
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.value.remote()) == 0
+    ray_tpu.kill(c)
+    with pytest.raises(exc.ActorError):
+        ray_tpu.get(c.value.remote())
+
+
+def test_actor_constructor_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("bad init")
+
+        def m(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(exc.ActorError):
+        ray_tpu.get(b.m.remote())
+
+
+def test_actor_handle_passed_to_task(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote(10))
+
+    assert ray_tpu.get(bump.remote(c)) == 10
+    assert ray_tpu.get(c.value.remote()) == 10
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class Flaky:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    f = Flaky.remote()
+    pid1 = ray_tpu.get(f.pid.remote())
+    try:
+        ray_tpu.get(f.die.remote())
+    except exc.RayTpuError:
+        pass
+    # Restarted actor: state reset, new process.
+    deadline = time.time() + 30
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(f.pid.remote(), timeout=10)
+            break
+        except exc.RayTpuError:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+    assert ray_tpu.get(f.incr.remote()) == 1

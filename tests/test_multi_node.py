@@ -1,0 +1,122 @@
+"""Multi-node semantics via the fake cluster: spillback scheduling,
+cross-node object transfer, node death.
+
+Parity: reference python/ray/tests/test_multi_node*.py +
+test_object_reconstruction* over cluster_utils.Cluster.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_two_nodes_spillback(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        # Long enough that all 5 leases are concurrently occupied even with
+        # multi-second worker cold-starts, so lease reuse can't serialize
+        # everything through one node.
+        time.sleep(5)
+        return ray_tpu.get_runtime_context().node_id
+
+    # 5 concurrent 1-CPU tasks on a 1+4 CPU cluster must use both nodes.
+    refs = [where.remote() for _ in range(5)]
+    nodes = set(ray_tpu.get(refs, timeout=120))
+    assert len(nodes) == 2
+
+
+def test_cross_node_object_transfer(ray_start_cluster_head):
+    cluster = ray_start_cluster_head
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=2)
+    def produce():
+        return np.full((512, 1024), 7.0)  # 4MB, lands in producer's store
+
+    @ray_tpu.remote(num_cpus=2)
+    def consume(arr):
+        return float(arr.sum())
+
+    # Force produce and consume onto different nodes by saturating each.
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=30)
+    total = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert total == 7.0 * 512 * 1024
+
+
+def test_driver_gets_remote_object(ray_start_cluster_head):
+    cluster = ray_start_cluster_head
+    cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=3)  # only fits on the second node
+    def produce():
+        return np.arange(1 << 20, dtype=np.float64)  # 8MB
+
+    out = ray_tpu.get(produce.remote(), timeout=60)
+    assert out.shape == (1 << 20,)
+    assert out[123] == 123.0
+
+
+def test_node_death_detected(ray_start_cluster_head):
+    cluster = ray_start_cluster_head
+    n2 = cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(2)
+    cluster.remove_node(n2)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        if len(alive) == 1:
+            return
+        time.sleep(0.1)
+    pytest.fail("node death not detected")
+
+
+def test_task_retry_after_node_death(ray_start_cluster_head):
+    cluster = ray_start_cluster_head
+    n2 = cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=3, max_retries=3)
+    def slow_task():
+        time.sleep(3)
+        return "done"
+
+    ref = slow_task.remote()
+    time.sleep(1.0)  # task is now running on n2
+    cluster.remove_node(n2)
+    cluster.add_node(num_cpus=4)
+    # Retry must reschedule onto the new node.
+    assert ray_tpu.get(ref, timeout=120) == "done"
+
+
+def test_object_reconstruction_after_node_death(ray_start_cluster_head):
+    """Lineage recovery: all copies of a task-produced object are lost with
+    its node; the owner resubmits the creating task (reference:
+    object_recovery_manager.h:96 ReconstructObject)."""
+    cluster = ray_start_cluster_head
+    n2 = cluster.add_node(num_cpus=4)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(num_cpus=3, max_retries=3)
+    def produce():
+        return np.ones(1 << 20)  # 8MB: stored in producer node's shm
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=60)
+    cluster.remove_node(n2)
+    cluster.add_node(num_cpus=4)
+    time.sleep(0.5)
+    out = ray_tpu.get(ref, timeout=120)
+    assert out.sum() == float(1 << 20)
