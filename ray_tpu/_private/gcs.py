@@ -61,6 +61,7 @@ class GcsServer:
         self.jobs: dict[str, dict] = {}
         self.placement_groups: dict[str, dict] = {}
         self.task_events: deque = deque(maxlen=self.config.task_events_max_buffer)
+        self.pending_demand: dict[str, list] = {}
         self.subscribers: dict[str, set[rpc.Connection]] = defaultdict(set)
         self._server = rpc.RpcServer(self._handlers(), name="gcs")
         self._health_task: asyncio.Task | None = None
@@ -160,6 +161,7 @@ class GcsServer:
             return {"ok": False, "reason": "unknown or dead node"}
         node.last_heartbeat = time.monotonic()
         node.available_resources = payload.get("available_resources", node.available_resources)
+        self.pending_demand[node.node_id] = payload.get("pending_demand", [])
         # Reply piggy-backs the cluster resource view so raylets can make
         # spillback decisions (replaces the reference's ray_syncer gossip,
         # reference: src/ray/common/ray_syncer/ray_syncer.h).
@@ -207,6 +209,7 @@ class GcsServer:
         node.alive = False
         node.available_resources = {}
         self.node_conns.pop(node_id, None)
+        self.pending_demand.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id[:8], reason)
         await self.publish("NODE", {"event": "dead", "node_id": node_id, "reason": reason})
         # Actor fault tolerance: restart or kill actors that lived there
@@ -644,6 +647,13 @@ class GcsServer:
     async def handle_get_cluster_status(self, conn, payload):
         return {
             "nodes": [n.to_wire() for n in self.nodes.values()],
+            "pending_demand": [d for demands in self.pending_demand.values()
+                               for d in demands],
+            "pending_placement_groups": [
+                {"strategy": pg["strategy"],
+                 "bundles": [b["resources"] for b in pg["bundles"]]}
+                for pg in self.placement_groups.values()
+                if pg["state"] == PG_PENDING],
             "actors": len([a for a in self.actors.values() if a["state"] == ACTOR_ALIVE]),
             "placement_groups": len([p for p in self.placement_groups.values()
                                      if p["state"] == PG_CREATED]),

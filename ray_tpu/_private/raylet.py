@@ -93,6 +93,8 @@ class Raylet:
         self._tasks: list[asyncio.Task] = []
         self._lease_seq = 0
         self._num_leases_granted = 0
+        # Recently-rejected infeasible demands, kept ~10s for the autoscaler.
+        self._infeasible_demand: list[tuple[float, dict]] = []
 
     def _handlers(self):
         return {
@@ -167,9 +169,19 @@ class Raylet:
         period = min(0.2, self.config.health_check_period_s)
         while True:
             try:
+                now = time.monotonic()
+                self._infeasible_demand = [
+                    (ts, d) for ts, d in self._infeasible_demand
+                    if now - ts < 10.0]
                 resp = await self.gcs_conn.call("Heartbeat", {
                     "node_id": self.node_id,
                     "available_resources": self.available,
+                    # Demand signal for the autoscaler (reference: raylets
+                    # report resource load via ray_syncer →
+                    # gcs_autoscaler_state_manager).
+                    "pending_demand": [r for r, _pg, _idx, _f in
+                                       list(self.pending_leases)[:100]]
+                    + [d for _ts, d in self._infeasible_demand],
                 }, timeout=self.config.health_check_timeout_s)
                 if resp.get("ok"):
                     self.cluster_view = resp.get("cluster", {})
@@ -357,6 +369,15 @@ class Raylet:
         _, nid, info = candidates[0]
         return {"node_id": nid, "host": info["host"], "port": info["raylet_port"]}
 
+    def _note_infeasible(self, resources: dict):
+        now = time.monotonic()
+        # One entry per distinct shape: owners retry infeasible leases every
+        # second, and a log of rejections would read as N pending tasks.
+        self._infeasible_demand = [
+            (ts, d) for ts, d in self._infeasible_demand
+            if now - ts < 10.0 and d != resources]
+        self._infeasible_demand.append((now, resources))
+
     async def handle_request_worker_lease(self, conn, payload):
         """Grant a worker lease, spill back, or queue (reference:
         node_manager.cc:1778 HandleRequestWorkerLease)."""
@@ -397,9 +418,11 @@ class Raylet:
                             info.get("total_resources", {}), resources):
                         return {"spillback": {"node_id": nid, "host": info["host"],
                                               "port": info["raylet_port"]}}
+                self._note_infeasible(resources)
                 return {"error": f"infeasible resource demand {resources} "
                                  f"(no node in cluster fits)", "infeasible": True}
         elif not locally_feasible:
+            self._note_infeasible(resources)
             return {"error": f"infeasible resource demand {resources} "
                              f"(node total {self.total_resources})",
                     "infeasible": True}

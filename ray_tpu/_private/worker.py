@@ -1121,6 +1121,16 @@ def main():
     logging.basicConfig(level=logging.INFO,
                         format="[worker] %(asctime)s %(levelname)s %(message)s")
     env = os.environ
+    # Tests pin worker JAX to the CPU fake backend (the machine image
+    # force-registers the TPU platform via config, ignoring JAX_PLATFORMS).
+    plat = env.get("RAY_TPU_JAX_PLATFORM")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except ImportError:
+            pass
     cw = CoreWorker(
         gcs_host=env["RAY_TPU_GCS_HOST"], gcs_port=int(env["RAY_TPU_GCS_PORT"]),
         raylet_host=env["RAY_TPU_RAYLET_HOST"],

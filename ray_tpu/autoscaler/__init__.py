@@ -1,0 +1,8 @@
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    NodeProvider,
+    NodeType,
+)
+
+__all__ = ["StandardAutoscaler", "NodeProvider", "FakeNodeProvider", "NodeType"]

@@ -1,0 +1,143 @@
+"""StandardAutoscaler: demand-driven cluster scaling.
+
+Parity: reference python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler.update :171/:373 reconciliation) +
+resource_demand_scheduler.py:102 (bin-packing get_nodes_to_launch:170) +
+load_metrics.py. Load comes from the GCS (pending lease demand reported in
+raylet heartbeats + pending placement groups); the scheduler bin-packs
+demand onto hypothetical nodes of the configured types and launches what's
+missing; idle nodes beyond min_workers are terminated after idle_timeout.
+
+TPU-first: a node type with hosts_per_slice > 1 is a pod slice — demand
+for STRICT_ICI placement groups launches whole slices (the gang unit).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ray_tpu._private.common import resources_fit, subtract_resources
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
+
+logger = logging.getLogger(__name__)
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider, node_types: list[NodeType],
+                 *, get_cluster_status, idle_timeout_s: float = 60.0,
+                 upscaling_speed: float = 1.0, max_workers: int = 20):
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.get_cluster_status = get_cluster_status
+        self.idle_timeout_s = idle_timeout_s
+        self.upscaling_speed = upscaling_speed
+        self.max_workers = max_workers
+        self._idle_since: dict[str, float] = {}
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ---- demand scheduling (reference: resource_demand_scheduler.py) ----
+
+    def get_nodes_to_launch(self, pending_demand: list[dict],
+                            pending_pgs: list[dict],
+                            current_available: list[dict]) -> dict[str, int]:
+        """First-fit-decreasing bin-pack of unmet demand onto node types."""
+        bins = [dict(a) for a in current_available]
+        to_launch: dict[str, int] = {}
+        for demand in sorted(pending_demand,
+                             key=lambda d: -sum(d.values())):
+            placed = False
+            for b in bins:  # existing nodes AND already-planned launches
+                if resources_fit(b, demand):
+                    subtract_resources(b, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in self.node_types.values():
+                if resources_fit(t.resources, demand):
+                    to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                    bins.append(dict(t.resources))
+                    subtract_resources(bins[-1], demand)
+                    break
+            else:
+                logger.warning("demand %s fits no node type", demand)
+        # STRICT_ICI placement groups: launch whole slices.
+        for pg in pending_pgs:
+            if pg.get("strategy") != "STRICT_ICI":
+                continue
+            bundles = pg["bundles"]
+            for t in self.node_types.values():
+                if t.hosts_per_slice > 1 and all(
+                        resources_fit(t.resources, b) for b in bundles):
+                    to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                    break
+        return to_launch
+
+    # ---- reconcile loop (reference: StandardAutoscaler.update) ----
+
+    def update(self) -> dict:
+        status = self.get_cluster_status()
+        alive = [n for n in status["nodes"] if n["alive"]]
+        available = [n["available_resources"] for n in alive]
+        demand = status.get("pending_demand", [])
+        pgs = status.get("pending_placement_groups", [])
+
+        current = self.provider.non_terminated_nodes()
+        launched: dict[str, int] = {}
+        if len(current) < self.max_workers:
+            to_launch = self.get_nodes_to_launch(demand, pgs, available)
+            for type_name, count in to_launch.items():
+                t = self.node_types[type_name]
+                count = min(count, self.max_workers - len(current))
+                if count > 0:
+                    logger.info("autoscaler launching %d x %s", count, type_name)
+                    self.provider.create_node(t, count)
+                    launched[type_name] = count
+
+        # Idle termination: fully-available worker nodes past the timeout.
+        terminated = []
+        now = time.monotonic()
+        by_id = {n["node_id"]: n for n in alive}
+        min_by_type: dict[str, int] = {}
+        for nid in list(current):
+            info = by_id.get(nid)
+            if info is None:
+                continue
+            t_name = self.provider.node_type(nid)
+            t = self.node_types.get(t_name)
+            idle = (info["available_resources"] == info["total_resources"]
+                    and not demand)
+            if not idle:
+                self._idle_since.pop(nid, None)
+                continue
+            first_idle = self._idle_since.setdefault(nid, now)
+            kept = min_by_type.get(t_name, 0)
+            if now - first_idle > self.idle_timeout_s and t is not None \
+                    and kept >= t.min_workers:
+                logger.info("autoscaler terminating idle node %s", nid[:8])
+                self.provider.terminate_node(nid)
+                terminated.append(nid)
+                self._idle_since.pop(nid, None)
+            else:
+                min_by_type[t_name] = kept + 1
+        return {"launched": launched, "terminated": terminated,
+                "demand": len(demand)}
+
+    def start(self, interval_s: float = 1.0):
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.update()
+                except Exception:
+                    logger.exception("autoscaler update failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,131 @@
+"""ray_tpu.data: block-parallel datasets with streaming execution.
+
+Parity: reference python/ray/data/__init__.py read APIs (range:*,
+from_items, read_*, from_pandas/numpy).
+"""
+
+from __future__ import annotations
+
+import builtins as _builtins
+import glob as _glob
+import math
+from typing import Any, Iterable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.dataset import Dataset
+
+DEFAULT_BLOCK_COUNT = 8
+
+
+def _to_blocks(rows: list, num_blocks: int | None) -> list:
+    n = num_blocks or min(DEFAULT_BLOCK_COUNT, max(1, len(rows)))
+    per = math.ceil(len(rows) / n) if rows else 0
+    blocks = [rows[i * per:(i + 1) * per] for i in _builtins.range(n)]
+    return [b for b in blocks if b] or [[]]
+
+
+def from_items(items: list, *, override_num_blocks: int | None = None) -> Dataset:
+    return Dataset(_to_blocks(list(items), override_num_blocks))
+
+
+def range(n: int, *, override_num_blocks: int | None = None) -> Dataset:  # noqa: A001
+    return from_items(list(_builtins.range(n)),
+                      override_num_blocks=override_num_blocks)
+
+
+def range_tensor(n: int, *, shape: tuple = (1,),
+                 override_num_blocks: int | None = None) -> Dataset:
+    rows = [{"data": np.full(shape, i, dtype=np.int64)}
+            for i in _builtins.range(n)]
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def from_numpy(arr: "np.ndarray", *, column: str = "data",
+               override_num_blocks: int | None = None) -> Dataset:
+    rows = [{column: a} for a in arr]
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def from_pandas(df, *, override_num_blocks: int | None = None) -> Dataset:
+    rows = df.to_dict("records")
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def read_text(paths: str | list, *, override_num_blocks: int | None = None
+              ) -> Dataset:
+    files = _expand(paths)
+    rows = []
+    for p in files:
+        with open(p) as f:
+            rows.extend({"text": line.rstrip("\n")} for line in f)
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def read_json(paths: str | list, *, lines: bool = True,
+              override_num_blocks: int | None = None) -> Dataset:
+    import json
+
+    files = _expand(paths)
+    rows = []
+    for p in files:
+        with open(p) as f:
+            if lines:
+                rows.extend(json.loads(ln) for ln in f if ln.strip())
+            else:
+                data = json.load(f)
+                rows.extend(data if isinstance(data, list) else [data])
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def read_csv(paths: str | list, *, override_num_blocks: int | None = None
+             ) -> Dataset:
+    import csv
+
+    files = _expand(paths)
+    rows = []
+    for p in files:
+        with open(p) as f:
+            rows.extend(dict(r) for r in csv.DictReader(f))
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def read_numpy(paths: str | list, *, override_num_blocks: int | None = None
+               ) -> Dataset:
+    files = _expand(paths)
+    rows = []
+    for p in files:
+        arr = np.load(p)
+        rows.extend({"data": a} for a in arr)
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def read_parquet(paths: str | list, *, override_num_blocks: int | None = None
+                 ) -> Dataset:
+    try:
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("read_parquet requires pyarrow") from e
+    files = _expand(paths)
+    rows = []
+    for p in files:
+        rows.extend(pq.read_table(p).to_pylist())
+    return from_items(rows, override_num_blocks=override_num_blocks)
+
+
+def _expand(paths: str | list) -> list:
+    if isinstance(paths, str):
+        paths = [paths]
+    out = []
+    for p in paths:
+        matches = sorted(_glob.glob(p))
+        out.extend(matches if matches else [p])
+    return out
+
+
+__all__ = [
+    "Dataset", "from_items", "range", "range_tensor", "from_numpy",
+    "from_pandas", "read_text", "read_json", "read_csv", "read_numpy",
+    "read_parquet",
+]

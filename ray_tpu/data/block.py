@@ -1,0 +1,72 @@
+"""Blocks: the unit of data in ray_tpu.data.
+
+Parity: reference python/ray/data/block.py — blocks are Arrow/pandas/numpy
+tables living in plasma. Here a block is either a list of rows (simple
+format) or a dict of numpy column arrays (batch format); blocks travel as
+object-store refs so the streaming executor moves references, not data.
+The numpy-dict format is the TPU feed format: columns are contiguous
+arrays that `jax.device_put` ships to HBM without conversion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+
+def block_len(block) -> int:
+    if isinstance(block, dict):
+        if not block:
+            return 0
+        return len(next(iter(block.values())))
+    return len(block)
+
+
+def block_to_rows(block) -> list:
+    if isinstance(block, dict):
+        keys = list(block.keys())
+        n = block_len(block)
+        return [{k: block[k][i] for k in keys} for i in range(n)]
+    return list(block)
+
+
+def rows_to_batch(rows: list) -> dict:
+    """rows of dicts → dict of numpy arrays; non-dict rows get 'item'."""
+    if not rows:
+        return {}
+    if isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return {k: np.asarray([r[k] for r in rows]) for k in keys}
+    return {"item": np.asarray(rows)}
+
+
+def block_to_batch(block) -> dict:
+    if isinstance(block, dict):
+        return block
+    return rows_to_batch(block)
+
+
+def batch_to_block(batch, batch_format: str):
+    if batch_format in ("numpy", "batch", "dict"):
+        return batch
+    return block_to_rows(batch)
+
+
+def slice_block(block, start: int, end: int):
+    if isinstance(block, dict):
+        return {k: v[start:end] for k, v in block.items()}
+    return block[start:end]
+
+
+def concat_blocks(blocks: list):
+    blocks = [b for b in blocks if block_len(b)]
+    if not blocks:
+        return []
+    if isinstance(blocks[0], dict):
+        keys = blocks[0].keys()
+        return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    out = []
+    for b in blocks:
+        out.extend(block_to_rows(b))
+    return out

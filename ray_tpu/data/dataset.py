@@ -1,0 +1,311 @@
+"""Dataset: lazy block-parallel data pipelines executed as ray_tpu tasks.
+
+Parity: reference python/ray/data/dataset.py:178 (Dataset, map_batches:397,
+iter_batches:3499) with the streaming execution model of
+data/_internal/execution/streaming_executor.py:49 — a logical plan of
+stages, executed block-parallel with bounded in-flight tasks
+(backpressure), blocks living in the shared-memory object store.
+
+TPU-first addition: `iter_jax_batches` feeds mesh-sharded device arrays
+(the host-CPU data plane feeding per-host jax.device_put, SURVEY.md §7
+stage 8).
+"""
+
+from __future__ import annotations
+
+import math
+import random as _random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    batch_to_block,
+    block_len,
+    block_to_batch,
+    block_to_rows,
+    concat_blocks,
+    rows_to_batch,
+    slice_block,
+)
+
+DEFAULT_MAX_IN_FLIGHT = 8
+
+
+@dataclass
+class _Stage:
+    name: str
+    fn: Callable  # block -> block  (run remotely)
+    all_to_all: bool = False  # needs every input block materialized first
+    all_to_all_fn: Callable | None = None  # blocks(list of refs) -> list[blocks]
+    num_cpus: float = 1.0
+
+
+@ray_tpu.remote
+def _apply_stage(fn_blob, block):
+    from ray_tpu._private import serialization
+
+    fn = serialization.loads_func(fn_blob)
+    return fn(block)
+
+
+class Dataset:
+    """Lazy, immutable; transforms return new Datasets."""
+
+    def __init__(self, source_blocks: list, stages: list[_Stage] | None = None):
+        # source_blocks: list of ObjectRefs OR in-memory blocks (small data).
+        self._source = source_blocks
+        self._stages = stages or []
+
+    # ------------- transforms (lazy) -------------
+
+    def _with(self, stage: _Stage) -> "Dataset":
+        return Dataset(self._source, self._stages + [stage])
+
+    def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    batch_size: int | None = None, **_ignored) -> "Dataset":
+        def stage_fn(block, fn=fn, batch_format=batch_format,
+                     batch_size=batch_size):
+            if batch_size is None:
+                batch = block_to_batch(block) if batch_format == "numpy" \
+                    else block_to_rows(block)
+                return batch_to_block(fn(batch), batch_format)
+            outs = []
+            n = block_len(block)
+            for s in range(0, n, batch_size):
+                piece = slice_block(block, s, min(s + batch_size, n))
+                batch = block_to_batch(piece) if batch_format == "numpy" \
+                    else block_to_rows(piece)
+                outs.append(batch_to_block(fn(batch), batch_format))
+            return concat_blocks(outs)
+
+        return self._with(_Stage("map_batches", stage_fn))
+
+    def map(self, fn: Callable) -> "Dataset":
+        def stage_fn(block, fn=fn):
+            return [fn(r) for r in block_to_rows(block)]
+
+        return self._with(_Stage("map", stage_fn))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        def stage_fn(block, fn=fn):
+            return [r for r in block_to_rows(block) if fn(r)]
+
+        return self._with(_Stage("filter", stage_fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        def stage_fn(block, fn=fn):
+            out = []
+            for r in block_to_rows(block):
+                out.extend(fn(r))
+            return out
+
+        return self._with(_Stage("flat_map", stage_fn))
+
+    def random_shuffle(self, seed: int | None = None) -> "Dataset":
+        def shuffle_fn(blocks: list, seed=seed):
+            rows = []
+            for b in blocks:
+                rows.extend(block_to_rows(b))
+            rng = _random.Random(seed)
+            rng.shuffle(rows)
+            n_out = max(1, len(blocks))
+            per = math.ceil(len(rows) / n_out)
+            return [rows[i * per:(i + 1) * per] for i in range(n_out)]
+
+        return self._with(_Stage("random_shuffle", None, all_to_all=True,
+                                 all_to_all_fn=shuffle_fn))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def repart_fn(blocks: list, num_blocks=num_blocks):
+            rows = []
+            for b in blocks:
+                rows.extend(block_to_rows(b))
+            per = math.ceil(len(rows) / num_blocks) if rows else 0
+            return [rows[i * per:(i + 1) * per] for i in range(num_blocks)]
+
+        return self._with(_Stage("repartition", None, all_to_all=True,
+                                 all_to_all_fn=repart_fn))
+
+    def sort(self, key: Callable | str | None = None,
+             descending: bool = False) -> "Dataset":
+        def sort_fn(blocks: list, key=key, descending=descending):
+            rows = []
+            for b in blocks:
+                rows.extend(block_to_rows(b))
+            if isinstance(key, str):
+                rows.sort(key=lambda r: r[key], reverse=descending)
+            else:
+                rows.sort(key=key, reverse=descending)
+            n_out = max(1, len(blocks))
+            per = math.ceil(len(rows) / n_out)
+            return [rows[i * per:(i + 1) * per] for i in range(n_out)]
+
+        return self._with(_Stage("sort", None, all_to_all=True,
+                                 all_to_all_fn=sort_fn))
+
+    # ------------- execution -------------
+
+    def _iter_output_blocks(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+                            ) -> Iterator[Any]:
+        """The streaming loop: push blocks through stages with bounded
+        in-flight remote tasks (reference: streaming_executor.py:217
+        scheduling loop + ExecutionResources backpressure :280)."""
+        from ray_tpu._private import serialization
+
+        blocks: Iterable = self._source
+        stages = list(self._stages)
+        # Split into segments at all-to-all barriers.
+        segment: list[_Stage] = []
+        segments: list[tuple[list[_Stage], _Stage | None]] = []
+        for st in stages:
+            if st.all_to_all:
+                segments.append((segment, st))
+                segment = []
+            else:
+                segment.append(st)
+        segments.append((segment, None))
+
+        def run_segment(in_blocks: Iterable, seg: list[_Stage]) -> Iterator:
+            if not seg:
+                yield from in_blocks
+                return
+            fn_blobs = [serialization.dumps_func(s.fn) for s in seg]
+
+            def launch(blk):
+                ref = blk
+                for blob in fn_blobs:
+                    ref = _apply_stage.remote(blob, ref)
+                return ref
+
+            # FIFO window: yield in submission order (dataset semantics are
+            # ordered, matching the reference's OutputSplitter default).
+            window: list = []
+            for blk in in_blocks:
+                window.append(launch(blk))
+                if len(window) >= max_in_flight:
+                    yield ray_tpu.get(window.pop(0), timeout=300)
+            while window:
+                yield ray_tpu.get(window.pop(0), timeout=300)
+
+        for seg, barrier in segments:
+            blocks = run_segment(blocks, seg)
+            if barrier is not None:
+                materialized = [b if not isinstance(b, ray_tpu.ObjectRef)
+                                else ray_tpu.get(b) for b in blocks]
+                blocks = iter(barrier.all_to_all_fn(materialized))
+        for b in blocks:
+            if isinstance(b, ray_tpu.ObjectRef):
+                b = ray_tpu.get(b)
+            yield b
+
+    def materialize(self) -> "Dataset":
+        out = list(self._iter_output_blocks())
+        return Dataset(out, [])
+
+    # ------------- consumption -------------
+
+    def iter_rows(self) -> Iterator:
+        for block in self._iter_output_blocks():
+            yield from block_to_rows(block)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator:
+        carry: list = []
+        for block in self._iter_output_blocks():
+            carry.extend(block_to_rows(block))
+            while len(carry) >= batch_size:
+                chunk, carry = carry[:batch_size], carry[batch_size:]
+                yield rows_to_batch(chunk) if batch_format == "numpy" else chunk
+        if carry and not drop_last:
+            yield rows_to_batch(carry) if batch_format == "numpy" else carry
+
+    def iter_jax_batches(self, *, batch_size: int, mesh=None, spec=None,
+                         drop_last: bool = True) -> Iterator:
+        """Batches as (mesh-sharded) jax arrays — the TPU ingest path."""
+        import jax
+
+        sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, spec or PartitionSpec(("dp", "fsdp")))
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            arrs = {k: jax.device_put(v, sharding) if sharding is not None
+                    else jax.device_put(v) for k, v in batch.items()}
+            yield arrs
+
+    def take(self, n: int = 20) -> list:
+        out = []
+        for r in self.iter_rows():
+            out.append(r)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> list:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(block_len(b) for b in self._iter_output_blocks())
+
+    def sum(self, on: str | None = None):
+        total = 0
+        for r in self.iter_rows():
+            total += r[on] if on else r
+        return total
+
+    def min(self, on: str | None = None):
+        return min(r[on] if on else r for r in self.iter_rows())
+
+    def max(self, on: str | None = None):
+        return max(r[on] if on else r for r in self.iter_rows())
+
+    def mean(self, on: str | None = None):
+        values = [r[on] if on else r for r in self.iter_rows()]
+        return sum(values) / len(values) if values else 0.0
+
+    def num_blocks(self) -> int:
+        return len(self._source)
+
+    def split(self, n: int, *, equal: bool = True) -> list["Dataset"]:
+        """Split into n datasets (per-train-worker shards)."""
+        blocks = list(self._iter_output_blocks())
+        rows = []
+        for b in blocks:
+            rows.extend(block_to_rows(b))
+        per = len(rows) // n if equal else math.ceil(len(rows) / n)
+        out = []
+        for i in range(n):
+            chunk = rows[i * per:(i + 1) * per] if (equal or i < n - 1) \
+                else rows[i * per:]
+            out.append(Dataset([chunk], []))
+        return out
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = list(self._iter_output_blocks())
+        for o in others:
+            blocks.extend(o._iter_output_blocks())
+        return Dataset(blocks, [])
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.take_all())
+
+    def schema(self):
+        first = self.take(1)
+        if not first:
+            return None
+        row = first[0]
+        if isinstance(row, dict):
+            return {k: type(v).__name__ for k, v in row.items()}
+        return type(row).__name__
+
+    def __repr__(self):
+        names = [s.name for s in self._stages]
+        return f"Dataset(blocks={len(self._source)}, stages={names})"

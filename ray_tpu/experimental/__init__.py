@@ -1,0 +1,38 @@
+"""Experimental utilities (parity: reference python/ray/experimental/)."""
+
+from __future__ import annotations
+
+from ray_tpu._private.api_internal import get_core_worker
+
+
+class internal_kv:
+    """Direct access to the GCS KV store (parity:
+    python/ray/experimental/internal_kv.py)."""
+
+    @staticmethod
+    def _kv_put(key: bytes, value: bytes, overwrite: bool = True,
+                namespace: str = "") -> bool:
+        cw = get_core_worker()
+        return cw._run(cw.gcs.call("KVPut", {
+            "ns": namespace, "key": key, "value": value,
+            "overwrite": overwrite}))["added"]
+
+    @staticmethod
+    def _kv_get(key: bytes, namespace: str = "") -> bytes | None:
+        cw = get_core_worker()
+        return cw._run(cw.gcs.call("KVGet", {"ns": namespace, "key": key}))["value"]
+
+    @staticmethod
+    def _kv_del(key: bytes, namespace: str = "") -> bool:
+        cw = get_core_worker()
+        return cw._run(cw.gcs.call("KVDel", {"ns": namespace, "key": key}))["deleted"]
+
+    @staticmethod
+    def _kv_exists(key: bytes, namespace: str = "") -> bool:
+        cw = get_core_worker()
+        return cw._run(cw.gcs.call("KVExists", {"ns": namespace, "key": key}))["exists"]
+
+    @staticmethod
+    def _kv_list(prefix: bytes, namespace: str = "") -> list[bytes]:
+        cw = get_core_worker()
+        return cw._run(cw.gcs.call("KVKeys", {"ns": namespace, "prefix": prefix}))["keys"]

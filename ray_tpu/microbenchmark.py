@@ -1,0 +1,96 @@
+"""Core-runtime microbenchmarks.
+
+Parity: reference python/ray/_private/ray_perf.py:93-200 (`ray
+microbenchmark` CLI): single-client task throughput, actor call
+throughput/latency, put/get bandwidth. Run: `python -m
+ray_tpu.microbenchmark` (or `ray_tpu microbenchmark`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def _rate(n, dt):
+    return round(n / dt, 1)
+
+
+def bench_tasks(n: int = 200) -> dict:
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get(noop.remote())  # warm the worker pool
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    return {"tasks_per_s": _rate(n, dt)}
+
+
+def bench_actor_calls(n: int = 500) -> dict:
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.m.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(50):
+        ray_tpu.get(a.m.remote())
+    sync_dt = time.perf_counter() - t0
+    return {"actor_calls_per_s": _rate(n, dt),
+            "actor_call_roundtrip_ms": round(sync_dt / 50 * 1000, 3)}
+
+
+def bench_put_get(mb: int = 64) -> dict:
+    arr = np.ones(mb * 1024 * 1024 // 8)
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(arr)
+    put_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = ray_tpu.get(ref)
+    get_dt = time.perf_counter() - t0
+    assert out.shape == arr.shape
+    return {"put_gb_per_s": round(mb / 1024 / put_dt, 3),
+            "get_gb_per_s": round(mb / 1024 / get_dt, 3)}
+
+
+def bench_task_args_throughput(n_args: int = 100) -> dict:
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args)
+
+    refs = [ray_tpu.put(i) for i in range(n_args)]
+    t0 = time.perf_counter()
+    assert ray_tpu.get(consume.remote(*refs)) == n_args
+    dt = time.perf_counter() - t0
+    return {"args_per_task": n_args, "many_args_call_s": round(dt, 3)}
+
+
+def main(as_json: bool = True):
+    owns_cluster = not ray_tpu.is_initialized()
+    if owns_cluster:
+        ray_tpu.init(num_cpus=4)
+    try:
+        results = {}
+        for fn in (bench_tasks, bench_actor_calls, bench_put_get,
+                   bench_task_args_throughput):
+            results.update(fn())
+        print(json.dumps(results) if as_json else results)
+        return results
+    finally:
+        if owns_cluster:
+            ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, Env, make_env
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = ["PPO", "PPOConfig", "Env", "CartPole", "ENV_REGISTRY", "make_env"]

@@ -1,0 +1,85 @@
+"""Environment API + built-in CartPole.
+
+Parity: reference rllib/env/env_runner.py's gym-style contract. A
+dependency-free numpy CartPole (classic Barto-Sutton dynamics) stands in
+for gym in tests and examples; any object with the same
+reset()/step() surface works.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Env:
+    observation_size: int
+    num_actions: int
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> tuple[np.ndarray, float, bool, dict]:
+        raise NotImplementedError
+
+
+class CartPole(Env):
+    """Classic cart-pole balancing, matches gym's CartPole-v1 dynamics."""
+
+    observation_size = 4
+    num_actions = 2
+    max_episode_steps = 500
+
+    def __init__(self):
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.total_mass = self.masspole + self.masscart
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.state = None
+        self.steps = 0
+        self._rng = np.random.default_rng()
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.state = self._rng.uniform(-0.05, 0.05, size=4)
+        self.steps = 0
+        return self.state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self.state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + self.polemass_length * theta_dot ** 2 * sintheta
+                ) / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta ** 2
+                           / self.total_mass))
+        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+        x += self.tau * x_dot
+        x_dot += self.tau * xacc
+        theta += self.tau * theta_dot
+        theta_dot += self.tau * thetaacc
+        self.state = np.array([x, x_dot, theta, theta_dot])
+        self.steps += 1
+        done = bool(abs(x) > self.x_threshold
+                    or abs(theta) > self.theta_threshold
+                    or self.steps >= self.max_episode_steps)
+        return self.state.astype(np.float32), 1.0, done, {}
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPole, "CartPole": CartPole}
+
+
+def make_env(env: str | type) -> Env:
+    if isinstance(env, str):
+        if env not in ENV_REGISTRY:
+            raise ValueError(f"unknown env {env!r}; register it in "
+                             "ray_tpu.rllib.env.ENV_REGISTRY")
+        return ENV_REGISTRY[env]()
+    return env()

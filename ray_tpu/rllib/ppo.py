@@ -1,0 +1,272 @@
+"""PPO: CPU rollout actors + JAX (TPU) learner.
+
+Parity: reference rllib/algorithms/ppo/ + the rollout/learner split of
+SURVEY.md §3.6 — WorkerSet.sample on CPU actors, LearnerGroup.update on
+accelerators (reference: rllib/core/learner/learner_group.py wraps torch
+DDP; here the learner is ONE jitted jax update — data parallelism over
+learner devices comes from the mesh, not a gradient bucket library).
+
+Rollout workers evaluate the policy with a pure-numpy forward pass (no
+jax import in the sampling processes); the learner runs the PPO
+clipped-surrogate update under jit on whatever accelerator is attached.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+
+
+# ---------------- policy: MLP actor-critic ----------------
+
+
+def init_policy_params(obs_size: int, num_actions: int, hidden: int = 64,
+                       seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def dense(i, o):
+        return {"w": (rng.standard_normal((i, o)) / np.sqrt(i)).astype(np.float32),
+                "b": np.zeros(o, np.float32)}
+
+    return {
+        "h1": dense(obs_size, hidden),
+        "h2": dense(hidden, hidden),
+        "pi": dense(hidden, num_actions),
+        "vf": dense(hidden, 1),
+    }
+
+
+def numpy_forward(params: dict, obs: np.ndarray):
+    """Policy forward pass used inside rollout workers."""
+    h = np.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
+    h = np.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+@ray_tpu.remote
+class RolloutWorker:
+    """CPU sampling actor (parity: rllib/evaluation/rollout_worker.py)."""
+
+    def __init__(self, env_spec, worker_index: int, gamma: float, lam: float):
+        self.env = make_env(env_spec)
+        self.index = worker_index
+        self.gamma = gamma
+        self.lam = lam
+        self.rng = np.random.default_rng(1000 + worker_index)
+        self.obs = self.env.reset(seed=worker_index)
+
+    def sample(self, params: dict, num_steps: int) -> dict:
+        obs_buf, act_buf, logp_buf, rew_buf, val_buf, done_buf = \
+            [], [], [], [], [], []
+        episode_returns = []
+        ep_ret = 0.0
+        for _ in range(num_steps):
+            logits, value = numpy_forward(params, self.obs[None, :])
+            logits = logits[0]
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            action = int(self.rng.choice(len(p), p=p))
+            logp = float(np.log(p[action] + 1e-8))
+            next_obs, reward, done, _ = self.env.step(action)
+            obs_buf.append(self.obs)
+            act_buf.append(action)
+            logp_buf.append(logp)
+            rew_buf.append(reward)
+            val_buf.append(float(value[0]))
+            done_buf.append(done)
+            ep_ret += reward
+            if done:
+                episode_returns.append(ep_ret)
+                ep_ret = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        # Bootstrap value for the final partial episode.
+        _, last_val = numpy_forward(params, self.obs[None, :])
+        vals = np.array(val_buf + [float(last_val[0])], np.float32)
+        rews = np.array(rew_buf, np.float32)
+        dones = np.array(done_buf, bool)
+        adv = np.zeros(num_steps, np.float32)
+        last = 0.0
+        for t in range(num_steps - 1, -1, -1):
+            nonterminal = 0.0 if dones[t] else 1.0
+            delta = rews[t] + self.gamma * vals[t + 1] * nonterminal - vals[t]
+            last = delta + self.gamma * self.lam * nonterminal * last
+            adv[t] = last
+        returns = adv + vals[:-1]
+        return {
+            "obs": np.asarray(obs_buf, np.float32),
+            "actions": np.asarray(act_buf, np.int32),
+            "logp": np.asarray(logp_buf, np.float32),
+            "advantages": adv,
+            "returns": returns,
+            "episode_returns": episode_returns,
+        }
+
+
+@dataclass
+class PPOConfig:
+    """Parity: rllib AlgorithmConfig/PPOConfig fluent-config object."""
+
+    env: Any = "CartPole-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 512
+    train_batch_size: int = 1024
+    num_sgd_iter: int = 6
+    sgd_minibatch_size: int = 256
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip_param: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    lr: float = 3e-4
+    hidden_size: int = 64
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: int = None, **kw):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown PPO option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """Algorithm driver (parity: Algorithm.step rllib/algorithms/
+    algorithm.py:815 / training_step:1402)."""
+
+    def __init__(self, config: PPOConfig):
+        self.config = config
+        probe_env = make_env(config.env)
+        self.obs_size = probe_env.observation_size
+        self.num_actions = probe_env.num_actions
+        self.params = init_policy_params(
+            self.obs_size, self.num_actions, config.hidden_size, config.seed)
+        self.workers = [
+            RolloutWorker.remote(config.env, i, config.gamma, config.lam)
+            for i in range(config.num_rollout_workers)]
+        self._update = None
+        self.iteration = 0
+
+    # ---- learner (jit) ----
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def loss_fn(params, batch):
+            h = jnp.tanh(batch["obs"] @ params["h1"]["w"] + params["h1"]["b"])
+            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+            logits = h @ params["pi"]["w"] + params["pi"]["b"]
+            value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            clipped = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param)
+            pi_loss = -jnp.minimum(ratio * adv, clipped * adv).mean()
+            vf_loss = ((value - batch["returns"]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pi_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch)
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        """One training iteration: parallel sample → minibatch SGD epochs."""
+        import jax
+        import numpy as np
+
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        t0 = time.time()
+        per_worker = max(cfg.rollout_fragment_length,
+                         cfg.train_batch_size // max(1, len(self.workers)))
+        host_params = jax.tree_util.tree_map(np.asarray, self.params)
+        batches = ray_tpu.get(
+            [w.sample.remote(host_params, per_worker) for w in self.workers],
+            timeout=600)
+        batch = {k: np.concatenate([b[k] for b in batches])
+                 for k in ("obs", "actions", "logp", "advantages", "returns")}
+        episode_returns = sum((b["episode_returns"] for b in batches), [])
+        sample_time = time.time() - t0
+
+        n = len(batch["obs"])
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        t1 = time.time()
+        last_aux = {}
+        for _ in range(cfg.num_sgd_iter):
+            perm = rng.permutation(n)
+            for s in range(0, n, cfg.sgd_minibatch_size):
+                idx = perm[s: s + cfg.sgd_minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                self.params, self._opt_state, loss, aux = self._update(
+                    self.params, self._opt_state, mb)
+                last_aux = aux
+        learn_time = time.time() - t1
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(episode_returns))
+            if episode_returns else 0.0,
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_this_iter": n,
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(learn_time, 3),
+            **{k: float(v) for k, v in last_aux.items()},
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def get_policy_params(self):
+        import jax
+        import numpy as np
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def compute_single_action(self, obs) -> int:
+        logits, _ = numpy_forward(self.get_policy_params(), obs[None, :])
+        return int(np.argmax(logits[0]))

@@ -1,0 +1,146 @@
+"""ray_tpu CLI.
+
+Parity: reference python/ray/scripts/scripts.py (`ray start/stop/status`,
+`ray list ...` at :2441-2492, `ray microbenchmark`). Run as
+`python -m ray_tpu.scripts <cmd>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def cmd_start(args):
+    from ray_tpu._private.accelerator import node_resources_and_labels
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.node import RuntimeNode
+
+    cfg = Config()
+    node = RuntimeNode(cfg)
+    resources, labels = node_resources_and_labels()
+    if args.resources:
+        resources.update(json.loads(args.resources))
+    if args.num_cpus is not None:
+        resources["CPU"] = args.num_cpus
+    if args.head:
+        host, port = node.start_gcs()
+        handle = node.start_raylet(resources=resources or None, labels=labels,
+                                   is_head=True)
+        info = {"gcs_address": f"{host}:{port}",
+                "raylet": f"{handle.host}:{handle.port}",
+                "node_id": handle.node_id,
+                "store_path": handle.store_path,
+                "session_dir": node.session_dir}
+        with open(args.state_file, "w") as f:
+            json.dump(info, f)
+        print(json.dumps(info))
+        print(f"\nhead started; connect with:\n  ray_tpu.init("
+              f"address='{host}:{port}', ...)\nstate written to "
+              f"{args.state_file}; `ray_tpu stop` to shut down")
+    else:
+        if not args.address:
+            print("worker nodes need --address=<gcs host:port>", file=sys.stderr)
+            return 1
+        host, port = args.address.rsplit(":", 1)
+        node.attach_gcs(host, int(port))
+        handle = node.start_raylet(resources=resources or None, labels=labels)
+        print(json.dumps({"node_id": handle.node_id,
+                          "raylet": f"{handle.host}:{handle.port}"}))
+    # Keep the daemon processes alive under this supervisor.
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.shutdown()
+    return 0
+
+
+def cmd_stop(args):
+    if os.path.exists(args.state_file):
+        os.unlink(args.state_file)
+    os.system("pkill -f 'ray_tpu._private.(gcs|raylet|worker)' 2>/dev/null")
+    print("stopped ray_tpu daemons")
+    return 0
+
+
+def _connect_from_state(args):
+    import ray_tpu
+
+    with open(args.state_file) as f:
+        info = json.load(f)
+    host, port = info["raylet"].rsplit(":", 1)
+    ray_tpu.init(address=info["gcs_address"],
+                 _head_raylet=(host, int(port)),
+                 _store_path=info["store_path"],
+                 _node_id=info["node_id"])
+    return ray_tpu
+
+
+def cmd_status(args):
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util import state
+
+    st = state.cluster_status()
+    print(json.dumps(st, indent=2, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_list(args):
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util import state
+
+    fn = {"nodes": state.list_nodes, "actors": state.list_actors,
+          "jobs": state.list_jobs, "tasks": state.list_tasks,
+          "placement-groups": state.list_placement_groups,
+          "objects": state.list_objects}[args.entity]
+    print(json.dumps(fn(), indent=2, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_microbenchmark(args):
+    from ray_tpu import microbenchmark
+
+    microbenchmark.main()
+    return 0
+
+
+def main():
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    parser.add_argument("--state-file", default="/tmp/ray_tpu_head.json")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default="")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", default="")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop local daemons")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster status")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument("entity", choices=["nodes", "actors", "jobs", "tasks",
+                                      "placement-groups", "objects"])
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("microbenchmark", help="core-runtime throughput suite")
+    p.set_defaults(fn=cmd_microbenchmark)
+
+    args = parser.parse_args()
+    sys.exit(args.fn(args) or 0)
+
+
+if __name__ == "__main__":
+    main()

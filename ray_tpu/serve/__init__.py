@@ -1,0 +1,139 @@
+"""ray_tpu.serve: model serving with autoscaling replicas.
+
+Parity: reference python/ray/serve (serve.run api.py:465, @serve.deployment
+:258, controller, handles, batching, HTTP proxy). `serve.run` deploys onto
+the cluster's detached ServeController; handles route with
+power-of-two-choices; `start_http_proxy` exposes deployments over HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import (
+    AutoscalingConfig,
+    Deployment,
+    DeploymentHandle,
+    DeploymentResponse,
+    deployment,
+)
+
+_proxy_server = None
+
+
+def _get_controller():
+    return ServeController.options(
+        name=CONTROLLER_NAME, get_if_exists=True, lifetime="detached",
+        namespace="serve").remote()
+
+
+def run(target: Deployment, *, name: str | None = None,
+        route_prefix: str | None = None) -> DeploymentHandle:
+    """Deploy and return a handle (parity: serve.run api.py:465)."""
+    controller = _get_controller()
+    cfg = target._config
+    asc = None
+    if cfg.autoscaling_config is not None:
+        asc = dict(cfg.autoscaling_config.__dict__)
+    ray_tpu.get(controller.deploy.remote(
+        cfg.name,
+        serialization.dumps_func(target._target),
+        serialization.dumps_func((target._init_args, target._init_kwargs)),
+        cfg.num_replicas,
+        cfg.ray_actor_options,
+        asc,
+        serialization.dumps_func(cfg.user_config)
+        if cfg.user_config is not None else None,
+    ))
+    return DeploymentHandle(cfg.name, controller)
+
+
+def get_deployment_handle(name: str, *_a, **_k) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_controller())
+
+
+def status() -> dict:
+    return ray_tpu.get(_get_controller().list_deployments.remote())
+
+
+def delete(name: str) -> None:
+    ray_tpu.get(_get_controller().delete_deployment.remote(name))
+
+
+def shutdown() -> None:
+    global _proxy_server
+    if _proxy_server is not None:
+        _proxy_server.shutdown()
+        _proxy_server = None
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace="serve")
+        ray_tpu.get(controller.shutdown.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """@serve.batch marker (parity: serve/batching.py). Attach batching
+    metadata; the handle batches calls into list-of-inputs invocations."""
+
+    def wrap(fn):
+        fn._serve_batch = (max_batch_size, batch_wait_timeout_s)
+        return fn
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    handles: dict[str, DeploymentHandle] = {}
+
+    def log_message(self, *args):  # silence
+        pass
+
+    def do_POST(self):
+        name = self.path.strip("/").split("/")[0]
+        handle = self.handles.get(name)
+        if handle is None:
+            handle = self.handles[name] = get_deployment_handle(name)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b"{}"
+        try:
+            payload = json.loads(body) if body else {}
+            result = handle.remote(payload).result(timeout=60)
+            data = json.dumps({"result": result}).encode()
+            self.send_response(200)
+        except Exception as e:  # noqa: BLE001
+            data = json.dumps({"error": str(e)}).encode()
+            self.send_response(500)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    do_GET = do_POST
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> int:
+    """HTTP ingress (parity: serve/_private/proxy.py uvicorn proxies;
+    stdlib threading server this round). POST /<deployment> with a JSON
+    body calls the deployment with that payload."""
+    global _proxy_server
+    _proxy_server = ThreadingHTTPServer((host, port), _ProxyHandler)
+    t = threading.Thread(target=_proxy_server.serve_forever, daemon=True)
+    t.start()
+    return _proxy_server.server_address[1]
+
+
+__all__ = [
+    "deployment", "run", "get_deployment_handle", "status", "delete",
+    "shutdown", "batch", "start_http_proxy", "Deployment",
+    "DeploymentHandle", "DeploymentResponse", "AutoscalingConfig",
+]

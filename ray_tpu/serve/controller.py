@@ -1,0 +1,118 @@
+"""ServeController: the reconciling control loop.
+
+Parity: reference python/ray/serve/_private/controller.py:87 (detached
+controller actor; control loop :312 reconciles DeploymentState →
+replica actors; autoscaling decision from handle-reported metrics
+:221 + autoscaling_policy.py:117).
+"""
+
+from __future__ import annotations
+
+import time
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.serve.deployment import AutoscalingConfig, ReplicaActor
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self):
+        # name -> {config fields, replicas: [handle], target: int, ...}
+        self.deployments: dict[str, dict] = {}
+        self._last_scale: dict[str, float] = {}
+        self._load: dict[str, tuple[float, float]] = {}  # name -> (ts, load)
+
+    def deploy(self, name: str, callable_blob: bytes, init_args_blob: bytes,
+               num_replicas: int, actor_options: dict,
+               autoscaling: dict | None, user_config_blob: bytes | None):
+        d = self.deployments.get(name)
+        if d is None:
+            d = self.deployments[name] = {
+                "replicas": [], "version": 0}
+        d["callable_blob"] = callable_blob
+        d["init_args_blob"] = init_args_blob
+        d["actor_options"] = actor_options or {}
+        d["autoscaling"] = autoscaling
+        d["user_config_blob"] = user_config_blob
+        d["target"] = (autoscaling or {}).get("min_replicas", num_replicas) \
+            if autoscaling else num_replicas
+        d["version"] += 1
+        self._reconcile(name)
+        return True
+
+    def _make_replica(self, d):
+        init_args, init_kwargs = serialization.loads_func(d["init_args_blob"])
+        user_config = (serialization.loads_func(d["user_config_blob"])
+                       if d["user_config_blob"] else None)
+        opts = dict(d["actor_options"])
+        kwargs = {}
+        if "num_cpus" in opts:
+            kwargs["num_cpus"] = opts["num_cpus"]
+        if "resources" in opts:
+            kwargs["resources"] = opts["resources"]
+        cls = ReplicaActor.options(**kwargs) if kwargs else ReplicaActor
+        return cls.remote(d["callable_blob"], init_args, init_kwargs,
+                          user_config)
+
+    def _reconcile(self, name: str):
+        d = self.deployments[name]
+        while len(d["replicas"]) < d["target"]:
+            d["replicas"].append(self._make_replica(d))
+        while len(d["replicas"]) > d["target"]:
+            victim = d["replicas"].pop()
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+
+    def get_replicas(self, name: str):
+        d = self.deployments.get(name)
+        return list(d["replicas"]) if d else []
+
+    def list_deployments(self):
+        return {name: {"num_replicas": len(d["replicas"]),
+                       "target": d["target"], "version": d["version"]}
+                for name, d in self.deployments.items()}
+
+    def record_handle_load(self, name: str, outstanding: float):
+        """Handle-side queue metric → autoscaling decision (reference:
+        controller.py:221 record_autoscaling_metrics +
+        calculate_desired_num_replicas)."""
+        self._load[name] = (time.time(), outstanding)
+        d = self.deployments.get(name)
+        if d is None or not d.get("autoscaling"):
+            return
+        asc = d["autoscaling"]
+        target_per = asc.get("target_ongoing_requests", 2.0)
+        desired = max(asc.get("min_replicas", 1),
+                      min(asc.get("max_replicas", 4),
+                          int((outstanding + target_per - 1) // target_per)))
+        now = time.time()
+        last = self._last_scale.get(name, 0.0)
+        if desired > d["target"] and now - last > asc.get("upscale_delay_s", 0.5):
+            d["target"] = desired
+            self._last_scale[name] = now
+            self._reconcile(name)
+        elif desired < d["target"] and now - last > asc.get(
+                "downscale_delay_s", 5.0):
+            d["target"] = desired
+            self._last_scale[name] = now
+            self._reconcile(name)
+
+    def delete_deployment(self, name: str):
+        d = self.deployments.pop(name, None)
+        if d:
+            for r in d["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return True
+
+    def shutdown(self):
+        for name in list(self.deployments):
+            self.delete_deployment(name)
+        return True

@@ -1,0 +1,337 @@
+"""Deployments, replicas, handles, and routing.
+
+Parity: reference python/ray/serve — @serve.deployment (api.py:258),
+replica actors (_private/replica.py), handle-side Router with
+PowerOfTwoChoicesReplicaScheduler (router.py:290), @serve.batch dynamic
+batching (batching.py). Differences this round: request routing and
+dynamic batching live entirely handle-side (the newer reference also moved
+queue-length metrics into the handle), and replicas execute requests
+through the ordered actor queue.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import random
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+
+
+@dataclass
+class AutoscalingConfig:
+    """Parity: serve/_private/autoscaling_policy.py BasicAutoscalingPolicy."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 5.0
+
+
+@dataclass
+class DeploymentConfig:
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: dict = field(default_factory=dict)
+    autoscaling_config: AutoscalingConfig | None = None
+    max_ongoing_requests: int = 100
+    user_config: Any = None
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    """Hosts one copy of the deployment callable."""
+
+    def __init__(self, callable_blob: bytes, init_args, init_kwargs,
+                 user_config=None):
+        from ray_tpu._private import serialization
+
+        target = serialization.loads_func(callable_blob)
+        if isinstance(target, type):
+            self._instance = target(*init_args, **(init_kwargs or {}))
+        else:
+            self._instance = target
+        if user_config is not None and hasattr(self._instance,
+                                               "reconfigure"):
+            self._instance.reconfigure(user_config)
+
+    def handle_request(self, method: str, args, kwargs):
+        fn = self._instance if method == "__call__" \
+            else getattr(self._instance, method)
+        return fn(*args, **(kwargs or {}))
+
+    def handle_batch(self, method: str, batched_args: list):
+        fn = self._instance if method == "__call__" \
+            else getattr(self._instance, method)
+        return fn([args[0] if args else None for args, _kwargs in batched_args])
+
+    def reconfigure(self, user_config):
+        if hasattr(self._instance, "reconfigure"):
+            self._instance.reconfigure(user_config)
+        return True
+
+    def health_check(self):
+        return True
+
+
+class Deployment:
+    """The declarative object produced by @serve.deployment."""
+
+    def __init__(self, target, config: DeploymentConfig,
+                 init_args=(), init_kwargs=None):
+        self._target = target
+        self._config = config
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs or {}
+
+    @property
+    def name(self) -> str:
+        return self._config.name
+
+    def options(self, **kwargs) -> "Deployment":
+        cfg = DeploymentConfig(**{**self._config.__dict__, **{
+            k: v for k, v in kwargs.items()
+            if k in DeploymentConfig.__dataclass_fields__}})
+        return Deployment(self._target, cfg, self._init_args, self._init_kwargs)
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        return Deployment(self._target, self._config, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name}, replicas={self._config.num_replicas})"
+
+
+def deployment(target=None, *, name: str | None = None, num_replicas: int = 1,
+               ray_actor_options: dict | None = None,
+               autoscaling_config: dict | AutoscalingConfig | None = None,
+               max_ongoing_requests: int = 100, user_config=None):
+    """@serve.deployment decorator (parity: serve/api.py:258)."""
+
+    def wrap(t):
+        asc = autoscaling_config
+        if isinstance(asc, dict):
+            asc = AutoscalingConfig(**asc)
+        cfg = DeploymentConfig(
+            name=name or getattr(t, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            ray_actor_options=ray_actor_options or {},
+            autoscaling_config=asc,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config)
+        return Deployment(t, cfg)
+
+    if target is not None:
+        return wrap(target)
+    return wrap
+
+
+class _BatchQueue:
+    """Handle-side dynamic batching (parity: serve/batching.py)."""
+
+    def __init__(self, submit_batch: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.submit_batch = submit_batch
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.pending: list = []
+        self.lock = threading.Lock()
+        self.timer: threading.Timer | None = None
+
+    def add(self, item, result_slot):
+        with self.lock:
+            self.pending.append((item, result_slot))
+            if len(self.pending) >= self.max_batch_size:
+                batch, self.pending = self.pending, []
+                if self.timer:
+                    self.timer.cancel()
+                    self.timer = None
+            else:
+                batch = None
+                if self.timer is None:
+                    self.timer = threading.Timer(self.timeout, self._flush)
+                    self.timer.daemon = True
+                    self.timer.start()
+        if batch:
+            self.submit_batch(batch)
+
+    def _flush(self):
+        with self.lock:
+            batch, self.pending = self.pending, []
+            self.timer = None
+        if batch:
+            self.submit_batch(batch)
+
+
+class DeploymentResponse:
+    """Future-like response from handle.remote()."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+        self._ref = None
+
+    def _resolve_ref(self, ref):
+        self._ref = ref
+        self._event.set()
+
+    def _resolve_value(self, value):
+        self._value = value
+        self._event.set()
+
+    def _resolve_error(self, err: BaseException):
+        self._error = err
+        self._event.set()
+
+    def result(self, timeout: float | None = 60.0):
+        if not self._event.wait(timeout):
+            raise TimeoutError("deployment response timed out")
+        if self._error is not None:
+            raise self._error
+        if self._ref is not None:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        return self._value
+
+
+class DeploymentHandle:
+    """Routes requests to replicas: power-of-two-choices on outstanding
+    per-replica request counts (reference: router.py:290)."""
+
+    def __init__(self, deployment_name: str, controller, method: str = "__call__",
+                 batching: tuple[int, float] | None = None):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._method = method
+        self._replicas: list = []
+        self._outstanding: dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+        self._batchq: _BatchQueue | None = None
+        if batching:
+            self._batchq = _BatchQueue(self._submit_batch, batching[0],
+                                       batching[1])
+        # (idx, ref) pairs not yet observed complete; a reaper thread
+        # retires them so "ongoing requests" means submitted-but-unfinished
+        # (the autoscaling metric), not merely mid-submit.
+        self._inflight: list = []
+        self._reaper: threading.Thread | None = None
+
+    def options(self, method_name: str | None = None,
+                batching: tuple[int, float] | None = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self._controller,
+                                method_name or self._method, batching)
+
+    # -- replica set maintenance (long-poll analog: periodic refresh) --
+
+    def _get_replicas(self):
+        now = time.monotonic()
+        if now - self._last_refresh > 0.5 or not self._replicas:
+            reps = ray_tpu.get(self._controller.get_replicas.remote(
+                self.deployment_name))
+            with self._lock:
+                self._replicas = reps
+                self._last_refresh = now
+                for i in range(len(reps)):
+                    self._outstanding.setdefault(i, 0)
+        return self._replicas
+
+    def _pick_replica(self):
+        reps = self._get_replicas()
+        if not reps:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no replicas")
+        with self._lock:
+            if len(reps) == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(len(reps)), 2)
+                idx = a if self._outstanding.get(a, 0) <= \
+                    self._outstanding.get(b, 0) else b
+            self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
+        return idx, reps[idx]
+
+    def _done(self, idx):
+        with self._lock:
+            self._outstanding[idx] = max(0, self._outstanding.get(idx, 0) - 1)
+
+    def _report_load(self):
+        with self._lock:
+            total = sum(self._outstanding.values())
+        try:
+            self._controller.record_handle_load.remote(
+                self.deployment_name, total)
+        except Exception:
+            pass
+
+    # -- request path --
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        resp = DeploymentResponse()
+        if self._batchq is not None:
+            self._batchq.add((args, kwargs), resp)
+            return resp
+        idx, replica = self._pick_replica()
+        try:
+            ref = replica.handle_request.remote(self._method, list(args), kwargs)
+            resp._resolve_ref(ref)
+            with self._lock:
+                self._inflight.append((idx, ref))
+            self._ensure_reaper()
+        except BaseException as e:  # noqa: BLE001
+            resp._resolve_error(e)
+            self._done(idx)
+        self._report_load()
+        return resp
+
+    def _ensure_reaper(self):
+        if self._reaper is None or not self._reaper.is_alive():
+            self._reaper = threading.Thread(target=self._reap_loop, daemon=True)
+            self._reaper.start()
+
+    def _reap_loop(self):
+        while True:
+            with self._lock:
+                inflight = list(self._inflight)
+            if not inflight:
+                time.sleep(0.1)
+                with self._lock:
+                    if not self._inflight:
+                        continue
+                continue
+            refs = [ref for _idx, ref in inflight]
+            try:
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=0.2)
+            except Exception:
+                time.sleep(0.2)
+                continue
+            ready_set = set(ready)
+            finished = [(i, r) for i, r in inflight if r in ready_set]
+            if finished:
+                with self._lock:
+                    for item in finished:
+                        if item in self._inflight:
+                            self._inflight.remove(item)
+                for idx, _r in finished:
+                    self._done(idx)
+            self._report_load()
+
+    def _submit_batch(self, batch):
+        idx, replica = self._pick_replica()
+        try:
+            ref = replica.handle_batch.remote(
+                self._method, [item for item, _slot in batch])
+            results = ray_tpu.get(ref, timeout=120)
+            for (item, slot), value in zip(batch, results):
+                slot._resolve_value(value)
+        except BaseException as e:  # noqa: BLE001
+            for _item, slot in batch:
+                slot._resolve_error(e)
+        finally:
+            self._done(idx)

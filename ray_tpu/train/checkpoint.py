@@ -62,13 +62,26 @@ class Checkpoint:
         return f"Checkpoint({self.path})"
 
 
+_checkpointer = None
+
+
+def _get_checkpointer():
+    """One process-wide StandardCheckpointer (it owns a background thread;
+    constructing one per call leaks threads over a long training run)."""
+    global _checkpointer
+    if _checkpointer is None:
+        _checkpointer = ocp.StandardCheckpointer()
+    return _checkpointer
+
+
 def save_pytree(tree: Any, path: str) -> None:
     path = os.path.abspath(path)
     if os.path.exists(path):
         shutil.rmtree(path)
     if _HAS_ORBAX:
-        ckptr = ocp.PyTreeCheckpointer()
+        ckptr = _get_checkpointer()
         ckptr.save(path, tree)
+        ckptr.wait_until_finished()
     else:  # pragma: no cover
         import pickle
 
@@ -80,10 +93,23 @@ def save_pytree(tree: Any, path: str) -> None:
 def restore_pytree(path: str, template: Any | None = None) -> Any:
     path = os.path.abspath(path)
     if _HAS_ORBAX:
-        ckptr = ocp.PyTreeCheckpointer()
+        ckptr = _get_checkpointer()
         if template is not None:
-            return ckptr.restore(path, item=template)
-        return ckptr.restore(path)
+            # Sharded SPMD restore: orbax loads each shard directly onto
+            # the template's sharding (no full-host materialization).
+            try:
+                return ckptr.restore(
+                    path, args=ocp.args.StandardRestore(template))
+            except Exception:
+                # Template/checkpoint mismatch (e.g. plain numpy template):
+                # fall through to the unsharded path below.
+                pass
+        tree = ckptr.restore(path)
+        if template is not None:
+            tree = jax.tree_util.tree_map(
+                lambda t, v: jax.device_put(v, t.sharding)
+                if hasattr(t, "sharding") else v, template, tree)
+        return tree
     else:  # pragma: no cover
         import pickle
 

@@ -1,0 +1,21 @@
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler", "FIFOScheduler",
+    "PopulationBasedTraining", "MedianStoppingRule", "uniform", "loguniform",
+    "choice", "randint", "quniform", "grid_search",
+]

@@ -1,0 +1,163 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Parity: reference python/ray/tune/schedulers/ — ASHA
+(async_hyperband.py:19: asynchronous successive halving with rungs at
+reduction_factor intervals) and PBT (pbt.py:222; exploit at :881 clones a
+better trial's checkpoint and perturbs hyperparams).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+EXPLOIT = "EXPLOIT"  # PBT: restart from better trial's checkpoint
+
+
+class FIFOScheduler:
+    def on_result(self, trial, metric_value: float, iteration: int) -> str:
+        return CONTINUE
+
+    def exploit_target(self, trial, trials):
+        return None
+
+
+class ASHAScheduler:
+    """Async successive halving: at each rung, trials below the top
+    1/reduction_factor quantile of completed rung results stop early."""
+
+    def __init__(self, *, metric: str, mode: str = "max", max_t: int = 100,
+                 grace_period: int = 1, reduction_factor: int = 4):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestone -> list of recorded metric values
+        self.rungs: dict[int, list[float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self.milestones = milestones
+
+    def on_result(self, trial, metric_value: float, iteration: int) -> str:
+        if iteration >= self.max_t:
+            return STOP
+        for m in self.milestones:
+            if iteration == m:
+                sign = metric_value if self.mode == "max" else -metric_value
+                recorded = self.rungs.setdefault(m, [])
+                recorded.append(sign)
+                k = max(1, len(recorded) // self.rf)
+                top_k = sorted(recorded, reverse=True)[:k]
+                if sign < top_k[-1]:
+                    return STOP
+        return CONTINUE
+
+    def exploit_target(self, trial, trials):
+        return None
+
+
+class PopulationBasedTraining:
+    """PBT: every perturbation_interval iterations, bottom-quantile trials
+    clone a top-quantile trial's checkpoint and perturb hyperparams."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int | None = None):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_probability = resample_probability
+        self.rng = random.Random(seed)
+
+    def on_result(self, trial, metric_value: float, iteration: int) -> str:
+        trial.last_metric = metric_value
+        if iteration > 0 and iteration % self.interval == 0:
+            return EXPLOIT
+        return CONTINUE
+
+    def exploit_target(self, trial, trials):
+        """If `trial` is bottom-quantile, return a top-quantile trial to
+        clone from; else None (keep training)."""
+        scored = [t for t in trials if t.last_metric is not None]
+        if len(scored) < 2:
+            return None
+        key = (lambda t: t.last_metric) if self.mode == "max" \
+            else (lambda t: -t.last_metric)
+        ranked = sorted(scored, key=key, reverse=True)
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom = ranked[-k:]
+        top = ranked[:k]
+        if trial in bottom and trial not in top:
+            return self.rng.choice(top)
+        return None
+
+    def perturb(self, config: dict) -> dict:
+        """Mutate hyperparams (reference: pbt.py explore)."""
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_probability:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self.rng)
+                elif isinstance(spec, list):
+                    out[key] = self.rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            else:
+                cur = out.get(key)
+                if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+                    factor = self.rng.choice([0.8, 1.2])
+                    out[key] = type(cur)(cur * factor)
+                elif isinstance(spec, list) and cur in spec:
+                    idx = spec.index(cur)
+                    shift = self.rng.choice([-1, 1])
+                    out[key] = spec[max(0, min(len(spec) - 1, idx + shift))]
+        return out
+
+
+class MedianStoppingRule:
+    """Stop trials whose running mean falls below the median of others
+    (reference: schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 grace_period: int = 4):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.histories: dict[Any, list[float]] = {}
+
+    def on_result(self, trial, metric_value: float, iteration: int) -> str:
+        sign = metric_value if self.mode == "max" else -metric_value
+        self.histories.setdefault(trial.trial_id, []).append(sign)
+        if iteration < self.grace:
+            return CONTINUE
+        means = [sum(h) / len(h) for tid, h in self.histories.items()
+                 if tid != trial.trial_id and h]
+        if not means:
+            return CONTINUE
+        means.sort()
+        median = means[len(means) // 2]
+        mine = self.histories[trial.trial_id]
+        if sum(mine) / len(mine) < median:
+            return STOP
+        return CONTINUE
+
+    def exploit_target(self, trial, trials):
+        return None

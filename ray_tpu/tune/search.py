@@ -1,0 +1,96 @@
+"""Search spaces + variant generation.
+
+Parity: reference python/ray/tune/search/ — sample spaces
+(tune.uniform/loguniform/choice/randint), grid_search, and
+BasicVariantGenerator (search/basic_variant.py) expanding param_space
+dicts into trial configs.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class Domain:
+    sampler: Callable[[random.Random], Any]
+
+    def sample(self, rng: random.Random):
+        return self.sampler(rng)
+
+
+def uniform(low: float, high: float) -> Domain:
+    return Domain(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> Domain:
+    return Domain(lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))))
+
+
+def randint(low: int, high: int) -> Domain:
+    return Domain(lambda rng: rng.randrange(low, high))
+
+
+def choice(options: list) -> Domain:
+    opts = list(options)
+    return Domain(lambda rng: rng.choice(opts))
+
+
+def quniform(low: float, high: float, q: float) -> Domain:
+    return Domain(lambda rng: round(rng.uniform(low, high) / q) * q)
+
+
+@dataclass
+class GridSearch:
+    values: list
+
+
+def grid_search(values: list) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def generate_variants(param_space: dict, num_samples: int = 1,
+                      seed: int | None = None) -> list[dict]:
+    """Expand grid axes (cross product) × num_samples random draws.
+
+    Matches the reference semantics: num_samples multiplies the grid
+    (basic_variant.py)."""
+    rng = random.Random(seed)
+    grid_axes: list[tuple[str, list]] = []
+
+    def find_grids(prefix: str, node):
+        if isinstance(node, GridSearch):
+            grid_axes.append((prefix, node.values))
+        elif isinstance(node, dict):
+            for k, v in node.items():
+                find_grids(f"{prefix}.{k}" if prefix else k, v)
+
+    find_grids("", param_space)
+
+    def grid_combos(axes):
+        if not axes:
+            return [{}]
+        key, values = axes[0]
+        rest = grid_combos(axes[1:])
+        return [{**r, key: v} for v in values for r in rest]
+
+    def resolve(node, overrides: dict, prefix: str = ""):
+        if isinstance(node, GridSearch):
+            return overrides[prefix]
+        if isinstance(node, Domain):
+            return node.sample(rng)
+        if isinstance(node, dict):
+            return {k: resolve(v, overrides, f"{prefix}.{k}" if prefix else k)
+                    for k, v in node.items()}
+        if callable(node) and not isinstance(node, type):
+            return node()
+        return node
+
+    variants = []
+    for _ in range(num_samples):
+        for combo in grid_combos(grid_axes):
+            variants.append(resolve(param_space, combo))
+    return variants

@@ -1,0 +1,210 @@
+"""Tuner + trial controller.
+
+Parity: reference python/ray/tune/tuner.py:59 (Tuner) and
+tune/execution/tune_controller.py (the event loop managing trials as
+actors). Trials run as TrainWorker actors (the same session/report
+machinery Train uses — the reference likewise runs trainers as Tune
+trials, base_trainer.py:877); the controller polls reports, applies the
+scheduler (ASHA early-stopping, PBT exploit/explore with checkpoint
+cloning), and collects a ResultGrid.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.worker_group import TrainWorker
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, FIFOScheduler, STOP
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    metric: str | None = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int | None = None
+    scheduler: Any = None
+    seed: int | None = None
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = "PENDING"
+        self.actor = None
+        self.iteration = 0
+        self.last_metric: float | None = None
+        self.metrics_history: list[dict] = []
+        self.checkpoint: Checkpoint | None = None
+        self.error: str | None = None
+
+    def best_metric(self, metric: str, mode: str):
+        vals = [m[metric] for m in self.metrics_history if metric in m]
+        if not vals:
+            return None
+        return max(vals) if mode == "max" else min(vals)
+
+
+@dataclass
+class TrialResult:
+    config: dict
+    metrics: dict
+    checkpoint: Checkpoint | None
+    error: str | None
+    metrics_history: list = field(default_factory=list)
+
+
+class ResultGrid:
+    def __init__(self, results: list[TrialResult], metric: str | None,
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self, metric: str | None = None,
+                        mode: str | None = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required")
+        scored = [r for r in self._results
+                  if r.error is None and metric in r.metrics]
+        if not scored:
+            raise ValueError("no successful trials with metric " + metric)
+        key = lambda r: r.metrics[metric]
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([{**r.metrics, **{f"config/{k}": v
+                                              for k, v in r.config.items()}}
+                             for r in self._results])
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 resources_per_trial: dict | None = None):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._resources = resources_per_trial or {"CPU": 1}
+
+    def fit(self) -> ResultGrid:
+        cfgs = generate_variants(self._param_space,
+                                 self.tune_config.num_samples,
+                                 self.tune_config.seed)
+        trials = [Trial(f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", c)
+                  for i, c in enumerate(cfgs)]
+        scheduler = self.tune_config.scheduler or FIFOScheduler()
+        metric = self.tune_config.metric
+        max_conc = self.tune_config.max_concurrent_trials or len(trials)
+        controller = _TuneController(
+            self._trainable, trials, scheduler, metric,
+            self.tune_config.mode, max_conc, self._resources)
+        controller.run()
+        results = [TrialResult(
+            config=t.config,
+            metrics=t.metrics_history[-1] if t.metrics_history else {},
+            checkpoint=t.checkpoint, error=t.error,
+            metrics_history=t.metrics_history) for t in trials]
+        return ResultGrid(results, metric, self.tune_config.mode)
+
+
+class _TuneController:
+    """Polling event loop (reference: tune_controller.py)."""
+
+    def __init__(self, trainable, trials, scheduler, metric, mode,
+                 max_concurrent, resources):
+        self.trainable_blob = serialization.dumps_func(trainable)
+        self.trials: list[Trial] = trials
+        self.scheduler = scheduler
+        self.metric = metric
+        self.mode = mode
+        self.max_concurrent = max_concurrent
+        self.resources = resources
+
+    def _start_trial(self, trial: Trial, restore_from: Checkpoint | None = None):
+        opts = {"num_cpus": self.resources.get("CPU", 1),
+                "resources": {k: v for k, v in self.resources.items()
+                              if k != "CPU"}}
+        trial.actor = TrainWorker.options(**opts).remote(0, 1, {})
+        cfg = dict(trial.config)
+        if restore_from is not None:
+            cfg["_checkpoint_path"] = restore_from.path
+        ray_tpu.get(trial.actor.run.remote(self.trainable_blob, cfg))
+        trial.status = "RUNNING"
+
+    def _stop_trial(self, trial: Trial, status: str):
+        trial.status = status
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    def run(self):
+        pending = list(self.trials)
+        running: list[Trial] = []
+        while pending or running:
+            while pending and len(running) < self.max_concurrent:
+                t = pending.pop(0)
+                self._start_trial(t)
+                running.append(t)
+            polls = ray_tpu.get([t.actor.poll.remote() for t in running],
+                                timeout=300)
+            for trial, p in zip(list(running), polls):
+                decision = CONTINUE
+                for rep in p["reports"]:
+                    m = rep["metrics"]
+                    trial.metrics_history.append(m)
+                    trial.iteration += 1
+                    if rep.get("checkpoint_path"):
+                        trial.checkpoint = Checkpoint(rep["checkpoint_path"])
+                    if self.metric and self.metric in m:
+                        trial.last_metric = m[self.metric]
+                        decision = self.scheduler.on_result(
+                            trial, m[self.metric], trial.iteration)
+                        if decision != CONTINUE:
+                            break
+                if p["done"]:
+                    trial.error = p["error"]
+                    self._stop_trial(trial,
+                                     "ERROR" if p["error"] else "TERMINATED")
+                    running.remove(trial)
+                elif decision == STOP:
+                    self._stop_trial(trial, "TERMINATED")
+                    running.remove(trial)
+                elif decision == EXPLOIT:
+                    target = self.scheduler.exploit_target(trial, self.trials)
+                    if target is not None and target.checkpoint is not None:
+                        # PBT exploit: clone checkpoint + perturbed config.
+                        self._stop_trial(trial, "PAUSED")
+                        trial.config = self.scheduler.perturb(target.config)
+                        self._start_trial(trial, restore_from=target.checkpoint)
+            if running or pending:
+                time.sleep(0.05)

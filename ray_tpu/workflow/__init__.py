@@ -1,0 +1,146 @@
+"""Durable workflows: DAG execution with per-step checkpointing.
+
+Parity: reference python/ray/workflow/ (workflow_executor.py,
+workflow_storage.py) — each step's result is checkpointed to storage
+before dependents run, so a crashed driver re-running the same workflow id
+skips completed steps and resumes where it stopped.
+
+Model: steps are memoized by (workflow_id, step name, occurrence index);
+re-running the same program with the same workflow_id is resumption — the
+reference's recovery path re-executes the DAG the same way, consulting the
+step log.
+
+Example::
+
+    @workflow.step
+    def add(a, b): return a + b
+
+    out = workflow.run(add.step(add.step(1, 2), 3), workflow_id="w1",
+                       storage="/tmp/wf")
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+
+_DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
+
+
+class Step:
+    def __init__(self, fn: Callable, args: tuple, kwargs: dict, name: str,
+                 options: dict | None = None):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name
+        self.options = options or {}
+
+
+class StepFunction:
+    def __init__(self, fn: Callable, options: dict | None = None):
+        self._fn = fn
+        self._options = options or {}
+        self.name = getattr(fn, "__name__", "step")
+
+    def step(self, *args, **kwargs) -> Step:
+        return Step(self._fn, args, kwargs, self.name, self._options)
+
+    def options(self, **opts) -> "StepFunction":
+        return StepFunction(self._fn, {**self._options, **opts})
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def step(fn=None, **options):
+    """@workflow.step decorator."""
+    if fn is not None:
+        return StepFunction(fn)
+    return lambda f: StepFunction(f, options)
+
+
+@dataclass
+class _RunState:
+    workflow_id: str
+    storage: str
+    counters: Counter = field(default_factory=Counter)
+
+    def step_dir(self) -> str:
+        d = os.path.join(self.storage, self.workflow_id, "steps")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def next_step_id(self, name: str) -> str:
+        idx = self.counters[name]
+        self.counters[name] += 1
+        return f"{name}_{idx}"
+
+
+def _result_path(state: _RunState, step_id: str) -> str:
+    return os.path.join(state.step_dir(), f"{step_id}.pkl")
+
+
+def _execute(node: Any, state: _RunState):
+    if isinstance(node, Step):
+        step_id = state.next_step_id(node.name)
+        path = _result_path(state, step_id)
+        # Resolve dependencies first (post-order), then memoize.
+        args = tuple(_execute(a, state) for a in node.args)
+        kwargs = {k: _execute(v, state) for k, v in node.kwargs.items()}
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        remote_fn = ray_tpu.remote(**node.options)(node.fn) \
+            if node.options else ray_tpu.remote(node.fn)
+        result = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(result, f)
+        os.replace(tmp, path)  # atomic commit (reference: workflow_storage)
+        return result
+    if isinstance(node, (list, tuple)):
+        return type(node)(_execute(x, state) for x in node)
+    return node
+
+
+def run(dag: Step, *, workflow_id: str, storage: str | None = None):
+    """Execute (or resume) a workflow; returns the final result."""
+    state = _RunState(workflow_id, storage or _DEFAULT_STORAGE)
+    result = _execute(dag, state)
+    done_path = os.path.join(state.storage, workflow_id, "result.pkl")
+    with open(done_path, "wb") as f:
+        pickle.dump(result, f)
+    return result
+
+
+def get_output(workflow_id: str, *, storage: str | None = None):
+    path = os.path.join(storage or _DEFAULT_STORAGE, workflow_id, "result.pkl")
+    if not os.path.exists(path):
+        raise ValueError(f"workflow {workflow_id!r} has no stored result")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def list_workflows(*, storage: str | None = None) -> list[str]:
+    root = storage or _DEFAULT_STORAGE
+    if not os.path.isdir(root):
+        return []
+    return sorted(os.listdir(root))
+
+
+def delete(workflow_id: str, *, storage: str | None = None) -> None:
+    import shutil
+
+    shutil.rmtree(os.path.join(storage or _DEFAULT_STORAGE, workflow_id),
+                  ignore_errors=True)
+
+
+__all__ = ["step", "run", "get_output", "list_workflows", "delete", "Step",
+           "StepFunction"]

@@ -14,6 +14,8 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Spawned ray_tpu worker processes honor this (see _private/worker.py main).
+os.environ["RAY_TPU_JAX_PLATFORM"] = "cpu"
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,75 @@
+"""Autoscaler tests (parity: reference tests/test_autoscaler.py unit tests
++ test_autoscaler_fake_multinode.py end-to-end)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeNodeProvider, NodeType, StandardAutoscaler
+
+
+def test_bin_packing_unit():
+    sched = StandardAutoscaler(
+        provider=None,
+        node_types=[NodeType("cpu4", {"CPU": 4.0}),
+                    NodeType("tpu_host", {"CPU": 8.0, "TPU": 4.0})],
+        get_cluster_status=lambda: None)
+    # 6 one-CPU tasks, 2 CPU free on existing nodes -> 1 new cpu4 node.
+    out = sched.get_nodes_to_launch(
+        [{"CPU": 1.0}] * 6, [], [{"CPU": 2.0}])
+    assert out == {"cpu4": 1}
+    # TPU demand picks the TPU type.
+    out = sched.get_nodes_to_launch([{"TPU": 4.0}], [], [])
+    assert out == {"tpu_host": 1}
+
+
+def test_strict_ici_launches_slice():
+    sched = StandardAutoscaler(
+        provider=None,
+        node_types=[NodeType("v4_slice", {"CPU": 8.0, "TPU": 4.0},
+                             hosts_per_slice=4)],
+        get_cluster_status=lambda: None)
+    out = sched.get_nodes_to_launch(
+        [], [{"strategy": "STRICT_ICI",
+              "bundles": [{"TPU": 4.0}] * 4}], [])
+    assert out == {"v4_slice": 1}
+
+
+def test_autoscaler_end_to_end(ray_start_cluster_head):
+    """Infeasible demand -> fake provider launches a node -> task runs."""
+    cluster = ray_start_cluster_head  # head: 2 CPUs
+    provider = FakeNodeProvider(cluster._node)
+    cw = ray_tpu._private.api_internal.get_core_worker()
+
+    def get_status():
+        return cw._run(cw.gcs.call("GetClusterStatus", {}))
+
+    autoscaler = StandardAutoscaler(
+        provider,
+        node_types=[NodeType("cpu8", {"CPU": 8.0}, max_workers=2)],
+        get_cluster_status=get_status,
+        idle_timeout_s=3600)
+    autoscaler.start(interval_s=0.5)
+    try:
+        @ray_tpu.remote(num_cpus=8)  # does not fit the 2-CPU head
+        def big():
+            return "scaled"
+
+        assert ray_tpu.get(big.remote(), timeout=120) == "scaled"
+        assert len(provider.non_terminated_nodes()) == 1
+    finally:
+        autoscaler.stop()
+
+
+def test_fake_provider_slice_labels(ray_start_cluster_head):
+    cluster = ray_start_cluster_head
+    provider = FakeNodeProvider(cluster._node)
+    created = provider.create_node(
+        NodeType("v4_slice", {"CPU": 1.0, "TPU": 4.0}, hosts_per_slice=2))
+    assert len(created) == 2
+    cluster.wait_for_nodes(3)
+    by_id = {n["node_id"]: n for n in ray_tpu.nodes()}
+    labels = [by_id[nid]["labels"] for nid in created]
+    assert labels[0]["tpu-slice"] == labels[1]["tpu-slice"]
+    assert {l["tpu-worker-id"] for l in labels} == {"0", "1"}

@@ -1,0 +1,103 @@
+"""ray_tpu.data tests (parity: reference python/ray/data/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_count_take(ray_start_regular):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+
+
+def test_map_and_filter(ray_start_regular):
+    ds = rd.range(20).map(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    assert ds.take_all() == [x * 2 for x in range(20) if (x * 2) % 4 == 0]
+
+
+def test_map_batches_numpy(ray_start_regular):
+    ds = rd.from_items([{"x": float(i)} for i in range(32)])
+    out = ds.map_batches(lambda b: {"y": b["x"] * 10}).take_all()
+    assert out[3]["y"] == 30.0
+    assert len(out) == 32
+
+
+def test_flat_map(ray_start_regular):
+    ds = rd.from_items([1, 2, 3]).flat_map(lambda x: [x] * x)
+    assert sorted(ds.take_all()) == [1, 2, 2, 3, 3, 3]
+
+
+def test_iter_batches(ray_start_regular):
+    ds = rd.from_items([{"v": i} for i in range(10)])
+    batches = list(ds.iter_batches(batch_size=4))
+    assert [len(b["v"]) for b in batches] == [4, 4, 2]
+    np.testing.assert_array_equal(batches[0]["v"], [0, 1, 2, 3])
+
+
+def test_random_shuffle_preserves_elements(ray_start_regular):
+    ds = rd.range(50).random_shuffle(seed=42)
+    out = ds.take_all()
+    assert sorted(out) == list(range(50))
+    assert out != list(range(50))
+
+
+def test_repartition(ray_start_regular):
+    ds = rd.range(30, override_num_blocks=2).repartition(5)
+    assert ds.materialize().num_blocks() == 5
+    assert ds.count() == 30
+
+
+def test_sort(ray_start_regular):
+    ds = rd.from_items([5, 3, 9, 1]).sort(key=lambda x: x)
+    assert ds.take_all() == [1, 3, 5, 9]
+
+
+def test_aggregates(ray_start_regular):
+    ds = rd.from_items([{"a": i} for i in range(10)])
+    assert ds.sum(on="a") == 45
+    assert ds.min(on="a") == 0
+    assert ds.max(on="a") == 9
+    assert ds.mean(on="a") == 4.5
+
+
+def test_split_for_workers(ray_start_regular):
+    shards = rd.range(12).split(3)
+    assert [s.count() for s in shards] == [4, 4, 4]
+    all_rows = sorted(sum((s.take_all() for s in shards), []))
+    assert all_rows == list(range(12))
+
+
+def test_chained_lazy_stages_distributed(ray_start_regular):
+    """Stages execute as remote tasks over blocks."""
+    ds = (rd.range(64, override_num_blocks=8)
+          .map(lambda x: x + 1)
+          .map_batches(lambda b: {"item": b["item"] * 2})
+          .filter(lambda r: r["item"] <= 64))
+    out = [r["item"] for r in ds.take_all()]
+    assert out == [(x + 1) * 2 for x in range(64) if (x + 1) * 2 <= 64]
+
+
+def test_read_text_json_csv(ray_start_regular, tmp_path):
+    (tmp_path / "a.txt").write_text("hello\nworld\n")
+    ds = rd.read_text(str(tmp_path / "a.txt"))
+    assert ds.take_all() == [{"text": "hello"}, {"text": "world"}]
+
+    (tmp_path / "b.jsonl").write_text('{"x": 1}\n{"x": 2}\n')
+    assert rd.read_json(str(tmp_path / "b.jsonl")).sum(on="x") == 3
+
+    (tmp_path / "c.csv").write_text("a,b\n1,2\n3,4\n")
+    rows = rd.read_csv(str(tmp_path / "c.csv")).take_all()
+    assert rows == [{"a": "1", "b": "2"}, {"a": "3", "b": "4"}]
+
+
+def test_iter_jax_batches(ray_start_regular):
+    import jax
+
+    ds = rd.from_items([{"x": np.float32(i)} for i in range(16)])
+    batches = list(ds.iter_jax_batches(batch_size=8))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["x"], jax.Array)
+    assert float(batches[0]["x"].sum()) == sum(range(8))

@@ -1,0 +1,119 @@
+"""Serve tests (parity: reference python/ray/serve/tests)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def test_function_deployment(serve_cluster):
+    @serve.deployment
+    def echo(x):
+        return {"echo": x}
+
+    handle = serve.run(echo.bind())
+    assert handle.remote(42).result() == {"echo": 42}
+
+
+def test_class_deployment_with_state(serve_cluster):
+    @serve.deployment
+    class Model:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def __call__(self, x):
+            return x * self.scale
+
+        def describe(self):
+            return {"scale": self.scale}
+
+    handle = serve.run(Model.bind(10))
+    assert handle.remote(4).result() == 40
+    assert handle.options(method_name="describe").remote().result() == \
+        {"scale": 10}
+
+
+def test_multiple_replicas_route(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {handle.remote(None).result() for _ in range(12)}
+    assert len(pids) == 2  # both replicas served traffic
+
+
+def test_batching(serve_cluster):
+    @serve.deployment
+    class Batched:
+        def __call__(self, items):
+            # Receives a list when called through a batching handle.
+            return [i * 2 for i in items]
+
+    serve.run(Batched.bind())
+    handle = serve.get_deployment_handle("Batched").options(
+        batching=(4, 0.05))
+    responses = [handle.remote(i) for i in range(8)]
+    assert [r.result() for r in responses] == [i * 2 for i in range(8)]
+
+
+def test_status_and_delete(serve_cluster):
+    @serve.deployment
+    def f(x):
+        return x
+
+    serve.run(f.bind())
+    st = serve.status()
+    assert st["f"]["num_replicas"] == 1
+    serve.delete("f")
+    assert "f" not in serve.status()
+
+
+def test_http_proxy(serve_cluster):
+    @serve.deployment
+    def classify(payload):
+        return {"label": "ok", "score": payload.get("value", 0) * 2}
+
+    serve.run(classify.bind())
+    port = serve.start_http_proxy(port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/classify",
+        data=json.dumps({"value": 21}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        body = json.load(resp)
+    assert body["result"] == {"label": "ok", "score": 42}
+
+
+def test_autoscaling_up(serve_cluster):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.0})
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.5)
+            return 1
+
+    handle = serve.run(Slow.bind())
+    responses = [handle.remote(None) for _ in range(9)]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if serve.status()["Slow"]["num_replicas"] > 1:
+            break
+        time.sleep(0.2)
+    assert serve.status()["Slow"]["num_replicas"] > 1
+    for r in responses:
+        r.result(timeout=120)

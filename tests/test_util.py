@@ -1,0 +1,136 @@
+"""Workflow, ActorPool, Queue, collective host-plane, internal_kv, state API."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+def test_workflow_run_and_resume(ray_start_regular, tmp_path):
+    calls_file = tmp_path / "calls.txt"
+
+    @workflow.step
+    def add(a, b):
+        with open(calls_file, "a") as f:
+            f.write("x\n")
+        return a + b
+
+    dag = add.step(add.step(1, 2), add.step(3, 4))
+    out = workflow.run(dag, workflow_id="w1", storage=str(tmp_path / "wf"))
+    assert out == 10
+    assert calls_file.read_text().count("x") == 3
+    # Resume: same id re-runs nothing (memoized step log).
+    out2 = workflow.run(dag, workflow_id="w1", storage=str(tmp_path / "wf"))
+    assert out2 == 10
+    assert calls_file.read_text().count("x") == 3
+    assert workflow.get_output("w1", storage=str(tmp_path / "wf")) == 10
+
+
+def test_actor_pool(ray_start_regular):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    assert out == [2, 4, 6, 8]
+
+
+def test_queue(ray_start_regular):
+    from ray_tpu.util.queue import Empty, Queue
+
+    q = Queue(maxsize=3)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+
+def test_collective_host_plane(ray_start_regular):
+    """Tasks form a group and allreduce over the rendezvous actor."""
+
+    @ray_tpu.remote
+    def member(rank, world):
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world, rank, backend="cpu",
+                                  group_name="g1")
+        out = col.allreduce(np.full(4, float(rank + 1)), group_name="g1")
+        gathered = col.allgather(np.array([rank]), group_name="g1")
+        col.barrier(group_name="g1")
+        return float(out[0]), [int(g[0]) for g in gathered]
+
+    results = ray_tpu.get([member.remote(r, 2) for r in range(2)], timeout=120)
+    assert results[0][0] == results[1][0] == 3.0
+    assert results[0][1] == [0, 1]
+
+
+def test_internal_kv(ray_start_regular):
+    from ray_tpu.experimental import internal_kv
+
+    assert internal_kv._kv_put(b"k", b"v")
+    assert internal_kv._kv_get(b"k") == b"v"
+    assert internal_kv._kv_exists(b"k")
+    assert internal_kv._kv_list(b"") == [b"k"]
+    assert internal_kv._kv_del(b"k")
+    assert not internal_kv._kv_exists(b"k")
+
+
+def test_state_api(ray_start_regular):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+
+    @ray_tpu.remote
+    def t():
+        return 1
+
+    ray_tpu.get([t.remote() for _ in range(3)])
+    import time
+
+    time.sleep(1.5)  # task events flush interval
+    nodes = state.list_nodes()
+    assert len(nodes) == 1
+    actors = state.list_actors()
+    assert any(x["class_name"] == "A" for x in actors)
+    tasks = state.list_tasks()
+    assert any(x["name"] == "t" for x in tasks)
+    summary = state.summarize_tasks()
+    assert summary["by_name"].get("t", 0) >= 1
+    jobs = state.list_jobs()
+    assert len(jobs) == 1
+
+
+def test_metrics(ray_start_regular):
+    import time
+
+    from ray_tpu.util.metrics import Counter, Gauge, get_metrics_snapshot
+
+    c = Counter("test_requests", "reqs", ("route",))
+    c.inc(2.0, tags={"route": "/a"})
+    g = Gauge("test_depth", "queue depth")
+    g.set(7.0)
+    time.sleep(1.2)
+    c.inc(1.0, tags={"route": "/a"})  # triggers flush past interval
+    time.sleep(0.3)
+    snap = get_metrics_snapshot()
+    merged = {}
+    for worker_metrics in snap.values():
+        merged.update(worker_metrics)
+    assert "test_requests" in merged
+    assert "test_depth" in merged
