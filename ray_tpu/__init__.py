@@ -28,7 +28,8 @@ def init(address: str | None = None, *, resources: dict | None = None,
          labels: dict | None = None, num_cpus: float | None = None,
          object_store_memory: int | None = None, namespace: str | None = None,
          config: Config | None = None, ignore_reinit_error: bool = False,
-         log_to_driver: bool = True, _head_raylet: tuple[str, int] | None = None,
+         log_to_driver: bool = True, runtime_env: dict | None = None,
+         _head_raylet: tuple[str, int] | None = None,
          _store_path: str | None = None, _node_id: str | None = None):
     """Start (or connect to) a cluster and attach this process as a driver.
 
@@ -77,6 +78,10 @@ def init(address: str | None = None, *, resources: dict | None = None,
             is_driver=True, config=cfg)
         _driver_core_worker = cw
         api_internal.set_core_worker(cw)
+        if runtime_env is not None:
+            from ray_tpu.runtime_env import set_job_runtime_env
+
+            set_job_runtime_env(runtime_env)
 
 
 def is_initialized() -> bool:
@@ -91,6 +96,9 @@ def shutdown():
             cw.shutdown()
         api_internal.set_core_worker(None)
         _driver_core_worker = None
+        from ray_tpu.runtime_env import set_job_runtime_env
+
+        set_job_runtime_env(None)
         if _runtime_node is not None:
             _runtime_node.shutdown()
             _runtime_node = None

@@ -143,6 +143,14 @@ def _build_resources(opts: dict, default_cpus: float) -> dict:
     return normalize_resources(res)
 
 
+def _effective_runtime_env(task_env: dict | None) -> dict | None:
+    """Task env merged over the job-level default (reference semantics:
+    job runtime_env inherited unless the task overrides per-field)."""
+    from ray_tpu.runtime_env import RuntimeEnv, get_job_runtime_env
+
+    return RuntimeEnv.merge(get_job_runtime_env(), task_env)
+
+
 def _wire_strategy(opts: dict):
     """Convert a SchedulingStrategy option to wire form."""
     strategy = opts.get("scheduling_strategy")
@@ -212,7 +220,7 @@ class RemoteFunction:
             strategy=strategy,
             placement_group=pg_id,
             pg_bundle_index=bundle_index,
-            runtime_env=self._opts["runtime_env"],
+            runtime_env=_effective_runtime_env(self._opts["runtime_env"]),
         )
         returns = cw.submit_task(spec)
         refs = [ObjectRef(oid, cw.address) for oid in returns]
@@ -336,7 +344,7 @@ class ActorClass:
             strategy=strategy,
             placement_group=pg_id,
             pg_bundle_index=bundle_index,
-            runtime_env=self._opts["runtime_env"],
+            runtime_env=_effective_runtime_env(self._opts["runtime_env"]),
         )
         resp = cw.create_actor(
             spec,

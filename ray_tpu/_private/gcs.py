@@ -377,8 +377,12 @@ class GcsServer:
             return {"ok": False}
         a["state"] = ACTOR_ALIVE
         a["address"] = payload["address"]
+        # restarts doubles as the incarnation number: callers reset their
+        # per-actor sequence numbers when it changes (reference: the client
+        # queue resend path in direct_actor_task_submitter).
         await self.publish("ACTOR", {"actor_id": a["actor_id"], "state": ACTOR_ALIVE,
-                                     "address": a["address"]})
+                                     "address": a["address"],
+                                     "restarts": a["restarts"]})
         return {"ok": True}
 
     async def handle_report_actor_death(self, conn, payload):

@@ -309,6 +309,11 @@ class CoreWorker:
                 self.store.release(oid)
             if kind == serialization.KIND_EXCEPTION:
                 cause, tb = value
+                if isinstance(cause, exc.RayTpuError):
+                    # System errors (actor death, object loss, OOM, ...)
+                    # propagate as themselves, matching the reference where
+                    # ray.get raises RayActorError etc. directly.
+                    raise cause
                 raise exc.TaskError(cause, tb)
             out.append(value)
         return out
@@ -885,13 +890,18 @@ class CoreWorker:
         return pos, kwargs
 
     def _execute_task(self, spec: TaskSpec) -> dict:
+        from ray_tpu.runtime_env import runtime_env_context
+
         prev_task_id = self._current_task_id
         self._current_task_id = TaskID.from_hex(spec.task_id)
         try:
             if spec.actor_creation:
                 cls = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
-                self._actor_instance = cls(*args, **kwargs)
+                # Actor envs persist: the process is dedicated to the actor
+                # (reference: runtime-env-keyed workers, worker_pool.cc).
+                with runtime_env_context(spec.runtime_env, persistent=True):
+                    self._actor_instance = cls(*args, **kwargs)
                 return {"status": "ok", "results": []}
             if spec.actor_id:
                 fn = getattr(self._actor_instance, spec.name.split(".")[-1])
@@ -900,7 +910,8 @@ class CoreWorker:
             else:
                 fn = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
-                result = fn(*args, **kwargs)
+                with runtime_env_context(spec.runtime_env):
+                    result = fn(*args, **kwargs)
             return {"status": "ok",
                     "results": self._package_results(spec, result)}
         except Exception as e:
@@ -1007,6 +1018,7 @@ class CoreWorker:
         if st is None:
             return
         if msg["state"] == "ALIVE":
+            self._note_actor_incarnation(st, msg.get("restarts", 0))
             st["address"] = msg["address"]
             st["conn"] = None
             ev = st.get("alive_event")
@@ -1025,12 +1037,24 @@ class CoreWorker:
     def _actor_state(self, actor_id: str):
         return self.actor_handles_state.setdefault(
             actor_id, {"address": None, "conn": None, "seq": 0, "dead": False,
-                       "death_reason": "", "alive_event": None})
+                       "death_reason": "", "alive_event": None,
+                       "incarnation": 0})
+
+    @staticmethod
+    def _note_actor_incarnation(st, restarts: int):
+        """A restarted actor process has fresh per-caller ordering state, so
+        the caller's sequence numbers restart from 0 for the new
+        incarnation (otherwise the new process would buffer forever
+        waiting for seq 0)."""
+        if restarts != st.get("incarnation", 0):
+            st["incarnation"] = restarts
+            st["seq"] = 0
 
     def submit_actor_task(self, actor_id: str, spec: TaskSpec,
                           max_task_retries: int = 0) -> list[ObjectID]:
         st = self._actor_state(actor_id)
         spec.actor_seq = st["seq"]
+        spec.actor_incarnation = st["incarnation"]
         st["seq"] += 1
         returns = [ObjectID.for_task_return(TaskID.from_hex(spec.task_id), i + 1)
                    for i in range(spec.num_returns)]
@@ -1049,6 +1073,7 @@ class CoreWorker:
                 if not resp.get("found"):
                     raise exc.ActorDiedError(f"actor {actor_id[:8]} not found")
                 if resp["state"] == "ALIVE":
+                    self._note_actor_incarnation(st, resp.get("restarts", 0))
                     st["address"] = resp["address"]
                 elif resp["state"] == "DEAD":
                     st["dead"] = True
@@ -1077,6 +1102,12 @@ class CoreWorker:
             st = self._actor_state(actor_id)
             try:
                 conn = await self._actor_conn(actor_id, st)
+                if getattr(spec, "actor_incarnation", 0) != st["incarnation"]:
+                    # Actor restarted since this task got its seq-no:
+                    # re-number under the new incarnation.
+                    spec.actor_seq = st["seq"]
+                    st["seq"] += 1
+                    spec.actor_incarnation = st["incarnation"]
                 resp = await conn.call("ActorCall", {
                     "spec": spec.to_wire(), "caller_id": self.worker_id},
                     timeout=None)

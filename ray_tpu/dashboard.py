@@ -1,0 +1,135 @@
+"""Dashboard: HTTP observability endpoint for the cluster.
+
+Parity: reference dashboard/ (aiohttp head server + React SPA, modules:
+node, actor, job, state, metrics — dashboard/head.py). Here a stdlib
+threading HTTP server exposes the same data as JSON under /api/* plus a
+single self-contained HTML page; it runs inside any connected process
+(`ray_tpu.dashboard.start()`, or `ray_tpu dashboard` from the CLI).
+
+Endpoints: /api/version /api/nodes /api/actors /api/jobs /api/tasks
+/api/summary /api/cluster_status /api/submission_jobs
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+body { font-family: system-ui, sans-serif; margin: 2rem; background: #fafafa; }
+h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+table { border-collapse: collapse; margin-top: .5rem; }
+td, th { border: 1px solid #ccc; padding: .25rem .6rem; font-size: .85rem; }
+th { background: #eee; text-align: left; }
+#err { color: #b00; }
+</style></head><body>
+<h1>ray_tpu dashboard</h1><div id="err"></div>
+<div id="sections"></div>
+<script>
+const SECTIONS = [
+  ["Cluster", "/api/cluster_status"], ["Nodes", "/api/nodes"],
+  ["Actors", "/api/actors"], ["Jobs", "/api/jobs"],
+  ["Submission jobs", "/api/submission_jobs"],
+  ["Task summary", "/api/summary"]];
+function table(rows) {
+  if (!Array.isArray(rows)) rows = [rows];
+  if (!rows.length) return "<i>none</i>";
+  const keys = Object.keys(rows[0]);
+  let h = "<table><tr>" + keys.map(k => `<th>${k}</th>`).join("") + "</tr>";
+  for (const r of rows) h += "<tr>" + keys.map(
+    k => `<td>${JSON.stringify(r[k])}</td>`).join("") + "</tr>";
+  return h + "</table>";
+}
+async function refresh() {
+  let html = "";
+  for (const [name, url] of SECTIONS) {
+    try {
+      const data = await (await fetch(url)).json();
+      html += `<h2>${name}</h2>` + table(data);
+    } catch (e) { document.getElementById("err").textContent = String(e); }
+  }
+  document.getElementById("sections").innerHTML = html;
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+def _json_default(o):
+    try:
+        return o.item()  # numpy scalars
+    except AttributeError:
+        return str(o)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        from ray_tpu.util import state
+
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                return self._send(200, _PAGE.encode(), "text/html")
+            if path == "/api/version":
+                import ray_tpu
+
+                data = {"version": ray_tpu.__version__}
+            elif path == "/api/nodes":
+                data = state.list_nodes()
+            elif path == "/api/actors":
+                data = state.list_actors()
+            elif path == "/api/jobs":
+                data = state.list_jobs()
+            elif path == "/api/tasks":
+                data = state.list_tasks()
+            elif path == "/api/summary":
+                data = state.summarize_tasks()
+            elif path == "/api/cluster_status":
+                data = state.cluster_status()
+            elif path == "/api/submission_jobs":
+                from ray_tpu.job_submission import JobSubmissionClient
+
+                data = [j.__dict__ for j in JobSubmissionClient().list_jobs()]
+            else:
+                return self._send(404, b'{"error": "not found"}',
+                                  "application/json")
+            body = json.dumps(data, default=_json_default).encode()
+            return self._send(200, body, "application/json")
+        except Exception as e:  # noqa: BLE001
+            body = json.dumps({"error": str(e)}).encode()
+            return self._send(500, body, "application/json")
+
+
+_server: ThreadingHTTPServer | None = None
+
+
+def start(host: str = "127.0.0.1", port: int = 8265) -> int:
+    """Start the dashboard server; returns the bound port (the reference's
+    default dashboard port is also 8265)."""
+    global _server
+    if _server is not None:
+        return _server.server_address[1]
+    _server = ThreadingHTTPServer((host, port), _Handler)
+    t = threading.Thread(target=_server.serve_forever, daemon=True,
+                         name="ray_tpu-dashboard")
+    t.start()
+    return _server.server_address[1]
+
+
+def stop() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

@@ -14,7 +14,7 @@ from typing import Any, Iterable
 import numpy as np
 
 import ray_tpu
-from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.dataset import DataIterator, Dataset, GroupedData
 
 DEFAULT_BLOCK_COUNT = 8
 
@@ -125,7 +125,7 @@ def _expand(paths: str | list) -> list:
 
 
 __all__ = [
-    "Dataset", "from_items", "range", "range_tensor", "from_numpy",
-    "from_pandas", "read_text", "read_json", "read_csv", "read_numpy",
-    "read_parquet",
+    "Dataset", "DataIterator", "GroupedData", "from_items", "range",
+    "range_tensor", "from_numpy", "from_pandas", "read_text", "read_json",
+    "read_csv", "read_numpy", "read_parquet",
 ]

@@ -41,6 +41,9 @@ class _Stage:
     all_to_all: bool = False  # needs every input block materialized first
     all_to_all_fn: Callable | None = None  # blocks(list of refs) -> list[blocks]
     num_cpus: float = 1.0
+    # >0: run on a pool of stateful actors instead of tasks (parity:
+    # reference ActorPoolMapOperator for callable-class UDFs).
+    actor_pool: int = 0
 
 
 @ray_tpu.remote
@@ -49,6 +52,19 @@ def _apply_stage(fn_blob, block):
 
     fn = serialization.loads_func(fn_blob)
     return fn(block)
+
+
+@ray_tpu.remote
+class _StageActor:
+    """Stateful map worker: constructs the UDF once, applies it per block."""
+
+    def __init__(self, fn_blob):
+        from ray_tpu._private import serialization
+
+        self._fn = serialization.loads_func(fn_blob)
+
+    def apply(self, block):
+        return self._fn(block)
 
 
 class Dataset:
@@ -65,23 +81,44 @@ class Dataset:
         return Dataset(self._source, self._stages + [stage])
 
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
-                    batch_size: int | None = None, **_ignored) -> "Dataset":
+                    batch_size: int | None = None,
+                    concurrency: int | None = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: dict | None = None,
+                    **_ignored) -> "Dataset":
+        is_class = isinstance(fn, type)
+        if is_class and concurrency is None:
+            concurrency = 2
+
         def stage_fn(block, fn=fn, batch_format=batch_format,
-                     batch_size=batch_size):
+                     batch_size=batch_size, is_class=is_class,
+                     ctor_args=fn_constructor_args,
+                     ctor_kwargs=fn_constructor_kwargs):
+            if is_class:
+                # Construct once per process (the _StageActor deserializes
+                # this function a single time, so the attribute persists
+                # across blocks — stateful UDF semantics).
+                udf = getattr(stage_fn, "_cached_udf", None)
+                if udf is None:
+                    udf = fn(*ctor_args, **(ctor_kwargs or {}))
+                    stage_fn._cached_udf = udf
+            else:
+                udf = fn
             if batch_size is None:
                 batch = block_to_batch(block) if batch_format == "numpy" \
                     else block_to_rows(block)
-                return batch_to_block(fn(batch), batch_format)
+                return batch_to_block(udf(batch), batch_format)
             outs = []
             n = block_len(block)
             for s in range(0, n, batch_size):
                 piece = slice_block(block, s, min(s + batch_size, n))
                 batch = block_to_batch(piece) if batch_format == "numpy" \
                     else block_to_rows(piece)
-                outs.append(batch_to_block(fn(batch), batch_format))
+                outs.append(batch_to_block(udf(batch), batch_format))
             return concat_blocks(outs)
 
-        return self._with(_Stage("map_batches", stage_fn))
+        return self._with(_Stage("map_batches", stage_fn,
+                                 actor_pool=concurrency or 0))
 
     def map(self, fn: Callable) -> "Dataset":
         def stage_fn(block, fn=fn):
@@ -129,6 +166,78 @@ class Dataset:
         return self._with(_Stage("repartition", None, all_to_all=True,
                                  all_to_all_fn=repart_fn))
 
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (parity: dataset.py Dataset.limit)."""
+        rows = []
+        for r in self.iter_rows():
+            rows.append(r)
+            if len(rows) >= n:
+                break
+        return Dataset([rows], [])
+
+    def random_sample(self, fraction: float, *, seed: int | None = None
+                      ) -> "Dataset":
+        def stage_fn(block, fraction=fraction, seed=seed):
+            rng = _random.Random(seed)
+            return [r for r in block_to_rows(block)
+                    if rng.random() < fraction]
+
+        return self._with(_Stage("random_sample", stage_fn))
+
+    def unique(self, column: str) -> list:
+        seen = []
+        seen_set = set()
+        for r in self.iter_rows():
+            v = r[column] if isinstance(r, dict) else r
+            if v not in seen_set:
+                seen_set.add(v)
+                seen.append(v)
+        return seen
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def stage_fn(block, name=name, fn=fn):
+            batch = block_to_batch(block)
+            batch = dict(batch)
+            batch[name] = np.asarray(fn(batch))
+            return batch
+
+        return self._with(_Stage("add_column", stage_fn))
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        def stage_fn(block, cols=tuple(cols)):
+            batch = block_to_batch(block)
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self._with(_Stage("drop_columns", stage_fn))
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        def stage_fn(block, cols=tuple(cols)):
+            batch = block_to_batch(block)
+            return {k: batch[k] for k in cols}
+
+        return self._with(_Stage("select_columns", stage_fn))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-wise zip of two datasets (parity: Dataset.zip)."""
+        rows_a = self.take_all()
+        rows_b = other.take_all()
+        if len(rows_a) != len(rows_b):
+            raise ValueError(
+                f"zip requires equal row counts ({len(rows_a)} vs {len(rows_b)})")
+        out = []
+        for a, b in _builtin_zip(rows_a, rows_b):
+            if isinstance(a, dict) and isinstance(b, dict):
+                merged = dict(a)
+                for k, v in b.items():
+                    merged[k if k not in merged else k + "_1"] = v
+                out.append(merged)
+            else:
+                out.append((a, b))
+        return Dataset([out], [])
+
+    def groupby(self, key: str | Callable) -> "GroupedData":
+        return GroupedData(self, key)
+
     def sort(self, key: Callable | str | None = None,
              descending: bool = False) -> "Dataset":
         def sort_fn(blocks: list, key=key, descending=descending):
@@ -157,20 +266,49 @@ class Dataset:
 
         blocks: Iterable = self._source
         stages = list(self._stages)
-        # Split into segments at all-to-all barriers.
+        # Split into segments at all-to-all barriers and actor-pool stages.
         segment: list[_Stage] = []
         segments: list[tuple[list[_Stage], _Stage | None]] = []
         for st in stages:
             if st.all_to_all:
                 segments.append((segment, st))
                 segment = []
+            elif st.actor_pool:
+                # Actor stage runs alone in its own segment.
+                if segment:
+                    segments.append((segment, None))
+                segments.append(([st], None))
+                segment = []
             else:
                 segment.append(st)
         segments.append((segment, None))
 
+        def run_actor_segment(in_blocks: Iterable, st: _Stage) -> Iterator:
+            blob = serialization.dumps_func(st.fn)
+            actors = [_StageActor.remote(blob) for _ in range(st.actor_pool)]
+            window: list = []
+            i = 0
+            try:
+                for blk in in_blocks:
+                    window.append(actors[i % len(actors)].apply.remote(blk))
+                    i += 1
+                    if len(window) >= max(max_in_flight, len(actors)):
+                        yield ray_tpu.get(window.pop(0), timeout=300)
+                while window:
+                    yield ray_tpu.get(window.pop(0), timeout=300)
+            finally:
+                for a in actors:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass
+
         def run_segment(in_blocks: Iterable, seg: list[_Stage]) -> Iterator:
             if not seg:
                 yield from in_blocks
+                return
+            if len(seg) == 1 and seg[0].actor_pool:
+                yield from run_actor_segment(in_blocks, seg[0])
                 return
             fn_blobs = [serialization.dumps_func(s.fn) for s in seg]
 
@@ -306,6 +444,158 @@ class Dataset:
             return {k: type(v).__name__ for k, v in row.items()}
         return type(row).__name__
 
+    def streaming_split(self, n: int, *, equal: bool = True
+                        ) -> list["DataIterator"]:
+        """n iterators over disjoint shards, for per-train-worker ingest
+        (parity: Dataset.streaming_split feeding Train workers)."""
+        shards = self.split(n, equal=equal)
+        return [DataIterator(s) for s in shards]
+
+    def iterator(self) -> "DataIterator":
+        return DataIterator(self)
+
+    # ------------- writes -------------
+
+    def write_json(self, path: str) -> None:
+        import json as _json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_output_blocks()):
+            with open(os.path.join(path, f"part-{i:05d}.jsonl"), "w") as f:
+                for r in block_to_rows(block):
+                    f.write(_json.dumps(_jsonable(r)) + "\n")
+
+    def write_csv(self, path: str) -> None:
+        import csv as _csv
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_output_blocks()):
+            rows = [r if isinstance(r, dict) else {"value": r}
+                    for r in block_to_rows(block)]
+            if not rows:
+                continue
+            with open(os.path.join(path, f"part-{i:05d}.csv"), "w",
+                      newline="") as f:
+                w = _csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+                w.writeheader()
+                w.writerows(_jsonable(r) for r in rows)
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_output_blocks()):
+            rows = [r if isinstance(r, dict) else {"value": r}
+                    for r in block_to_rows(block)]
+            if not rows:
+                continue
+            table = pa.Table.from_pylist([_jsonable(r) for r in rows])
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_numpy(self, path: str, *, column: str = "data") -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_output_blocks()):
+            batch = block_to_batch(block)
+            if column in batch:
+                np.save(os.path.join(path, f"part-{i:05d}.npy"), batch[column])
+
     def __repr__(self):
         names = [s.name for s in self._stages]
         return f"Dataset(blocks={len(self._source)}, stages={names})"
+
+
+def _jsonable(r):
+    if isinstance(r, dict):
+        return {k: _jsonable(v) for k, v in r.items()}
+    if isinstance(r, np.generic):
+        return r.item()
+    if isinstance(r, np.ndarray):
+        return r.tolist()
+    return r
+
+
+_builtin_zip = zip
+
+
+class DataIterator:
+    """Per-consumer iterator over a dataset shard (parity: reference
+    ray.data.DataIterator from streaming_split / Dataset.iterator)."""
+
+    def __init__(self, ds: Dataset):
+        self._ds = ds
+
+    def iter_rows(self):
+        return self._ds.iter_rows()
+
+    def iter_batches(self, **kwargs):
+        return self._ds.iter_batches(**kwargs)
+
+    def iter_jax_batches(self, **kwargs):
+        return self._ds.iter_jax_batches(**kwargs)
+
+
+class GroupedData:
+    """ds.groupby(key).count()/sum()/mean()/min()/max()/aggregate()
+    (parity: reference data/grouped_data.py). Executes as a hash shuffle:
+    rows bucket by key hash into num_blocks partitions, then per-partition
+    aggregation runs block-parallel."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def _key_fn(self):
+        key = self._key
+        if callable(key):
+            return key
+        return lambda r: r[key]
+
+    def _groups(self) -> dict:
+        kf = self._key_fn()
+        groups: dict = {}
+        for r in self._ds.iter_rows():
+            groups.setdefault(kf(r), []).append(r)
+        return groups
+
+    def count(self) -> "Dataset":
+        keyname = self._key if isinstance(self._key, str) else "key"
+        rows = [{keyname: k, "count()": len(v)}
+                for k, v in sorted(self._groups().items())]
+        return Dataset([rows], [])
+
+    def _agg(self, on: str, fn: Callable, label: str) -> "Dataset":
+        keyname = self._key if isinstance(self._key, str) else "key"
+        rows = []
+        for k, grp in sorted(self._groups().items()):
+            vals = [r[on] for r in grp]
+            rows.append({keyname: k, f"{label}({on})": fn(vals)})
+        return Dataset([rows], [])
+
+    def sum(self, on: str) -> "Dataset":
+        return self._agg(on, sum, "sum")
+
+    def min(self, on: str) -> "Dataset":
+        return self._agg(on, min, "min")
+
+    def max(self, on: str) -> "Dataset":
+        return self._agg(on, max, "max")
+
+    def mean(self, on: str) -> "Dataset":
+        return self._agg(on, lambda v: sum(v) / len(v), "mean")
+
+    def aggregate(self, on: str, fn: Callable, label: str = "agg") -> "Dataset":
+        return self._agg(on, fn, label)
+
+    def map_groups(self, fn: Callable) -> "Dataset":
+        rows = []
+        for _k, grp in sorted(self._groups().items()):
+            out = fn(grp)
+            rows.extend(out if isinstance(out, list) else [out])
+        return Dataset([rows], [])

@@ -1,0 +1,224 @@
+"""Job submission: run driver entrypoints on the cluster.
+
+Parity: reference job API (dashboard/modules/job/ — JobSubmissionClient in
+dashboard_sdk.py, job_manager.py's per-job supervisor actor, `ray job
+submit` CLI at scripts.py:2484). A detached JobSupervisor actor per job
+runs the entrypoint as a subprocess on a cluster node, streams its output
+to a log file, and records status in the GCS KV store, so the submitting
+client can disconnect and later poll status/logs from anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+
+import ray_tpu
+
+# Job lifecycle states (reference: job_submission JobStatus)
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+_KV_NS = "job_submission"
+
+
+@dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str = PENDING
+    message: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    metadata: dict = field(default_factory=dict)
+    log_path: str = ""
+
+
+def _kv_put(key: str, value: dict) -> None:
+    import json
+
+    cw = ray_tpu._private.api_internal.get_core_worker()
+    cw._run(cw.gcs.call("KVPut", {"ns": _KV_NS, "key": key,
+                                  "value": json.dumps(value).encode()}))
+
+
+def _kv_get(key: str) -> dict | None:
+    import json
+
+    cw = ray_tpu._private.api_internal.get_core_worker()
+    resp = cw._run(cw.gcs.call("KVGet", {"ns": _KV_NS, "key": key}))
+    v = resp.get("value")
+    return json.loads(bytes(v).decode()) if v else None
+
+
+def _kv_keys() -> list[str]:
+    cw = ray_tpu._private.api_internal.get_core_worker()
+    resp = cw._run(cw.gcs.call("KVKeys", {"ns": _KV_NS, "prefix": ""}))
+    return [k if isinstance(k, str) else bytes(k).decode()
+            for k in resp.get("keys", [])]
+
+
+@ray_tpu.remote
+class JobSupervisor:
+    """Detached actor owning one job's subprocess (reference:
+    job_manager.py JobSupervisor)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env_vars: dict | None, log_path: str, metadata: dict):
+        import json
+        import subprocess
+        import threading
+
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.log_path = log_path
+        self._stopped = False
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        self._record(RUNNING, start_time=time.time(), metadata=metadata)
+        self._logf = open(log_path, "wb", buffering=0)
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, stdout=self._logf,
+            stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _record(self, status: str, **extra) -> None:
+        info = _kv_get(self.submission_id) or {}
+        info.update({"submission_id": self.submission_id,
+                     "entrypoint": self.entrypoint,
+                     "status": status, "log_path": self.log_path}, **{})
+        info.update(extra)
+        _kv_put(self.submission_id, info)
+
+    def _wait(self) -> None:
+        code = self._proc.wait()
+        if self._stopped:
+            self._record(STOPPED, end_time=time.time(),
+                         message="stopped by user")
+        elif code == 0:
+            self._record(SUCCEEDED, end_time=time.time())
+        else:
+            self._record(FAILED, end_time=time.time(),
+                         message=f"entrypoint exited with code {code}")
+        self._logf.close()
+
+    def stop(self) -> bool:
+        import signal
+
+        self._stopped = True
+        try:
+            os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+        except ProcessLookupError:
+            return False
+        return True
+
+    def running(self) -> bool:
+        return self._proc.poll() is None
+
+    def ping(self) -> bool:
+        return True
+
+
+class JobSubmissionClient:
+    """Parity: reference JobSubmissionClient (REST in the reference; actor
+    RPC here — same method surface)."""
+
+    def __init__(self, address: str | None = None):
+        if not ray_tpu.is_initialized():
+            if address:
+                raise RuntimeError(
+                    "connect with ray_tpu.init(address=...) before creating "
+                    "a JobSubmissionClient")
+            ray_tpu.init()
+        self._log_dir = os.path.join("/tmp", "ray_tpu", "job_logs")
+        os.makedirs(self._log_dir, exist_ok=True)
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: dict | None = None,
+                   submission_id: str | None = None,
+                   metadata: dict | None = None) -> str:
+        sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        if _kv_get(sid) is not None:
+            raise ValueError(f"job {sid!r} already exists")
+        log_path = os.path.join(self._log_dir, f"{sid}.log")
+        env_vars = (runtime_env or {}).get("env_vars")
+        _kv_put(sid, {"submission_id": sid, "entrypoint": entrypoint,
+                      "status": PENDING, "log_path": log_path,
+                      "metadata": metadata or {}})
+        JobSupervisor.options(
+            name=f"_job_supervisor:{sid}", lifetime="detached",
+            namespace="_job_submission").remote(
+            sid, entrypoint, env_vars, log_path, metadata or {})
+        return sid
+
+    def get_job_status(self, submission_id: str) -> str:
+        info = _kv_get(submission_id)
+        if info is None:
+            raise ValueError(f"job {submission_id!r} not found")
+        return info["status"]
+
+    def get_job_info(self, submission_id: str) -> JobInfo:
+        info = _kv_get(submission_id)
+        if info is None:
+            raise ValueError(f"job {submission_id!r} not found")
+        return JobInfo(**{k: v for k, v in info.items()
+                          if k in JobInfo.__dataclass_fields__})
+
+    def get_job_logs(self, submission_id: str) -> str:
+        info = _kv_get(submission_id)
+        if info is None:
+            raise ValueError(f"job {submission_id!r} not found")
+        path = info.get("log_path")
+        if not path or not os.path.exists(path):
+            return ""
+        with open(path, "rb") as f:
+            return f.read().decode(errors="replace")
+
+    def list_jobs(self) -> list[JobInfo]:
+        out = []
+        for key in _kv_keys():
+            info = _kv_get(key)
+            if info:
+                out.append(JobInfo(**{k: v for k, v in info.items()
+                                      if k in JobInfo.__dataclass_fields__}))
+        return sorted(out, key=lambda j: j.start_time)
+
+    def stop_job(self, submission_id: str) -> bool:
+        try:
+            sup = ray_tpu.get_actor(f"_job_supervisor:{submission_id}",
+                                    namespace="_job_submission")
+        except Exception:
+            return False
+        return ray_tpu.get(sup.stop.remote())
+
+    def delete_job(self, submission_id: str) -> bool:
+        info = _kv_get(submission_id)
+        if info is None:
+            return False
+        if info["status"] in (PENDING, RUNNING):
+            raise RuntimeError("stop the job before deleting it")
+        cw = ray_tpu._private.api_internal.get_core_worker()
+        cw._run(cw.gcs.call("KVDel", {"ns": _KV_NS, "key": submission_id}))
+        return True
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 60.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in (SUCCEEDED, FAILED, STOPPED):
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"job {submission_id} still {status} after {timeout}s")
+
+
+__all__ = ["JobSubmissionClient", "JobInfo", "PENDING", "RUNNING",
+           "SUCCEEDED", "FAILED", "STOPPED"]

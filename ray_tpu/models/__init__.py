@@ -1,0 +1,34 @@
+"""Model families shipped with the framework (TPU-native flax modules).
+
+The reference ships no model implementations (its release gates pull
+GPT-J/vicuna through external torch engines); here the flagship decoder,
+an expert-parallel MoE, and the generation path are part of the framework.
+"""
+
+from ray_tpu.models.llama import (
+    LLAMA2_7B,
+    LLAMA2_13B,
+    LLAMA3_8B,
+    TINY,
+    LlamaConfig,
+    LlamaModel,
+    cross_entropy_loss,
+    init_kv_caches,
+)
+from ray_tpu.models.moe import (
+    MIXTRAL_8X7B,
+    MOE_RULES,
+    TINY_MOE,
+    MoEConfig,
+    MoEModel,
+    moe_aux_loss,
+)
+from ray_tpu.models.generate import Generator, SamplingParams, generate
+
+__all__ = [
+    "LlamaConfig", "LlamaModel", "LLAMA2_7B", "LLAMA2_13B", "LLAMA3_8B",
+    "TINY", "cross_entropy_loss", "init_kv_caches",
+    "MoEConfig", "MoEModel", "MIXTRAL_8X7B", "TINY_MOE", "MOE_RULES",
+    "moe_aux_loss",
+    "Generator", "SamplingParams", "generate",
+]

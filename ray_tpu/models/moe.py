@@ -1,0 +1,247 @@
+"""Mixture-of-Experts decoder (Mixtral-style), TPU-first with expert
+parallelism.
+
+The reference has no MoE / expert-parallel support at all (SURVEY.md §2.4:
+EP "absent"); this is a native addition. Design follows the GSPMD MoE
+idiom (Switch/GShard, public pattern): routing produces capacity-bounded
+dispatch/combine one-hot tensors, expert FFNs are a single batched einsum
+over a leading expert dimension, and *expert parallelism is a sharding*,
+not message passing — the expert dimension of the weights and the
+dispatched activations is sharded over the mesh axis `ep`
+(`MOE_RULES`), so XLA inserts the all-to-alls over ICI.
+
+Everything stays static-shape (capacity-bounded dispatch, no ragged
+gather) so the whole step compiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import (
+    RMSNorm,
+    apply_rope,
+    rope_frequencies,
+)
+from ray_tpu.ops.attention import flash_attention, mha_reference, ring_attention
+from ray_tpu.parallel.sharding import P, ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.01
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"  # "flash" | "ring" | "reference"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _maybe_constrain(x, spec):
+    """with_sharding_constraint when a mesh is active; no-op otherwise
+    (unit tests run the model without any mesh)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or mesh.empty or not any(
+                a in mesh.axis_names for a in ("ep", "tp")):
+            return x
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception:
+        return x
+
+
+MIXTRAL_8X7B = MoEConfig()
+TINY_MOE = MoEConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                     n_kv_heads=4, d_ff=128, n_experts=4, experts_per_token=2,
+                     max_seq_len=128, dtype=jnp.float32,
+                     attention="reference", remat=False)
+
+
+class MoEAttention(nn.Module):
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        dense = functools.partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                                  param_dtype=cfg.dtype)
+        q = dense(Hq * Dh, name="q_proj")(x).reshape(B, S, Hq, Dh)
+        k = dense(Hkv * Dh, name="k_proj")(x).reshape(B, S, Hkv, Dh)
+        v = dense(Hkv * Dh, name="v_proj")(x).reshape(B, S, Hkv, Dh)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+
+        cos, sin = rope_frequencies(Dh, cfg.max_seq_len, cfg.rope_theta)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        if Hkv != Hq:
+            rep = Hq // Hkv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        if cfg.attention == "flash":
+            out = flash_attention(q, k, v, None, True)
+        elif cfg.attention == "ring":
+            out = ring_attention(q, k, v, axis="sp", causal=True)
+        else:
+            out = mha_reference(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, Hq * Dh)
+        return dense(cfg.d_model, name="o_proj")(out)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert FFN with capacity-based dense dispatch.
+
+    Dispatch/combine are einsums against one-hot (token, expert, slot)
+    tensors; expert weights carry a leading E dim sharded over `ep`.
+    """
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, M = x.shape
+        E, K = cfg.n_experts, cfg.experts_per_token
+        G = B * S
+        # Per-expert slot budget; tokens routed past it are dropped (their
+        # residual stream passes through unchanged).
+        C = max(1, int(cfg.capacity_factor * G * K / E))
+
+        xf = x.reshape(G, M)
+        router = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router")
+        logits = router(xf.astype(jnp.float32))          # (G, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        top_p, top_e = jax.lax.top_k(probs, K)           # (G, K)
+        top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+        # Slot assignment: position of each (token, k) within its expert's
+        # queue, computed with a cumsum over the flat token order.
+        e_onehot = jax.nn.one_hot(top_e, E, dtype=jnp.float32)  # (G, K, E)
+        # priority: k=0 choices fill before k=1 across all tokens
+        flat = e_onehot.transpose(1, 0, 2).reshape(K * G, E)
+        pos_in_expert = (jnp.cumsum(flat, axis=0) - flat)        # (K*G, E)
+        pos = pos_in_expert.reshape(K, G, E).transpose(1, 0, 2)  # (G, K, E)
+        slot = jnp.sum(pos * e_onehot, axis=-1).astype(jnp.int32)  # (G, K)
+        keep = (slot < C).astype(jnp.float32)
+
+        # dispatch: (G, E, C) one-hot; combine adds the gate probs.
+        slot_oh = jax.nn.one_hot(slot, C, dtype=jnp.float32)     # (G, K, C)
+        dispatch = jnp.einsum("gke,gkc,gk->gec", e_onehot, slot_oh, keep)
+        combine = jnp.einsum("gec,gke,gk->gec", dispatch, e_onehot,
+                             top_p * keep)
+
+        # Load-balance aux loss (Switch eq. 4): E * Σ_e f_e · p_e.
+        f_e = e_onehot.sum(axis=(0, 1)) / (G * K)                # (E,)
+        p_e = probs.mean(axis=0)                                  # (E,)
+        aux = E * jnp.sum(f_e * p_e) * cfg.aux_loss_coef
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        expert_in = jnp.einsum("gec,gm->ecm", dispatch,
+                               xf.astype(jnp.float32)).astype(cfg.dtype)
+        expert_in = _maybe_constrain(expert_in, P("ep", None, "tp"))
+
+        # Batched expert FFN (SwiGLU), leading expert dim sharded over ep.
+        w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
+                            (E, M, cfg.d_ff), cfg.dtype)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (E, M, cfg.d_ff), cfg.dtype)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (E, cfg.d_ff, M), cfg.dtype)
+        h = jnp.einsum("ecm,emf->ecf", expert_in, w_gate)
+        u = jnp.einsum("ecm,emf->ecf", expert_in, w_up)
+        out_e = jnp.einsum("ecf,efm->ecm", nn.silu(h) * u, w_down)
+
+        out = jnp.einsum("gec,ecm->gm", combine,
+                         out_e.astype(jnp.float32)).astype(cfg.dtype)
+        return out.reshape(B, S, M)
+
+
+class MoEDecoderLayer(nn.Module):
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        h = RMSNorm(cfg.rms_eps, name="input_norm")(x)
+        x = x + MoEAttention(cfg, name="attn")(h, positions)
+        h = RMSNorm(cfg.rms_eps, name="post_attn_norm")(x)
+        x = x + MoEMLP(cfg, name="moe")(h)
+        return x
+
+
+class MoEModel(nn.Module):
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     param_dtype=cfg.dtype, name="embed")(tokens)
+        layer_cls = MoEDecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                MoEDecoderLayer,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.n_layers):
+            x = layer_cls(cfg, name=f"layers_{i}")(x, positions)
+        x = RMSNorm(cfg.rms_eps, name="norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          param_dtype=cfg.dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def moe_aux_loss(intermediates) -> jnp.ndarray:
+    """Sum the sown per-layer aux losses from apply(..., mutable=['intermediates'])."""
+    leaves = jax.tree_util.tree_leaves(intermediates)
+    if not leaves:
+        return jnp.array(0.0, jnp.float32)
+    return sum(jnp.asarray(l, jnp.float32).sum() for l in leaves)
+
+
+# Sharding rules: transformer rules + expert weights sharded over ep (and
+# tp/fsdp inside each expert). The router stays replicated.
+MOE_RULES = ShardingRules([
+    (r"embed/embedding", P("tp", "fsdp")),
+    (r"(q_proj|k_proj|v_proj)/kernel", P("fsdp", "tp")),
+    (r"o_proj/kernel", P("tp", "fsdp")),
+    (r"router/kernel", P()),
+    (r"(w_gate|w_up)$", P("ep", "fsdp", "tp")),
+    (r"w_down$", P("ep", "tp", "fsdp")),
+    (r"lm_head/kernel", P("fsdp", "tp")),
+    (r"(norm|ln|scale|bias)", P()),
+], default=P())
+
+
+def count_flops_per_token(cfg: MoEConfig) -> float:
+    """Active-parameter forward+backward FLOPs per token."""
+    attn = (cfg.d_model * cfg.head_dim * (cfg.n_heads + 2 * cfg.n_kv_heads)
+            + cfg.n_heads * cfg.head_dim * cfg.d_model)
+    ffn_active = cfg.experts_per_token * 3 * cfg.d_model * cfg.d_ff
+    n = (2 * cfg.vocab_size * cfg.d_model
+         + cfg.n_layers * (attn + ffn_active))
+    return 6.0 * n

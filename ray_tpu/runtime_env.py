@@ -1,0 +1,172 @@
+"""Per-task/actor runtime environments.
+
+Parity: reference python/ray/runtime_env/runtime_env.py +
+_private/runtime_env/ plugins (design doc: python/ray/runtime_env/
+ARCHITECTURE.md) — env_vars, working_dir, py_modules, and a plugin hook
+API. The reference materializes envs through a per-node RuntimeEnvAgent
+with ref-counted caching; here nodes share a filesystem (fake-multinode
+model, SURVEY.md §4), so materialization is in-process at task execution:
+env vars are swapped around the task, working_dir/py_modules go onto
+sys.path, and plugins get a setup callback in the worker.
+
+Supported fields:
+  env_vars: dict[str, str]      — set for the duration of the task; for
+                                  actors they persist (dedicated process).
+  working_dir: str              — chdir + sys.path for the task.
+  py_modules: list[str]         — directories prepended to sys.path.
+  config: dict                  — opaque; passed to plugins.
+  <plugin name>: Any            — handled by a registered plugin.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Any, Callable
+
+_KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "config"}
+
+# name -> setup(value, env_dict) callback, run in the executing worker.
+_PLUGINS: dict[str, Callable[[Any, dict], None]] = {}
+
+
+def register_plugin(name: str, setup: Callable[[Any, dict], None]) -> None:
+    """Register a runtime_env plugin (parity: reference RuntimeEnvPlugin
+    classes registered via RAY_RUNTIME_ENV_PLUGINS)."""
+    _PLUGINS[name] = setup
+
+
+def unregister_plugin(name: str) -> None:
+    _PLUGINS.pop(name, None)
+
+
+class RuntimeEnv(dict):
+    """Validated runtime environment; behaves as a plain dict on the wire."""
+
+    def __init__(self, *, env_vars: dict | None = None,
+                 working_dir: str | None = None,
+                 py_modules: list | None = None,
+                 config: dict | None = None, **plugin_fields):
+        super().__init__()
+        if env_vars is not None:
+            if not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env_vars.items()):
+                raise TypeError("env_vars must be dict[str, str]")
+            self["env_vars"] = dict(env_vars)
+        if working_dir is not None:
+            if not isinstance(working_dir, str):
+                raise TypeError("working_dir must be a path string")
+            self["working_dir"] = working_dir
+        if py_modules is not None:
+            if not isinstance(py_modules, (list, tuple)):
+                raise TypeError("py_modules must be a list of paths")
+            self["py_modules"] = list(py_modules)
+        if config is not None:
+            self["config"] = dict(config)
+        for k, v in plugin_fields.items():
+            if k not in _PLUGINS:
+                raise ValueError(
+                    f"unknown runtime_env field {k!r} (no plugin registered)")
+            self[k] = v
+
+    @staticmethod
+    def merge(parent: dict | None, child: dict | None) -> dict | None:
+        """Child overrides parent per-field; env_vars merge key-wise
+        (reference semantics for job → task inheritance)."""
+        if not parent:
+            return dict(child) if child else None
+        if not child:
+            return dict(parent)
+        out = dict(parent)
+        for k, v in child.items():
+            if k == "env_vars" and "env_vars" in out:
+                merged = dict(out["env_vars"])
+                merged.update(v)
+                out["env_vars"] = merged
+            else:
+                out[k] = v
+        return out
+
+
+@contextlib.contextmanager
+def runtime_env_context(env: dict | None, *, persistent: bool = False):
+    """Materialize `env` in this process for the duration of the block.
+
+    persistent=True (actor creation) applies without restoring — the worker
+    process is dedicated to the actor, matching the reference's
+    runtime-env-keyed worker processes (worker_pool.cc runtime env hash).
+    """
+    if not env:
+        yield
+        return
+
+    saved_env: dict[str, str | None] = {}
+    saved_cwd = None
+    added_paths: list[str] = []
+
+    env_vars = env.get("env_vars") or {}
+    for k, v in env_vars.items():
+        saved_env[k] = os.environ.get(k)
+        os.environ[k] = v
+
+    wd = env.get("working_dir")
+    if wd:
+        wd = os.path.abspath(os.path.expanduser(wd))
+        if not os.path.isdir(wd):
+            raise RuntimeEnvSetupError(f"working_dir {wd!r} does not exist")
+        saved_cwd = os.getcwd()
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+            added_paths.append(wd)
+
+    for p in env.get("py_modules") or []:
+        p = os.path.abspath(os.path.expanduser(p))
+        if not os.path.exists(p):
+            raise RuntimeEnvSetupError(f"py_module {p!r} does not exist")
+        if p not in sys.path:
+            sys.path.insert(0, p)
+            added_paths.append(p)
+
+    for name, setup in _PLUGINS.items():
+        if name in env:
+            setup(env[name], env)
+
+    try:
+        yield
+    finally:
+        if not persistent:
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            if saved_cwd is not None:
+                os.chdir(saved_cwd)
+            for p in added_paths:
+                try:
+                    sys.path.remove(p)
+                except ValueError:
+                    pass
+
+
+# Job-level default, inherited by tasks/actors without their own
+# runtime_env (set by ray_tpu.init(runtime_env=...)).
+_job_runtime_env: dict | None = None
+
+
+def set_job_runtime_env(env: dict | None) -> None:
+    global _job_runtime_env
+    _job_runtime_env = dict(env) if env else None
+
+
+def get_job_runtime_env() -> dict | None:
+    return _job_runtime_env
+
+
+from ray_tpu.exceptions import RuntimeEnvSetupError  # noqa: E402  (cycle-safe)
+
+__all__ = ["RuntimeEnv", "register_plugin", "unregister_plugin",
+           "runtime_env_context", "set_job_runtime_env",
+           "get_job_runtime_env"]

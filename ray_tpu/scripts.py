@@ -112,6 +112,60 @@ def cmd_microbenchmark(args):
     return 0
 
 
+def cmd_dashboard(args):
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu import dashboard
+
+    port = dashboard.start(port=args.port)
+    print(f"dashboard at http://127.0.0.1:{port}/")
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    dashboard.stop()
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_job(args):
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    try:
+        if args.job_cmd == "submit":
+            sid = client.submit_job(entrypoint=" ".join(args.entrypoint))
+            print(sid)
+            if args.wait:
+                status = client.wait_until_finished(sid, timeout=args.timeout)
+                print(status)
+                print(client.get_job_logs(sid), end="")
+                return 0 if status == "SUCCEEDED" else 1
+        elif args.job_cmd == "status":
+            print(client.get_job_status(args.id))
+        elif args.job_cmd == "logs":
+            print(client.get_job_logs(args.id), end="")
+        elif args.job_cmd == "list":
+            for j in client.list_jobs():
+                print(json.dumps(j.__dict__, default=str))
+        elif args.job_cmd == "stop":
+            print("stopped" if client.stop_job(args.id) else "not running")
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_timeline(args):
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util.timeline import dump_timeline
+
+    path = dump_timeline(args.output)
+    print(f"chrome trace written to {path} (open in chrome://tracing "
+          "or https://ui.perfetto.dev)")
+    ray_tpu.shutdown()
+    return 0
+
+
 def main():
     parser = argparse.ArgumentParser(prog="ray_tpu")
     parser.add_argument("--state-file", default="/tmp/ray_tpu_head.json")
@@ -137,6 +191,26 @@ def main():
 
     p = sub.add_parser("microbenchmark", help="core-runtime throughput suite")
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("dashboard", help="serve the web dashboard")
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("job", help="submit and manage jobs")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    pj = jsub.add_parser("submit")
+    pj.add_argument("entrypoint", nargs="+")
+    pj.add_argument("--wait", action="store_true")
+    pj.add_argument("--timeout", type=float, default=300.0)
+    for name in ("status", "logs", "stop"):
+        pj = jsub.add_parser(name)
+        pj.add_argument("id")
+    jsub.add_parser("list")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("timeline", help="dump chrome-trace of task events")
+    p.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    p.set_defaults(fn=cmd_timeline)
 
     args = parser.parse_args()
     sys.exit(args.fn(args) or 0)

@@ -1,0 +1,97 @@
+"""Chaos-testing utilities.
+
+Parity: reference _private/test_utils.py:1401 NodeKillerActor (random
+raylet SIGKILL during workloads) + release/nightly_tests/setup_chaos.py.
+The in-process `NodeKiller` thread kills worker raylets from a
+`cluster_utils.Cluster` at an interval, optionally re-adding replacements,
+while the test drives a workload — the assertion is that retries, actor
+restarts, and lineage reconstruction keep the workload correct
+(SURVEY.md §5 failure-detection inventory).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class NodeKiller:
+    """Kills random non-head nodes of a Cluster every `interval_s`.
+
+    with NodeKiller(cluster, interval_s=0.5, respawn=True,
+                    node_args={"num_cpus": 2}):
+        ... run workload ...
+    """
+
+    def __init__(self, cluster, *, interval_s: float = 1.0,
+                 respawn: bool = True, node_args: dict | None = None,
+                 max_kills: int | None = None, seed: int | None = None):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.respawn = respawn
+        self.node_args = node_args or {}
+        self.max_kills = max_kills
+        self.rng = random.Random(seed)
+        self.kills = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _victims(self):
+        return [n for n in self.cluster._node.nodes
+                if n is not self.cluster.head_node
+                and n.proc.poll() is None]
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            if self.max_kills is not None and self.kills >= self.max_kills:
+                return
+            victims = self._victims()
+            if not victims:
+                continue
+            node = self.rng.choice(victims)
+            try:
+                self.cluster.remove_node(node)
+                self.kills += 1
+            except Exception:
+                continue
+            if self.respawn:
+                try:
+                    self.cluster.add_node(**self.node_args)
+                except Exception:
+                    pass
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-killer")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def wait_for_condition(predicate, timeout: float = 30.0,
+                       retry_interval_ms: float = 100.0) -> None:
+    """Parity: reference _private/test_utils.py wait_for_condition."""
+    deadline = time.monotonic() + timeout
+    last_exc = None
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return
+        except Exception as e:  # noqa: BLE001
+            last_exc = e
+        time.sleep(retry_interval_ms / 1000.0)
+    msg = f"condition not met within {timeout}s"
+    if last_exc is not None:
+        msg += f" (last error: {last_exc})"
+    raise TimeoutError(msg)

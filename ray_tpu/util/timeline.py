@@ -1,0 +1,55 @@
+"""Chrome-trace timeline from GCS task events.
+
+Parity: reference `ray timeline` (scripts.py:2459) which dumps per-worker
+profile events (core_worker/profile_event.cc → task_event_buffer.h) as a
+chrome://tracing JSON. Here the GCS task-event table provides the
+RUNNING→FINISHED/FAILED pairs; rows are (node, worker), one "X" complete
+event per task execution.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu._private.api_internal import get_core_worker
+
+
+def build_trace_events(events: list[dict]) -> list[dict]:
+    """Pair per-task state transitions into chrome trace 'X' events."""
+    starts: dict[str, dict] = {}
+    trace: list[dict] = []
+    for e in sorted(events, key=lambda e: e.get("ts", 0.0)):
+        state = e.get("state")
+        tid = e.get("task_id")
+        if state == "RUNNING":
+            starts[tid] = e
+        elif state in ("FINISHED", "FAILED") and tid in starts:
+            s = starts.pop(tid)
+            trace.append({
+                "name": s.get("name", tid),
+                "cat": "task",
+                "ph": "X",
+                "ts": s["ts"] * 1e6,
+                "dur": max(0.0, (e["ts"] - s["ts"]) * 1e6),
+                "pid": s.get("node_id", "node")[:8],
+                "tid": s.get("worker_id", "worker")[:8],
+                "args": {"task_id": tid, "state": state,
+                         "job_id": s.get("job_id", "")},
+            })
+    # Unfinished tasks appear as instant events.
+    for tid, s in starts.items():
+        trace.append({"name": s.get("name", tid), "cat": "task", "ph": "i",
+                      "ts": s["ts"] * 1e6, "pid": s.get("node_id", "n")[:8],
+                      "tid": s.get("worker_id", "w")[:8], "s": "t",
+                      "args": {"task_id": tid, "state": "RUNNING"}})
+    return trace
+
+
+def dump_timeline(path: str = "/tmp/ray_tpu_timeline.json",
+                  limit: int = 100000) -> str:
+    cw = get_core_worker()
+    events = cw._run(cw.gcs.call("ListTaskEvents", {"limit": limit}))["events"]
+    trace = build_trace_events(events)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+    return path

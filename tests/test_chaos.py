@@ -1,0 +1,64 @@
+"""Chaos tests: workloads survive random node kills (parity model:
+reference python/ray/tests/chaos/ + NodeKillerActor suites)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.test_utils import NodeKiller, wait_for_condition
+
+
+@ray_tpu.remote
+def _compute(x):
+    time.sleep(0.05)
+    return x * 2
+
+
+def test_tasks_survive_node_churn(ray_start_cluster_head):
+    cluster = ray_start_cluster_head
+    for _ in range(2):
+        cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+
+    with NodeKiller(cluster, interval_s=0.7, respawn=True,
+                    node_args={"num_cpus": 2}, max_kills=2, seed=0) as killer:
+        refs = [_compute.options(max_retries=10).remote(i) for i in range(60)]
+        results = ray_tpu.get(refs, timeout=120)
+    assert results == [i * 2 for i in range(60)]
+    assert killer.kills >= 1
+
+
+def test_actor_restart_after_chaos_kill(ray_start_cluster_head):
+    cluster = ray_start_cluster_head
+    n2 = cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    # Actor pinned to the doomed node; max_restarts lets GCS reschedule it.
+    a = Counter.options(max_restarts=5, resources={"side": 0.1}).remote()
+    assert ray_tpu.get(a.incr.remote()) == 1
+    cluster.remove_node(n2)
+    # Replacement node also offers the 'side' resource.
+    cluster.add_node(num_cpus=2, resources={"side": 1})
+
+    def restarted():
+        try:
+            return ray_tpu.get(a.incr.remote(), timeout=10) >= 1
+        except ray_tpu.exceptions.RayTpuError:
+            return False
+
+    wait_for_condition(restarted, timeout=60)
+
+
+def test_wait_for_condition_raises():
+    with pytest.raises(TimeoutError):
+        wait_for_condition(lambda: False, timeout=0.3)

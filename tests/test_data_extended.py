@@ -1,0 +1,128 @@
+"""Extended ray_tpu.data tests: groupby, zip, limit, writes, actor pool,
+streaming_split (parity model: reference python/ray/data/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+pytestmark = pytest.mark.usefixtures("ray_start_regular")
+
+
+def test_limit_and_take():
+    ds = data.range(100)
+    assert ds.limit(7).take_all() == list(range(7))
+
+
+def test_groupby_count_sum_mean():
+    rows = [{"k": i % 3, "v": i} for i in range(12)]
+    ds = data.from_items(rows)
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    sums = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means[0] == (0 + 3 + 6 + 9) / 4
+
+
+def test_groupby_map_groups():
+    rows = [{"k": i % 2, "v": i} for i in range(6)]
+    out = data.from_items(rows).groupby("k").map_groups(
+        lambda grp: {"k": grp[0]["k"], "n": len(grp)}).take_all()
+    assert sorted((r["k"], r["n"]) for r in out) == [(0, 3), (1, 3)]
+
+
+def test_zip():
+    a = data.from_items([{"x": i} for i in range(5)])
+    b = data.from_items([{"y": i * 10} for i in range(5)])
+    rows = a.zip(b).take_all()
+    assert rows[3] == {"x": 3, "y": 30}
+
+
+def test_zip_mismatched_raises():
+    a = data.range(3)
+    b = data.range(4)
+    with pytest.raises(ValueError):
+        a.zip(b)
+
+
+def test_add_select_drop_columns():
+    ds = data.from_items([{"a": i, "b": i * 2} for i in range(8)])
+    ds2 = ds.add_column("c", lambda batch: batch["a"] + batch["b"])
+    rows = ds2.select_columns(["c"]).take_all()
+    assert [r["c"] for r in rows] == [3 * i for i in range(8)]
+    rows = ds2.drop_columns(["a"]).take(1)
+    assert set(rows[0].keys()) == {"b", "c"}
+
+
+def test_random_sample():
+    n = data.range(1000).random_sample(0.5, seed=7).count()
+    assert 350 < n < 650
+
+
+def test_unique():
+    ds = data.from_items([{"u": i % 4} for i in range(20)])
+    assert sorted(ds.unique("u")) == [0, 1, 2, 3]
+
+
+def test_writes_roundtrip(tmp_path):
+    rows = [{"a": i, "s": f"r{i}"} for i in range(10)]
+    ds = data.from_items(rows, override_num_blocks=2)
+
+    jdir = str(tmp_path / "j")
+    ds.write_json(jdir)
+    back = data.read_json(os.path.join(jdir, "*.jsonl"))
+    assert sorted(r["a"] for r in back.take_all()) == list(range(10))
+
+    cdir = str(tmp_path / "c")
+    ds.write_csv(cdir)
+    back = data.read_csv(os.path.join(cdir, "*.csv"))
+    assert len(back.take_all()) == 10
+
+    try:
+        import pyarrow  # noqa: F401
+    except ImportError:
+        return
+    pdir = str(tmp_path / "p")
+    ds.write_parquet(pdir)
+    back = data.read_parquet(os.path.join(pdir, "*.parquet"))
+    assert sorted(r["a"] for r in back.take_all()) == list(range(10))
+
+
+def test_map_batches_callable_class_actor_pool():
+    class AddBase:
+        def __init__(self, base):
+            self.base = base
+            self.calls = 0
+
+        def __call__(self, batch):
+            self.calls += 1
+            return {"item": batch["item"] + self.base}
+
+    ds = data.range(32, override_num_blocks=4).map_batches(
+        AddBase, concurrency=2, fn_constructor_args=(100,))
+    out = sorted(r["item"] for r in ds.take_all())
+    assert out == [100 + i for i in range(32)]
+
+
+def test_streaming_split():
+    ds = data.range(40, override_num_blocks=4)
+    its = ds.streaming_split(4)
+    assert len(its) == 4
+    all_rows = []
+    for it in its:
+        rows = list(it.iter_rows())
+        assert len(rows) == 10
+        all_rows.extend(rows)
+    assert sorted(all_rows) == list(range(40))
+
+
+def test_iter_batches_shapes():
+    ds = data.from_items([{"x": np.ones(3) * i} for i in range(10)])
+    batches = list(ds.iter_batches(batch_size=4))
+    assert batches[0]["x"].shape == (4, 3)
+    assert batches[-1]["x"].shape == (2, 3)

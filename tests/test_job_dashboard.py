@@ -1,0 +1,121 @@
+"""Job submission + dashboard + timeline tests (parity model: reference
+dashboard/modules/job/tests and `ray timeline`)."""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import (
+    FAILED,
+    STOPPED,
+    SUCCEEDED,
+    JobSubmissionClient,
+)
+
+
+def test_submit_job_succeeds(ray_start_regular):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    status = client.wait_until_finished(sid, timeout=60)
+    assert status == SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    infos = client.list_jobs()
+    assert any(j.submission_id == sid for j in infos)
+
+
+def test_submit_job_failure_reported(ray_start_regular):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"raise SystemExit(3)\"")
+    assert client.wait_until_finished(sid, timeout=60) == FAILED
+    info = client.get_job_info(sid)
+    assert "code 3" in info.message
+
+
+def test_job_env_vars(ray_start_regular):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=(f"{sys.executable} -c "
+                    "\"import os; print('VAR=' + os.environ['JOBVAR'])\""),
+        runtime_env={"env_vars": {"JOBVAR": "jv1"}})
+    assert client.wait_until_finished(sid, timeout=60) == SUCCEEDED
+    assert "VAR=jv1" in client.get_job_logs(sid)
+
+
+def test_stop_job(ray_start_regular):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(60)\"")
+    deadline = time.monotonic() + 30
+    while client.get_job_status(sid) != "RUNNING":
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+    # Give the subprocess a moment to actually spawn.
+    time.sleep(0.3)
+    assert client.stop_job(sid)
+    assert client.wait_until_finished(sid, timeout=30) == STOPPED
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu import dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(3)])
+    port = dashboard.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        page = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "ray_tpu dashboard" in page
+        nodes = json.loads(urllib.request.urlopen(f"{base}/api/nodes").read())
+        assert len(nodes) == 1
+        status = json.loads(
+            urllib.request.urlopen(f"{base}/api/cluster_status").read())
+        assert "nodes" in status or status
+        ver = json.loads(urllib.request.urlopen(f"{base}/api/version").read())
+        assert ver["version"] == ray_tpu.__version__
+    finally:
+        dashboard.stop()
+
+
+def test_timeline_dump(ray_start_regular, tmp_path):
+    from ray_tpu.util.timeline import build_trace_events, dump_timeline
+
+    @ray_tpu.remote
+    def work(x):
+        time.sleep(0.01)
+        return x
+
+    ray_tpu.get([work.remote(i) for i in range(5)])
+    time.sleep(1.5)  # task-event flush cadence is 1s
+    path = str(tmp_path / "trace.json")
+    dump_timeline(path)
+    with open(path) as f:
+        trace = json.load(f)
+    complete = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(complete) >= 5
+    assert all(e["dur"] >= 0 for e in complete)
+
+
+def test_build_trace_events_pairs():
+    from ray_tpu.util.timeline import build_trace_events
+
+    events = [
+        {"task_id": "t1", "name": "f", "state": "RUNNING", "ts": 10.0,
+         "node_id": "n1", "worker_id": "w1", "job_id": "j"},
+        {"task_id": "t1", "name": "f", "state": "FINISHED", "ts": 10.5,
+         "node_id": "n1", "worker_id": "w1", "job_id": "j"},
+        {"task_id": "t2", "name": "g", "state": "RUNNING", "ts": 11.0,
+         "node_id": "n1", "worker_id": "w1", "job_id": "j"},
+    ]
+    trace = build_trace_events(events)
+    x = [e for e in trace if e["ph"] == "X"]
+    assert len(x) == 1 and abs(x[0]["dur"] - 0.5e6) < 1
+    assert len([e for e in trace if e["ph"] == "i"]) == 1

@@ -1,0 +1,112 @@
+"""runtime_env tests (parity model: reference python/ray/tests/
+test_runtime_env*.py — env_vars, working_dir, py_modules, plugins,
+job-level inheritance)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import (
+    RuntimeEnv,
+    register_plugin,
+    runtime_env_context,
+    unregister_plugin,
+)
+from ray_tpu import exceptions as exc
+
+
+def test_runtime_env_validation():
+    env = RuntimeEnv(env_vars={"A": "1"}, working_dir="/tmp")
+    assert env["env_vars"] == {"A": "1"}
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+    with pytest.raises(ValueError):
+        RuntimeEnv(nonexistent_plugin={"x": 1})
+
+
+def test_merge_semantics():
+    parent = {"env_vars": {"A": "1", "B": "2"}, "working_dir": "/p"}
+    child = {"env_vars": {"B": "3"}}
+    merged = RuntimeEnv.merge(parent, child)
+    assert merged["env_vars"] == {"A": "1", "B": "3"}
+    assert merged["working_dir"] == "/p"
+    assert RuntimeEnv.merge(None, None) is None
+    assert RuntimeEnv.merge(parent, None) == parent
+
+
+def test_context_restores_state(tmp_path):
+    marker = "RAY_TPU_TEST_ENVVAR"
+    assert marker not in os.environ
+    cwd = os.getcwd()
+    with runtime_env_context({"env_vars": {marker: "on"},
+                              "working_dir": str(tmp_path)}):
+        assert os.environ[marker] == "on"
+        assert os.getcwd() == str(tmp_path)
+    assert marker not in os.environ
+    assert os.getcwd() == cwd
+
+
+def test_task_env_vars(ray_start_regular):
+    @ray_tpu.remote
+    def read_env(name):
+        return os.environ.get(name)
+
+    ref = read_env.options(
+        runtime_env={"env_vars": {"MY_TASK_VAR": "42"}}).remote("MY_TASK_VAR")
+    assert ray_tpu.get(ref) == "42"
+    # Next task on the (possibly same) worker must NOT see it.
+    assert ray_tpu.get(read_env.remote("MY_TASK_VAR")) is None
+
+
+def test_actor_env_vars_persist(ray_start_regular):
+    @ray_tpu.remote
+    class EnvActor:
+        def read(self, name):
+            return os.environ.get(name)
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"ACTOR_VAR": "yes"}}).remote()
+    assert ray_tpu.get(a.read.remote("ACTOR_VAR")) == "yes"
+    # Persists across calls (dedicated process).
+    assert ray_tpu.get(a.read.remote("ACTOR_VAR")) == "yes"
+
+
+def test_py_modules_import(ray_start_regular, tmp_path):
+    mod_dir = tmp_path / "mymods"
+    mod_dir.mkdir()
+    (mod_dir / "secret_mod_77.py").write_text("VALUE = 1234\n")
+
+    @ray_tpu.remote
+    def use_module():
+        import secret_mod_77
+
+        return secret_mod_77.VALUE
+
+    ref = use_module.options(
+        runtime_env={"py_modules": [str(mod_dir)]}).remote()
+    assert ray_tpu.get(ref) == 1234
+
+
+def test_working_dir_missing_fails(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return os.getcwd()
+
+    with pytest.raises((exc.TaskError, exc.RuntimeEnvSetupError)):
+        ray_tpu.get(f.options(
+            runtime_env={"working_dir": "/definitely/not/a/dir"}).remote())
+
+
+def test_plugin_hook():
+    calls = []
+    register_plugin("my_plugin", lambda value, env: calls.append(value))
+    try:
+        env = RuntimeEnv(my_plugin={"knob": 1})
+        with runtime_env_context(env):
+            pass
+        assert calls == [{"knob": 1}]
+    finally:
+        unregister_plugin("my_plugin")
