@@ -75,7 +75,7 @@ def init(address: str | None = None, *, resources: dict | None = None,
             gcs_host=gcs_host, gcs_port=gcs_port,
             raylet_host=raylet_host, raylet_port=raylet_port,
             store_path=store_path, node_id=node_id,
-            is_driver=True, config=cfg)
+            is_driver=True, config=cfg, owns_cluster=address is None)
         _driver_core_worker = cw
         api_internal.set_core_worker(cw)
         if runtime_env is not None:

@@ -81,6 +81,91 @@ def tpu_slice_labels() -> dict[str, str]:
     return labels
 
 
+# ---------------------------------------------------------------------------
+# Per-lease accelerator isolation for pool workers.
+#
+# Reference behavior: the raylet exports CUDA_VISIBLE_DEVICES /
+# TPU_VISIBLE_CHIPS per lease so a worker that did not reserve an
+# accelerator cannot touch it (ray_constants.py TPU_VISIBLE_CHIPS).
+# JAX analog: the platform choice is fixed at first backend use, and on
+# images that force-register a TPU platform the JAX_PLATFORMS env var is
+# ignored — only jax.config.update("jax_platforms", ...) works.  So pool
+# workers install an import hook that pins jax to CPU at jax-import time
+# unless the task being executed holds a TPU resource lease.  Without it,
+# two CPU-only workers importing jax would both open the (single-process)
+# TPU and deadlock.
+# ---------------------------------------------------------------------------
+
+_current_task_has_tpu: bool = False
+
+
+def set_current_task_tpu(has_tpu: bool) -> None:
+    global _current_task_has_tpu
+    _current_task_has_tpu = has_tpu
+
+
+def _pin_jax_platform(jax_module) -> None:
+    plat = os.environ.get("RAY_TPU_JAX_PLATFORM")
+    if plat is None and not _current_task_has_tpu:
+        plat = "cpu"
+    if plat:
+        try:
+            jax_module.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+
+def install_worker_jax_isolation() -> None:
+    """Install the jax import hook (idempotent; pool workers only)."""
+    import importlib.abc
+    import importlib.machinery
+    import sys
+
+    if "jax" in sys.modules:  # already imported: pin now
+        _pin_jax_platform(sys.modules["jax"])
+        return
+    if any(isinstance(f, _JaxIsolationFinder) for f in sys.meta_path):
+        return
+    sys.meta_path.insert(0, _JaxIsolationFinder())
+
+
+class _JaxIsolationFinder:
+    """Meta-path finder that pins the jax platform right after the top-level
+    `jax` package finishes importing (before any backend is initialized)."""
+
+    _in_find = False
+
+    def find_spec(self, name, path=None, target=None):
+        if name != "jax" or _JaxIsolationFinder._in_find:
+            return None
+        import importlib.util
+
+        _JaxIsolationFinder._in_find = True
+        try:
+            spec = importlib.util.find_spec("jax")
+        finally:
+            _JaxIsolationFinder._in_find = False
+        if spec is None or spec.loader is None:
+            return None
+        spec.loader = _PinningLoader(spec.loader)
+        return spec
+
+
+class _PinningLoader:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def create_module(self, spec):
+        return self._inner.create_module(spec)
+
+    def exec_module(self, module):
+        self._inner.exec_module(module)
+        _pin_jax_platform(module)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
 def node_resources_and_labels() -> tuple[dict, dict]:
     """Auto-detected resource/label additions for this node."""
     resources: dict[str, float] = {}

@@ -367,8 +367,12 @@ class GcsServer:
                  "pg_bundle_index": a.get("pg_bundle_index", -1)},
                 timeout=self.config.rpc_call_timeout_s)
             if not resp.get("ok"):
+                logger.warning("actor %s creation on node %s failed: %s",
+                               actor_id[:8], node_id[:8], resp.get("reason"))
                 await self._on_actor_worker_death(actor_id, resp.get("reason", "creation failed"))
         except Exception as e:
+            logger.warning("actor %s creation rpc to node %s failed: %s",
+                           actor_id[:8], node_id[:8], e)
             await self._on_actor_worker_death(actor_id, f"creation rpc failed: {e}")
 
     async def handle_actor_ready(self, conn, payload):
@@ -386,6 +390,17 @@ class GcsServer:
         return {"ok": True}
 
     async def handle_report_actor_death(self, conn, payload):
+        # Dedupe: a single worker death can surface through several signals
+        # (process reap, socket close); only the first report per worker
+        # may consume a restart (reference: ReconstructActor checks the
+        # dead worker matches the actor's current incarnation).
+        a = self.actors.get(payload["actor_id"])
+        wid = payload.get("worker_id")
+        if a is not None and wid:
+            seen = a.setdefault("dead_worker_ids", set())
+            if wid in seen:
+                return {"ok": True}
+            seen.add(wid)
         await self._on_actor_worker_death(payload["actor_id"],
                                           payload.get("reason", "worker died"),
                                           intended=payload.get("intended", False))
@@ -399,6 +414,9 @@ class GcsServer:
             return
         can_restart = (not intended) and (
             a["max_restarts"] == -1 or a["restarts"] < a["max_restarts"])
+        logger.info("actor %s worker died (%s), restart=%s (%d/%s)",
+                    actor_id[:8], reason, can_restart, a["restarts"],
+                    a["max_restarts"])
         if can_restart:
             a["restarts"] += 1
             a["state"] = ACTOR_RESTARTING
@@ -461,6 +479,22 @@ class GcsServer:
     # ---------- jobs ----------
 
     async def handle_register_job(self, conn, payload):
+        if payload.get("owns_cluster"):
+            # This driver started the session (local mode): the whole tree
+            # dies with it — GCS exits, raylets exit on GCS loss, workers
+            # exit on raylet loss.  Prevents orphaned daemons when the
+            # driver is killed (reference: ray.init() local session
+            # lifetime is the driver's lifetime).
+            loop = asyncio.get_running_loop()
+
+            def _driver_gone():
+                import os
+
+                logger.warning("owning driver for job %s disconnected; "
+                               "shutting down session", payload["job_id"][:8])
+                loop.call_later(0.2, lambda: os._exit(0))
+
+            conn.on_close(_driver_gone)
         self.jobs[payload["job_id"]] = {
             "job_id": payload["job_id"],
             "driver_address": payload.get("driver_address"),

@@ -179,12 +179,14 @@ class Raylet:
                     # Demand signal for the autoscaler (reference: raylets
                     # report resource load via ray_syncer →
                     # gcs_autoscaler_state_manager).
-                    "pending_demand": [r for r, _pg, _idx, _f in
+                    "pending_demand": [r for r, _pg, _idx, _f, _sp in
                                        list(self.pending_leases)[:100]]
                     + [d for _ts, d in self._infeasible_demand],
                 }, timeout=self.config.health_check_timeout_s)
                 if resp.get("ok"):
                     self.cluster_view = resp.get("cluster", {})
+                    # A fresher view may unblock queued leases via spillback.
+                    self._pump_pending_leases()
             except rpc.ConnectionLost:
                 logger.error("lost GCS connection; raylet %s exiting", self.node_id[:8])
                 os._exit(1)
@@ -234,7 +236,8 @@ class Raylet:
         if w.actor_id:
             try:
                 await self.gcs_conn.call("ReportActorDeath", {
-                    "actor_id": w.actor_id, "reason": reason})
+                    "actor_id": w.actor_id, "reason": reason,
+                    "worker_id": w.worker_id})
             except Exception:
                 pass
         logger.warning("worker %s died: %s", w.worker_id[:8], reason)
@@ -286,8 +289,8 @@ class Raylet:
                     "node_id": self.node_id}
         w.conn = conn
         w.address = (payload["host"], payload["port"])
-        conn.on_close(lambda: asyncio.ensure_future(
-            self._on_worker_death(w, "worker connection lost")) if not w.dead else None)
+        conn.on_close(lambda: None if w.dead else asyncio.ensure_future(
+            self._on_worker_death(w, "worker connection lost")))
         w.registered.set()
         if not w.leased and w.actor_id is None:
             w.idle_since = time.monotonic()
@@ -428,12 +431,13 @@ class Raylet:
                     "infeasible": True}
         # Queue until resources free up.
         fut = asyncio.get_running_loop().create_future()
-        self.pending_leases.append((resources, pg_id, bundle_index, fut))
+        item = (resources, pg_id, bundle_index, fut, allow_spill)
+        self.pending_leases.append(item)
         try:
             return await asyncio.wait_for(fut, self.config.worker_lease_timeout_s)
         except asyncio.TimeoutError:
             try:
-                self.pending_leases.remove((resources, pg_id, bundle_index, fut))
+                self.pending_leases.remove(item)
             except ValueError:
                 pass
             spill = self._pick_spillback(resources)
@@ -487,14 +491,23 @@ class Raylet:
     def _pump_pending_leases(self):
         granted = []
         for item in list(self.pending_leases):
-            resources, pg_id, bundle_index, fut = item
+            resources, pg_id, bundle_index, fut, spillable = item
             if fut.done():
                 self.pending_leases.remove(item)
                 continue
             if self._try_acquire(resources, pg_id, bundle_index):
                 self.pending_leases.remove(item)
                 granted.append(item)
-        for resources, pg_id, bundle_index, fut in granted:
+            elif spillable and not resources_fit(self.available, resources):
+                # Re-run the scheduling policy over queued work: a peer may
+                # have gained capacity (or just joined) since this lease
+                # queued (reference: ClusterTaskManager::ScheduleAndDispatch
+                # revisits the queue every round and can spill it).
+                spill = self._pick_spillback(resources)
+                if spill is not None:
+                    self.pending_leases.remove(item)
+                    fut.set_result({"spillback": spill})
+        for resources, pg_id, bundle_index, fut, _sp in granted:
             async def grant(resources=resources, pg_id=pg_id,
                             bundle_index=bundle_index, fut=fut):
                 result = await self._grant_lease(resources, pg_id, bundle_index)
@@ -512,7 +525,10 @@ class Raylet:
             if pg_id or resources_fit(self.total_resources, resources):
                 # Feasible later: wait for resources like a queued lease.
                 fut = asyncio.get_running_loop().create_future()
-                self.pending_leases.append((resources, pg_id, bundle_index, fut))
+                # Not spillable: the GCS owns actor placement and reschedules
+                # on failure; the raylet must not redirect actor creations.
+                self.pending_leases.append(
+                    (resources, pg_id, bundle_index, fut, False))
                 try:
                     grant = await asyncio.wait_for(
                         fut, self.config.worker_lease_timeout_s)

@@ -103,12 +103,14 @@ class CoreWorker:
     def __init__(self, *, gcs_host: str, gcs_port: int, raylet_host: str,
                  raylet_port: int, store_path: str, node_id: str,
                  is_driver: bool, job_id: str | None = None,
-                 worker_id: str | None = None, config: Config | None = None):
+                 worker_id: str | None = None, config: Config | None = None,
+                 owns_cluster: bool = False):
         self.config = config or Config()
         self.gcs_host, self.gcs_port = gcs_host, gcs_port
         self.raylet_host, self.raylet_port = raylet_host, raylet_port
         self.node_id = node_id
         self.is_driver = is_driver
+        self.owns_cluster = owns_cluster
         self.worker_id = worker_id or WorkerID.from_random().hex()
         self.job_id = job_id or JobID.from_random().hex()
         self.store = ObjectStoreClient(store_path)
@@ -197,7 +199,10 @@ class CoreWorker:
         if self.is_driver:
             await self.gcs.call("RegisterJob", {
                 "job_id": self.job_id, "driver_address": self.address.to_wire(),
-                "entrypoint": " ".join(os.sys.argv)})
+                "entrypoint": " ".join(os.sys.argv),
+                # Local-mode sessions die with their driver (reference: a
+                # ray.init() head tears down when the driver exits).
+                "owns_cluster": self.owns_cluster})
         asyncio.ensure_future(self._flush_task_events_loop())
 
     def shutdown(self):
@@ -894,6 +899,16 @@ class CoreWorker:
 
         prev_task_id = self._current_task_id
         self._current_task_id = TaskID.from_hex(spec.task_id)
+        if not self.is_driver and (spec.actor_creation or not spec.actor_id):
+            # Accelerator isolation: only a task holding a TPU lease may
+            # initialize the TPU backend when it imports jax (reference:
+            # TPU_VISIBLE_CHIPS per-lease isolation).  Actors pin the
+            # worker for life, so the constructor's lease decides — actor
+            # METHOD specs carry resources={} and must not flip the flag.
+            from ray_tpu._private import accelerator
+
+            accelerator.set_current_task_tpu(
+                (spec.resources or {}).get(accelerator.TPU_RESOURCE, 0) > 0)
         try:
             if spec.actor_creation:
                 cls = self._run(self._fetch_function(spec.func_key))
@@ -1038,17 +1053,24 @@ class CoreWorker:
         return self.actor_handles_state.setdefault(
             actor_id, {"address": None, "conn": None, "seq": 0, "dead": False,
                        "death_reason": "", "alive_event": None,
-                       "incarnation": 0})
+                       "incarnation": 0, "inflight": []})
 
     @staticmethod
     def _note_actor_incarnation(st, restarts: int):
         """A restarted actor process has fresh per-caller ordering state, so
         the caller's sequence numbers restart from 0 for the new
         incarnation (otherwise the new process would buffer forever
-        waiting for seq 0)."""
+        waiting for seq 0).  All in-flight tasks are renumbered HERE, in
+        original submission order — renumbering lazily in each send
+        coroutine would assign new seq-nos in wake order and could invert
+        per-caller ordering across the restart."""
         if restarts != st.get("incarnation", 0):
             st["incarnation"] = restarts
             st["seq"] = 0
+            for spec in st.get("inflight", []):
+                spec.actor_seq = st["seq"]
+                st["seq"] += 1
+                spec.actor_incarnation = restarts
 
     def submit_actor_task(self, actor_id: str, spec: TaskSpec,
                           max_task_retries: int = 0) -> list[ObjectID]:
@@ -1056,6 +1078,7 @@ class CoreWorker:
         spec.actor_seq = st["seq"]
         spec.actor_incarnation = st["incarnation"]
         st["seq"] += 1
+        st["inflight"].append(spec)
         returns = [ObjectID.for_task_return(TaskID.from_hex(spec.task_id), i + 1)
                    for i in range(spec.num_returns)]
         for oid in returns:
@@ -1098,35 +1121,35 @@ class CoreWorker:
                                        max_task_retries: int):
         attempts = max_task_retries + 1
         last_reason = ""
-        for _ in range(max(1, attempts)):
-            st = self._actor_state(actor_id)
+        st = self._actor_state(actor_id)
+        try:
+            for _ in range(max(1, attempts)):
+                try:
+                    conn = await self._actor_conn(actor_id, st)
+                    resp = await conn.call("ActorCall", {
+                        "spec": spec.to_wire(), "caller_id": self.worker_id},
+                        timeout=None)
+                    pt = _PendingTask(spec, 0)
+                    await self._complete_task(pt, resp, "")
+                    return
+                except exc.ActorDiedError as e:
+                    last_reason = str(e)
+                    break
+                except (rpc.RpcError, OSError, asyncio.TimeoutError) as e:
+                    last_reason = str(e)
+                    st["conn"] = None
+                    st["address"] = None
+                    await asyncio.sleep(0.2)
+                    continue
+            err = serialization.serialize_exception(
+                exc.ActorDiedError(f"actor task {spec.name} failed: {last_reason}"))
+            pt = _PendingTask(spec, 0)
+            self._complete_task_error(pt, err)
+        finally:
             try:
-                conn = await self._actor_conn(actor_id, st)
-                if getattr(spec, "actor_incarnation", 0) != st["incarnation"]:
-                    # Actor restarted since this task got its seq-no:
-                    # re-number under the new incarnation.
-                    spec.actor_seq = st["seq"]
-                    st["seq"] += 1
-                    spec.actor_incarnation = st["incarnation"]
-                resp = await conn.call("ActorCall", {
-                    "spec": spec.to_wire(), "caller_id": self.worker_id},
-                    timeout=None)
-                pt = _PendingTask(spec, 0)
-                await self._complete_task(pt, resp, "")
-                return
-            except exc.ActorDiedError as e:
-                last_reason = str(e)
-                break
-            except (rpc.RpcError, OSError, asyncio.TimeoutError) as e:
-                last_reason = str(e)
-                st["conn"] = None
-                st["address"] = None
-                await asyncio.sleep(0.2)
-                continue
-        err = serialization.serialize_exception(
-            exc.ActorDiedError(f"actor task {spec.name} failed: {last_reason}"))
-        pt = _PendingTask(spec, 0)
-        self._complete_task_error(pt, err)
+                st["inflight"].remove(spec)
+            except ValueError:
+                pass
 
     def kill_actor(self, actor_id: str, no_restart: bool = True):
         st = self._actor_state(actor_id)
@@ -1162,6 +1185,12 @@ def main():
             jax.config.update("jax_platforms", plat)
         except ImportError:
             pass
+    else:
+        # Default isolation: pin jax to CPU at import time unless the task
+        # being executed holds a TPU resource lease (see accelerator.py).
+        from ray_tpu._private import accelerator
+
+        accelerator.install_worker_jax_isolation()
     cw = CoreWorker(
         gcs_host=env["RAY_TPU_GCS_HOST"], gcs_port=int(env["RAY_TPU_GCS_PORT"]),
         raylet_host=env["RAY_TPU_RAYLET_HOST"],

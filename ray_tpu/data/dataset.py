@@ -46,10 +46,18 @@ class _Stage:
     actor_pool: int = 0
 
 
+# Index of the block currently being transformed — lets seeded per-block
+# stages (random_sample) derive a DISTINCT stream per block instead of
+# replaying one sequence on every block (which would correlate the draws).
+_current_block_index = 0
+
+
 @ray_tpu.remote
-def _apply_stage(fn_blob, block):
+def _apply_stage(fn_blob, block, index=0):
+    import ray_tpu.data.dataset as _ds
     from ray_tpu._private import serialization
 
+    _ds._current_block_index = index
     fn = serialization.loads_func(fn_blob)
     return fn(block)
 
@@ -178,7 +186,11 @@ class Dataset:
     def random_sample(self, fraction: float, *, seed: int | None = None
                       ) -> "Dataset":
         def stage_fn(block, fraction=fraction, seed=seed):
-            rng = _random.Random(seed)
+            import ray_tpu.data.dataset as _ds
+
+            block_seed = None if seed is None \
+                else seed * 1_000_003 + _ds._current_block_index
+            rng = _random.Random(block_seed)
             return [r for r in block_to_rows(block)
                     if rng.random() < fraction]
 
@@ -312,17 +324,17 @@ class Dataset:
                 return
             fn_blobs = [serialization.dumps_func(s.fn) for s in seg]
 
-            def launch(blk):
+            def launch(blk, idx):
                 ref = blk
                 for blob in fn_blobs:
-                    ref = _apply_stage.remote(blob, ref)
+                    ref = _apply_stage.remote(blob, ref, idx)
                 return ref
 
             # FIFO window: yield in submission order (dataset semantics are
             # ordered, matching the reference's OutputSplitter default).
             window: list = []
-            for blk in in_blocks:
-                window.append(launch(blk))
+            for idx, blk in enumerate(in_blocks):
+                window.append(launch(blk, idx))
                 if len(window) >= max_in_flight:
                     yield ray_tpu.get(window.pop(0), timeout=300)
             while window:

@@ -211,13 +211,14 @@ class JobSubmissionClient:
     def wait_until_finished(self, submission_id: str,
                             timeout: float = 60.0) -> str:
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        while True:
             status = self.get_job_status(submission_id)
             if status in (SUCCEEDED, FAILED, STOPPED):
                 return status
-            time.sleep(0.2)
-        raise TimeoutError(
-            f"job {submission_id} still {status} after {timeout}s")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {submission_id} still {status} after {timeout}s")
+            time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
 
 
 __all__ = ["JobSubmissionClient", "JobInfo", "PENDING", "RUNNING",

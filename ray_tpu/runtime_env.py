@@ -101,42 +101,47 @@ def runtime_env_context(env: dict | None, *, persistent: bool = False):
         yield
         return
 
-    saved_env: dict[str, str | None] = {}
-    saved_cwd = None
-    added_paths: list[str] = []
-
-    env_vars = env.get("env_vars") or {}
-    for k, v in env_vars.items():
-        saved_env[k] = os.environ.get(k)
-        os.environ[k] = v
-
+    # Validate BEFORE mutating any process state: a setup error must leave
+    # the pooled worker exactly as it was (otherwise a failed task leaks
+    # env vars / cwd / sys.path entries into every later task).
     wd = env.get("working_dir")
     if wd:
         wd = os.path.abspath(os.path.expanduser(wd))
         if not os.path.isdir(wd):
             raise RuntimeEnvSetupError(f"working_dir {wd!r} does not exist")
-        saved_cwd = os.getcwd()
-        os.chdir(wd)
-        if wd not in sys.path:
-            sys.path.insert(0, wd)
-            added_paths.append(wd)
-
+    py_modules = []
     for p in env.get("py_modules") or []:
         p = os.path.abspath(os.path.expanduser(p))
         if not os.path.exists(p):
             raise RuntimeEnvSetupError(f"py_module {p!r} does not exist")
-        if p not in sys.path:
-            sys.path.insert(0, p)
-            added_paths.append(p)
+        py_modules.append(p)
 
-    for name, setup in _PLUGINS.items():
-        if name in env:
-            setup(env[name], env)
-
+    saved_env: dict[str, str | None] = {}
+    saved_cwd = None
+    added_paths: list[str] = []
+    applied = False
     try:
+        for k, v in (env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        if wd:
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+            if wd not in sys.path:
+                sys.path.insert(0, wd)
+                added_paths.append(wd)
+        for p in py_modules:
+            if p not in sys.path:
+                sys.path.insert(0, p)
+                added_paths.append(p)
+        for name, setup in _PLUGINS.items():
+            if name in env:
+                setup(env[name], env)
+        applied = True
         yield
     finally:
-        if not persistent:
+        # Restore on any exit except a fully-applied persistent (actor) env.
+        if not (persistent and applied):
             for k, old in saved_env.items():
                 if old is None:
                     os.environ.pop(k, None)

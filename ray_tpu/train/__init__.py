@@ -1,4 +1,5 @@
 from ray_tpu.train import session
+from ray_tpu.train.session import get_context, report
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (
     CheckpointConfig,

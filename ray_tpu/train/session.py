@@ -47,6 +47,31 @@ def report(metrics: dict, checkpoint=None) -> None:
     s.report_queue.put(payload)
 
 
+class _TrainContext:
+    """Reference-shaped context object (ray.train.get_context() —
+    python/ray/train/context.py): rank/size accessors bundled."""
+
+    def get_world_rank(self) -> int:
+        return get_world_rank()
+
+    def get_world_size(self) -> int:
+        return get_world_size()
+
+    def get_local_rank(self) -> int:
+        return get_local_rank()
+
+    def get_local_world_size(self) -> int:
+        return 1  # one worker per host in this topology
+
+    def get_node_rank(self) -> int:
+        return get_world_rank()
+
+
+def get_context() -> _TrainContext:
+    _get_session()  # raise outside a train loop, like the reference
+    return _TrainContext()
+
+
 def get_world_rank() -> int:
     return _get_session().rank
 

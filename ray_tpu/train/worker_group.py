@@ -107,7 +107,14 @@ class WorkerGroup:
         if n > 1 or scaling.placement_strategy != "PACK":
             self.pg = placement_group(scaling.as_placement_group_bundles(),
                                       strategy=scaling.placement_strategy)
-            self.pg.ready(timeout=120)
+            if not self.pg.wait(timeout=120):
+                from ray_tpu import exceptions as exc
+
+                remove_placement_group(self.pg)
+                raise exc.PlacementGroupSchedulingError(
+                    f"train worker placement group "
+                    f"({scaling.as_placement_group_bundles()}) not schedulable "
+                    f"within 120s — not enough free cluster resources")
         self.workers = []
         res = scaling.worker_resources()
         for rank in range(n):

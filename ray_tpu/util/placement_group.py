@@ -28,9 +28,23 @@ class PlacementGroup:
     def bundle_specs(self) -> list[dict]:
         return self.bundles
 
-    def ready(self, timeout: float | None = None) -> bool:
-        """Block until the PG is scheduled (reference returns an ObjectRef;
-        here a blocking helper — `wait_until_ready`-style)."""
+    def ready(self):
+        """Returns an ObjectRef that resolves once the PG is scheduled, by
+        running a zero-CPU probe task inside bundle 0 (reference:
+        python/ray/util/placement_group.py ready() submits
+        bundle_reservation_check_func the same way)."""
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=0, placement_group=self,
+                        placement_group_bundle_index=0)
+        def _bundle_reservation_check():
+            return True
+
+        return _bundle_reservation_check.remote()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the PG is scheduled (reference:
+        PlacementGroup.wait(timeout_seconds))."""
         cw = get_core_worker()
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:

@@ -112,7 +112,10 @@ def test_actor_handle_passed_to_task(ray_start_regular):
 
 
 def test_actor_restart(ray_start_regular):
-    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    # max_task_retries must stay 0 here: a retried die() would kill the
+    # restarted actor too (reference: test_actor_failures.py:74 uses
+    # max_restarts=1 with no task retries for exactly this reason).
+    @ray_tpu.remote(max_restarts=1)
     class Flaky:
         def __init__(self):
             self.n = 0

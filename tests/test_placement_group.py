@@ -1,0 +1,110 @@
+"""Placement group tests (parity: reference
+python/ray/tests/test_placement_group*.py tier — creation, ready(),
+bundle-scoped scheduling, strategies, removal, and the TPU-first
+STRICT_ICI gang strategy)."""
+
+import pytest
+
+import ray_tpu
+import ray_tpu.exceptions as exc
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+def test_pg_ready_and_table(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    # Reference-shaped API: ready() returns an ObjectRef resolved once the
+    # bundle is reserved (python/ray/util/placement_group.py ready()).
+    assert ray_tpu.get(pg.ready(), timeout=30) is True
+    assert pg.wait(timeout=10)
+    states = {row["pg_id"]: row["state"] for row in placement_group_table()}
+    assert states[pg.id.hex()] == "CREATED"
+    remove_placement_group(pg)
+
+
+def test_pg_task_and_actor_in_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def node(self):
+            return ray_tpu.get_runtime_context().node_id
+
+    nid = ray_tpu.get(
+        where.options(placement_group=pg,
+                      placement_group_bundle_index=0).remote(),
+        timeout=30)
+    a = A.options(placement_group=pg, placement_group_bundle_index=1).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=30) == nid
+    remove_placement_group(pg)
+
+
+def test_pg_capacity_isolation(ray_start_regular):
+    # The PG reserves its bundles: a second PG demanding more CPUs than
+    # remain must stay pending, then schedule after the first is removed.
+    total = int(ray_tpu.cluster_resources().get("CPU", 0))
+    pg1 = placement_group([{"CPU": total}], strategy="PACK")
+    assert ray_tpu.get(pg1.ready(), timeout=30)
+    pg2 = placement_group([{"CPU": 1}], strategy="PACK")
+    assert not pg2.wait(timeout=2)
+    remove_placement_group(pg1)
+    assert pg2.wait(timeout=30)
+    remove_placement_group(pg2)
+
+
+def test_pg_strict_spread_infeasible(ray_start_regular):
+    # One node: STRICT_SPREAD over two bundles can never be satisfied.
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert not pg.wait(timeout=3)
+    remove_placement_group(pg)
+
+
+def test_pg_invalid_args(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+    with pytest.raises(ValueError):
+        placement_group([])
+
+
+def test_pg_spread_two_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(2)
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert ray_tpu.get(pg.ready(), timeout=60)
+    assert len(set(pg.bundle_node_ids())) == 2
+    remove_placement_group(pg)
+
+
+def test_pg_strict_ici(ray_start_cluster):
+    """TPU-first: STRICT_ICI places every bundle on ONE ICI-connected
+    slice (nodes sharing a tpu-slice label) — the gang-lease unit for
+    multi-host SPMD (SURVEY.md §7 stage 3)."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, labels={"tpu-slice": "slice-a"})
+    cluster.connect()
+    cluster.add_node(num_cpus=1, labels={"tpu-slice": "slice-a"})
+    cluster.add_node(num_cpus=1, labels={"tpu-slice": "slice-b"})
+    cluster.wait_for_nodes(3)
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_ICI")
+    assert ray_tpu.get(pg.ready(), timeout=60)
+    nodes = pg.bundle_node_ids()
+    assert len(set(nodes)) == 2  # two hosts, one slice
+
+    # Three 1-CPU bundles cannot fit on any single slice (slice-a has 2).
+    pg_big = placement_group([{"CPU": 1}] * 3, strategy="STRICT_ICI")
+    assert not pg_big.wait(timeout=3)
+    remove_placement_group(pg_big)
+    remove_placement_group(pg)
