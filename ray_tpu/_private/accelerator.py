@@ -97,6 +97,10 @@ def tpu_slice_labels() -> dict[str, str]:
 # ---------------------------------------------------------------------------
 
 _current_task_has_tpu: bool = False
+# Platform jax was actually pinned to in this process (None = not yet
+# imported/pinned). Frozen after first jax import — jax cannot switch
+# backends once initialized.
+_pinned_platform: str | None = None
 
 
 def set_current_task_tpu(has_tpu: bool) -> None:
@@ -104,10 +108,23 @@ def set_current_task_tpu(has_tpu: bool) -> None:
     _current_task_has_tpu = has_tpu
 
 
+def pinned_platform() -> str | None:
+    return _pinned_platform
+
+
+def current_task_needs_fresh_worker() -> bool:
+    """True when this worker's frozen jax pin can't serve the current
+    task: jax is pinned to CPU but the task holds a TPU lease.  The task
+    must be retried on a fresh worker (whose first import will pin TPU)."""
+    return _current_task_has_tpu and _pinned_platform == "cpu"
+
+
 def _pin_jax_platform(jax_module) -> None:
+    global _pinned_platform
     plat = os.environ.get("RAY_TPU_JAX_PLATFORM")
     if plat is None and not _current_task_has_tpu:
         plat = "cpu"
+    _pinned_platform = plat or "tpu"
     if plat:
         try:
             jax_module.config.update("jax_platforms", plat)

@@ -352,13 +352,16 @@ class Raylet:
         w.lease_resources = {}
         w.lease_pg = None
 
-    def _pick_spillback(self, resources: dict) -> dict | None:
+    def _pick_spillback(self, resources: dict, view: dict | None = None
+                        ) -> dict | None:
         """Hybrid policy tail: among alive peers that fit the demand, pick
         the best-utilized (pack) candidate (reference: top-k hybrid policy,
         hybrid_scheduling_policy.h:107-124 — we take k=1 of the sorted list
-        since the cluster view is already fresh)."""
+        since the cluster view is already fresh).  Pass `view` to pick
+        against a locally-debited copy (bulk spill decisions)."""
         candidates = []
-        for nid, info in self.cluster_view.items():
+        for nid, info in (view if view is not None
+                          else self.cluster_view).items():
             if nid == self.node_id:
                 continue
             if resources_fit(info.get("available_resources", {}), resources):
@@ -490,6 +493,13 @@ class Raylet:
 
     def _pump_pending_leases(self):
         granted = []
+        # Debited copy of the cluster view: each spill decision in this
+        # pass consumes the target's capacity locally, so a burst of
+        # queued leases fans out across peers instead of all redirecting
+        # to the same (stale-view) "best" node.
+        import copy
+
+        debit_view = None
         for item in list(self.pending_leases):
             resources, pg_id, bundle_index, fut, spillable = item
             if fut.done():
@@ -503,8 +513,13 @@ class Raylet:
                 # have gained capacity (or just joined) since this lease
                 # queued (reference: ClusterTaskManager::ScheduleAndDispatch
                 # revisits the queue every round and can spill it).
-                spill = self._pick_spillback(resources)
+                if debit_view is None:
+                    debit_view = copy.deepcopy(self.cluster_view)
+                spill = self._pick_spillback(resources, view=debit_view)
                 if spill is not None:
+                    avail = debit_view[spill["node_id"]]["available_resources"]
+                    for k, v in resources.items():
+                        avail[k] = avail.get(k, 0) - v
                     self.pending_leases.remove(item)
                     fut.set_result({"spillback": spill})
         for resources, pg_id, bundle_index, fut, _sp in granted:

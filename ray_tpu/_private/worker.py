@@ -168,6 +168,7 @@ class CoreWorker:
         self.server = rpc.RpcServer({
             "PushTask": self._handle_push_task,
             "ActorCall": self._handle_actor_call,
+            "ActorSeqSkip": self._handle_actor_seq_skip,
             "AssignActor": self._handle_assign_actor,
             "GetObjectStatus": self._handle_get_object_status,
             "CancelTask": self._handle_cancel_task,
@@ -771,7 +772,12 @@ class CoreWorker:
     async def _complete_task(self, pt: _PendingTask, resp: dict, node_id: str):
         spec = pt.spec
         if resp.get("status") == "error" and resp.get("retryable") \
-                and pt.retries_left != 0 and spec.retry_exceptions:
+                and pt.retries_left != 0 and (
+                    spec.retry_exceptions or resp.get("system_retryable")):
+            # system_retryable: the worker could not run the task at all
+            # (e.g. its jax backend is pinned to the wrong platform) — a
+            # system condition retried like worker death, independent of
+            # the user's retry_exceptions setting.
             pt.retries_left -= 1
             self._enqueue_task(pt)
             return
@@ -909,6 +915,19 @@ class CoreWorker:
 
             accelerator.set_current_task_tpu(
                 (spec.resources or {}).get(accelerator.TPU_RESOURCE, 0) > 0)
+            if accelerator.current_task_needs_fresh_worker():
+                # jax is already pinned to CPU in this process and cannot
+                # switch; running a TPU-lease task here would silently
+                # compute on CPU.  Fail retryable and retire this worker so
+                # the retry lands on a fresh process that pins TPU.
+                self._current_task_id = prev_task_id
+                self.loop.call_later(0.5, lambda: os._exit(0))
+                err = serialization.serialize_exception(RuntimeError(
+                    "worker jax backend pinned to cpu; TPU task must run on "
+                    "a fresh worker (will retry)"))
+                return {"status": "error",
+                        "error": [err.meta, err.to_bytes()],
+                        "retryable": True, "system_retryable": True}
         try:
             if spec.actor_creation:
                 cls = self._run(self._fetch_function(spec.func_key))
@@ -998,12 +1017,28 @@ class CoreWorker:
             caller, {"next_seq": 0, "buffer": {}})
         fut = asyncio.get_running_loop().create_future()
         state["buffer"][spec.actor_seq] = (spec, fut)
-        while state["next_seq"] in state["buffer"]:
-            seq = state["next_seq"]
-            s, f = state["buffer"].pop(seq)
-            state["next_seq"] += 1
-            self._exec_queue.put((s, f))
+        self._drain_actor_queue(state)
         return await fut
+
+    def _drain_actor_queue(self, state) -> None:
+        while state["next_seq"] in state["buffer"]:
+            item = state["buffer"].pop(state["next_seq"])
+            state["next_seq"] += 1
+            if item is not None:  # None = abandoned seq (see ActorSeqSkip)
+                self._exec_queue.put(item)
+
+    async def _handle_actor_seq_skip(self, conn, payload):
+        """A caller abandoned a seq-no it was assigned (its task failed
+        terminally without ever being sent, e.g. retries exhausted across
+        an actor restart).  Mark the slot so the ordered queue can advance
+        — otherwise every later task from that caller waits forever."""
+        state = self._actor_callers.setdefault(
+            payload["caller_id"], {"next_seq": 0, "buffer": {}})
+        seq = payload["seq"]
+        if seq >= state["next_seq"] and seq not in state["buffer"]:
+            state["buffer"][seq] = None
+        self._drain_actor_queue(state)
+        return {"ok": True}
 
     # ---------- actors: caller side ----------
 
@@ -1145,6 +1180,19 @@ class CoreWorker:
                 exc.ActorDiedError(f"actor task {spec.name} failed: {last_reason}"))
             pt = _PendingTask(spec, 0)
             self._complete_task_error(pt, err)
+            # This task holds a seq-no under the current incarnation that
+            # will never be sent; tell the actor to skip it, or every later
+            # task from this caller stalls in the ordered queue.
+            if not st["dead"] and \
+                    getattr(spec, "actor_incarnation", 0) == st["incarnation"]:
+                try:
+                    conn = await asyncio.wait_for(
+                        self._actor_conn(actor_id, st), timeout=10)
+                    await conn.call("ActorSeqSkip", {
+                        "caller_id": self.worker_id,
+                        "seq": spec.actor_seq})
+                except Exception:
+                    pass
         finally:
             try:
                 st["inflight"].remove(spec)

@@ -53,65 +53,90 @@ def from_pandas(df, *, override_num_blocks: int | None = None) -> Dataset:
     return from_items(rows, override_num_blocks=override_num_blocks)
 
 
+def _lazy_read(files: list, read_one, override_num_blocks: int | None
+               ) -> Dataset:
+    """Deferred ReadTasks: the reads run as cluster tasks when the dataset
+    executes (reference: data/datasource read tasks; the driver never
+    materializes the input).  Default: one block per file.
+    override_num_blocks < len(files) groups files into that many read
+    tasks; more blocks than files can't be honored without reading (row
+    counts unknown), so the block count stays at len(files) — chain
+    .repartition(n) to force it."""
+    from ray_tpu.data.dataset import ReadTask
+
+    def read_group(group):
+        out = []
+        for p in group:
+            out.extend(read_one(p))
+        return out
+
+    groups = [[p] for p in files]
+    if override_num_blocks is not None and 0 < override_num_blocks < len(files):
+        n = override_num_blocks
+        per = math.ceil(len(files) / n)
+        groups = [files[i * per:(i + 1) * per] for i in _builtins.range(n)]
+        groups = [g for g in groups if g]
+    return Dataset([ReadTask(fn=(lambda g=g: read_group(g)))
+                    for g in groups])
+
+
 def read_text(paths: str | list, *, override_num_blocks: int | None = None
               ) -> Dataset:
-    files = _expand(paths)
-    rows = []
-    for p in files:
+    def read_one(p):
         with open(p) as f:
-            rows.extend({"text": line.rstrip("\n")} for line in f)
-    return from_items(rows, override_num_blocks=override_num_blocks)
+            return [{"text": line.rstrip("\n")} for line in f]
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
 def read_json(paths: str | list, *, lines: bool = True,
               override_num_blocks: int | None = None) -> Dataset:
-    import json
+    def read_one(p, lines=lines):
+        import json
 
-    files = _expand(paths)
-    rows = []
-    for p in files:
         with open(p) as f:
             if lines:
-                rows.extend(json.loads(ln) for ln in f if ln.strip())
-            else:
-                data = json.load(f)
-                rows.extend(data if isinstance(data, list) else [data])
-    return from_items(rows, override_num_blocks=override_num_blocks)
+                return [json.loads(ln) for ln in f if ln.strip()]
+            data = json.load(f)
+            return data if isinstance(data, list) else [data]
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
 def read_csv(paths: str | list, *, override_num_blocks: int | None = None
              ) -> Dataset:
-    import csv
+    def read_one(p):
+        import csv
 
-    files = _expand(paths)
-    rows = []
-    for p in files:
         with open(p) as f:
-            rows.extend(dict(r) for r in csv.DictReader(f))
-    return from_items(rows, override_num_blocks=override_num_blocks)
+            return [dict(r) for r in csv.DictReader(f)]
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
 def read_numpy(paths: str | list, *, override_num_blocks: int | None = None
                ) -> Dataset:
-    files = _expand(paths)
-    rows = []
-    for p in files:
-        arr = np.load(p)
-        rows.extend({"data": a} for a in arr)
-    return from_items(rows, override_num_blocks=override_num_blocks)
+    def read_one(p):
+        import numpy as _np
+
+        return [{"data": a} for a in _np.load(p)]
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
 def read_parquet(paths: str | list, *, override_num_blocks: int | None = None
                  ) -> Dataset:
     try:
-        import pyarrow.parquet as pq
+        import pyarrow.parquet  # noqa: F401
     except ImportError as e:  # pragma: no cover
         raise ImportError("read_parquet requires pyarrow") from e
-    files = _expand(paths)
-    rows = []
-    for p in files:
-        rows.extend(pq.read_table(p).to_pylist())
-    return from_items(rows, override_num_blocks=override_num_blocks)
+
+    def read_one(p):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(p).to_pylist()
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
 def _expand(paths: str | list) -> list:

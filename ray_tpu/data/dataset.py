@@ -62,6 +62,25 @@ def _apply_stage(fn_blob, block, index=0):
     return fn(block)
 
 
+@dataclass
+class ReadTask:
+    """A deferred source block: `fn()` produces the block rows when executed
+    remotely (reference: data/datasource ReadTask — reads run as cluster
+    tasks, never materializing the whole dataset on the driver)."""
+
+    fn: Callable
+    # Metadata the driver may know without reading (row count for
+    # splits/estimates; None when unknown).
+    num_rows: int | None = None
+
+
+@ray_tpu.remote
+def _exec_read(fn_blob):
+    from ray_tpu._private import serialization
+
+    return serialization.loads_func(fn_blob)()
+
+
 @ray_tpu.remote
 class _StageActor:
     """Stateful map worker: constructs the UDF once, applies it per block."""
@@ -276,7 +295,17 @@ class Dataset:
         scheduling loop + ExecutionResources backpressure :280)."""
         from ray_tpu._private import serialization
 
-        blocks: Iterable = self._source
+        def resolve_sources() -> Iterator:
+            """Launch deferred reads as remote tasks; their ObjectRefs feed
+            straight into downstream stage tasks (blocks never route
+            through the driver)."""
+            for src in self._source:
+                if isinstance(src, ReadTask):
+                    yield _exec_read.remote(serialization.dumps_func(src.fn))
+                else:
+                    yield src
+
+        blocks: Iterable = resolve_sources()
         stages = list(self._stages)
         # Split into segments at all-to-all barriers and actor-pool stages.
         segment: list[_Stage] = []
@@ -346,10 +375,21 @@ class Dataset:
                 materialized = [b if not isinstance(b, ray_tpu.ObjectRef)
                                 else ray_tpu.get(b) for b in blocks]
                 blocks = iter(barrier.all_to_all_fn(materialized))
+        # Windowed fetch: keep up to max_in_flight refs outstanding so
+        # stage-less pipelines (bare lazy reads) still run reads in
+        # parallel instead of one round-trip per block.
+        window: list = []
         for b in blocks:
-            if isinstance(b, ray_tpu.ObjectRef):
-                b = ray_tpu.get(b)
-            yield b
+            if not isinstance(b, ray_tpu.ObjectRef):
+                while window:
+                    yield ray_tpu.get(window.pop(0), timeout=300)
+                yield b
+                continue
+            window.append(b)
+            if len(window) >= max_in_flight:
+                yield ray_tpu.get(window.pop(0), timeout=300)
+        while window:
+            yield ray_tpu.get(window.pop(0), timeout=300)
 
     def materialize(self) -> "Dataset":
         out = list(self._iter_output_blocks())

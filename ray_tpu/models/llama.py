@@ -23,7 +23,12 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops.attention import flash_attention, mha_reference, ring_attention
+from ray_tpu.ops.attention import (
+    flash_attention,
+    mha_reference,
+    ring_attention,
+    ulysses_attention,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +147,8 @@ class Attention(nn.Module):
             out = flash_attention(q, k, v, None, True)
         elif cfg.attention == "ring":
             out = ring_attention(q, k, v, axis="sp", causal=True)
+        elif cfg.attention == "ulysses":
+            out = ulysses_attention(q, k, v, axis="sp", causal=True)
         else:
             out = mha_reference(q, k, v, causal=True)
 

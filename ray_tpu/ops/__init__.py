@@ -1,3 +1,9 @@
-from ray_tpu.ops.attention import flash_attention, mha_reference, ring_attention
+from ray_tpu.ops.attention import (
+    flash_attention,
+    mha_reference,
+    ring_attention,
+    ulysses_attention,
+)
 
-__all__ = ["flash_attention", "mha_reference", "ring_attention"]
+__all__ = ["flash_attention", "mha_reference", "ring_attention",
+           "ulysses_attention"]

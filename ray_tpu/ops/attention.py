@@ -298,3 +298,46 @@ def ring_attention(q, k, v, axis: str = "sp", *, causal: bool = False,
     _, _, acc, _, l = lax.fori_loop(0, n, body, (k, v, acc0, m0, l0))
     out = acc / jnp.where(l == 0.0, 1.0, l)
     return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses attention (all-to-all sequence parallelism)
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention(q, k, v, axis: str = "sp", *, causal: bool = False,
+                      sm_scale: float | None = None):
+    """DeepSpeed-Ulysses-style sequence parallelism inside shard_map.
+
+    Inputs are sequence-sharded on `axis`: per-device (B, H, S/n, D).
+    One all-to-all re-shards sequence→heads: (B, H/n, S, D) — each device
+    then holds the FULL sequence for H/n heads and runs ordinary (flash)
+    attention locally; a second all-to-all restores sequence sharding.
+    Two all-to-alls ride ICI vs ring attention's n-1 ppermute hops —
+    better when H ≥ n and the sequence fits per-device after head split.
+
+    The reference has no sequence parallelism at all (SURVEY.md §2.4: SP
+    "absent", Ulysses named as the rebuild deliverable).
+    """
+    n = lax.axis_size(axis)
+    B, H, S, D = q.shape  # S = local shard of the sequence
+    if H % n:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by axis ({n})")
+
+    def seq_to_heads(x):
+        # (B, H, S_local, D) -> (B, H/n, S_full, D): head dim scatters
+        # across devices, sequence chunks gather in device order.
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        # (B, H/n, S_full, D) -> (B, H, S_local, D): inverse exchange.
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if _interpret_mode():
+        out = mha_reference(qh, kh, vh, sm_scale, causal)
+    else:
+        out = flash_attention(qh, kh, vh, sm_scale, causal)
+    return heads_to_seq(out.astype(q.dtype))

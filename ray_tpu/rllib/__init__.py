@@ -1,4 +1,6 @@
+from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, Env, make_env
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
-__all__ = ["PPO", "PPOConfig", "Env", "CartPole", "ENV_REGISTRY", "make_env"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "ReplayBuffer", "Env",
+           "CartPole", "ENV_REGISTRY", "make_env"]

@@ -1,0 +1,285 @@
+"""DQN: off-policy Q-learning with replay buffer and target network.
+
+Parity: reference rllib/algorithms/dqn/ (double-DQN update, epsilon-greedy
+exploration schedule, target-network sync every N steps) with the
+rollout/learner split of SURVEY.md §3.6: CPU sampling actors feed a
+replay buffer (reference: rllib/utils/replay_buffers/replay_buffer.py);
+the learner is one jitted jax update on the attached accelerator.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+
+
+def init_q_params(obs_size: int, num_actions: int, hidden: int = 64,
+                  seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def dense(i, o):
+        return {"w": (rng.standard_normal((i, o)) / np.sqrt(i)).astype(np.float32),
+                "b": np.zeros(o, np.float32)}
+
+    return {"h1": dense(obs_size, hidden), "h2": dense(hidden, hidden),
+            "q": dense(hidden, num_actions)}
+
+
+def numpy_q_values(params: dict, obs: np.ndarray) -> np.ndarray:
+    h = np.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
+    h = np.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+    return h @ params["q"]["w"] + params["q"]["b"]
+
+
+class ReplayBuffer:
+    """Uniform-sampling ring buffer (reference:
+    rllib/utils/replay_buffers/replay_buffer.py storage + sample)."""
+
+    def __init__(self, capacity: int, obs_size: int, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_size), np.float32)
+        self.next_obs = np.zeros((capacity, obs_size), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.pos = 0
+        self.size = 0
+        self.rng = np.random.default_rng(seed)
+
+    def add_batch(self, batch: dict) -> None:
+        n = len(batch["obs"])
+        idx = (self.pos + np.arange(n)) % self.capacity
+        self.obs[idx] = batch["obs"]
+        self.next_obs[idx] = batch["next_obs"]
+        self.actions[idx] = batch["actions"]
+        self.rewards[idx] = batch["rewards"]
+        self.dones[idx] = batch["dones"]
+        self.pos = int((self.pos + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self.rng.integers(0, self.size, batch_size)
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx], "rewards": self.rewards[idx],
+                "dones": self.dones[idx]}
+
+
+@ray_tpu.remote
+class DQNRolloutWorker:
+    """CPU epsilon-greedy sampler (parity: rollout_worker.py)."""
+
+    def __init__(self, env_spec, worker_index: int):
+        self.env = make_env(env_spec)
+        self.index = worker_index
+        self.rng = np.random.default_rng(2000 + worker_index)
+        self.obs = self.env.reset(seed=worker_index)
+        self.ep_ret = 0.0
+
+    def sample(self, params: dict, num_steps: int, epsilon: float) -> dict:
+        obs_b, act_b, rew_b, next_b, done_b = [], [], [], [], []
+        episode_returns = []
+        for _ in range(num_steps):
+            if self.rng.random() < epsilon:
+                action = int(self.rng.integers(self.env.num_actions))
+            else:
+                q = numpy_q_values(params, self.obs[None, :])[0]
+                action = int(np.argmax(q))
+            next_obs, reward, done, _ = self.env.step(action)
+            obs_b.append(self.obs)
+            act_b.append(action)
+            rew_b.append(reward)
+            next_b.append(next_obs)
+            done_b.append(float(done))
+            self.ep_ret += reward
+            if done:
+                episode_returns.append(self.ep_ret)
+                self.ep_ret = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        return {"obs": np.asarray(obs_b, np.float32),
+                "actions": np.asarray(act_b, np.int32),
+                "rewards": np.asarray(rew_b, np.float32),
+                "next_obs": np.asarray(next_b, np.float32),
+                "dones": np.asarray(done_b, np.float32),
+                "episode_returns": episode_returns}
+
+
+@dataclass
+class DQNConfig:
+    """Parity: rllib DQNConfig fluent-config object."""
+
+    env: Any = "CartPole-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 256
+    buffer_capacity: int = 50_000
+    learning_starts: int = 1_000
+    train_batch_size: int = 128
+    num_sgd_iter: int = 32
+    gamma: float = 0.99
+    lr: float = 1e-3
+    hidden_size: int = 64
+    target_network_update_freq: int = 4  # iterations between target syncs
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_iters: int = 20
+    double_q: bool = True
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: int | None = None, **kw):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown DQN option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """Algorithm driver (parity: Algorithm.step / DQN training_step)."""
+
+    def __init__(self, config: DQNConfig):
+        self.config = config
+        probe = make_env(config.env)
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        self.params = init_q_params(self.obs_size, self.num_actions,
+                                    config.hidden_size, config.seed)
+        self.target_params = {k: {kk: vv.copy() for kk, vv in v.items()}
+                              for k, v in self.params.items()}
+        self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_size,
+                                   config.seed)
+        self.workers = [DQNRolloutWorker.remote(config.env, i)
+                        for i in range(config.num_rollout_workers)]
+        self._update = None
+        self.iteration = 0
+        self.total_steps = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def q_fn(params, obs):
+            h = jnp.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
+            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+            return h @ params["q"]["w"] + params["q"]["b"]
+
+        def loss_fn(params, target_params, batch):
+            q = q_fn(params, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            q_next_target = q_fn(target_params, batch["next_obs"])
+            if cfg.double_q:
+                # Double DQN: online net picks the argmax, target net rates it.
+                a_star = jnp.argmax(q_fn(params, batch["next_obs"]), axis=1)
+                q_next = jnp.take_along_axis(
+                    q_next_target, a_star[:, None], axis=1)[:, 0]
+            else:
+                q_next = q_next_target.max(axis=1)
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) \
+                * q_next
+            td = q_sel - jax.lax.stop_gradient(target)
+            # Huber loss (reference: dqn uses huber by default)
+            loss = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
+                             jnp.abs(td) - 0.5).mean()
+            return loss
+
+        def update(params, target_params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, target_params,
+                                                      batch)
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        import jax
+
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        t0 = time.time()
+        eps = self._epsilon()
+        host_params = jax.tree_util.tree_map(np.asarray, self.params)
+        batches = ray_tpu.get(
+            [w.sample.remote(host_params, cfg.rollout_fragment_length, eps)
+             for w in self.workers], timeout=600)
+        episode_returns = []
+        for b in batches:
+            episode_returns.extend(b.pop("episode_returns"))
+            self.buffer.add_batch(b)
+            self.total_steps += len(b["obs"])
+        sample_time = time.time() - t0
+
+        t1 = time.time()
+        loss = 0.0
+        updates_done = 0
+        if self.buffer.size >= max(cfg.train_batch_size, cfg.learning_starts):
+            for _ in range(cfg.num_sgd_iter):
+                mb = self.buffer.sample(cfg.train_batch_size)
+                self.params, self._opt_state, loss = self._update(
+                    self.params, self.target_params, self._opt_state, mb)
+                updates_done += 1
+        self.iteration += 1
+        if self.iteration % cfg.target_network_update_freq == 0:
+            self.target_params = jax.tree_util.tree_map(
+                lambda x: x, self.params)
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(episode_returns))
+            if episode_returns else 0.0,
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_total": self.total_steps,
+            "buffer_size": self.buffer.size,
+            "epsilon": round(eps, 4),
+            "num_updates": updates_done,
+            "loss": float(loss),
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(time.time() - t1, 3),
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def get_policy_params(self) -> dict:
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def compute_single_action(self, obs) -> int:
+        return int(np.argmax(
+            numpy_q_values(self.get_policy_params(), obs[None, :])[0]))

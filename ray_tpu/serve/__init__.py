@@ -21,6 +21,8 @@ from ray_tpu.serve.deployment import (
     DeploymentHandle,
     DeploymentResponse,
     deployment,
+    get_multiplexed_model_id,
+    multiplexed,
 )
 
 _proxy_server = None
@@ -49,6 +51,7 @@ def run(target: Deployment, *, name: str | None = None,
         asc,
         serialization.dumps_func(cfg.user_config)
         if cfg.user_config is not None else None,
+        route_prefix,
     ))
     return DeploymentHandle(cfg.name, controller)
 
@@ -94,12 +97,41 @@ def batch(_fn=None, *, max_batch_size: int = 8,
 
 class _ProxyHandler(BaseHTTPRequestHandler):
     handles: dict[str, DeploymentHandle] = {}
+    # Cached route table {prefix: deployment}; refreshed on a TTL, not per
+    # request (reference: proxies get route updates pushed via long-poll).
+    _routes: dict[str, str] = {}
+    _routes_ts: float = 0.0
+    _ROUTE_TTL = 2.0
 
     def log_message(self, *args):  # silence
         pass
 
+    @classmethod
+    def _route_table(cls) -> dict[str, str]:
+        import time as _time
+
+        now = _time.monotonic()
+        if now - cls._routes_ts > cls._ROUTE_TTL:
+            try:
+                cls._routes = ray_tpu.get(
+                    _get_controller().route_table.remote(), timeout=10)
+                cls._routes_ts = now
+            except Exception:
+                pass
+        return cls._routes
+
     def do_POST(self):
-        name = self.path.strip("/").split("/")[0]
+        # Route by longest matching route_prefix (reference: proxy_router);
+        # falls back to /<deployment-name>.
+        path = self.path.split("?")[0]
+        name = None
+        best_len = -1
+        for prefix, dep in self._route_table().items():
+            if (path == prefix or path.startswith(prefix.rstrip("/") + "/")
+                    or prefix == "/") and len(prefix) > best_len:
+                name, best_len = dep, len(prefix)
+        if name is None:
+            name = path.strip("/").split("/")[0]
         handle = self.handles.get(name)
         if handle is None:
             handle = self.handles[name] = get_deployment_handle(name)
@@ -136,4 +168,5 @@ __all__ = [
     "deployment", "run", "get_deployment_handle", "status", "delete",
     "shutdown", "batch", "start_http_proxy", "Deployment",
     "DeploymentHandle", "DeploymentResponse", "AutoscalingConfig",
+    "multiplexed", "get_multiplexed_model_id",
 ]

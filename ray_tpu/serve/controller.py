@@ -20,14 +20,75 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 @ray_tpu.remote
 class ServeController:
     def __init__(self):
+        import threading
+
         # name -> {config fields, replicas: [handle], target: int, ...}
         self.deployments: dict[str, dict] = {}
         self._last_scale: dict[str, float] = {}
         self._load: dict[str, tuple[float, float]] = {}  # name -> (ts, load)
+        self._stop = threading.Event()
+        # Guards replica-list mutation: the health loop runs on its own
+        # thread, concurrent with actor methods (deploy/record_handle_load)
+        # that also reconcile.
+        self._rlock = threading.Lock()
+        # Health-check loop: replace crashed replicas (reference: the
+        # controller control loop at controller.py:312 reconciles
+        # DeploymentState each tick; a dead replica actor is restarted).
+        self._hc_thread = threading.Thread(target=self._health_loop,
+                                           daemon=True)
+        self._hc_thread.start()
+
+    def _health_loop(self):
+        # A busy replica answers slowly (requests are serviced in order),
+        # so one slow/timed-out probe is not death: require 3 consecutive
+        # failures, like the reference's consecutive health-check-failure
+        # threshold (deployment_state.py replica health tracking).
+        fails: dict[str, int] = {}
+        while not self._stop.wait(2.0):
+            # Purge counters for replicas no longer in any deployment
+            # (actor ids are stable; id() would be recyclable).
+            current = {r._actor_id.hex() for dd in self.deployments.values()
+                       for r in dd["replicas"]}
+            for k in list(fails):
+                if k not in current:
+                    del fails[k]
+            for name in list(self.deployments):
+                d = self.deployments.get(name)
+                if d is None:
+                    continue
+                dead_ids = set()
+                for r in list(d["replicas"]):
+                    key = r._actor_id.hex()
+                    try:
+                        ray_tpu.get(r.health_check.remote(), timeout=10)
+                        fails.pop(key, None)
+                    except ray_tpu.exceptions.ActorDiedError:
+                        dead_ids.add(key)
+                        fails.pop(key, None)
+                    except Exception:
+                        fails[key] = fails.get(key, 0) + 1
+                        if fails[key] >= 3:
+                            dead_ids.add(key)
+                            fails.pop(key, None)
+                            try:
+                                ray_tpu.kill(r)
+                            except Exception:
+                                pass
+                if dead_ids:
+                    with self._rlock:
+                        # Drop only the replicas observed dead; replicas
+                        # appended concurrently by deploy/scale-up survive.
+                        d["replicas"] = [r for r in d["replicas"]
+                                         if r._actor_id.hex() not in dead_ids]
+                    try:
+                        self._reconcile(name)
+                    except Exception:
+                        pass
 
     def deploy(self, name: str, callable_blob: bytes, init_args_blob: bytes,
                num_replicas: int, actor_options: dict,
-               autoscaling: dict | None, user_config_blob: bytes | None):
+               autoscaling: dict | None, user_config_blob: bytes | None,
+               route_prefix: str | None = None):
         d = self.deployments.get(name)
         if d is None:
             d = self.deployments[name] = {
@@ -37,6 +98,8 @@ class ServeController:
         d["actor_options"] = actor_options or {}
         d["autoscaling"] = autoscaling
         d["user_config_blob"] = user_config_blob
+        d["route_prefix"] = route_prefix if route_prefix is not None \
+            else f"/{name}"
         d["target"] = (autoscaling or {}).get("min_replicas", num_replicas) \
             if autoscaling else num_replicas
         d["version"] += 1
@@ -59,10 +122,13 @@ class ServeController:
 
     def _reconcile(self, name: str):
         d = self.deployments[name]
-        while len(d["replicas"]) < d["target"]:
-            d["replicas"].append(self._make_replica(d))
-        while len(d["replicas"]) > d["target"]:
-            victim = d["replicas"].pop()
+        with self._rlock:
+            while len(d["replicas"]) < d["target"]:
+                d["replicas"].append(self._make_replica(d))
+            victims = []
+            while len(d["replicas"]) > d["target"]:
+                victims.append(d["replicas"].pop())
+        for victim in victims:
             try:
                 ray_tpu.kill(victim)
             except Exception:
@@ -71,6 +137,12 @@ class ServeController:
     def get_replicas(self, name: str):
         d = self.deployments.get(name)
         return list(d["replicas"]) if d else []
+
+    def route_table(self) -> dict:
+        """{route_prefix: deployment_name} for proxy-side caching (the
+        proxy does the longest-prefix match against this table)."""
+        return {d.get("route_prefix") or f"/{name}": name
+                for name, d in self.deployments.items()}
 
     def list_deployments(self):
         return {name: {"num_replicas": len(d["replicas"]),
@@ -113,6 +185,7 @@ class ServeController:
         return True
 
     def shutdown(self):
+        self._stop.set()
         for name in list(self.deployments):
             self.delete_deployment(name)
         return True

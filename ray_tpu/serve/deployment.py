@@ -43,6 +43,51 @@ class DeploymentConfig:
     user_config: Any = None
 
 
+# --- model multiplexing (parity: serve/multiplex.py) -----------------------
+
+# Plain module global, not TLS: replica actors execute requests serially
+# (ordered actor queue), and a threading.local here would make the replica
+# class blob unpicklable (cloudpickle captures referenced globals by value).
+_current_model_id: str = ""
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id the current request was routed with
+    (reference: serve.get_multiplexed_model_id)."""
+    import ray_tpu.serve.deployment as _dep
+
+    return _dep._current_model_id
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorator for a per-model loader method: results are LRU-cached on
+    the replica, at most `max_num_models_per_replica` resident (reference:
+    serve/multiplex.py _ModelMultiplexWrapper)."""
+
+    def wrap(fn):
+        def loader(self, model_id: str):
+            cache = getattr(self, "_serve_model_cache", None)
+            if cache is None:
+                from collections import OrderedDict
+
+                cache = self._serve_model_cache = OrderedDict()
+            if model_id in cache:
+                cache.move_to_end(model_id)
+                return cache[model_id]
+            model = fn(self, model_id)
+            cache[model_id] = model
+            while len(cache) > max_num_models_per_replica:
+                cache.popitem(last=False)
+            return model
+
+        loader._serve_multiplexed = True
+        return loader
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
 @ray_tpu.remote
 class ReplicaActor:
     """Hosts one copy of the deployment callable."""
@@ -60,10 +105,20 @@ class ReplicaActor:
                                                "reconfigure"):
             self._instance.reconfigure(user_config)
 
-    def handle_request(self, method: str, args, kwargs):
+    def handle_request(self, method: str, args, kwargs, model_id: str = ""):
+        import ray_tpu.serve.deployment as _dep
+
         fn = self._instance if method == "__call__" \
             else getattr(self._instance, method)
-        return fn(*args, **(kwargs or {}))
+        _dep._current_model_id = model_id
+        try:
+            return fn(*args, **(kwargs or {}))
+        finally:
+            _dep._current_model_id = ""
+
+    def loaded_model_ids(self) -> list[str]:
+        cache = getattr(self._instance, "_serve_model_cache", None)
+        return list(cache.keys()) if cache else []
 
     def handle_batch(self, method: str, batched_args: list):
         fn = self._instance if method == "__call__" \
@@ -198,19 +253,33 @@ class DeploymentResponse:
         return self._value
 
 
+class _RouterState:
+    """Shared routing state for a deployment: replica cache, per-replica
+    outstanding counts, and multiplexed-model residency.  One instance is
+    shared by a handle and every handle derived from it via .options()
+    (reference: handle clones share one Router, router.py)."""
+
+    def __init__(self):
+        self.replicas: list = []
+        self.outstanding: dict[int, int] = {}
+        self.model_replicas: dict[str, set[int]] = {}
+        self.lock = threading.Lock()
+        self.last_refresh = 0.0
+
+
 class DeploymentHandle:
     """Routes requests to replicas: power-of-two-choices on outstanding
     per-replica request counts (reference: router.py:290)."""
 
     def __init__(self, deployment_name: str, controller, method: str = "__call__",
-                 batching: tuple[int, float] | None = None):
+                 batching: tuple[int, float] | None = None,
+                 multiplexed_model_id: str = "",
+                 router: _RouterState | None = None):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method = method
-        self._replicas: list = []
-        self._outstanding: dict[int, int] = {}
-        self._lock = threading.Lock()
-        self._last_refresh = 0.0
+        self._model_id = multiplexed_model_id
+        self._router = router or _RouterState()
         self._batchq: _BatchQueue | None = None
         if batching:
             self._batchq = _BatchQueue(self._submit_batch, batching[0],
@@ -222,10 +291,46 @@ class DeploymentHandle:
         self._reaper: threading.Thread | None = None
 
     def options(self, method_name: str | None = None,
-                batching: tuple[int, float] | None = None
+                batching: tuple[int, float] | None = None,
+                multiplexed_model_id: str | None = None
                 ) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, self._controller,
-                                method_name or self._method, batching)
+        return DeploymentHandle(
+            self.deployment_name, self._controller,
+            method_name or self._method, batching,
+            self._model_id if multiplexed_model_id is None
+            else multiplexed_model_id,
+            router=self._router)
+
+    # Routing state lives on the shared router; these aliases keep the
+    # method bodies below reading naturally.
+
+    @property
+    def _lock(self):
+        return self._router.lock
+
+    @property
+    def _replicas(self):
+        return self._router.replicas
+
+    @_replicas.setter
+    def _replicas(self, value):
+        self._router.replicas = value
+
+    @property
+    def _outstanding(self):
+        return self._router.outstanding
+
+    @property
+    def _model_replicas(self):
+        return self._router.model_replicas
+
+    @property
+    def _last_refresh(self):
+        return self._router.last_refresh
+
+    @_last_refresh.setter
+    def _last_refresh(self, value):
+        self._router.last_refresh = value
 
     # -- replica set maintenance (long-poll analog: periodic refresh) --
 
@@ -235,6 +340,10 @@ class DeploymentHandle:
             reps = ray_tpu.get(self._controller.get_replicas.remote(
                 self.deployment_name))
             with self._lock:
+                if len(reps) != len(self._replicas):
+                    # Replica set changed: cached model->index residency is
+                    # no longer valid.
+                    self._model_replicas.clear()
                 self._replicas = reps
                 self._last_refresh = now
                 for i in range(len(reps)):
@@ -246,6 +355,20 @@ class DeploymentHandle:
         if not reps:
             raise RuntimeError(
                 f"deployment {self.deployment_name!r} has no replicas")
+        # Multiplexing: prefer the least-loaded replica that already has
+        # this model resident (reference: router.py multiplexed routing).
+        # Residency is tracked handle-side — recorded when a request for a
+        # model is routed — not probed per request (a per-request RPC to
+        # every replica would queue behind in-flight inference).
+        if self._model_id and len(reps) > 1:
+            with self._lock:
+                cached = [i for i in self._model_replicas.get(
+                    self._model_id, ()) if i < len(reps)]
+                if cached:
+                    idx = min(cached,
+                              key=lambda i: self._outstanding.get(i, 0))
+                    self._outstanding[idx] = self._outstanding.get(idx, 0) + 1
+                    return idx, reps[idx]
         with self._lock:
             if len(reps) == 1:
                 idx = 0
@@ -278,7 +401,12 @@ class DeploymentHandle:
             return resp
         idx, replica = self._pick_replica()
         try:
-            ref = replica.handle_request.remote(self._method, list(args), kwargs)
+            ref = replica.handle_request.remote(self._method, list(args), kwargs,
+                                                self._model_id)
+            if self._model_id:
+                with self._lock:
+                    self._model_replicas.setdefault(
+                        self._model_id, set()).add(idx)
             resp._resolve_ref(ref)
             with self._lock:
                 self._inflight.append((idx, ref))

@@ -97,9 +97,30 @@ def _execute(node: Any, state: _RunState):
         if os.path.exists(path):
             with open(path, "rb") as f:
                 return pickle.load(f)
-        remote_fn = ray_tpu.remote(**node.options)(node.fn) \
-            if node.options else ray_tpu.remote(node.fn)
-        result = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+        opts = dict(node.options)
+        max_retries = opts.pop("max_retries", 0)
+        catch = opts.pop("catch_exceptions", False)
+        remote_fn = ray_tpu.remote(**opts)(node.fn) \
+            if opts else ray_tpu.remote(node.fn)
+        last_err: BaseException | None = None
+        result = None
+        for _ in range(max(1, max_retries + 1)):
+            try:
+                result = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+                last_err = None
+                break
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+        if last_err is not None:
+            if not catch:
+                raise last_err
+            # catch_exceptions: the step RESULT is (value, error) — the
+            # error is durable too (reference: workflow step options).
+            # Unwrap the task-error envelope to the application exception.
+            cause = getattr(last_err, "cause", None)
+            result = (None, cause if cause is not None else last_err)
+        elif catch:
+            result = (result, None)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(result, f)
@@ -110,14 +131,48 @@ def _execute(node: Any, state: _RunState):
     return node
 
 
+def _write_status(storage: str, workflow_id: str, status: str) -> None:
+    d = os.path.join(storage, workflow_id)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "status"), "w") as f:
+        f.write(status)
+
+
+def get_status(workflow_id: str, *, storage: str | None = None) -> str:
+    """RUNNING / SUCCEEDED / FAILED / NOT_FOUND (reference:
+    workflow.get_status WorkflowStatus)."""
+    path = os.path.join(storage or _DEFAULT_STORAGE, workflow_id, "status")
+    if not os.path.exists(path):
+        return "NOT_FOUND"
+    with open(path) as f:
+        return f.read().strip()
+
+
 def run(dag: Step, *, workflow_id: str, storage: str | None = None):
     """Execute (or resume) a workflow; returns the final result."""
     state = _RunState(workflow_id, storage or _DEFAULT_STORAGE)
-    result = _execute(dag, state)
+    _write_status(state.storage, workflow_id, "RUNNING")
+    try:
+        result = _execute(dag, state)
+    except BaseException:
+        _write_status(state.storage, workflow_id, "FAILED")
+        raise
     done_path = os.path.join(state.storage, workflow_id, "result.pkl")
     with open(done_path, "wb") as f:
         pickle.dump(result, f)
+    _write_status(state.storage, workflow_id, "SUCCEEDED")
     return result
+
+
+def run_async(dag: Step, *, workflow_id: str, storage: str | None = None):
+    """Run in a background thread; returns a concurrent.futures.Future
+    (reference: workflow.run_async returns an ObjectRef)."""
+    import concurrent.futures
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    fut = pool.submit(run, dag, workflow_id=workflow_id, storage=storage)
+    pool.shutdown(wait=False)
+    return fut
 
 
 def get_output(workflow_id: str, *, storage: str | None = None):
@@ -142,5 +197,5 @@ def delete(workflow_id: str, *, storage: str | None = None) -> None:
                   ignore_errors=True)
 
 
-__all__ = ["step", "run", "get_output", "list_workflows", "delete", "Step",
-           "StepFunction"]
+__all__ = ["step", "run", "run_async", "get_output", "get_status",
+           "list_workflows", "delete", "Step", "StepFunction"]

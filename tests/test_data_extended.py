@@ -126,3 +126,23 @@ def test_iter_batches_shapes():
     batches = list(ds.iter_batches(batch_size=4))
     assert batches[0]["x"].shape == (4, 3)
     assert batches[-1]["x"].shape == (2, 3)
+
+
+def test_lazy_read_executes_remotely(ray_start_regular, tmp_path):
+    """read_* defers file IO into cluster tasks (reference: datasource
+    ReadTasks) — the driver holds only ReadTask descriptors until the
+    dataset is consumed."""
+    from ray_tpu.data.dataset import ReadTask
+
+    for i in range(3):
+        (tmp_path / f"part-{i}.txt").write_text(f"line-{i}\n")
+    ds = data.read_text(str(tmp_path / "part-*.txt"))
+    assert all(isinstance(s, ReadTask) for s in ds._source)
+    assert ds.num_blocks() == 3
+    rows = sorted(r["text"] for r in ds.iter_rows())
+    assert rows == ["line-0", "line-1", "line-2"]
+    # Transform chained on the lazy read still runs block-parallel.
+    n = data.read_text(str(tmp_path / "part-*.txt")) \
+        .map(lambda r: {"n": int(r["text"].split("-")[1])}) \
+        .sum("n")
+    assert n == 3

@@ -69,3 +69,24 @@ def test_ring_attention_matches_reference(causal):
     ref = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(causal):
+    from ray_tpu.ops.attention import ulysses_attention
+
+    assert len(jax.devices()) == 8
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("sp",))
+    B, H, S, D = 2, 8, 256, 32  # H divisible by the sp axis
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), B=B, H=H, S=S, D=D)
+
+    ulysses = shard_map(
+        functools.partial(ulysses_attention, axis="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None),
+    )
+    out = jax.jit(ulysses)(q, k, v)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -117,3 +117,70 @@ def test_autoscaling_up(serve_cluster):
     assert serve.status()["Slow"]["num_replicas"] > 1
     for r in responses:
         r.result(timeout=120)
+
+
+def test_replica_replaced_on_crash(serve_cluster):
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def __call__(self, x):
+            if x == "die":
+                import os
+
+                os._exit(1)
+            return "alive"
+
+    handle = serve.run(Fragile.bind())
+    assert handle.remote("hi").result(timeout=60) == "alive"
+    try:
+        handle.remote("die").result(timeout=10)
+    except Exception:
+        pass
+    # The controller health loop replaces the dead replica.
+    deadline = time.time() + 40
+    while time.time() < deadline:
+        try:
+            if handle.remote("hi").result(timeout=10) == "alive":
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert handle.remote("hi").result(timeout=30) == "alive"
+
+
+def test_multiplexed_models(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class MultiModel:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return {"id": model_id, "pid_loaded": __import__("os").getpid()}
+
+        def __call__(self, _):
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return {"model": model["id"], "pid": __import__("os").getpid()}
+
+    handle = serve.run(MultiModel.bind())
+    r1 = handle.options(multiplexed_model_id="m1").remote(None).result(timeout=60)
+    assert r1["model"] == "m1"
+    # Subsequent m1 requests stick to a replica that has m1 resident.
+    pids = {handle.options(multiplexed_model_id="m1")
+            .remote(None).result(timeout=60)["pid"] for _ in range(4)}
+    assert pids == {r1["pid"]}
+
+
+def test_route_prefix(serve_cluster):
+    import json
+    import urllib.request
+
+    @serve.deployment
+    def api(payload):
+        return {"got": payload}
+
+    serve.run(api.bind(), route_prefix="/v1/api")
+    port = serve.start_http_proxy(port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/api/anything",
+        data=json.dumps({"k": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        body = json.load(resp)
+    assert body["result"] == {"got": {"k": 1}}

@@ -134,3 +134,43 @@ def test_metrics(ray_start_regular):
         merged.update(worker_metrics)
     assert "test_requests" in merged
     assert "test_depth" in merged
+
+
+def test_workflow_retries_and_status(ray_start_regular, tmp_path):
+    attempts = tmp_path / "attempts.txt"
+
+    @workflow.step(max_retries=3)
+    def flaky():
+        with open(attempts, "a") as f:
+            f.write("x\n")
+        if attempts.read_text().count("x") < 3:
+            raise RuntimeError("transient")
+        return "done"
+
+    out = workflow.run(flaky.step(), workflow_id="wr",
+                       storage=str(tmp_path / "wf"))
+    assert out == "done"
+    assert attempts.read_text().count("x") == 3
+    assert workflow.get_status("wr", storage=str(tmp_path / "wf")) == "SUCCEEDED"
+
+
+def test_workflow_catch_exceptions(ray_start_regular, tmp_path):
+    @workflow.step(catch_exceptions=True)
+    def boom():
+        raise ValueError("expected")
+
+    value, err = workflow.run(boom.step(), workflow_id="wc",
+                              storage=str(tmp_path / "wf"))
+    assert value is None
+    assert isinstance(err, ValueError)
+
+    @workflow.step
+    def always_fails():
+        raise RuntimeError("no")
+
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        workflow.run(always_fails.step(), workflow_id="wf2",
+                     storage=str(tmp_path / "wf"))
+    assert workflow.get_status("wf2", storage=str(tmp_path / "wf")) == "FAILED"
