@@ -1,8 +1,18 @@
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.cluster_config import (
+    load_cluster_config,
+    make_provider,
+    node_types_from_config,
+    validate_cluster_config,
+)
+from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
 from ray_tpu.autoscaler.node_provider import (
     FakeNodeProvider,
     NodeProvider,
     NodeType,
 )
 
-__all__ = ["StandardAutoscaler", "NodeProvider", "FakeNodeProvider", "NodeType"]
+__all__ = ["StandardAutoscaler", "NodeProvider", "FakeNodeProvider",
+           "NodeType", "GCPTPUNodeProvider", "load_cluster_config",
+           "validate_cluster_config", "node_types_from_config",
+           "make_provider"]

@@ -1,0 +1,75 @@
+"""Cluster YAML config: schema + provider construction.
+
+Parity: reference python/ray/autoscaler/ray-schema.json + `ray up`
+(autoscaler/_private/commands.py).  Shape::
+
+    cluster_name: my-tpu-cluster
+    max_workers: 16
+    idle_timeout_minutes: 5
+    provider:
+      type: gcp_tpu            # or: fake
+      project: my-project
+      zone: us-central2-b
+      accelerator_type: v5e-8
+      runtime_version: tpu-ubuntu2204-base
+    available_node_types:
+      tpu_worker:
+        resources: {"TPU": 8, "CPU": 16}
+        min_workers: 0
+        max_workers: 8
+        hosts_per_slice: 1
+"""
+
+from __future__ import annotations
+
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
+
+_REQUIRED = ("cluster_name", "provider", "available_node_types")
+
+
+def load_cluster_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    validate_cluster_config(cfg)
+    return cfg
+
+
+def validate_cluster_config(cfg: dict) -> None:
+    for key in _REQUIRED:
+        if key not in cfg:
+            raise ValueError(f"cluster config missing {key!r}")
+    if "type" not in cfg["provider"]:
+        raise ValueError("provider config needs 'type'")
+    for name, t in cfg["available_node_types"].items():
+        if "resources" not in t:
+            raise ValueError(f"node type {name!r} needs 'resources'")
+
+
+def node_types_from_config(cfg: dict) -> list[NodeType]:
+    out = []
+    for name, t in cfg["available_node_types"].items():
+        out.append(NodeType(
+            name=name,
+            resources=dict(t["resources"]),
+            labels=dict(t.get("labels", {})),
+            min_workers=int(t.get("min_workers", 0)),
+            max_workers=int(t.get("max_workers", cfg.get("max_workers", 10))),
+            hosts_per_slice=int(t.get("hosts_per_slice", 1))))
+    return out
+
+
+def make_provider(cfg: dict, runtime_node=None) -> NodeProvider:
+    ptype = cfg["provider"]["type"]
+    if ptype == "fake":
+        from ray_tpu.autoscaler.node_provider import FakeNodeProvider
+
+        if runtime_node is None:
+            raise ValueError("fake provider needs the local RuntimeNode")
+        return FakeNodeProvider(runtime_node, cfg["provider"])
+    if ptype == "gcp_tpu":
+        from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
+
+        return GCPTPUNodeProvider(cfg["provider"])
+    raise ValueError(f"unknown provider type {ptype!r}")

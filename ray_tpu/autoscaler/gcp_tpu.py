@@ -1,0 +1,219 @@
+"""GCP TPU-VM node provider.
+
+Parity: reference python/ray/autoscaler/gcp/node_provider.py:77-90 (GCPTPU
+resource class) + gcp/tpu_command_runner.py:56 (TPUCommandRunner fans
+setup/start commands out to every host of a TPU-VM slice) + gcp/config.py.
+
+Re-design notes: the reference drives the GCE REST API through
+googleapiclient; this provider shells out to `gcloud` (the TPU-VM
+queued-resources flow), which is what the TPU provisioning docs
+standardize on and keeps the provider dependency-free.  One *node* here
+is one TPU-VM (possibly multi-host) slice — the ICI gang unit — matching
+the STRICT_ICI scheduling model (SURVEY.md §7 stage 3: slices live and
+die together).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import uuid
+
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
+
+
+class GCPTPUNodeProvider(NodeProvider):
+    """Provisions TPU-VM slices via `gcloud compute tpus`.
+
+    config keys: project, zone, accelerator_type (e.g. "v5e-8"),
+    runtime_version, optional reserved/spot, optional use_queued_resources.
+    """
+
+    def __init__(self, config: dict):
+        super().__init__(config)
+        for key in ("project", "zone", "accelerator_type", "runtime_version"):
+            if key not in config:
+                raise ValueError(f"GCPTPUNodeProvider config needs {key!r}")
+        self._nodes: dict[str, dict] = {}
+
+    # -- gcloud plumbing (separated so tests can assert the exact argv) --
+
+    def _gcloud(self) -> str:
+        path = shutil.which("gcloud")
+        if path is None:
+            raise RuntimeError(
+                "gcloud CLI not found; GCPTPUNodeProvider requires the "
+                "Google Cloud SDK on the head node")
+        return path
+
+    def create_command(self, name: str, node_type: NodeType) -> list[str]:
+        cfg = self.config
+        if cfg.get("use_queued_resources", True):
+            # Queued resources: the supported path for v5e/v5p/v6e slices
+            # and for spot/reserved capacity.
+            cmd = [
+                "gcloud", "compute", "tpus", "queued-resources", "create",
+                name,
+                f"--node-id={name}",
+                f"--project={cfg['project']}",
+                f"--zone={cfg['zone']}",
+                f"--accelerator-type={cfg['accelerator_type']}",
+                f"--runtime-version={cfg['runtime_version']}",
+            ]
+            if cfg.get("spot"):
+                cmd.append("--spot")
+            if cfg.get("reserved"):
+                cmd.append("--reserved")
+        else:
+            cmd = [
+                "gcloud", "compute", "tpus", "tpu-vm", "create", name,
+                f"--project={cfg['project']}",
+                f"--zone={cfg['zone']}",
+                f"--accelerator-type={cfg['accelerator_type']}",
+                f"--version={cfg['runtime_version']}",
+            ]
+        return cmd
+
+    def delete_command(self, name: str) -> list[str]:
+        cfg = self.config
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "delete", name,
+            f"--project={cfg['project']}", f"--zone={cfg['zone']}",
+            "--quiet",
+        ]
+
+    def ssh_fanout_command(self, name: str, remote_cmd: str) -> list[str]:
+        """Run `remote_cmd` on EVERY host of the slice (reference:
+        tpu_command_runner.py:56 TPUCommandRunner --worker=all fan-out)."""
+        cfg = self.config
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", name,
+            f"--project={cfg['project']}", f"--zone={cfg['zone']}",
+            "--worker=all", f"--command={remote_cmd}",
+        ]
+
+    def list_command(self) -> list[str]:
+        cfg = self.config
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "list",
+            f"--project={cfg['project']}", f"--zone={cfg['zone']}",
+            "--format=json",
+        ]
+
+    def list_queued_command(self) -> list[str]:
+        cfg = self.config
+        return [
+            "gcloud", "compute", "tpus", "queued-resources", "list",
+            f"--project={cfg['project']}", f"--zone={cfg['zone']}",
+            "--format=json",
+        ]
+
+    def delete_queued_command(self, name: str) -> list[str]:
+        cfg = self.config
+        return [
+            "gcloud", "compute", "tpus", "queued-resources", "delete", name,
+            f"--project={cfg['project']}", f"--zone={cfg['zone']}",
+            "--quiet", "--force",
+        ]
+
+    def _run(self, cmd: list[str]) -> str:
+        cmd = [self._gcloud()] + cmd[1:]
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(f"{' '.join(cmd)} failed: {out.stderr[-500:]}")
+        return out.stdout
+
+    # -- NodeProvider interface --
+
+    NAME_PREFIX = "ray-tpu-"
+
+    def non_terminated_nodes(self) -> list[str]:
+        """Nodes this CLUSTER owns (name-prefix filter — a shared zone may
+        hold unrelated TPUs): READY/CREATING tpu-vms plus queued resources
+        still waiting for capacity (so pending gangs are not double-
+        launched every autoscaler tick).  READY nodes that were created
+        via the async queued-resources flow get their deferred raylet
+        bootstrap here (create-time SSH would race provisioning)."""
+        names = []
+        try:
+            listed = json.loads(self._run(self.list_command()) or "[]")
+        except RuntimeError:
+            listed = None
+        if listed is None:
+            return list(self._nodes)
+        for tpu in listed:
+            name = tpu.get("name", "").rsplit("/", 1)[-1]
+            if not name.startswith(self.NAME_PREFIX):
+                continue
+            state = tpu.get("state", "")
+            if state in ("READY", "CREATING"):
+                names.append(name)
+                info = self._nodes.setdefault(
+                    name, {"type_name": "tpu", "queued": True})
+                if state == "READY" and not info.get("bootstrapped"):
+                    self._bootstrap(name, info)
+        # Queued resources not yet materialized as tpu-vms still count as
+        # pending capacity.
+        try:
+            queued = json.loads(self._run(self.list_queued_command()) or "[]")
+        except RuntimeError:
+            queued = []
+        for qr in queued:
+            name = qr.get("name", "").rsplit("/", 1)[-1]
+            state = qr.get("state", {})
+            if isinstance(state, dict):
+                state = state.get("state", "")
+            if name.startswith(self.NAME_PREFIX) and name not in names \
+                    and state in ("WAITING_FOR_RESOURCES", "PROVISIONING",
+                                  "ACCEPTED"):
+                names.append(name)
+                self._nodes.setdefault(name, {"type_name": "tpu",
+                                              "queued": True})
+        return names
+
+    def _bootstrap(self, name: str, info: dict) -> None:
+        """Start the raylet on every host of a now-READY slice."""
+        head = self.config.get("head_address")
+        if not head:
+            info["bootstrapped"] = True
+            return
+        start = f"python -m ray_tpu.scripts start --address={head}"
+        try:
+            self._run(self.ssh_fanout_command(name, start))
+            info["bootstrapped"] = True
+        except RuntimeError:
+            pass  # retried next tick
+
+    def node_resources(self, node_id: str) -> dict:
+        chips = int(self.config["accelerator_type"].rsplit("-", 1)[-1])
+        return {"TPU": float(chips)}
+
+    def node_type(self, node_id: str) -> str:
+        return self._nodes.get(node_id, {}).get("type_name", "tpu")
+
+    def create_node(self, node_type: NodeType, count: int = 1) -> list[str]:
+        created = []
+        use_qr = self.config.get("use_queued_resources", True)
+        for _ in range(count):
+            name = f"{self.NAME_PREFIX}{node_type.name}-{uuid.uuid4().hex[:8]}"
+            self._run(self.create_command(name, node_type))
+            self._nodes[name] = {"type_name": node_type.name,
+                                 "queued": use_qr}
+            # Raylet bootstrap is deferred to non_terminated_nodes once the
+            # slice reports READY (queued-resources creation is async and
+            # can take minutes to hours).
+            created.append(name)
+        return created
+
+    def terminate_node(self, node_id: str) -> None:
+        info = self._nodes.get(node_id, {})
+        try:
+            if info.get("queued", True):
+                # Queued-resource-managed slices must be deleted through
+                # the queued-resources API (tpu-vm delete is rejected).
+                self._run(self.delete_queued_command(node_id))
+            else:
+                self._run(self.delete_command(node_id))
+        finally:
+            self._nodes.pop(node_id, None)

@@ -24,6 +24,14 @@ from ray_tpu.models.moe import (
     moe_aux_loss,
 )
 from ray_tpu.models.generate import Generator, SamplingParams, generate
+from ray_tpu.models.vit import (
+    VIT_B16,
+    VIT_L16,
+    VIT_TINY,
+    ViT,
+    ViTConfig,
+    vit_loss,
+)
 
 __all__ = [
     "LlamaConfig", "LlamaModel", "LLAMA2_7B", "LLAMA2_13B", "LLAMA3_8B",
@@ -31,4 +39,5 @@ __all__ = [
     "MoEConfig", "MoEModel", "MIXTRAL_8X7B", "TINY_MOE", "MOE_RULES",
     "moe_aux_loss",
     "Generator", "SamplingParams", "generate",
+    "ViT", "ViTConfig", "VIT_B16", "VIT_L16", "VIT_TINY", "vit_loss",
 ]

@@ -1,0 +1,137 @@
+"""Vision Transformer, TPU-first.
+
+The reference ships no vision models (its release gates run torchvision
+models through TorchTrainer — reference: release/release_tests.yaml air
+batch-inference entries); here the vision family is part of the framework:
+flax ViT whose parameter names line up with
+`ray_tpu.parallel.TRANSFORMER_RULES` (q/k/v/o_proj, gate/up/down_proj) so
+the same TP/FSDP rules shard it, and whose attention rides the same
+Pallas flash kernel.
+
+Conventions: images (batch, height, width, channels), patches flattened
+to a (batch, tokens, d_model) sequence, bf16-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"  # or "reference"
+    pool: str = "cls"  # or "mean"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+VIT_B16 = ViTConfig()
+VIT_L16 = ViTConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)
+VIT_TINY = ViTConfig(image_size=32, patch_size=8, num_classes=10, d_model=64,
+                     n_layers=2, n_heads=4, d_ff=128, dtype=jnp.float32,
+                     attention="reference")
+
+
+class ViTAttention(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, T, _ = x.shape
+        H, Dh = cfg.n_heads, cfg.head_dim
+        dense = functools.partial(nn.Dense, use_bias=True, dtype=cfg.dtype,
+                                  param_dtype=cfg.dtype)
+        q = dense(H * Dh, name="q_proj")(x).reshape(B, T, H, Dh)
+        k = dense(H * Dh, name="k_proj")(x).reshape(B, T, H, Dh)
+        v = dense(H * Dh, name="v_proj")(x).reshape(B, T, H, Dh)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if cfg.attention == "flash" and T % 128 == 0:
+            out = flash_attention(q, k, v, None, False)
+        else:
+            out = mha_reference(q, k, v, causal=False)
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+        return dense(cfg.d_model, name="o_proj")(out)
+
+
+class ViTMLP(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = functools.partial(nn.Dense, use_bias=True, dtype=cfg.dtype,
+                                  param_dtype=cfg.dtype)
+        h = nn.gelu(dense(cfg.d_ff, name="up_proj")(x))
+        return dense(cfg.d_model, name="down_proj")(h)
+
+
+class ViTBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + ViTAttention(cfg, name="attn")(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        return x + ViTMLP(cfg, name="mlp")(h)
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        B = images.shape[0]
+        # Patchify: a Conv with stride=patch is the canonical XLA-friendly
+        # embedding (one big MXU matmul after im2col).
+        x = nn.Conv(cfg.d_model, (cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    dtype=cfg.dtype, param_dtype=cfg.dtype,
+                    name="patch_embed")(images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.d_model)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.d_model), cfg.dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, cfg.d_model)), x],
+                            axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(0.02),
+                         (1, cfg.num_patches + 1, cfg.d_model), cfg.dtype)
+        x = x + pos
+        for i in range(cfg.n_layers):
+            x = ViTBlock(cfg, name=f"layers_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="norm")(x)
+        pooled = x[:, 0] if cfg.pool == "cls" else x.mean(axis=1)
+        logits = nn.Dense(cfg.num_classes, dtype=cfg.dtype,
+                          param_dtype=cfg.dtype, name="lm_head")(pooled)
+        return logits.astype(jnp.float32)
+
+
+def vit_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()

@@ -48,7 +48,8 @@ def _interpret_mode() -> bool:
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       m_scratch, l_scratch, acc_scratch,
                       *, sm_scale: float, causal: bool,
-                      block_q: int, block_k: int, num_k_blocks: int):
+                      block_q: int, block_k: int, num_k_blocks: int,
+                      kv_valid_len: int | None = None):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -79,6 +80,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        if kv_valid_len is not None and \
+                kv_valid_len < num_k_blocks * block_k:
+            # Sequence padded up to a block multiple: keys at or beyond
+            # kv_valid_len are invisible.  (Static shapes — the mask is an
+            # elementwise where; interior blocks pass through unchanged.)
+            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(k_pos < kv_valid_len, s, _NEG_INF)
 
         m_prev = m_scratch[:]                        # (block_q, 1)
         l_prev = l_scratch[:]
@@ -106,7 +114,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _flash_forward(q, k, v, sm_scale: float, causal: bool,
-                   block_q: int, block_k: int):
+                   block_q: int, block_k: int,
+                   kv_valid_len: int | None = None):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     block_q = min(block_q, Sq)
@@ -130,7 +139,8 @@ def _flash_forward(q, k, v, sm_scale: float, causal: bool,
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          num_k_blocks=Sk // block_k),
+                          num_k_blocks=Sk // block_k,
+                          kv_valid_len=kv_valid_len),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -164,7 +174,7 @@ def _flash_forward(q, k, v, sm_scale: float, causal: bool,
 # ---------------------------------------------------------------------------
 
 
-def _flash_backward(sm_scale, causal, block_q, block_k, res, do):
+def _flash_backward(sm_scale, causal, block_q, block_k, kv_valid_len, res, do):
     q, k, v, out, lse = res
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -183,6 +193,11 @@ def _flash_backward(sm_scale, causal, block_q, block_k, res, do):
             q_pos = qi_start + jnp.arange(q_blk.shape[2])[:, None]
             k_pos = jnp.arange(Sk)[None, :]
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        if kv_valid_len is not None and kv_valid_len < Sk:
+            # Same padded-key mask as the forward: without it the
+            # recomputed p would leak gradient into padding keys.
+            s = jnp.where(jnp.arange(Sk)[None, :] < kv_valid_len, s,
+                          _NEG_INF)
         return jnp.exp(s - lse_blk[..., None])
 
     def scan_body(carry, idx):

@@ -1,0 +1,140 @@
+"""TorchTrainer: torch train loops on the ray_tpu worker gang.
+
+Parity: reference python/ray/train/torch/ — TorchTrainer wraps the same
+DataParallelTrainer machinery; `_setup_torch_process_group`
+(train/torch/config.py:63) becomes a gloo rendezvous wired from the
+driver (MASTER_ADDR/PORT env, rank/world from the session), and
+`prepare_model` (train/torch/train_loop_utils.py:74) wraps
+DistributedDataParallel.  CPU/gloo here — the accelerator path in this
+framework is JAX/TPU (JaxTrainer); TorchTrainer exists for torch-native
+user code and host-side models, the same role the reference's gloo
+backend plays off-GPU.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Callable
+
+from ray_tpu.train import session
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import JaxTrainer, Result
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def prepare_model(model):
+    """Wrap for distributed training (reference: prepare_model
+    train_loop_utils.py:74 → DDP). No-op for world_size 1."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Re-batch a DataLoader with a DistributedSampler shard (reference:
+    prepare_data_loader train_loop_utils.py)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return loader
+    sampler = DistributedSampler(loader.dataset,
+                                 num_replicas=dist.get_world_size(),
+                                 rank=dist.get_rank())
+    return DataLoader(loader.dataset, batch_size=loader.batch_size,
+                      sampler=sampler, num_workers=0,
+                      collate_fn=loader.collate_fn,
+                      drop_last=loader.drop_last)
+
+
+def _torch_wrapped_loop(user_loop_blob: bytes, config: dict):
+    """Runs inside each train worker: gloo process group up, then the
+    user loop, then teardown (reference: _TorchBackend.on_start/on_shutdown
+    train/torch/config.py).  Rendezvous: rank 0 binds a port on ITS host
+    and publishes host:port through the GCS KV — the reference likewise
+    has the backend pick the address on the rank-0 worker, not the driver
+    (a driver-chosen 127.0.0.1 would break multi-node gangs)."""
+    import os
+    import time
+
+    from ray_tpu._private import serialization
+    from ray_tpu._private.api_internal import get_core_worker
+
+    rank = session.get_world_rank()
+    world = session.get_world_size()
+    if world > 1:
+        import torch.distributed as dist
+
+        cw = get_core_worker()
+        key = config.pop("_torch_rdzv_key")
+        if rank == 0:
+            addr, port = cw.address.host, _free_port()
+            cw._run(cw.gcs.call("KVPut", {
+                "ns": "torch_rdzv", "key": key, "value": f"{addr}:{port}"}))
+        else:
+            deadline = time.monotonic() + 120
+            while True:
+                val = cw._run(cw.gcs.call("KVGet", {
+                    "ns": "torch_rdzv", "key": key}))["value"]
+                if val:
+                    addr, port_s = val.rsplit(":", 1)
+                    port = int(port_s)
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError("torch rendezvous: rank 0 never "
+                                       "published its address")
+                time.sleep(0.05)
+        os.environ["MASTER_ADDR"] = addr
+        os.environ["MASTER_PORT"] = str(port)
+        dist.init_process_group("gloo", rank=rank, world_size=world)
+    user_loop = serialization.loads_func(user_loop_blob)
+    try:
+        user_loop(config)
+    finally:
+        if world > 1:
+            import torch.distributed as dist
+
+            if dist.is_initialized():
+                dist.destroy_process_group()
+
+
+class TorchTrainer(JaxTrainer):
+    """Parity: ray.train.torch.TorchTrainer — same fit()/Result surface
+    as JaxTrainer, with the torch process-group backend installed."""
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: dict | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None):
+        import uuid
+
+        from ray_tpu._private import serialization
+
+        user_blob = serialization.dumps_func(train_loop_per_worker)
+        cfg = dict(train_loop_config or {})
+        if (scaling_config or ScalingConfig()).num_workers > 1:
+            cfg["_torch_rdzv_key"] = uuid.uuid4().hex
+
+        def wrapped(config):
+            _torch_wrapped_loop(user_blob, config)
+
+        super().__init__(wrapped, train_loop_config=cfg,
+                         scaling_config=scaling_config,
+                         run_config=run_config,
+                         collective_backend=None)
+
+
+__all__ = ["TorchTrainer", "prepare_model", "prepare_data_loader", "Result"]

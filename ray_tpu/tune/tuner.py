@@ -106,25 +106,90 @@ class Tuner:
     def __init__(self, trainable: Callable, *, param_space: dict | None = None,
                  tune_config: TuneConfig | None = None,
                  run_config: RunConfig | None = None,
-                 resources_per_trial: dict | None = None):
+                 resources_per_trial: dict | None = None,
+                 _restored_trials: list | None = None):
         self._trainable = trainable
         self._param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self._resources = resources_per_trial or {"CPU": 1}
+        self._restored_trials = _restored_trials
+
+    def _experiment_dir(self) -> str | None:
+        rc = self.run_config
+        if rc.storage_path is None:
+            return None
+        import os
+
+        d = os.path.join(rc.storage_path, rc.name or "tune_experiment")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                *, resume_errored: bool = False) -> "Tuner":
+        """Resume an interrupted experiment from its directory (reference:
+        Tuner.restore + tune/execution/experiment_state.py).  Finished
+        trials keep their recorded results; unfinished (and optionally
+        errored) trials re-run, restoring from their last checkpoint."""
+        import json
+        import os
+
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        trials = []
+        for ts in state["trials"]:
+            t = Trial(ts["trial_id"], ts["config"])
+            t.status = ts["status"]
+            t.iteration = ts["iteration"]
+            t.metrics_history = ts["metrics_history"]
+            t.error = ts.get("error")
+            if ts.get("checkpoint_path"):
+                t.checkpoint = Checkpoint(ts["checkpoint_path"])
+            if t.status in ("PENDING", "RUNNING", "PAUSED") or \
+                    (resume_errored and t.status == "ERROR"):
+                t.status = "PENDING"
+                t.error = None
+            trials.append(t)
+        tc = TuneConfig(**state.get("tune_config", {}))
+        sched_path = os.path.join(path, "scheduler.pkl")
+        if os.path.exists(sched_path):
+            from ray_tpu._private import serialization as _ser
+
+            with open(sched_path, "rb") as f:
+                tc.scheduler = _ser.loads_func(f.read())
+        rc = RunConfig(storage_path=os.path.dirname(path.rstrip("/")),
+                       name=os.path.basename(path.rstrip("/")))
+        return cls(trainable, param_space=state.get("param_space", {}),
+                   tune_config=tc, run_config=rc,
+                   resources_per_trial=state.get("resources"),
+                   _restored_trials=trials)
 
     def fit(self) -> ResultGrid:
-        cfgs = generate_variants(self._param_space,
-                                 self.tune_config.num_samples,
-                                 self.tune_config.seed)
-        trials = [Trial(f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", c)
-                  for i, c in enumerate(cfgs)]
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            cfgs = generate_variants(self._param_space,
+                                     self.tune_config.num_samples,
+                                     self.tune_config.seed)
+            trials = [Trial(f"trial_{i:04d}_{uuid.uuid4().hex[:6]}", c)
+                      for i, c in enumerate(cfgs)]
         scheduler = self.tune_config.scheduler or FIFOScheduler()
         metric = self.tune_config.metric
         max_conc = self.tune_config.max_concurrent_trials or len(trials)
         controller = _TuneController(
             self._trainable, trials, scheduler, metric,
-            self.tune_config.mode, max_conc, self._resources)
+            self.tune_config.mode, max_conc, self._resources,
+            experiment_dir=self._experiment_dir(),
+            experiment_state={
+                "param_space": self._param_space,
+                "tune_config": {
+                    "metric": metric, "mode": self.tune_config.mode,
+                    "num_samples": self.tune_config.num_samples,
+                    "max_concurrent_trials":
+                        self.tune_config.max_concurrent_trials,
+                    "seed": self.tune_config.seed},
+                "resources": self._resources})
         controller.run()
         results = [TrialResult(
             config=t.config,
@@ -138,7 +203,8 @@ class _TuneController:
     """Polling event loop (reference: tune_controller.py)."""
 
     def __init__(self, trainable, trials, scheduler, metric, mode,
-                 max_concurrent, resources):
+                 max_concurrent, resources, experiment_dir: str | None = None,
+                 experiment_state: dict | None = None):
         self.trainable_blob = serialization.dumps_func(trainable)
         self.trials: list[Trial] = trials
         self.scheduler = scheduler
@@ -146,6 +212,52 @@ class _TuneController:
         self.mode = mode
         self.max_concurrent = max_concurrent
         self.resources = resources
+        self.experiment_dir = experiment_dir
+        self.experiment_state = experiment_state or {}
+
+    def _save_experiment_state(self, force: bool = False):
+        """Durable experiment snapshot for Tuner.restore, throttled to one
+        write per few seconds (reference: experiment_state.py time-based
+        periodic checkpointing — per-tick writes would put O(total
+        reports) of JSON I/O in the scheduling hot loop)."""
+        if self.experiment_dir is None:
+            return
+        now = time.monotonic()
+        if not force and now - getattr(self, "_last_state_save", 0.0) < 5.0:
+            return
+        self._last_state_save = now
+        import json
+        import os
+
+        def _plain(x):
+            """JSON-safe: numpy scalars → python numbers (default=str
+            would silently stringify metrics and break get_best_result
+            comparisons after a restore)."""
+            if hasattr(x, "item") and not isinstance(x, (str, bytes)):
+                try:
+                    return x.item()
+                except Exception:
+                    pass
+            return str(x)
+
+        state = dict(self.experiment_state)
+        state["trials"] = [{
+            "trial_id": t.trial_id, "config": t.config, "status": t.status,
+            "iteration": t.iteration, "metrics_history": t.metrics_history,
+            "error": t.error,
+            "checkpoint_path": t.checkpoint.path if t.checkpoint else None,
+        } for t in self.trials]
+        tmp = os.path.join(self.experiment_dir, "experiment_state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=_plain)
+        os.replace(tmp, os.path.join(self.experiment_dir,
+                                     "experiment_state.json"))
+        # The scheduler (ASHA rungs, PBT state) rides along as a pickle so
+        # restore resumes under the SAME scheduling policy.
+        sched_blob = serialization.dumps_func(self.scheduler)
+        with open(os.path.join(self.experiment_dir, "scheduler.pkl"),
+                  "wb") as f:
+            f.write(sched_blob)
 
     def _start_trial(self, trial: Trial, restore_from: Checkpoint | None = None):
         opts = {"num_cpus": self.resources.get("CPU", 1),
@@ -168,12 +280,16 @@ class _TuneController:
             trial.actor = None
 
     def run(self):
-        pending = list(self.trials)
+        # Restored TERMINATED/ERROR trials keep their results; only
+        # PENDING ones (fresh or reset by Tuner.restore) run.
+        pending = [t for t in self.trials if t.status == "PENDING"]
         running: list[Trial] = []
+        self._save_experiment_state()
         while pending or running:
             while pending and len(running) < self.max_concurrent:
                 t = pending.pop(0)
-                self._start_trial(t)
+                # A restored trial resumes from its last checkpoint.
+                self._start_trial(t, restore_from=t.checkpoint)
                 running.append(t)
             polls = ray_tpu.get([t.actor.poll.remote() for t in running],
                                 timeout=300)
@@ -206,5 +322,8 @@ class _TuneController:
                         self._stop_trial(trial, "PAUSED")
                         trial.config = self.scheduler.perturb(target.config)
                         self._start_trial(trial, restore_from=target.checkpoint)
+            self._save_experiment_state()
             if running or pending:
                 time.sleep(0.05)
+        # Final snapshot must not be lost to the throttle window.
+        self._save_experiment_state(force=True)

@@ -73,3 +73,60 @@ def test_fake_provider_slice_labels(ray_start_cluster_head):
     labels = [by_id[nid]["labels"] for nid in created]
     assert labels[0]["tpu-slice"] == labels[1]["tpu-slice"]
     assert {l["tpu-worker-id"] for l in labels} == {"0", "1"}
+
+
+def test_gcp_tpu_provider_commands():
+    """The gcloud argv surfaces are the provider contract (no cloud in
+    tests); reference: gcp/tpu_command_runner.py --worker=all fan-out."""
+    from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
+
+    p = GCPTPUNodeProvider({
+        "project": "proj", "zone": "us-central2-b",
+        "accelerator_type": "v5e-8",
+        "runtime_version": "tpu-ubuntu2204-base", "spot": True})
+    create = p.create_command("n1", NodeType("tpu", {"TPU": 8}))
+    assert "queued-resources" in create and "--spot" in create
+    assert "--accelerator-type=v5e-8" in create
+    ssh = p.ssh_fanout_command("n1", "echo hi")
+    assert "--worker=all" in ssh  # every host of the slice
+    delete = p.delete_command("n1")
+    assert "--quiet" in delete and "delete" in delete
+    assert p.node_resources("n1") == {"TPU": 8.0}
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        GCPTPUNodeProvider({"project": "p"})
+
+
+def test_cluster_config_yaml(tmp_path):
+    from ray_tpu.autoscaler import (load_cluster_config,
+                                    node_types_from_config)
+
+    cfg_path = tmp_path / "cluster.yaml"
+    cfg_path.write_text("""
+cluster_name: demo
+max_workers: 4
+provider:
+  type: gcp_tpu
+  project: proj
+  zone: us-central2-b
+  accelerator_type: v5e-8
+  runtime_version: tpu-ubuntu2204-base
+available_node_types:
+  tpu_worker:
+    resources: {"TPU": 8, "CPU": 16}
+    min_workers: 0
+    hosts_per_slice: 2
+""")
+    cfg = load_cluster_config(str(cfg_path))
+    types = node_types_from_config(cfg)
+    assert types[0].name == "tpu_worker"
+    assert types[0].hosts_per_slice == 2
+    assert types[0].resources["TPU"] == 8
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("cluster_name: x\n")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        load_cluster_config(str(bad))

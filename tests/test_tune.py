@@ -107,3 +107,63 @@ def test_pbt_exploits_checkpoint(ray_start_regular, tmp_path):
     best = grid.get_best_result()
     assert best.metrics["w"] >= 3.0  # the strong trial made progress
     assert len(grid) == 2
+
+
+def test_tuner_experiment_resume(ray_start_regular, tmp_path):
+    """Experiment state persists; Tuner.restore re-runs only unfinished
+    trials, restoring them from their last checkpoint (reference:
+    Tuner.restore + experiment_state.py)."""
+    import json
+    import os
+
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.train.config import RunConfig
+
+    marker = tmp_path / "ran.jsonl"
+
+    def trainable(config):
+        w = 0.0
+        if config.get("_checkpoint_path"):
+            w = float(np.asarray(
+                Checkpoint(config["_checkpoint_path"]).to_pytree()["w"]))
+        with open(marker, "a") as f:
+            f.write(json.dumps({"lr": config["lr"], "start_w": w}) + "\n")
+        for i in range(3):
+            w += config["lr"]
+            ck = Checkpoint.from_pytree(
+                {"w": np.float64(w)},
+                os.path.join(config["dir"],
+                             f"r_{config['lr']}_{os.getpid()}_{i}"))
+            session.report({"w": w}, checkpoint=ck)
+
+    exp_dir = str(tmp_path / "exp")
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([1.0, 2.0]),
+                     "dir": str(tmp_path)},
+        tune_config=tune.TuneConfig(metric="w", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path), name="exp"),
+    ).fit()
+    assert len(grid) == 2
+    state_file = os.path.join(exp_dir, "experiment_state.json")
+    assert os.path.exists(state_file)
+
+    # Simulate an interruption: mark one finished trial as RUNNING.
+    with open(state_file) as f:
+        state = json.load(f)
+    assert all(t["status"] == "TERMINATED" for t in state["trials"])
+    state["trials"][1]["status"] = "RUNNING"
+    with open(state_file, "w") as f:
+        json.dump(state, f)
+
+    runs_before = len(marker.read_text().splitlines())
+    grid2 = tune.Tuner.restore(exp_dir, trainable).fit()
+    runs_after = len(marker.read_text().splitlines())
+    # Only the interrupted trial re-ran...
+    assert runs_after == runs_before + 1
+    # ...and it resumed from its checkpoint, not from zero.
+    last = json.loads(marker.read_text().splitlines()[-1])
+    assert last["start_w"] > 0.0
+    assert len(grid2) == 2
+    best = grid2.get_best_result()
+    assert best.metrics["w"] >= 6.0
