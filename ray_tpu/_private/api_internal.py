@@ -335,7 +335,10 @@ class ActorClass:
             args=wire_args,
             kwargs_keys=kwargs_keys,
             num_returns=0,
-            resources=_build_resources(self._opts, default_cpus=1.0),
+            # Actors with no explicit resources hold 0 CPU while alive
+            # (reference: python/ray/actor.py default num_cpus=0 for
+            # running — long-lived actors must not starve task scheduling).
+            resources=_build_resources(self._opts, default_cpus=0.0),
             owner=cw.address.to_wire(),
             actor_id=actor_id.hex(),
             actor_creation=True,

@@ -67,6 +67,17 @@ class GcsServer:
         self._health_task: asyncio.Task | None = None
         self._actor_seq = 0
         self.start_time = time.time()
+        # Native C++ scheduling core (src/scheduler.cc). Mirrors the node
+        # table and answers actor/PG placement queries; the pure-Python
+        # policies below remain as the fallback when the toolchain is
+        # unavailable.
+        self.native_sched = None
+        try:
+            from ray_tpu._private.native_scheduler import ClusterScheduler
+
+            self.native_sched = ClusterScheduler()
+        except Exception:
+            logger.info("native scheduler unavailable; using Python policies")
 
     def _handlers(self):
         return {
@@ -150,6 +161,10 @@ class GcsServer:
         )
         self.nodes[info.node_id] = info
         self.node_conns[info.node_id] = conn
+        if self.native_sched is not None:
+            self.native_sched.update_node(
+                info.node_id, total=info.total_resources,
+                available=info.available_resources, labels=info.labels)
         conn.on_close(lambda: asyncio.ensure_future(self._on_node_conn_lost(info.node_id)))
         await self.publish("NODE", {"event": "alive", "node": info.to_wire()})
         logger.info("node %s registered (%s:%s)", info.node_id[:8], info.host, info.raylet_port)
@@ -161,6 +176,9 @@ class GcsServer:
             return {"ok": False, "reason": "unknown or dead node"}
         node.last_heartbeat = time.monotonic()
         node.available_resources = payload.get("available_resources", node.available_resources)
+        if self.native_sched is not None:
+            self.native_sched.update_node(
+                node.node_id, available=node.available_resources)
         self.pending_demand[node.node_id] = payload.get("pending_demand", [])
         # Reply piggy-backs the cluster resource view so raylets can make
         # spillback decisions (replaces the reference's ray_syncer gossip,
@@ -209,6 +227,8 @@ class GcsServer:
         node.alive = False
         node.available_resources = {}
         self.node_conns.pop(node_id, None)
+        if self.native_sched is not None:
+            self.native_sched.update_node(node_id, available={}, alive=False)
         self.pending_demand.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id[:8], reason)
         await self.publish("NODE", {"event": "dead", "node_id": node_id, "reason": reason})
@@ -327,6 +347,10 @@ class GcsServer:
                 if node and node.alive and resources_fit(b["available"], resources):
                     return b["node_id"]
             return None
+        if self.native_sched is not None:
+            strat = "spread" if (strategy and strategy[0] == "spread") else "pack"
+            return self.native_sched.pick_node(resources, strat,
+                                               fallback_total=True)
         candidates = [n for n in alive if resources_fit(n.available_resources, resources)]
         if not candidates:
             # Fall back to nodes that could EVER fit (total resources) —
@@ -351,8 +375,15 @@ class GcsServer:
         a = self.actors.get(actor_id)
         if a is None or a["state"] == ACTOR_DEAD:
             return
+        # Resource-less actors hold nothing while alive, but placement still
+        # charges 1 CPU so creations spread and land on feasible nodes
+        # (reference: actor creation schedules against num_cpus=1, runs
+        # against num_cpus=0).
+        placement_demand = a["resources"]
+        if not placement_demand and not a.get("placement_group"):
+            placement_demand = {"CPU": 1.0}
         node_id = self._pick_node_for(
-            a["resources"], a.get("strategy"), a.get("placement_group", ""),
+            placement_demand, a.get("strategy"), a.get("placement_group", ""),
             a.get("pg_bundle_index", -1))
         if node_id is None or node_id not in self.node_conns:
             # No feasible node right now; retry (autoscaler demand signal).
@@ -591,6 +622,12 @@ class GcsServer:
     def _pack_bundles(self, pg) -> list[tuple[int, str]] | None:
         """Returns [(bundle_index, node_id)] or None if infeasible now."""
         strategy = pg["strategy"]
+        if self.native_sched is not None:
+            got = self.native_sched.schedule_bundles(
+                [b["resources"] for b in pg["bundles"]], strategy)
+            if got is None:
+                return None
+            return list(enumerate(got))
         alive = [n for n in self.nodes.values() if n.alive]
         if strategy == "STRICT_ICI":
             # Group nodes by slice label; try each slice as a unit.

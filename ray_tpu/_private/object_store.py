@@ -50,15 +50,19 @@ def _ensure_built() -> str:
         ):
             return _LIB_PATH
         os.makedirs(_LIB_DIR, exist_ok=True)
+        # Temp file + atomic rename: concurrent processes must never dlopen
+        # a half-written .so.
+        tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
         subprocess.run(
             [
                 os.environ.get("CXX", "g++"),
                 "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
-                "-o", _LIB_PATH, src, "-lpthread",
+                "-o", tmp, src, "-lpthread",
             ],
             check=True,
             capture_output=True,
         )
+        os.replace(tmp, _LIB_PATH)
     return _LIB_PATH
 
 

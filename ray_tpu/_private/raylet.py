@@ -95,6 +95,16 @@ class Raylet:
         self._num_leases_granted = 0
         # Recently-rejected infeasible demands, kept ~10s for the autoscaler.
         self._infeasible_demand: list[tuple[float, dict]] = []
+        # Native C++ scheduling core mirrors the GCS-fed cluster view for
+        # spillback decisions (src/scheduler.cc; Python policy is fallback).
+        self._native_sched = None
+        self._native_known: set[str] = set()
+        try:
+            from ray_tpu._private.native_scheduler import ClusterScheduler
+
+            self._native_sched = ClusterScheduler()
+        except Exception:
+            pass
 
     def _handlers(self):
         return {
@@ -185,6 +195,7 @@ class Raylet:
                 }, timeout=self.config.health_check_timeout_s)
                 if resp.get("ok"):
                     self.cluster_view = resp.get("cluster", {})
+                    self._sync_native_view()
                     # A fresher view may unblock queued leases via spillback.
                     self._pump_pending_leases()
             except rpc.ConnectionLost:
@@ -352,13 +363,37 @@ class Raylet:
         w.lease_resources = {}
         w.lease_pg = None
 
+    def _sync_native_view(self):
+        """Mirror the GCS cluster view into the native scheduler core."""
+        if self._native_sched is None:
+            return
+        seen = set()
+        for nid, info in self.cluster_view.items():
+            seen.add(nid)
+            self._native_sched.update_node(
+                nid, total=info.get("total_resources"),
+                available=info.get("available_resources"),
+                labels=info.get("labels"))
+        for nid in self._native_known - seen:
+            self._native_sched.remove_node(nid)
+        self._native_known = seen
+
     def _pick_spillback(self, resources: dict, view: dict | None = None
                         ) -> dict | None:
         """Hybrid policy tail: among alive peers that fit the demand, pick
         the best-utilized (pack) candidate (reference: top-k hybrid policy,
         hybrid_scheduling_policy.h:107-124 — we take k=1 of the sorted list
         since the cluster view is already fresh).  Pass `view` to pick
-        against a locally-debited copy (bulk spill decisions)."""
+        against a locally-debited copy (bulk spill decisions); the native
+        path debits its own mirrored table instead."""
+        if self._native_sched is not None and view is None:
+            nid = self._native_sched.pick_node(resources, "pack",
+                                               exclude=self.node_id)
+            info = self.cluster_view.get(nid) if nid else None
+            if info is None:
+                return None
+            return {"node_id": nid, "host": info["host"],
+                    "port": info["raylet_port"]}
         candidates = []
         for nid, info in (view if view is not None
                           else self.cluster_view).items():
@@ -512,7 +547,17 @@ class Raylet:
                 # Re-run the scheduling policy over queued work: a peer may
                 # have gained capacity (or just joined) since this lease
                 # queued (reference: ClusterTaskManager::ScheduleAndDispatch
-                # revisits the queue every round and can spill it).
+                # revisits the queue every round and can spill it). Each
+                # spill decision debits the target locally so a burst fans
+                # out across peers instead of herding onto one node.
+                if self._native_sched is not None:
+                    spill = self._pick_spillback(resources)
+                    if spill is not None:
+                        self._native_sched.debit_node(spill["node_id"],
+                                                      resources)
+                        self.pending_leases.remove(item)
+                        fut.set_result({"spillback": spill})
+                    continue
                 if debit_view is None:
                     debit_view = copy.deepcopy(self.cluster_view)
                 spill = self._pick_spillback(resources, view=debit_view)

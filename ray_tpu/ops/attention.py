@@ -240,7 +240,7 @@ def _fa_fwd(q, k, v, sm_scale, causal, block_q, block_k):
 
 def _fa_bwd(sm_scale, causal, block_q, block_k, res, do):
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(res[0].shape[-1])
-    return _flash_backward(scale, causal, block_q, block_k, res, do)
+    return _flash_backward(scale, causal, block_q, block_k, None, res, do)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -1,0 +1,95 @@
+"""joblib backend running jobs as cluster tasks.
+
+Parity: reference python/ray/util/joblib/ — `register_ray()` installs a
+`ray` parallel backend so scikit-learn-style `Parallel(n_jobs=...)`
+fan-outs run on the cluster:
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray"):
+        Parallel()(delayed(f)(x) for x in xs)
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+__all__ = ["register_ray"]
+
+
+def _call(func):
+    return func()
+
+
+class _RayFuture:
+    """Future-like handle joblib polls via .get(timeout)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def get(self, timeout=None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+def register_ray() -> None:
+    """Register the 'ray' joblib backend."""
+    try:
+        from joblib._parallel_backends import ParallelBackendBase
+        from joblib.parallel import register_parallel_backend
+    except ImportError as e:  # pragma: no cover - joblib is a soft dep
+        raise ImportError(
+            "joblib is required for register_ray(); pip install joblib"
+        ) from e
+    import threading
+
+    class RayBackend(ParallelBackendBase):
+        """Batches of calls run as remote tasks instead of local forks."""
+
+        supports_timeout = True
+        supports_retrieve_callback = False
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 in Parallel has no meaning")
+            if n_jobs is None:
+                return 1
+            if n_jobs < 0:
+                return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+            return n_jobs
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **backend_kwargs):
+            self.parallel = parallel
+            self._run = ray_tpu.remote(_call)
+            return self.effective_n_jobs(n_jobs)
+
+        def submit(self, func, callback=None):
+            ref = self._run.remote(func)
+            fut = _RayFuture(ref)
+            if callback is not None:
+                # joblib dispatches further batches from the completion
+                # callback; fire it from a waiter thread (errors included —
+                # retrieve_result re-raises them on the main thread).
+                def waiter():
+                    try:
+                        ray_tpu.get(ref)
+                    except Exception:
+                        pass
+                    callback(fut)
+
+                threading.Thread(target=waiter, daemon=True).start()
+            return fut
+
+        # Legacy name some joblib versions still call.
+        def apply_async(self, func, callback=None):
+            return self.submit(func, callback)
+
+        def terminate(self):
+            pass
+
+        def abort_everything(self, ensure_ready=True):
+            if ensure_ready:
+                self.configure(n_jobs=self.parallel.n_jobs,
+                               parallel=self.parallel)
+
+    register_parallel_backend("ray", RayBackend)

@@ -1,0 +1,73 @@
+"""Ecosystem shims: multiprocessing.Pool and the joblib backend.
+
+Parity: reference python/ray/tests/test_multiprocessing.py and
+python/ray/util/joblib tests.
+"""
+
+import pytest
+
+from ray_tpu.util.multiprocessing import Pool
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+@pytest.fixture
+def pool(ray_start_regular):
+    p = Pool(processes=2)
+    yield p
+    p.terminate()
+
+
+def test_pool_map(pool):
+    assert pool.map(_sq, range(10)) == [x * x for x in range(10)]
+
+
+def test_pool_map_chunked(pool):
+    assert pool.map(_sq, range(7), chunksize=3) == [x * x for x in range(7)]
+
+
+def test_pool_apply(pool):
+    assert pool.apply(_add, (2, 3)) == 5
+    res = pool.apply_async(_add, (4, 5))
+    res.wait(timeout=30)
+    assert res.ready()
+    assert res.get() == 9
+
+
+def test_pool_starmap(pool):
+    assert pool.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_pool_imap(pool):
+    assert list(pool.imap(_sq, range(5), chunksize=2)) == [0, 1, 4, 9, 16]
+    assert sorted(pool.imap_unordered(_sq, range(5), chunksize=2)) == \
+        sorted([0, 1, 4, 9, 16])
+
+
+def test_pool_lifecycle(ray_start_regular):
+    p = Pool(processes=1)
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+    p.join()
+
+
+def test_pool_context_manager(ray_start_regular):
+    with Pool(processes=1) as p:
+        assert p.map(_sq, [3]) == [9]
+
+
+def test_joblib_backend(ray_start_regular):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray", n_jobs=2):
+        got = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
+    assert got == [x * x for x in range(6)]
