@@ -389,6 +389,15 @@ class GcsServer:
             # No feasible node right now; retry (autoscaler demand signal).
             asyncio.ensure_future(self._schedule_actor(actor_id, delay=0.5))
             return
+        # Transient debit of the placement demand against the GCS view: a
+        # burst of concurrent creations fans out across nodes instead of
+        # herding onto one stale "best" node. The next heartbeat from the
+        # raylet restores ground truth (real holds are debited there).
+        node = self.nodes.get(node_id)
+        if node is not None:
+            subtract_resources(node.available_resources, placement_demand)
+        if self.native_sched is not None:
+            self.native_sched.debit_node(node_id, placement_demand)
         a["node_id"] = node_id
         try:
             resp = await self.node_conns[node_id].call(

@@ -1,0 +1,39 @@
+"""Shared build-on-first-use helper for the native C++ components
+(src/*.cc → ray_tpu/_private/_lib/*.so, loaded via ctypes)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+LIB_DIR = os.path.join(os.path.dirname(__file__), "_lib")
+SRC_DIR = os.path.normpath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "src"))
+
+_build_lock = threading.Lock()
+
+
+def ensure_built(src_name: str, lib_name: str,
+                 extra_flags: tuple[str, ...] = ()) -> str:
+    """Compile src/<src_name> to _lib/<lib_name> if stale; returns the lib
+    path. Compiles to a private temp file then os.replace()s: concurrent
+    processes (GCS + raylet on a fresh checkout) must never dlopen a
+    half-written .so."""
+    src = os.path.join(SRC_DIR, src_name)
+    lib_path = os.path.join(LIB_DIR, lib_name)
+    with _build_lock:
+        if os.path.exists(lib_path) and (
+            not os.path.exists(src)
+            or os.path.getmtime(lib_path) >= os.path.getmtime(src)
+        ):
+            return lib_path
+        os.makedirs(LIB_DIR, exist_ok=True)
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        subprocess.run(
+            [os.environ.get("CXX", "g++"),
+             "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
+             "-o", tmp, src, *extra_flags],
+            check=True, capture_output=True)
+        os.replace(tmp, lib_path)
+    return lib_path

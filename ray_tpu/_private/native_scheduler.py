@@ -12,47 +12,18 @@ toolchain is unavailable the callers keep their pure-Python paths.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
 
-_LIB_DIR = os.path.join(os.path.dirname(__file__), "_lib")
-_LIB_PATH = os.path.join(_LIB_DIR, "libtpusched.so")
-_SRC_DIR = os.path.normpath(
-    os.path.join(os.path.dirname(__file__), "..", "..", "src"))
+from ray_tpu._private.native_build import ensure_built
 
-_build_lock = threading.Lock()
 _lib = None
 _lib_failed = False
-
-
-def _ensure_built() -> str:
-    src = os.path.join(_SRC_DIR, "scheduler.cc")
-    with _build_lock:
-        if os.path.exists(_LIB_PATH) and (
-            not os.path.exists(src)
-            or os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)
-        ):
-            return _LIB_PATH
-        os.makedirs(_LIB_DIR, exist_ok=True)
-        # Compile to a private temp file then rename: concurrent processes
-        # (GCS + raylet on a fresh checkout) must never dlopen a half-written
-        # .so; rename is atomic within the directory.
-        tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-        subprocess.run(
-            [os.environ.get("CXX", "g++"),
-             "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
-             "-o", tmp, src],
-            check=True, capture_output=True)
-        os.replace(tmp, _LIB_PATH)
-    return _LIB_PATH
 
 
 def _get_lib():
     global _lib, _lib_failed
     if _lib is None and not _lib_failed:
         try:
-            lib = ctypes.CDLL(_ensure_built())
+            lib = ctypes.CDLL(ensure_built("scheduler.cc", "libtpusched.so"))
         except Exception:
             _lib_failed = True
             return None

@@ -13,16 +13,9 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
-import subprocess
-import threading
 
 from ray_tpu._private.ids import ObjectID
-
-_LIB_DIR = os.path.join(os.path.dirname(__file__), "_lib")
-_LIB_PATH = os.path.join(_LIB_DIR, "libtpustore.so")
-_SRC_DIR = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", "..", "src"))
-
-_build_lock = threading.Lock()
+from ray_tpu._private.native_build import ensure_built
 
 # Error codes matching src/object_store.cc
 OK = 0
@@ -42,37 +35,14 @@ class ObjectStoreFullError(ObjectStoreError):
     pass
 
 
-def _ensure_built() -> str:
-    src = os.path.join(_SRC_DIR, "object_store.cc")
-    with _build_lock:
-        if os.path.exists(_LIB_PATH) and (
-            not os.path.exists(src) or os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)
-        ):
-            return _LIB_PATH
-        os.makedirs(_LIB_DIR, exist_ok=True)
-        # Temp file + atomic rename: concurrent processes must never dlopen
-        # a half-written .so.
-        tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-        subprocess.run(
-            [
-                os.environ.get("CXX", "g++"),
-                "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
-                "-o", tmp, src, "-lpthread",
-            ],
-            check=True,
-            capture_output=True,
-        )
-        os.replace(tmp, _LIB_PATH)
-    return _LIB_PATH
-
-
 _lib = None
 
 
 def _get_lib():
     global _lib
     if _lib is None:
-        lib = ctypes.CDLL(_ensure_built())
+        lib = ctypes.CDLL(ensure_built("object_store.cc", "libtpustore.so",
+                                       extra_flags=("-lpthread",)))
         lib.store_create_arena.restype = ctypes.c_void_p
         lib.store_create_arena.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
         lib.store_attach.restype = ctypes.c_void_p

@@ -44,6 +44,19 @@ class _Stage:
     # >0: run on a pool of stateful actors instead of tasks (parity:
     # reference ActorPoolMapOperator for callable-class UDFs).
     actor_pool: int = 0
+    # Distributed shuffle barrier (parity: reference push-based shuffle,
+    # data/_internal/push_based_shuffle.py): map tasks split each block into
+    # n_out partitions (separate objects via num_returns), reduce task j
+    # merges partition j of every map — blocks never route through the
+    # driver. shuffle_map_fn(block, n_out, index) -> [n_out blocks];
+    # shuffle_reduce_fn(parts, j) -> block.
+    shuffle_map_fn: Callable | None = None
+    shuffle_reduce_fn: Callable | None = None
+    # Optional driver-side planner run before the maps: samples small
+    # per-block digests to compute partition boundaries (distributed sort).
+    # shuffle_plan_fn(sampled) -> aux passed to map/reduce fns.
+    shuffle_sample_fn: Callable | None = None
+    shuffle_plan_fn: Callable | None = None
 
 
 # Index of the block currently being transformed — lets seeded per-block
@@ -79,6 +92,30 @@ def _exec_read(fn_blob):
     from ray_tpu._private import serialization
 
     return serialization.loads_func(fn_blob)()
+
+
+@ray_tpu.remote
+def _shuffle_map(map_blob, block, n_out, index, aux):
+    from ray_tpu._private import serialization
+
+    fn = serialization.loads_func(map_blob)
+    parts = fn(block, n_out, index, aux)
+    return parts if n_out > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _shuffle_reduce(reduce_blob, j, aux, *parts):
+    from ray_tpu._private import serialization
+
+    fn = serialization.loads_func(reduce_blob)
+    return fn(list(parts), j, aux)
+
+
+@ray_tpu.remote
+def _shuffle_sample(sample_blob, block):
+    from ray_tpu._private import serialization
+
+    return serialization.loads_func(sample_blob)(block)
 
 
 @ray_tpu.remote
@@ -169,18 +206,30 @@ class Dataset:
         return self._with(_Stage("flat_map", stage_fn))
 
     def random_shuffle(self, seed: int | None = None) -> "Dataset":
-        def shuffle_fn(blocks: list, seed=seed):
-            rows = []
-            for b in blocks:
-                rows.extend(block_to_rows(b))
-            rng = _random.Random(seed)
-            rng.shuffle(rows)
-            n_out = max(1, len(blocks))
-            per = math.ceil(len(rows) / n_out)
-            return [rows[i * per:(i + 1) * per] for i in range(n_out)]
+        """Distributed push-based shuffle: each map task scatters its rows
+        across n_out partitions, each reduce task merges and re-shuffles one
+        partition (reference: data/_internal/push_based_shuffle.py)."""
+        def map_fn(block, n_out, index, aux, seed=seed):
+            rows = block_to_rows(block)
+            rng = _random.Random(None if seed is None
+                                 else seed * 1_000_003 + index)
+            parts = [[] for _ in range(n_out)]
+            for r in rows:
+                parts[rng.randrange(n_out)].append(r)
+            return parts
 
-        return self._with(_Stage("random_shuffle", None, all_to_all=True,
-                                 all_to_all_fn=shuffle_fn))
+        def reduce_fn(parts, j, aux, seed=seed):
+            rows = []
+            for p in parts:
+                rows.extend(block_to_rows(p))
+            rng = _random.Random(None if seed is None
+                                 else seed * 7_368_787 + j)
+            rng.shuffle(rows)
+            return rows
+
+        return self._with(_Stage("random_shuffle", None,
+                                 shuffle_map_fn=map_fn,
+                                 shuffle_reduce_fn=reduce_fn))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         def repart_fn(blocks: list, num_blocks=num_blocks):
@@ -271,20 +320,53 @@ class Dataset:
 
     def sort(self, key: Callable | str | None = None,
              descending: bool = False) -> "Dataset":
-        def sort_fn(blocks: list, key=key, descending=descending):
-            rows = []
-            for b in blocks:
-                rows.extend(block_to_rows(b))
+        """Distributed range-partitioned sort: sample keys per block →
+        boundaries on the driver → maps route rows by range → each reduce
+        sorts one disjoint range (reference: data sort_and_partition /
+        push-based shuffle reduce)."""
+        def key_of(r, key=key):
+            if key is None:
+                return r
             if isinstance(key, str):
-                rows.sort(key=lambda r: r[key], reverse=descending)
-            else:
-                rows.sort(key=key, reverse=descending)
-            n_out = max(1, len(blocks))
-            per = math.ceil(len(rows) / n_out)
-            return [rows[i * per:(i + 1) * per] for i in range(n_out)]
+                return r[key]
+            return key(r)
 
-        return self._with(_Stage("sort", None, all_to_all=True,
-                                 all_to_all_fn=sort_fn))
+        def sample_fn(block):
+            rows = block_to_rows(block)
+            # ~20 evenly-spaced key samples per block.
+            step = max(1, len(rows) // 20)
+            return [key_of(r) for r in rows[::step]]
+
+        def plan_fn(sampled, descending=descending):
+            return {"keys": sorted(k for s in sampled for k in s)}
+
+        def map_fn(block, n_out, index, aux, descending=descending):
+            import bisect
+
+            keys = aux["keys"]
+            # n_out-1 boundaries at sample quantiles.
+            bounds = [keys[(i + 1) * len(keys) // n_out]
+                      for i in range(n_out - 1)] if keys else []
+            parts = [[] for _ in range(n_out)]
+            for r in block_to_rows(block):
+                j = bisect.bisect_right(bounds, key_of(r))
+                if descending:
+                    j = n_out - 1 - j
+                parts[j].append(r)
+            return parts
+
+        def reduce_fn(parts, j, aux, descending=descending):
+            rows = []
+            for p in parts:
+                rows.extend(block_to_rows(p))
+            rows.sort(key=key_of, reverse=descending)
+            return rows
+
+        return self._with(_Stage("sort", None,
+                                 shuffle_map_fn=map_fn,
+                                 shuffle_reduce_fn=reduce_fn,
+                                 shuffle_sample_fn=sample_fn,
+                                 shuffle_plan_fn=plan_fn))
 
     # ------------- execution -------------
 
@@ -307,11 +389,12 @@ class Dataset:
 
         blocks: Iterable = resolve_sources()
         stages = list(self._stages)
-        # Split into segments at all-to-all barriers and actor-pool stages.
+        # Split into segments at all-to-all/shuffle barriers and actor-pool
+        # stages.
         segment: list[_Stage] = []
         segments: list[tuple[list[_Stage], _Stage | None]] = []
         for st in stages:
-            if st.all_to_all:
+            if st.all_to_all or st.shuffle_map_fn is not None:
                 segments.append((segment, st))
                 segment = []
             elif st.actor_pool:
@@ -369,9 +452,41 @@ class Dataset:
             while window:
                 yield ray_tpu.get(window.pop(0), timeout=300)
 
+        def run_shuffle(in_blocks: Iterable, st: _Stage) -> Iterator:
+            """Push-based shuffle: map tasks partition (num_returns=n_out
+            separate objects), reduce task j fetches partition j from every
+            map — no driver materialization."""
+            in_refs = [b if isinstance(b, ray_tpu.ObjectRef)
+                       else ray_tpu.put(b) for b in in_blocks]
+            if not in_refs:
+                return
+            n_out = len(in_refs)
+            aux = None
+            if st.shuffle_sample_fn is not None:
+                sblob = serialization.dumps_func(st.shuffle_sample_fn)
+                sampled = ray_tpu.get(
+                    [_shuffle_sample.remote(sblob, r) for r in in_refs],
+                    timeout=600)
+                aux = st.shuffle_plan_fn(sampled)
+            mblob = serialization.dumps_func(st.shuffle_map_fn)
+            rblob = serialization.dumps_func(st.shuffle_reduce_fn)
+            map_out = [
+                _shuffle_map.options(num_returns=n_out).remote(
+                    mblob, ref, n_out, i, aux)
+                for i, ref in enumerate(in_refs)]
+            if n_out == 1:
+                map_out = [[r] for r in map_out]
+            for j in range(n_out):
+                yield _shuffle_reduce.remote(
+                    rblob, j, aux, *[parts[j] for parts in map_out])
+
         for seg, barrier in segments:
             blocks = run_segment(blocks, seg)
-            if barrier is not None:
+            if barrier is None:
+                continue
+            if barrier.shuffle_map_fn is not None:
+                blocks = run_shuffle(blocks, barrier)
+            else:
                 materialized = [b if not isinstance(b, ray_tpu.ObjectRef)
                                 else ray_tpu.get(b) for b in blocks]
                 blocks = iter(barrier.all_to_all_fn(materialized))

@@ -1,6 +1,9 @@
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
-from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, Env, make_env
+from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, Env, Pendulum, make_env
+from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sac import SAC, SACConfig
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "ReplayBuffer", "Env",
-           "CartPole", "ENV_REGISTRY", "make_env"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+           "Impala", "ImpalaConfig", "ReplayBuffer", "Env", "CartPole",
+           "Pendulum", "ENV_REGISTRY", "make_env"]

@@ -41,11 +41,12 @@ class ReplayBuffer:
     """Uniform-sampling ring buffer (reference:
     rllib/utils/replay_buffers/replay_buffer.py storage + sample)."""
 
-    def __init__(self, capacity: int, obs_size: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_size: int, seed: int = 0,
+                 action_shape: tuple = (), action_dtype=np.int32):
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_size), np.float32)
         self.next_obs = np.zeros((capacity, obs_size), np.float32)
-        self.actions = np.zeros(capacity, np.int32)
+        self.actions = np.zeros((capacity, *action_shape), action_dtype)
         self.rewards = np.zeros(capacity, np.float32)
         self.dones = np.zeros(capacity, np.float32)
         self.pos = 0

@@ -73,7 +73,56 @@ class CartPole(Env):
         return self.state.astype(np.float32), 1.0, done, {}
 
 
-ENV_REGISTRY = {"CartPole-v1": CartPole, "CartPole": CartPole}
+class Pendulum(Env):
+    """Classic torque-controlled pendulum swing-up, matches gym's
+    Pendulum-v1 dynamics. Continuous action in [-2, 2]."""
+
+    observation_size = 3
+    num_actions = 0            # continuous
+    action_size = 1
+    action_low = -2.0
+    action_high = 2.0
+    max_episode_steps = 200
+
+    def __init__(self):
+        self.max_speed = 8.0
+        self.dt = 0.05
+        self.g = 10.0
+        self.m = 1.0
+        self.length = 1.0
+        self.state = None
+        self.steps = 0
+        self._rng = np.random.default_rng()
+
+    def _obs(self) -> np.ndarray:
+        th, thdot = self.state
+        return np.array([np.cos(th), np.sin(th), thdot], np.float32)
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.state = self._rng.uniform([-np.pi, -1.0], [np.pi, 1.0])
+        self.steps = 0
+        return self._obs()
+
+    def step(self, action):
+        th, thdot = self.state
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          self.action_low, self.action_high))
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * self.g / (2 * self.length) * np.sin(th)
+                         + 3.0 / (self.m * self.length ** 2) * u) * self.dt
+        thdot = np.clip(thdot, -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        self.state = np.array([th, thdot])
+        self.steps += 1
+        done = self.steps >= self.max_episode_steps
+        return self._obs(), -cost, done, {}
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPole, "CartPole": CartPole,
+                "Pendulum-v1": Pendulum, "Pendulum": Pendulum}
 
 
 def make_env(env: str | type) -> Env:

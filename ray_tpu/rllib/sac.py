@@ -1,0 +1,327 @@
+"""SAC: soft actor-critic for continuous control.
+
+Parity: reference rllib/algorithms/sac/ (torch learner + replay) rebuilt
+on the rollout/learner split — numpy Gaussian-policy rollout actors feed a
+replay buffer; the learner runs the twin-Q soft-Bellman update with
+automatic entropy-temperature tuning as ONE jitted jax step on the
+attached accelerator.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env import make_env
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def init_sac_params(obs_size: int, act_size: int, hidden: int = 64,
+                    seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def dense(i, o):
+        return {"w": (rng.standard_normal((i, o)) / np.sqrt(i)).astype(np.float32),
+                "b": np.zeros(o, np.float32)}
+
+    def q_net():
+        return {"h1": dense(obs_size + act_size, hidden),
+                "h2": dense(hidden, hidden), "out": dense(hidden, 1)}
+
+    return {
+        "pi": {"h1": dense(obs_size, hidden), "h2": dense(hidden, hidden),
+               "mu": dense(hidden, act_size), "log_std": dense(hidden, act_size)},
+        "q1": q_net(),
+        "q2": q_net(),
+    }
+
+
+def numpy_policy(params: dict, obs: np.ndarray):
+    """Gaussian policy forward (rollout side): returns (mu, log_std)."""
+    pi = params["pi"]
+    h = np.tanh(obs @ pi["h1"]["w"] + pi["h1"]["b"])
+    h = np.tanh(h @ pi["h2"]["w"] + pi["h2"]["b"])
+    mu = h @ pi["mu"]["w"] + pi["mu"]["b"]
+    log_std = np.clip(h @ pi["log_std"]["w"] + pi["log_std"]["b"],
+                      LOG_STD_MIN, LOG_STD_MAX)
+    return mu, log_std
+
+
+@ray_tpu.remote
+class SACRolloutWorker:
+    """CPU sampling actor with a squashed-Gaussian exploration policy."""
+
+    def __init__(self, env_spec, worker_index: int):
+        self.env = make_env(env_spec)
+        self.index = worker_index
+        self.rng = np.random.default_rng(2000 + worker_index)
+        self.obs = self.env.reset(seed=worker_index)
+        self.scale = (self.env.action_high - self.env.action_low) / 2.0
+        self.mid = (self.env.action_high + self.env.action_low) / 2.0
+
+    def sample(self, params: dict, num_steps: int, random_policy: bool = False
+               ) -> dict:
+        obs_b, act_b, rew_b, next_b, done_b = [], [], [], [], []
+        episode_returns, ep_ret = [], 0.0
+        for _ in range(num_steps):
+            if random_policy:
+                a = self.rng.uniform(-1.0, 1.0, self.env.action_size)
+            else:
+                mu, log_std = numpy_policy(params, self.obs[None, :])
+                a = np.tanh(mu[0] + np.exp(log_std[0])
+                            * self.rng.standard_normal(mu.shape[1]))
+            env_action = self.mid + self.scale * a
+            next_obs, reward, done, _ = self.env.step(env_action)
+            obs_b.append(self.obs)
+            act_b.append(a.astype(np.float32))
+            rew_b.append(reward)
+            next_b.append(next_obs)
+            # Time-limit terminations still bootstrap (done=False for the
+            # Bellman target) — the pendulum never "fails", it just times out.
+            done_b.append(False)
+            ep_ret += reward
+            if done:
+                episode_returns.append(ep_ret)
+                ep_ret = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        return {
+            "obs": np.asarray(obs_b, np.float32),
+            "actions": np.asarray(act_b, np.float32),
+            "rewards": np.asarray(rew_b, np.float32),
+            "next_obs": np.asarray(next_b, np.float32),
+            "dones": np.asarray(done_b, np.float32),
+            "episode_returns": episode_returns,
+        }
+
+
+@dataclass
+class SACConfig:
+    """Parity: rllib SACConfig fluent-config object."""
+
+    env: Any = "Pendulum-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 200
+    train_batch_size: int = 256
+    num_updates_per_iter: int = 64
+    replay_buffer_capacity: int = 100_000
+    learning_starts: int = 500
+    gamma: float = 0.99
+    tau: float = 0.005               # polyak averaging for target nets
+    lr: float = 3e-4
+    initial_alpha: float = 0.1
+    autotune_alpha: bool = True
+    hidden_size: int = 64
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: int | None = None, **kw):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown SAC option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    """Algorithm driver (parity: Algorithm.step / SAC training_step)."""
+
+    def __init__(self, config: SACConfig):
+        self.config = config
+        probe = make_env(config.env)
+        if getattr(probe, "action_size", 0) < 1:
+            raise ValueError("SAC needs a continuous-action env "
+                             "(action_size >= 1)")
+        self.obs_size = probe.observation_size
+        self.act_size = probe.action_size
+        self.params = init_sac_params(self.obs_size, self.act_size,
+                                      config.hidden_size, config.seed)
+        self.target = {"q1": self.params["q1"], "q2": self.params["q2"]}
+        self.log_alpha = float(np.log(config.initial_alpha))
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   self.obs_size, seed=config.seed,
+                                   action_shape=(self.act_size,),
+                                   action_dtype=np.float32)
+        self.workers = [SACRolloutWorker.remote(config.env, i)
+                        for i in range(config.num_rollout_workers)]
+        self._update = None
+        self.iteration = 0
+        self.total_steps = 0
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        target_entropy = -float(self.act_size)
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+        alpha_opt = optax.adam(cfg.lr)
+        self._alpha_opt = alpha_opt
+        self._alpha_state = alpha_opt.init(jnp.asarray(self.log_alpha))
+
+        def mlp(net, x):
+            h = jnp.tanh(x @ net["h1"]["w"] + net["h1"]["b"])
+            h = jnp.tanh(h @ net["h2"]["w"] + net["h2"]["b"])
+            return h
+
+        def q_val(net, obs, act):
+            h = mlp(net, jnp.concatenate([obs, act], -1))
+            return (h @ net["out"]["w"] + net["out"]["b"])[..., 0]
+
+        def pi_sample(pi, obs, key):
+            h = mlp(pi, obs)
+            mu = h @ pi["mu"]["w"] + pi["mu"]["b"]
+            log_std = jnp.clip(h @ pi["log_std"]["w"] + pi["log_std"]["b"],
+                               LOG_STD_MIN, LOG_STD_MAX)
+            std = jnp.exp(log_std)
+            eps = jax.random.normal(key, mu.shape)
+            pre = mu + std * eps
+            act = jnp.tanh(pre)
+            # log prob with tanh-squash correction
+            logp = (-0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+                    ).sum(-1)
+            logp = logp - jnp.log(1 - act ** 2 + 1e-6).sum(-1)
+            return act, logp
+
+        def update(params, target, log_alpha, opt_state, alpha_state, batch,
+                   key):
+            alpha = jnp.exp(log_alpha)
+            key_t, key_a = jax.random.split(key)
+
+            # -- critic loss: soft Bellman target from the TARGET twin-Q --
+            next_act, next_logp = pi_sample(params["pi"], batch["next_obs"],
+                                            key_t)
+            tq = jnp.minimum(q_val(target["q1"], batch["next_obs"], next_act),
+                             q_val(target["q2"], batch["next_obs"], next_act))
+            y = batch["rewards"] + cfg.gamma * (1 - batch["dones"]) * (
+                tq - alpha * next_logp)
+            y = jax.lax.stop_gradient(y)
+
+            def critic_loss(p):
+                l1 = ((q_val(p["q1"], batch["obs"], batch["actions"]) - y) ** 2
+                      ).mean()
+                l2 = ((q_val(p["q2"], batch["obs"], batch["actions"]) - y) ** 2
+                      ).mean()
+                return l1 + l2
+
+            def actor_loss(p):
+                act, logp = pi_sample(p["pi"], batch["obs"], key_a)
+                q = jnp.minimum(q_val(jax.lax.stop_gradient(p["q1"]),
+                                      batch["obs"], act),
+                                q_val(jax.lax.stop_gradient(p["q2"]),
+                                      batch["obs"], act))
+                return (alpha * logp - q).mean(), logp
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(params)
+            (aloss, logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params)
+            # Critic grads touch q1/q2, actor grads touch pi; merge.
+            grads = {"pi": agrads["pi"], "q1": cgrads["q1"], "q2": cgrads["q2"]}
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+
+            # -- temperature --
+            def alpha_loss(la):
+                return -(jnp.exp(la) * jax.lax.stop_gradient(
+                    logp + target_entropy)).mean()
+
+            if cfg.autotune_alpha:
+                agrad = jax.grad(alpha_loss)(log_alpha)
+                aupd, alpha_state = alpha_opt.update(agrad, alpha_state)
+                log_alpha = optax.apply_updates(log_alpha, aupd)
+
+            # -- polyak target update --
+            target = jax.tree_util.tree_map(
+                lambda t, p: (1 - cfg.tau) * t + cfg.tau * p, target,
+                {"q1": params["q1"], "q2": params["q2"]})
+            metrics = {"critic_loss": closs, "actor_loss": aloss,
+                       "alpha": alpha, "entropy": -logp.mean()}
+            return params, target, log_alpha, opt_state, alpha_state, metrics
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        t0 = time.time()
+        host = jax.tree_util.tree_map(np.asarray, self.params)
+        random_phase = self.total_steps < cfg.learning_starts
+        batches = ray_tpu.get(
+            [w.sample.remote(host, cfg.rollout_fragment_length, random_phase)
+             for w in self.workers], timeout=600)
+        episode_returns = []
+        for b in batches:
+            episode_returns += b.pop("episode_returns")
+            self.buffer.add_batch(b)
+            self.total_steps += len(b["obs"])
+        sample_time = time.time() - t0
+
+        t1 = time.time()
+        metrics = {}
+        log_alpha = jnp.asarray(self.log_alpha)
+        if self.total_steps >= cfg.learning_starts:
+            for i in range(cfg.num_updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                key = jax.random.PRNGKey(cfg.seed * 100003 + self.iteration
+                                         * 1009 + i)
+                (self.params, self.target, log_alpha, self._opt_state,
+                 self._alpha_state, metrics) = self._update(
+                    self.params, self.target, log_alpha, self._opt_state,
+                    self._alpha_state, batch, key)
+            self.log_alpha = float(log_alpha)
+        learn_time = time.time() - t1
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(episode_returns))
+            if episode_returns else float("nan"),
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_total": self.total_steps,
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(learn_time, 3),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def get_policy_params(self) -> dict:
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def compute_single_action(self, obs) -> np.ndarray:
+        mu, _ = numpy_policy(self.get_policy_params(), obs[None, :])
+        env = make_env(self.config.env)
+        scale = (env.action_high - env.action_low) / 2.0
+        mid = (env.action_high + env.action_low) / 2.0
+        return mid + scale * np.tanh(mu[0])

@@ -61,23 +61,41 @@ def register_ray() -> None:
                       require=None, **backend_kwargs):
             self.parallel = parallel
             self._run = ray_tpu.remote(_call)
+            self._pending: dict = {}   # ref -> (future, callback)
+            self._cv = threading.Condition()
+            self._stop = False
+            self._drainer = None
             return self.effective_n_jobs(n_jobs)
+
+        def _drain_loop(self):
+            """Single thread firing completion callbacks — joblib dispatches
+            further batches from them. One thread regardless of how many
+            batches are in flight (errors surface via retrieve_result on
+            the main thread, not here)."""
+            while True:
+                with self._cv:
+                    while not self._pending and not self._stop:
+                        self._cv.wait()
+                    if self._stop and not self._pending:
+                        return
+                    refs = list(self._pending)
+                ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
+                for ref in ready:
+                    with self._cv:
+                        fut, callback = self._pending.pop(ref)
+                    if callback is not None:
+                        callback(fut)
 
         def submit(self, func, callback=None):
             ref = self._run.remote(func)
             fut = _RayFuture(ref)
-            if callback is not None:
-                # joblib dispatches further batches from the completion
-                # callback; fire it from a waiter thread (errors included —
-                # retrieve_result re-raises them on the main thread).
-                def waiter():
-                    try:
-                        ray_tpu.get(ref)
-                    except Exception:
-                        pass
-                    callback(fut)
-
-                threading.Thread(target=waiter, daemon=True).start()
+            with self._cv:
+                self._pending[ref] = (fut, callback)
+                if self._drainer is None:
+                    self._drainer = threading.Thread(target=self._drain_loop,
+                                                     daemon=True)
+                    self._drainer.start()
+                self._cv.notify()
             return fut
 
         # Legacy name some joblib versions still call.
@@ -85,7 +103,9 @@ def register_ray() -> None:
             return self.submit(func, callback)
 
         def terminate(self):
-            pass
+            with self._cv:
+                self._stop = True
+                self._cv.notify()
 
         def abort_everything(self, ensure_ready=True):
             if ensure_ready:

@@ -75,6 +75,7 @@ class Pool:
                          for _ in range(processes)]
         self._rr = itertools.cycle(range(processes))
         self._closed = False
+        self._inflight: list = []  # refs close()/join() must drain
         if initializer is not None:
             # Initializers run once per worker (stdlib semantics); results
             # are discarded.
@@ -99,6 +100,12 @@ class Pool:
             w = self._workers[next(self._rr)]
             refs.append(w.run_chunk.remote(fn, items[i:i + chunksize], star,
                                            args, kwargs or {}))
+        self._inflight.extend(refs)
+        if len(self._inflight) > 512:  # prune completed refs
+            _, pending = ray_tpu.wait(self._inflight,
+                                      num_returns=len(self._inflight),
+                                      timeout=0)
+            self._inflight = pending
         return refs
 
     def apply(self, fn: Callable, args: tuple = (), kwds: dict | None = None):
@@ -110,6 +117,7 @@ class Pool:
         w = self._workers[next(self._rr)]
         ref = w.run_chunk.remote(lambda _a, **_k: fn(*args, **(kwds or {})),
                                  [None], False, (), {})
+        self._inflight.append(ref)
         return AsyncResult([ref], single=True)
 
     def map(self, fn: Callable, iterable: Iterable,
@@ -150,6 +158,8 @@ class Pool:
     # ---- lifecycle ----
 
     def close(self):
+        """Stop accepting work; in-flight tasks keep running (stdlib
+        contract — join() then waits for them and reaps the workers)."""
         self._closed = True
 
     def terminate(self):
@@ -157,10 +167,17 @@ class Pool:
         for w in self._workers:
             ray_tpu.kill(w)
         self._workers = []
+        self._inflight = []
 
     def join(self):
         if not self._closed:
             raise ValueError("Pool is still running")
+        if self._inflight:
+            ray_tpu.wait(self._inflight, num_returns=len(self._inflight))
+            self._inflight = []
+        for w in self._workers:
+            ray_tpu.kill(w)
+        self._workers = []
 
     def __enter__(self):
         return self

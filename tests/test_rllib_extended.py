@@ -1,0 +1,117 @@
+"""SAC and IMPALA tests (parity: reference rllib/algorithms/{sac,impala}
+tests — contract + learning-regression style)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.env import Pendulum
+from ray_tpu.rllib.sac import init_sac_params, numpy_policy
+
+
+def test_pendulum_env_contract():
+    env = Pendulum()
+    obs = env.reset(seed=0)
+    assert obs.shape == (3,)
+    assert env.action_size == 1
+    total, done, steps = 0.0, False, 0
+    while not done:
+        obs, r, done, _ = env.step(np.array([0.5]))
+        assert r <= 0.0  # cost-based reward
+        total += r
+        steps += 1
+    assert steps == env.max_episode_steps
+
+
+def test_sac_policy_shapes():
+    params = init_sac_params(3, 1)
+    mu, log_std = numpy_policy(params, np.zeros((5, 3), np.float32))
+    assert mu.shape == (5, 1)
+    assert log_std.shape == (5, 1)
+    assert (log_std >= -20).all() and (log_std <= 2).all()
+
+
+def test_sac_rejects_discrete_env():
+    from ray_tpu.rllib import SACConfig
+
+    with pytest.raises(ValueError, match="continuous"):
+        SACConfig().environment("CartPole-v1").build()
+
+
+def test_sac_learns_pendulum(ray_start_regular):
+    from ray_tpu.rllib import SACConfig
+
+    algo = (SACConfig()
+            .environment("Pendulum-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(rollout_fragment_length=200, learning_starts=400,
+                      num_updates_per_iter=128, train_batch_size=128,
+                      lr=1e-3)
+            .build())
+    try:
+        results = [algo.train() for _ in range(12)]
+        last = results[-1]
+        assert last["training_iteration"] == 12
+        assert last["timesteps_total"] >= 12 * 2 * 200
+        assert last["alpha"] > 0
+        # Learning signals: the critic converges (loss shrinks an order of
+        # magnitude from the first learning iteration) and swing-up cost
+        # improves late vs early (pendulum returns are noisy — wide windows).
+        assert last["critic_loss"] < results[0]["critic_loss"] / 3
+        early = np.nanmean([r["episode_reward_mean"] for r in results[:3]])
+        late = np.nanmean([r["episode_reward_mean"] for r in results[-3:]])
+        assert late > early
+    finally:
+        algo.stop()
+
+
+def test_impala_learns_cartpole(ray_start_regular):
+    from ray_tpu.rllib import ImpalaConfig
+
+    algo = (ImpalaConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(rollout_fragment_length=256,
+                      num_fragments_per_iter=4, lr=1e-3)
+            .build())
+    try:
+        first = algo.train()
+        last = first
+        for _ in range(7):
+            last = algo.train()
+        assert last["training_iteration"] == 8
+        assert last["timesteps_total"] == 8 * 4 * 256
+        # V-trace importance ratios hover near 1 (small async staleness).
+        assert 0.2 < last["mean_rho"] < 5.0
+        assert last["episode_reward_mean"] > first["episode_reward_mean"]
+    finally:
+        algo.stop()
+
+
+def test_impala_vtrace_on_policy_matches_returns():
+    """With rho=c=1 (on-policy) and no bootstrapping, vs ≈ discounted
+    returns — the V-trace recursion must reduce to TD(1)."""
+    import jax
+    import jax.numpy as jnp
+
+    gamma = 0.9
+    T = 5
+    rewards = jnp.asarray(np.ones(T, np.float32))
+    values = jnp.zeros(T)
+    dones = jnp.zeros(T).at[-1].set(1.0)
+    rhos = jnp.ones(T)
+
+    # Re-implement the scan exactly as the learner does.
+    next_values = jnp.concatenate([values[1:], jnp.zeros(1)]) * (1 - dones)
+    deltas = rhos * (rewards + gamma * next_values - values)
+
+    def body(acc, xs):
+        delta, c, done = xs
+        acc = delta + gamma * (1 - done) * c * acc
+        return acc, acc
+
+    _, advs = jax.lax.scan(body, jnp.zeros(()), (deltas, rhos, dones),
+                           reverse=True)
+    vs = values + advs
+    expected = np.array([sum(gamma ** k for k in range(T - t))
+                         for t in range(T)], np.float32)
+    np.testing.assert_allclose(np.asarray(vs), expected, rtol=1e-5)
