@@ -67,7 +67,9 @@ class Config:
     task_events_max_buffer: int = 100000
 
     # --- misc ---
-    temp_dir: str = "/tmp/ray_tpu"  # override via RAY_TPU_TEMP_DIR
+    # NOT "/tmp/ray_tpu": a directory named like the package next to a user
+    # script (sys.path[0]) would shadow `import ray_tpu`.
+    temp_dir: str = "/tmp/ray_tpu_sessions"  # override via RAY_TPU_TEMP_DIR
     log_to_driver: bool = True
 
     def __post_init__(self):

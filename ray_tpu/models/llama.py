@@ -47,6 +47,11 @@ class LlamaConfig:
     # shard_map over axis sp), "reference" (plain jnp)
     attention: str = "flash"
     remat: bool = True
+    # "full": recompute everything (nothing_saveable — min memory);
+    # "dots": save matmul outputs, recompute elementwise (far less
+    # recompute per backward at slightly more memory — usually the right
+    # speed/memory point on TPU).
+    remat_policy: str = "dots"
     tie_embeddings: bool = False
 
     @property
@@ -205,7 +210,10 @@ class LlamaModel(nn.Module):
         x = embed(tokens)
         layer_cls = DecoderLayer
         if cfg.remat and kv_caches is None:
-            layer_cls = nn.remat(DecoderLayer, policy=jax.checkpoint_policies.nothing_saveable)
+            policy = (jax.checkpoint_policies.nothing_saveable
+                      if cfg.remat_policy == "full" else
+                      jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            layer_cls = nn.remat(DecoderLayer, policy=policy)
         new_caches = []
         for i in range(cfg.n_layers):
             layer = layer_cls(cfg, name=f"layers_{i}")

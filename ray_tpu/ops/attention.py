@@ -67,9 +67,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)            # (block_q, d)
-        k = k_ref[0, 0].astype(jnp.float32)            # (block_k, d)
-        v = v_ref[0, 0].astype(jnp.float32)            # (block_k, d)
+        # Keep q/k/v in their storage dtype (bf16 on TPU): the MXU runs
+        # bf16×bf16→f32 at full rate; upcasting inputs to f32 first would
+        # halve matmul throughput. Accumulation is f32 via
+        # preferred_element_type.
+        q = q_ref[0, 0]                                # (block_q, d)
+        k = k_ref[0, 0]                                # (block_k, d)
+        v = v_ref[0, 0]                                # (block_k, d)
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
@@ -99,7 +103,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                   m_prev - m_safe))
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scratch[:] = m_new
         l_scratch[:] = l_new
 
@@ -118,11 +123,20 @@ def _flash_forward(q, k, v, sm_scale: float, causal: bool,
                    kv_valid_len: int | None = None):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Sk)
-    if Sq % block_q or Sk % block_k:
-        raise ValueError(
-            f"sequence lengths ({Sq},{Sk}) must divide blocks ({block_q},{block_k})")
+
+    def fit_block(block, seq):
+        # Largest block ≤ requested that divides the sequence (halving
+        # first — stays MXU-aligned for the common power-of-two seqs —
+        # then any divisor; a prime length degrades to one block).
+        block = min(block, seq)
+        while block > 1 and seq % block:
+            block //= 2
+        if seq % block:
+            block = seq
+        return block
+
+    block_q = fit_block(block_q, Sq)
+    block_k = fit_block(block_k, Sk)
     grid = (B, H, Sq // block_q, Sk // block_k)
 
     if causal:
@@ -175,20 +189,21 @@ def _flash_forward(q, k, v, sm_scale: float, causal: bool,
 
 
 def _flash_backward(sm_scale, causal, block_q, block_k, kv_valid_len, res, do):
+    # Operands stay in their storage dtype (bf16 on TPU — full-rate MXU);
+    # every einsum accumulates in f32 via preferred_element_type, and the
+    # dk/dv accumulators are f32.
     q, k, v, out, lse = res
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-    of, dof = out.astype(jnp.float32), do.astype(jnp.float32)
-    delta = jnp.sum(of * dof, axis=-1)                       # (B,H,Sq)
+    f32 = functools.partial(jnp.einsum, preferred_element_type=jnp.float32)
+    delta = f32("bhsd,bhsd->bhs", out, do)                   # (B,H,Sq)
 
     bq = min(block_q, Sq)
-    nq = Sq // bq if Sq % bq == 0 else 1
     if Sq % bq:
         bq = Sq
 
     def p_block(qi_start, q_blk, lse_blk):
-        s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kf) * sm_scale
+        s = f32("bhqd,bhkd->bhqk", q_blk, k) * sm_scale
         if causal:
             q_pos = qi_start + jnp.arange(q_blk.shape[2])[:, None]
             k_pos = jnp.arange(Sk)[None, :]
@@ -203,19 +218,20 @@ def _flash_backward(sm_scale, causal, block_q, block_k, kv_valid_len, res, do):
     def scan_body(carry, idx):
         dk_acc, dv_acc = carry
         qs = idx * bq
-        q_blk = lax.dynamic_slice_in_dim(qf, qs, bq, axis=2)
-        do_blk = lax.dynamic_slice_in_dim(dof, qs, bq, axis=2)
+        q_blk = lax.dynamic_slice_in_dim(q, qs, bq, axis=2)
+        do_blk = lax.dynamic_slice_in_dim(do, qs, bq, axis=2)
         lse_blk = lax.dynamic_slice_in_dim(lse, qs, bq, axis=2)
         dl_blk = lax.dynamic_slice_in_dim(delta, qs, bq, axis=2)
-        p = p_block(qs, q_blk, lse_blk)                      # (B,H,bq,Sk)
-        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, do_blk)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do_blk, vf)
-        ds = p * (dp - dl_blk[..., None]) * sm_scale
-        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_blk)
+        p = p_block(qs, q_blk, lse_blk)                      # (B,H,bq,Sk) f32
+        pb = p.astype(v.dtype)
+        dv_acc = dv_acc + f32("bhqk,bhqd->bhkd", pb, do_blk)
+        dp = f32("bhqd,bhkd->bhqk", do_blk, v)
+        ds = (p * (dp - dl_blk[..., None]) * sm_scale).astype(v.dtype)
+        dq_blk = f32("bhqk,bhkd->bhqd", ds, k)
+        dk_acc = dk_acc + f32("bhqk,bhqd->bhkd", ds, q_blk)
         return (dk_acc, dv_acc), dq_blk
 
-    init = (jnp.zeros_like(kf), jnp.zeros_like(vf))
+    init = (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
     (dk, dv), dq_blocks = lax.scan(scan_body, init, jnp.arange(Sq // bq))
     # dq_blocks: (nq, B, H, bq, D) → (B, H, Sq, D)
     dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(B, H, Sq, D)
@@ -224,8 +240,8 @@ def _flash_backward(sm_scale, causal, block_q, block_k, kv_valid_len, res, do):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, sm_scale: float | None = None,
-                    causal: bool = False, block_q: int = 128,
-                    block_k: int = 128):
+                    causal: bool = False, block_q: int = 512,
+                    block_k: int = 512):
     """Flash attention. q,k,v: (batch, heads, seq, head_dim)."""
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k)
@@ -264,8 +280,7 @@ def mha_reference(q, k, v, sm_scale: float | None = None, causal: bool = False):
 
 
 def ring_attention(q, k, v, axis: str = "sp", *, causal: bool = False,
-                   sm_scale: float | None = None, block_q: int = 128,
-                   block_k: int = 128):
+                   sm_scale: float | None = None):
     """Exact attention over a sequence sharded on a mesh axis.
 
     Call inside shard_map with q,k,v sequence-sharded on `axis`

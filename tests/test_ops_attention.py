@@ -90,3 +90,25 @@ def test_ulysses_attention_matches_reference(causal):
     ref = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_flash_non_block_multiple_seq():
+    """Sequences that aren't multiples of the default block must still work
+    (blocks auto-shrink to a divisor)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.ops.attention import flash_attention, mha_reference
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 768, 32))
+    out = jax.jit(lambda q: flash_attention(q, q, q, None, True))(q)
+    ref = mha_reference(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # Odd length degrades to a single block but stays correct.
+    q3 = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 129, 16))
+    out3 = jax.jit(lambda q: flash_attention(q, q, q, None, False))(q3)
+    ref3 = mha_reference(q3, q3, q3, causal=False)
+    np.testing.assert_allclose(np.asarray(out3), np.asarray(ref3),
+                               atol=2e-5, rtol=2e-5)
