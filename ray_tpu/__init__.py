@@ -64,13 +64,35 @@ def init(address: str | None = None, *, resources: dict | None = None,
         else:
             gcs_host, gcs_port_s = address.rsplit(":", 1)
             gcs_port = int(gcs_port_s)
-            if _head_raylet is None:
-                raise exceptions.RayTpuError(
-                    "connecting by address requires _head_raylet (host, port) "
-                    "for this round; use cluster_utils.Cluster.connect()")
-            raylet_host, raylet_port = _head_raylet
-            store_path = _store_path
-            node_id = _node_id
+            if _head_raylet is not None:
+                raylet_host, raylet_port = _head_raylet
+                store_path = _store_path
+                node_id = _node_id
+            else:
+                # Resolve a raylet to attach to from the GCS node table
+                # (reference: ray.init(address=...) bootstraps from the GCS):
+                # prefer this host's raylet (shared-memory store is local),
+                # else the head node's.
+                raylet_host = raylet_port = store_path = node_id = None
+                import socket
+
+                local_names = {"127.0.0.1", "localhost", socket.gethostname()}
+                try:
+                    local_names.add(socket.gethostbyname(socket.gethostname()))
+                except OSError:
+                    pass
+                nodes = _query_nodes(gcs_host, gcs_port, cfg)
+                alive = [n for n in nodes if n.get("alive")]
+                alive.sort(key=lambda n: (n["host"] not in local_names,
+                                          not n.get("is_head")))
+                if not alive:
+                    raise exceptions.RayTpuError(
+                        f"no alive nodes in cluster at {address}")
+                chosen = alive[0]
+                raylet_host = chosen["host"]
+                raylet_port = chosen["raylet_port"]
+                store_path = chosen["store_path"]
+                node_id = chosen["node_id"]
         cw = CoreWorker(
             gcs_host=gcs_host, gcs_port=gcs_port,
             raylet_host=raylet_host, raylet_port=raylet_port,
@@ -82,6 +104,31 @@ def init(address: str | None = None, *, resources: dict | None = None,
             from ray_tpu.runtime_env import set_job_runtime_env
 
             set_job_runtime_env(runtime_env)
+
+
+def _query_nodes(gcs_host: str, gcs_port: int, cfg: Config) -> list[dict]:
+    """One-shot GCS query usable before a CoreWorker exists."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+
+    async def go():
+        conn = await rpc.connect_retry(
+            gcs_host, gcs_port, name="init-bootstrap",
+            timeout=cfg.rpc_connect_timeout_s)
+        try:
+            resp = await conn.call("GetAllNodes", {},
+                                   timeout=cfg.rpc_call_timeout_s)
+            return resp["nodes"]
+        finally:
+            await conn.close()
+
+    # A dedicated thread, not asyncio.run(): init() may be called from
+    # inside a running event loop (notebook cell, async web handler).
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(1) as pool:
+        return pool.submit(asyncio.run, go()).result()
 
 
 def is_initialized() -> bool:

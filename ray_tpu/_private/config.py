@@ -58,6 +58,13 @@ class Config:
     # (reference: task_manager.cc lineage pinning).
     max_lineage_bytes: int = 64 * 1024 * 1024
 
+    # --- memory monitor (reference: memory_monitor.h:52,
+    # worker_killing_policy.h:34) ---
+    # Kill workers when system memory usage exceeds this fraction;
+    # <= 0 disables the monitor.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_period_s: float = 1.0
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0

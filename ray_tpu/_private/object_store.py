@@ -70,6 +70,11 @@ def _get_lib():
         lib.store_list.restype = ctypes.c_int
         lib.store_list.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
         lib.store_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.store_set_auto_evict.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.store_set_auto_evict.restype = None
+        lib.store_lru_candidates.restype = ctypes.c_int
+        lib.store_lru_candidates.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int]
         _lib = lib
     return _lib
 
@@ -164,6 +169,19 @@ class ObjectStoreClient:
     def list_objects(self, max_n: int = 65536) -> list[ObjectID]:
         buf = ctypes.create_string_buffer(max_n * ObjectID.SIZE)
         n = self._lib.store_list(self._handle, buf, max_n)
+        raw = buf.raw
+        return [ObjectID(raw[i * 20:(i + 1) * 20]) for i in range(n)]
+
+    def set_auto_evict(self, enabled: bool) -> None:
+        """Off = create() reports OOM instead of evicting, so the raylet
+        can spill idle objects to disk first (spilled copies are
+        restorable; evicted ones are gone until lineage re-executes)."""
+        self._lib.store_set_auto_evict(self._handle, 1 if enabled else 0)
+
+    def lru_candidates(self, needed: int, max_n: int = 4096) -> list[ObjectID]:
+        """LRU-first sealed refcount==0 objects totalling >= needed bytes."""
+        buf = ctypes.create_string_buffer(max_n * ObjectID.SIZE)
+        n = self._lib.store_lru_candidates(self._handle, needed, buf, max_n)
         raw = buf.raw
         return [ObjectID(raw[i * 20:(i + 1) * 20]) for i in range(n)]
 

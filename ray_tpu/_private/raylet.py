@@ -44,6 +44,65 @@ from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullErro
 logger = logging.getLogger(__name__)
 
 
+def _cgroup_memory_fraction() -> float:
+    """Usage fraction of the enclosing cgroup limit (v2 then v1), or 0.0
+    when unlimited/unavailable. Containers hit their cgroup limit long
+    before the host's (reference: memory_monitor reads cgroup usage)."""
+    for usage_p, limit_p in (
+        ("/sys/fs/cgroup/memory.current", "/sys/fs/cgroup/memory.max"),
+        ("/sys/fs/cgroup/memory/memory.usage_in_bytes",
+         "/sys/fs/cgroup/memory/memory.limit_in_bytes"),
+    ):
+        try:
+            with open(limit_p) as f:
+                limit_s = f.read().strip()
+            if limit_s == "max":
+                continue
+            limit = int(limit_s)
+            if limit <= 0 or limit > 1 << 60:  # effectively unlimited
+                continue
+            with open(usage_p) as f:
+                usage = int(f.read().strip())
+            return usage / limit
+        except (OSError, ValueError):
+            continue
+    return 0.0
+
+
+def system_memory_fraction() -> float:
+    """Used fraction of available memory: the tighter of the host
+    (/proc/meminfo, reference: memory_monitor.h:52) and the enclosing
+    cgroup limit."""
+    host = 0.0
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                key, val = line.split(":", 1)
+                info[key] = int(val.strip().split()[0]) * 1024
+        total = info["MemTotal"]
+        avail = info.get("MemAvailable", info.get("MemFree", 0))
+        host = (total - avail) / max(total, 1)
+    except Exception:
+        pass
+    return max(host, _cgroup_memory_fraction())
+
+
+def pick_oom_victim(workers) -> "WorkerHandle | None":
+    """Worker-killing policy: newest-leased task worker first (its task is
+    retriable and lost the least progress — reference retriable-FIFO policy,
+    worker_killing_policy.h retriable_fifo); actors only as a last resort
+    (restart costs more), newest first."""
+    tasks = [w for w in workers
+             if w.leased and w.actor_id is None and not w.dead]
+    if tasks:
+        return max(tasks, key=lambda w: w.leased_at)
+    actors = [w for w in workers if w.actor_id is not None and not w.dead]
+    if actors:
+        return max(actors, key=lambda w: w.leased_at)
+    return None
+
+
 class WorkerHandle:
     def __init__(self, proc: subprocess.Popen, worker_id: str):
         self.proc = proc
@@ -57,6 +116,7 @@ class WorkerHandle:
         self.lease_pg: tuple[str, int] | None = None
         self.actor_id: str | None = None
         self.idle_since = time.monotonic()
+        self.leased_at = 0.0
         self.dead = False
 
 
@@ -114,6 +174,7 @@ class Raylet:
             "ReturnWorker": self.handle_return_worker,
             "PullObject": self.handle_pull_object,
             "FreeObjects": self.handle_free_objects,
+            "MakeRoom": self.handle_make_room,
             "GetNodeInfo": self.handle_get_node_info,
             "ReportWorkerDeath": self.handle_report_worker_death,
             # peer-raylet-facing
@@ -137,6 +198,17 @@ class Raylet:
             size=int(self.total_resources.get(
                 "object_store_memory", self.config.object_store_memory)),
             table_capacity=self.config.object_store_table_capacity)
+        # Spilling: the raylet (not the store) handles memory pressure —
+        # idle objects go to disk and restore on demand (reference:
+        # local_object_manager.h:110 SpillObjects / :122 restore).
+        self.store.set_auto_evict(False)
+        self.spill_dir = os.path.join(self.session_dir,
+                                      f"spilled-{self.node_id[:12]}")
+        self.spilled: dict[str, tuple[str, int, int]] = {}  # oid -> (path, meta_size, size)
+        self._spill_lock = asyncio.Lock()
+        self._spilled_bytes = 0
+        self._num_spilled = 0
+        self._num_restored = 0
         # The GCS issues calls (CreateActor, PG prepare/commit, Drain) back
         # over this same connection, so it gets the full handler table.
         self.gcs_conn = await rpc.connect_retry(
@@ -158,6 +230,8 @@ class Raylet:
         await self.gcs_conn.call("Subscribe", {"channels": ["NODE"]})
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._reap_loop()))
+        if self.config.memory_usage_threshold > 0:
+            self._tasks.append(asyncio.create_task(self._memory_monitor_loop()))
         logger.info("raylet %s on %s:%s resources=%s", self.node_id[:8], self.host,
                     self.port, self.total_resources)
         return self.host, self.port
@@ -236,6 +310,44 @@ class Raylet:
                 if now - w.idle_since > 60.0 and len(self.idle_workers) > 1:
                     self.idle_workers.remove(w)
                     self._kill_worker(w)
+
+    async def _memory_monitor_loop(self):
+        """Kill a worker when system memory crosses the threshold
+        (reference: memory_monitor.h:52 + worker_killing_policy.h:34; the
+        owner retries the killed task, so pressure sheds instead of the
+        kernel OOM-killer taking out the raylet)."""
+        threshold = self.config.memory_usage_threshold
+        last_kill = 0.0
+        frac_at_last_kill = 0.0
+        while True:
+            await asyncio.sleep(self.config.memory_monitor_period_s)
+            frac = system_memory_fraction()
+            if frac < threshold:
+                continue
+            now = time.monotonic()
+            # Cooldown + effectiveness check: give a kill 3 periods to
+            # show up in the reading, and don't keep killing when the
+            # pressure is external (usage not dropping because our workers
+            # aren't the cause).
+            if now - last_kill < 3 * self.config.memory_monitor_period_s:
+                continue
+            if last_kill and frac >= frac_at_last_kill - 0.005 and \
+                    now - last_kill < 30 * self.config.memory_monitor_period_s:
+                continue
+            victim = pick_oom_victim(self.workers.values())
+            if victim is None:
+                continue
+            last_kill = now
+            frac_at_last_kill = frac
+            logger.warning(
+                "memory usage %.0f%% >= %.0f%%: killing worker %s "
+                "(%s) to relieve pressure", frac * 100, threshold * 100,
+                victim.worker_id[:8],
+                f"actor {victim.actor_id[:8]}" if victim.actor_id
+                else "retriable task")
+            await self._on_worker_death(
+                victim, f"killed by memory monitor at {frac:.0%} usage")
+            self._kill_worker(victim)
 
     async def _on_worker_death(self, w: WorkerHandle, reason: str):
         w.dead = True
@@ -498,6 +610,7 @@ class Raylet:
         self._num_leases_granted += 1
         lease_id = f"{self.node_id[:8]}-{self._lease_seq}"
         w.leased = True
+        w.leased_at = time.monotonic()
         w.lease_id = lease_id
         w.lease_resources = resources
         w.lease_pg = (pg_id, bundle_index) if pg_id else None
@@ -604,6 +717,7 @@ class Raylet:
             add_resources(self.available, resources)
             return {"ok": False, "reason": "worker startup failed"}
         w.leased = True
+        w.leased_at = time.monotonic()
         w.lease_resources = resources
         w.lease_pg = (pg_id, bundle_index) if pg_id else None
         return await self._assign_actor(w, payload, resources)
@@ -667,11 +781,121 @@ class Raylet:
             self._pump_pending_leases()
         return {"ok": True}
 
+    # ---------- objects: spill / restore ----------
+
+    async def _ensure_room(self, needed: int) -> int:
+        """Spill idle (sealed, unreferenced) objects to disk until `needed`
+        bytes are plausibly free. Returns bytes spilled. File writes run in
+        a thread (reference: spilling is offloaded to IO workers) so
+        heartbeats and RPCs keep flowing while gigabytes hit disk."""
+        async with self._spill_lock:
+            candidates = self.store.lru_candidates(needed)
+            if not candidates:
+                return 0
+            os.makedirs(self.spill_dir, exist_ok=True)
+            freed = 0
+            for oid in candidates:
+                oid_hex = oid.hex()
+                if oid_hex in self.spilled:
+                    continue
+                got = self.store.get_buffer(oid)
+                if got is None:
+                    continue
+                meta, data = got
+                path = os.path.join(self.spill_dir, oid_hex)
+
+                def write_file(path=path, meta=meta, data=data):
+                    with open(path, "wb") as f:
+                        f.write(meta)
+                        f.write(data)
+
+                try:
+                    await asyncio.to_thread(write_file)
+                finally:
+                    self.store.release(oid)
+                # Non-forced delete: if a reader grabbed it between
+                # candidate selection and now, keep it in shm and drop the
+                # file.
+                if self.store.delete(oid, force=False):
+                    size = len(meta) + len(data)
+                    self.spilled[oid_hex] = (path, len(meta), size)
+                    self._spilled_bytes += size
+                    self._num_spilled += 1
+                    freed += size
+                else:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+            if freed:
+                logger.info("spilled %d objects (%.1f MB) to %s",
+                            self._num_spilled, freed / 1e6, self.spill_dir)
+            return freed
+
+    async def _create_with_room(self, oid: ObjectID, size: int,
+                                meta_size: int):
+        """store.create with one spill-and-retry on OOM. Returns the buffer,
+        None if the object already exists (benign race with a concurrent
+        writer), or raises ObjectStoreFullError."""
+        for attempt in (0, 1):
+            try:
+                return self.store.create(oid, size, meta_size)
+            except ObjectStoreFullError:
+                if attempt or not await self._ensure_room(size):
+                    raise
+            except Exception as e:
+                if "already exists" in str(e):
+                    return None
+                raise
+
+    async def _restore_spilled(self, oid: ObjectID) -> bool:
+        """Read a spilled object back into the store (restore path)."""
+        entry = self.spilled.get(oid.hex())
+        if entry is None:
+            return False
+        path, meta_size, size = entry
+
+        def read_file():
+            with open(path, "rb") as f:
+                return f.read()
+
+        try:
+            blob = await asyncio.to_thread(read_file)
+        except OSError:
+            return False
+        try:
+            buf = await self._create_with_room(oid, len(blob), meta_size)
+        except ObjectStoreFullError:
+            return False
+        if buf is not None:
+            buf[:] = blob
+            self.store.seal(oid)
+        # buf None: someone else is re-creating it (e.g. lineage
+        # re-execution); keep the spill file until that copy seals.
+        if buf is None and not self.store.contains(oid):
+            return False
+        self.spilled.pop(oid.hex(), None)
+        self._spilled_bytes -= size
+        self._num_restored += 1
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return True
+
+    async def handle_make_room(self, conn, payload):
+        """A worker's store.create hit OOM; spill idle objects on its
+        behalf, then it retries."""
+        freed = await self._ensure_room(int(payload.get("needed", 0)))
+        return {"ok": True, "freed": freed}
+
     # ---------- objects ----------
 
     async def handle_object_info(self, conn, payload):
         oid = ObjectID.from_hex(payload["object_id"])
         got = self.store.get_buffer(oid)
+        if got is None and await self._restore_spilled(oid):
+            got = self.store.get_buffer(oid)
         if got is None:
             return {"found": False}
         meta, data = got
@@ -684,6 +908,8 @@ class Raylet:
         push_manager.h:30 streams chunks over the ObjectManager service)."""
         oid = ObjectID.from_hex(payload["object_id"])
         got = self.store.get_buffer(oid)
+        if got is None and await self._restore_spilled(oid):
+            got = self.store.get_buffer(oid)
         if got is None:
             return {"found": False}
         meta, data = got
@@ -691,9 +917,6 @@ class Raylet:
             off = payload["offset"]
             n = payload["size"]
             # Chunk space covers meta + data concatenated.
-            whole = bytes(meta) + bytes(data[max(0, off - len(meta)):
-                                             max(0, off - len(meta)) + n]) \
-                if off >= len(meta) else None
             if off < len(meta):
                 combined = bytes(meta) + bytes(data)
                 chunk = combined[off: off + n]
@@ -718,6 +941,8 @@ class Raylet:
         oid_hex = payload["object_id"]
         oid = ObjectID.from_hex(oid_hex)
         if self.store.contains(oid):
+            return {"ok": True}
+        if oid_hex in self.spilled and await self._restore_spilled(oid):
             return {"ok": True}
         lock = self._pull_locks.setdefault(oid_hex, asyncio.Lock())
         async with lock:
@@ -759,9 +984,11 @@ class Raylet:
             chunks.append(nxt["chunk"])
             got += len(nxt["chunk"])
         try:
-            buf = self.store.create(oid, total, meta_size)
+            buf = await self._create_with_room(oid, total, meta_size)
         except ObjectStoreFullError:
             return False
+        if buf is None:  # concurrent writer already has it
+            return self.store.contains(oid)
         off = 0
         for c in chunks:
             buf[off: off + len(c)] = c
@@ -772,6 +999,13 @@ class Raylet:
     async def handle_free_objects(self, conn, payload):
         for oid_hex in payload["object_ids"]:
             self.store.delete(ObjectID.from_hex(oid_hex), force=True)
+            entry = self.spilled.pop(oid_hex, None)
+            if entry is not None:
+                self._spilled_bytes -= entry[2]
+                try:
+                    os.unlink(entry[0])
+                except OSError:
+                    pass
         return {"ok": True}
 
     async def handle_get_node_info(self, conn, payload):
@@ -804,6 +1038,9 @@ class Raylet:
             "leases_granted": self._num_leases_granted,
             "pg_bundles": [list(k) for k in self.pg_bundles],
             "store": self.store.stats() if self.store else {},
+            "spilled_objects": len(self.spilled),
+            "spilled_bytes": self._spilled_bytes,
+            "num_restored": self._num_restored,
             "draining": self.draining,
         }
 

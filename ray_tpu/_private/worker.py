@@ -286,18 +286,34 @@ class CoreWorker:
             o.ready_event.set()
 
     async def _write_to_store(self, oid: ObjectID, sobj):
-        try:
-            if not self.store.contains(oid):
-                meta = sobj.meta
-                buf = self.store.create(oid, len(meta) + sobj.total_size, len(meta))
-                buf[: len(meta)] = meta
-                sobj.write_to(buf[len(meta):])
-                self.store.seal(oid)
-        except ObjectStoreFullError:
-            raise
-        except Exception as e:
-            if "already exists" not in str(e):
-                raise
+        for attempt in (0, 1):
+            try:
+                if not self.store.contains(oid):
+                    meta = sobj.meta
+                    buf = self.store.create(oid, len(meta) + sobj.total_size, len(meta))
+                    buf[: len(meta)] = meta
+                    sobj.write_to(buf[len(meta):])
+                    self.store.seal(oid)
+                return
+            except ObjectStoreFullError:
+                if attempt:
+                    raise
+                # Ask the raylet to spill idle objects to disk, then retry
+                # (reference: plasma create-retry via local_object_manager
+                # spilling).
+                try:
+                    await self.raylet.call(
+                        "MakeRoom",
+                        {"needed": len(sobj.meta) + sobj.total_size},
+                        timeout=self.config.rpc_call_timeout_s)
+                except Exception:
+                    raise ObjectStoreFullError(
+                        f"store full and spill request failed "
+                        f"({sobj.total_size} bytes)") from None
+            except Exception as e:
+                if "already exists" not in str(e):
+                    raise
+                return
 
     def get(self, refs: list, timeout: float | None = None):
         """refs: list of (ObjectID, owner Address). Returns list of values."""

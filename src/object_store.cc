@@ -85,7 +85,10 @@ struct StoreHeader {
   uint64_t heap_offset;
   uint64_t heap_size;
   uint32_t table_capacity;
-  uint32_t _pad0;
+  // 1 = store_create evicts LRU objects itself when full (standalone use);
+  // 0 = create returns OOM and the raylet decides (spill-to-disk first,
+  // reference: local_object_manager.h spill/restore).
+  uint32_t auto_evict;
   pthread_mutex_t mutex;  // process-shared, robust
   uint64_t lru_tick;
   uint64_t bytes_in_use;
@@ -301,6 +304,7 @@ void* store_create_arena(const char* path, uint64_t arena_size, uint32_t table_c
   s->hdr->heap_offset = heap_off;
   s->hdr->heap_size = arena_size - heap_off;
   s->hdr->table_capacity = table_capacity;
+  s->hdr->auto_evict = 1;
   s->table = reinterpret_cast<ObjectEntry*>(s->base + table_off);
   memset(s->table, 0, (uint64_t)table_capacity * sizeof(ObjectEntry));
 
@@ -373,7 +377,7 @@ int store_create(void* handle, const uint8_t* id, uint64_t data_size, uint64_t m
   }
   uint64_t actual = 0;
   uint64_t off = heap_alloc(s, data_size, &actual);
-  if (off == kNullOffset) {
+  if (off == kNullOffset && s->hdr->auto_evict) {
     evict_lru(s, data_size);
     off = heap_alloc(s, data_size, &actual);
   }
@@ -508,6 +512,47 @@ int store_list(void* handle, uint8_t* out, int max_n) {
       memcpy(out + (size_t)n * kIdSize, e->id, kIdSize);
       n++;
     }
+  }
+  unlock(s);
+  return n;
+}
+
+// 1 = evict-on-full inside store_create; 0 = return OOM and let the raylet
+// spill (it is the only caller that may flip this, at arena creation).
+void store_set_auto_evict(void* handle, int enabled) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  s->hdr->auto_evict = enabled ? 1 : 0;
+  unlock(s);
+}
+
+// Spill candidate selection: LRU-ordered sealed refcount==0 objects whose
+// cumulative reserved bytes reach `needed`. Writes ids (kIdSize bytes each)
+// to out; returns the count (may satisfy less than `needed` if the store
+// has fewer idle objects).
+int store_lru_candidates(void* handle, uint64_t needed, uint8_t* out,
+                         int max_n) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  int n = 0;
+  uint64_t freed = 0;
+  // O(candidates · table) selection-sort walk; bounded by max_n picks.
+  uint64_t last_tick = 0;
+  while (n < max_n && freed < needed) {
+    ObjectEntry* best = nullptr;
+    for (uint32_t i = 0; i < s->hdr->table_capacity; i++) {
+      ObjectEntry* e = &s->table[i];
+      if (e->state == kStateSealed && e->refcount <= 0 &&
+          e->lru_tick > last_tick &&
+          (!best || e->lru_tick < best->lru_tick)) {
+        best = e;
+      }
+    }
+    if (!best) break;
+    last_tick = best->lru_tick;
+    memcpy(out + (size_t)n * kIdSize, best->id, kIdSize);
+    freed += best->alloc_size;
+    n++;
   }
   unlock(s);
   return n;

@@ -120,3 +120,22 @@ def test_object_reconstruction_after_node_death(ray_start_cluster_head):
     time.sleep(0.5)
     out = ray_tpu.get(ref, timeout=120)
     assert out.sum() == float(1 << 20)
+
+
+def test_connect_by_address_only(ray_start_cluster):
+    """ray_tpu.init(address=...) bootstraps from the GCS node table with no
+    raylet hints (reference: ray.init(address=...) connect path)."""
+    import ray_tpu
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.gcs_address)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(41)) == 42
+        assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 1
+    finally:
+        ray_tpu.shutdown()
