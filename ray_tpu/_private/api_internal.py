@@ -222,7 +222,11 @@ class RemoteFunction:
             pg_bundle_index=bundle_index,
             runtime_env=_effective_runtime_env(self._opts["runtime_env"]),
         )
-        returns = cw.submit_task(spec)
+        from ray_tpu.util import tracing
+
+        with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
+            spec.trace_ctx = trace_ctx
+            returns = cw.submit_task(spec)
         refs = [ObjectRef(oid, cw.address) for oid in returns]
         if self._opts["num_returns"] == 1:
             return refs[0]
@@ -288,8 +292,12 @@ class ActorHandle:
             owner=cw.address.to_wire(),
             actor_id=self._actor_id.hex(),
         )
-        returns = cw.submit_actor_task(self._actor_id.hex(), spec,
-                                       self._max_task_retries)
+        from ray_tpu.util import tracing
+
+        with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
+            spec.trace_ctx = trace_ctx
+            returns = cw.submit_actor_task(self._actor_id.hex(), spec,
+                                           self._max_task_retries)
         refs = [ObjectRef(oid, cw.address) for oid in returns]
         return refs[0] if num_returns == 1 else refs
 
@@ -349,13 +357,17 @@ class ActorClass:
             pg_bundle_index=bundle_index,
             runtime_env=_effective_runtime_env(self._opts["runtime_env"]),
         )
-        resp = cw.create_actor(
-            spec,
-            name=self._opts["name"] or "",
-            namespace=self._opts["namespace"] or "default",
-            class_name=self._cls.__name__,
-            detached=self._opts["lifetime"] == "detached",
-            get_if_exists=self._opts["get_if_exists"])
+        from ray_tpu.util import tracing
+
+        with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
+            spec.trace_ctx = trace_ctx
+            resp = cw.create_actor(
+                spec,
+                name=self._opts["name"] or "",
+                namespace=self._opts["namespace"] or "default",
+                class_name=self._cls.__name__,
+                detached=self._opts["lifetime"] == "detached",
+                get_if_exists=self._opts["get_if_exists"])
         if not resp.get("ok"):
             raise exc.RayTpuError(resp.get("reason", "actor registration failed"))
         if resp.get("existing"):

@@ -104,6 +104,9 @@ class TaskSpec:
     placement_group: str = ""         # pg id hex
     pg_bundle_index: int = -1
     runtime_env: dict | None = None
+    # W3C traceparent of the submitting span (reference: tracing context
+    # propagates inside the TaskSpec, tracing_helper.py).
+    trace_ctx: str = ""
 
     def to_wire(self):
         return [
@@ -112,6 +115,7 @@ class TaskSpec:
             self.retry_exceptions, self.owner, self.actor_id, self.actor_creation,
             self.actor_seq, self.max_restarts, self.max_task_retries, self.strategy,
             self.placement_group, self.pg_bundle_index, self.runtime_env,
+            self.trace_ctx,
         ]
 
     @classmethod

@@ -944,6 +944,8 @@ class CoreWorker:
                 return {"status": "error",
                         "error": [err.meta, err.to_bytes()],
                         "retryable": True, "system_retryable": True}
+        from ray_tpu.util import tracing
+
         try:
             if spec.actor_creation:
                 cls = self._run(self._fetch_function(spec.func_key))
@@ -951,17 +953,23 @@ class CoreWorker:
                 # Actor envs persist: the process is dedicated to the actor
                 # (reference: runtime-env-keyed workers, worker_pool.cc).
                 with runtime_env_context(spec.runtime_env, persistent=True):
-                    self._actor_instance = cls(*args, **kwargs)
+                    with tracing.execute_span(spec.name, spec.task_id,
+                                              spec.trace_ctx):
+                        self._actor_instance = cls(*args, **kwargs)
                 return {"status": "ok", "results": []}
             if spec.actor_id:
                 fn = getattr(self._actor_instance, spec.name.split(".")[-1])
                 args, kwargs = self._resolve_args(spec)
-                result = fn(*args, **kwargs)
+                with tracing.execute_span(spec.name, spec.task_id,
+                                          spec.trace_ctx):
+                    result = fn(*args, **kwargs)
             else:
                 fn = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
                 with runtime_env_context(spec.runtime_env):
-                    result = fn(*args, **kwargs)
+                    with tracing.execute_span(spec.name, spec.task_id,
+                                              spec.trace_ctx):
+                        result = fn(*args, **kwargs)
             return {"status": "ok",
                     "results": self._package_results(spec, result)}
         except Exception as e:
@@ -1239,6 +1247,9 @@ def main():
     logging.basicConfig(level=logging.INFO,
                         format="[worker] %(asctime)s %(levelname)s %(message)s")
     env = os.environ
+    from ray_tpu.util import tracing
+
+    tracing.maybe_setup_from_env()
     # Tests pin worker JAX to the CPU fake backend (the machine image
     # force-registers the TPU platform via config, ignoring JAX_PLATFORMS).
     plat = env.get("RAY_TPU_JAX_PLATFORM")

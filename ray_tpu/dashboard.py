@@ -82,6 +82,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/":
                 return self._send(200, _PAGE.encode(), "text/html")
+            if path == "/metrics":
+                from ray_tpu.util.metrics import prometheus_text
+
+                return self._send(200, prometheus_text().encode(),
+                                  "text/plain; version=0.0.4")
             if path == "/api/version":
                 import ray_tpu
 

@@ -1,0 +1,71 @@
+"""HuggingFace Transformers integration for the trainer gang.
+
+Parity: reference python/ray/train/huggingface/transformers/
+(transformers_trainer.py / the modern `prepare_trainer` +
+`RayTrainReportCallback` surface): run an unmodified `transformers.Trainer`
+inside `train_loop_per_worker`; the callback streams its logs and
+checkpoints into the ray_tpu train session so Tune/Result plumbing sees
+them.
+
+    def train_loop(config):
+        trainer = transformers.Trainer(...)
+        trainer = prepare_trainer(trainer)
+        trainer.train()
+
+    TorchTrainer(train_loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train import session
+
+__all__ = ["RayTrainReportCallback", "prepare_trainer"]
+
+
+def _transformers():
+    try:
+        import transformers
+    except ImportError as e:  # pragma: no cover - soft dep
+        raise ImportError(
+            "transformers is required for ray_tpu.train.huggingface") from e
+    return transformers
+
+
+class RayTrainReportCallback:
+    """transformers TrainerCallback reporting logs + checkpoints to the
+    session (reference: RayTrainReportCallback)."""
+
+    def __new__(cls):
+        transformers = _transformers()
+
+        class _Callback(transformers.TrainerCallback):
+            _is_ray_tpu_report_cb = True
+
+            def on_log(self, args, state, control, logs=None, **kwargs):
+                if logs and state.is_world_process_zero:
+                    metrics = {k: v for k, v in logs.items()
+                               if isinstance(v, (int, float))}
+                    metrics.setdefault("step", state.global_step)
+                    session.report(metrics)
+
+            def on_save(self, args, state, control, **kwargs):
+                if state.is_world_process_zero:
+                    from ray_tpu.train.checkpoint import Checkpoint
+
+                    ckpt_dir = f"{args.output_dir}/checkpoint-{state.global_step}"
+                    session.report(
+                        {"checkpoint_step": state.global_step},
+                        checkpoint=Checkpoint.from_directory(ckpt_dir))
+
+        return _Callback()
+
+
+def prepare_trainer(trainer):
+    """Attach the report callback (idempotent — adding twice would
+    double-report every log line). Returns the same trainer."""
+    _transformers()
+    already = any(getattr(cb, "_is_ray_tpu_report_cb", False)
+                  for cb in trainer.callback_handler.callbacks)
+    if not already:
+        trainer.add_callback(RayTrainReportCallback())
+    return trainer

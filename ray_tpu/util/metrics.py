@@ -85,18 +85,21 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
         self.boundaries = sorted(boundaries or [0.1, 1, 10, 100, 1000])
         self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
 
     def observe(self, value: float, tags: dict | None = None):
         key = self._tag_tuple(tags)
         counts = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
         counts[bisect.bisect_left(self.boundaries, value)] += 1
         self._values[key] = value  # last observation
+        self._sums[key] = self._sums.get(key, 0.0) + value
         self._flush_maybe()
 
     def snapshot(self) -> dict:
         base = super().snapshot()
         base["boundaries"] = self.boundaries
         base["counts"] = {json.dumps(k): v for k, v in self._counts.items()}
+        base["sums"] = {json.dumps(k): v for k, v in self._sums.items()}
         return base
 
 
@@ -112,3 +115,88 @@ def get_metrics_snapshot() -> dict:
         if v:
             out[k.decode()] = json.loads(v)
     return out
+
+
+def _prom_escape(v) -> str:
+    # Prometheus text-format label-value escaping: backslash, quote, newline.
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(tag_json: str, extra: dict[str, str]) -> str:
+    pairs = dict(tuple(p) for p in json.loads(tag_json))
+    pairs.update(extra)
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{_prom_escape(v)}"'
+                    for k, v in sorted(pairs.items()))
+    return "{" + body + "}"
+
+
+def prometheus_text() -> str:
+    """Render every published metric + built-in cluster gauges in the
+    Prometheus text exposition format (parity: reference metrics agent →
+    prometheus_exporter.py endpoint scraped by Prometheus)."""
+    import ray_tpu
+
+    lines: list[str] = []
+
+    # Built-in cluster gauges.
+    try:
+        nodes = ray_tpu.nodes()
+        alive = [n for n in nodes if n["alive"]]
+        lines.append("# TYPE ray_tpu_cluster_nodes_alive gauge")
+        lines.append(f"ray_tpu_cluster_nodes_alive {len(alive)}")
+        for field, name in (("total_resources", "total"),
+                            ("available_resources", "available")):
+            lines.append(f"# TYPE ray_tpu_cluster_resources_{name} gauge")
+            agg: dict[str, float] = {}
+            for n in alive:
+                for k, v in n[field].items():
+                    agg[k] = agg.get(k, 0.0) + v
+            for k, v in sorted(agg.items()):
+                lines.append(
+                    f'ray_tpu_cluster_resources_{name}{{resource="{k}"}} {v}')
+    except Exception:
+        pass
+
+    # Group by metric family across workers: the exposition format requires
+    # every sample of a family under ONE TYPE/HELP block.
+    families: dict[str, list[tuple[str, dict]]] = {}
+    for worker_key, metrics in sorted(get_metrics_snapshot().items()):
+        worker = worker_key.split(":", 1)[-1][:12]
+        for name, m in metrics.items():
+            families.setdefault(name, []).append((worker, m))
+
+    for name, series in sorted(families.items()):
+        first = series[0][1]
+        mtype = {"Counter": "counter", "Gauge": "gauge",
+                 "Histogram": "histogram"}.get(first["type"], "untyped")
+        if first.get("description"):
+            lines.append(f"# HELP {name} {first['description']}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for worker, m in series:
+            if mtype == "histogram":
+                bounds = m.get("boundaries", [])
+                for tag_json, counts in m.get("counts", {}).items():
+                    cum = 0
+                    for b, c in zip(bounds + [float("inf")], counts):
+                        cum += c
+                        le = "+Inf" if b == float("inf") else repr(b)
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_prom_labels(tag_json, {'worker': worker, 'le': le})}"
+                            f" {cum}")
+                    lines.append(
+                        f"{name}_count"
+                        f"{_prom_labels(tag_json, {'worker': worker})} {cum}")
+                for tag_json, s in m.get("sums", {}).items():
+                    lines.append(
+                        f"{name}_sum"
+                        f"{_prom_labels(tag_json, {'worker': worker})} {s}")
+            else:
+                for tag_json, v in m.get("values", {}).items():
+                    lines.append(
+                        f"{name}{_prom_labels(tag_json, {'worker': worker})}"
+                        f" {v}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,179 @@
+// Unit tests for the shared-memory object store (plain-assert harness —
+// the reference uses gtest, src/ray/object_manager/plasma/test/; same
+// coverage intent, no gtest dependency in this image). Built and run by
+// `make test` / `make test-asan` / `make test-tsan` (sanitizer builds are
+// the race-detection story, reference: .bazelrc:103-110 --config=tsan).
+
+#include <assert.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <unistd.h>
+
+extern "C" {
+void* store_create_arena(const char* path, uint64_t arena_size,
+                         uint32_t table_capacity);
+void* store_attach(const char* path);
+void store_detach(void* handle);
+void* store_base(void* handle);
+int store_create(void* h, const uint8_t* id, uint64_t size, uint64_t meta,
+                 uint64_t* out_off);
+int store_seal(void* h, const uint8_t* id);
+int store_get(void* h, const uint8_t* id, uint64_t* off, uint64_t* size,
+              uint64_t* meta);
+int store_release(void* h, const uint8_t* id);
+int store_contains(void* h, const uint8_t* id);
+int store_delete(void* h, const uint8_t* id, int force);
+int store_abort(void* h, const uint8_t* id);
+void store_set_auto_evict(void* h, int enabled);
+int store_lru_candidates(void* h, uint64_t needed, uint8_t* out, int max_n);
+void store_stats(void* h, uint64_t* out5);
+}
+
+static void make_id(uint8_t* id, int n) {
+  memset(id, 0, 20);
+  memcpy(id, &n, sizeof(n));
+}
+
+static const char* kPath = "/tmp/tpustore_test_arena";
+
+static void test_create_seal_get() {
+  void* h = store_create_arena(kPath, 1 << 20, 64);
+  assert(h);
+  uint8_t id[20];
+  make_id(id, 1);
+  uint64_t off = 0;
+  assert(store_create(h, id, 1000, 16, &off) == 0);
+  assert(store_contains(h, id) == 0);  // not sealed yet
+  uint8_t* base = (uint8_t*)store_base(h);
+  memset(base + off, 0xAB, 1000);
+  assert(store_seal(h, id) == 0);
+  assert(store_contains(h, id) == 1);
+  uint64_t goff, gsize, gmeta;
+  assert(store_get(h, id, &goff, &gsize, &gmeta) == 0);
+  assert(goff == off && gsize == 1000 && gmeta == 16);
+  assert(base[goff] == 0xAB);
+  // In use: non-forced delete must refuse (-6).
+  assert(store_delete(h, id, 0) == -6);
+  assert(store_release(h, id) == 0);
+  assert(store_delete(h, id, 0) == 0);
+  assert(store_contains(h, id) == 0);
+  store_detach(h);
+}
+
+static void test_attach_shares_state() {
+  void* h1 = store_create_arena(kPath, 1 << 20, 64);
+  uint8_t id[20];
+  make_id(id, 7);
+  uint64_t off;
+  assert(store_create(h1, id, 64, 0, &off) == 0);
+  assert(store_seal(h1, id) == 0);
+  void* h2 = store_attach(kPath);
+  assert(h2);
+  assert(store_contains(h2, id) == 1);
+  store_detach(h2);
+  store_detach(h1);
+}
+
+static void test_oom_and_auto_evict() {
+  void* h = store_create_arena(kPath, 1 << 20, 64);  // ~1MB heap
+  uint8_t id[20];
+  uint64_t off;
+  for (int i = 0; i < 3; i++) {
+    make_id(id, 100 + i);
+    assert(store_create(h, id, 250000, 0, &off) == 0);
+    assert(store_seal(h, id) == 0);
+  }
+  // auto_evict off: big create reports OOM (-3), victims survive.
+  store_set_auto_evict(h, 0);
+  make_id(id, 999);
+  assert(store_create(h, id, 700000, 0, &off) == -3);
+  make_id(id, 100);
+  assert(store_contains(h, id) == 1);
+  // Candidates: LRU order, enough bytes.
+  uint8_t out[64 * 20];
+  int n = store_lru_candidates(h, 500000, out, 64);
+  assert(n == 2);
+  int first;
+  memcpy(&first, out, sizeof(first));
+  assert(first == 100);  // oldest first
+  // auto_evict on: the same create succeeds by evicting.
+  store_set_auto_evict(h, 1);
+  make_id(id, 999);
+  assert(store_create(h, id, 700000, 0, &off) == 0);
+  assert(store_seal(h, id) == 0);
+  make_id(id, 100);
+  assert(store_contains(h, id) == 0);  // evicted
+  store_detach(h);
+}
+
+static void test_abort_frees() {
+  void* h = store_create_arena(kPath, 1 << 20, 64);
+  uint64_t stats[5];
+  store_stats(h, stats);
+  uint64_t in_use0 = stats[1];
+  uint8_t id[20];
+  make_id(id, 42);
+  uint64_t off;
+  assert(store_create(h, id, 5000, 0, &off) == 0);
+  assert(store_abort(h, id) == 0);
+  store_stats(h, stats);
+  assert(stats[1] == in_use0);
+  store_detach(h);
+}
+
+// Concurrency: N threads create/seal/get/release distinct objects through
+// their own attach handles — exercises the process-shared mutex (TSAN
+// target).
+struct ThreadArg {
+  int tid;
+};
+
+static void* thread_body(void* p) {
+  ThreadArg* a = (ThreadArg*)p;
+  void* h = store_attach(kPath);
+  assert(h);
+  uint8_t id[20];
+  for (int i = 0; i < 50; i++) {
+    make_id(id, a->tid * 1000 + i);
+    uint64_t off;
+    if (store_create(h, id, 512, 0, &off) != 0) continue;
+    uint8_t* base = (uint8_t*)store_base(h);
+    memset(base + off, a->tid, 512);
+    store_seal(h, id);
+    uint64_t goff, gsize, gmeta;
+    assert(store_get(h, id, &goff, &gsize, &gmeta) == 0);
+    assert(base[goff] == (uint8_t)a->tid);
+    store_release(h, id);
+    store_delete(h, id, 0);
+  }
+  store_detach(h);
+  return nullptr;
+}
+
+static void test_concurrent_clients() {
+  void* h = store_create_arena(kPath, 4 << 20, 4096);
+  pthread_t threads[8];
+  ThreadArg args[8];
+  for (int i = 0; i < 8; i++) {
+    args[i].tid = i + 1;
+    pthread_create(&threads[i], nullptr, thread_body, &args[i]);
+  }
+  for (int i = 0; i < 8; i++) pthread_join(threads[i], nullptr);
+  uint64_t stats[5];
+  store_stats(h, stats);
+  assert(stats[0] == 0);  // every object deleted
+  store_detach(h);
+}
+
+int main() {
+  test_create_seal_get();
+  test_attach_shares_state();
+  test_oom_and_auto_evict();
+  test_abort_frees();
+  test_concurrent_clients();
+  unlink(kPath);
+  printf("store_test: OK\n");
+  return 0;
+}

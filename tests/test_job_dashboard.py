@@ -119,3 +119,46 @@ def test_build_trace_events_pairs():
     x = [e for e in trace if e["ph"] == "X"]
     assert len(x) == 1 and abs(x[0]["dur"] - 0.5e6) < 1
     assert len([e for e in trace if e["ph"] == "i"]) == 1
+
+
+def test_prometheus_metrics_endpoint(ray_start_regular):
+    """/metrics serves Prometheus text exposition (parity: reference
+    metrics agent prometheus_exporter endpoint)."""
+    import time
+    import urllib.request
+
+    from ray_tpu import dashboard
+    from ray_tpu.util.metrics import Counter, Histogram
+
+    c = Counter("dash_requests_total", description="reqs",
+                tag_keys=("route",))
+    c.inc(3, tags={"route": "a"})
+    h = Histogram("dash_latency_seconds", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(5.0)
+    time.sleep(1.2)
+    c.inc(0, tags={"route": "a"})  # force a flush past the interval
+
+    port = dashboard.start(port=0)
+    try:
+        deadline = time.monotonic() + 10
+        text = ""
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            if "dash_requests_total" in text:
+                break
+            time.sleep(0.3)
+        assert "ray_tpu_cluster_nodes_alive 1" in text
+        assert 'resource="CPU"' in text
+        assert "# TYPE dash_requests_total counter" in text
+        assert 'route="a"' in text
+        assert "# TYPE dash_latency_seconds histogram" in text
+        assert 'dash_latency_seconds_bucket' in text
+        assert 'le="+Inf"' in text
+        assert "dash_latency_seconds_count" in text
+        assert "dash_latency_seconds_sum" in text
+    finally:
+        dashboard.stop()

@@ -1,0 +1,36 @@
+"""Build and run the C++ unit tests (src/*_test.cc).
+
+Sanitizer variants (`make test-asan` / `make test-tsan`) are the
+race-detection CI story (reference: .bazelrc tsan/asan configs); they run
+here only when RAY_TPU_SANITIZE=1 to keep the default suite fast.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+SRC = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", "src"))
+
+
+def _make(target: str):
+    return subprocess.run(["make", target], cwd=SRC, capture_output=True,
+                          text=True, timeout=300)
+
+
+@pytest.mark.skipif(shutil.which("make") is None or shutil.which("g++") is None,
+                    reason="native toolchain unavailable")
+def test_cpp_unit_tests():
+    res = _make("test")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "store_test: OK" in res.stdout
+    assert "scheduler_test: OK" in res.stdout
+
+
+@pytest.mark.skipif(os.environ.get("RAY_TPU_SANITIZE") != "1",
+                    reason="set RAY_TPU_SANITIZE=1 to run sanitizer builds")
+@pytest.mark.parametrize("target", ["test-asan", "test-tsan"])
+def test_cpp_sanitizers(target):
+    res = _make(target)
+    assert res.returncode == 0, res.stdout + res.stderr

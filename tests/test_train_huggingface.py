@@ -1,0 +1,61 @@
+"""HF Transformers trainer integration (parity: reference
+train/huggingface/transformers tests — callback reports into the session)."""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def test_transformers_trainer_reports(ray_start_regular, tmp_path):
+    from ray_tpu.train import ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    out_dir = str(tmp_path / "hf_out")
+
+    def train_loop(config):
+        import torch
+        from transformers import (
+            BertConfig,
+            BertForSequenceClassification,
+            Trainer,
+            TrainingArguments,
+        )
+
+        from ray_tpu.train.huggingface import prepare_trainer
+
+        cfg = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=1,
+                         num_attention_heads=2, intermediate_size=32,
+                         max_position_embeddings=16, num_labels=2)
+        model = BertForSequenceClassification(cfg)
+
+        class Toy(torch.utils.data.Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return {"input_ids": torch.randint(0, 64, (8,)),
+                        "attention_mask": torch.ones(8, dtype=torch.long),
+                        "labels": torch.tensor(i % 2)}
+
+        args = TrainingArguments(
+            output_dir=config["out_dir"], max_steps=3,
+            per_device_train_batch_size=4, logging_steps=1,
+            save_steps=3, report_to=[], use_cpu=True,
+            disable_tqdm=True)
+        trainer = Trainer(model=model, args=args, train_dataset=Toy())
+        trainer = prepare_trainer(trainer)
+        trainer = prepare_trainer(trainer)  # idempotent
+        n_ours = sum("_Callback" in type(cb).__name__
+                     for cb in trainer.callback_handler.callbacks)
+        assert n_ours == 1
+        trainer.train()
+
+    result = TorchTrainer(
+        train_loop, train_loop_config={"out_dir": out_dir},
+        scaling_config=ScalingConfig(num_workers=1)).fit()
+    # HF loss logs surfaced through session.report.
+    assert result.metrics, "no metrics reported"
+    assert "loss" in result.metrics or "train_loss" in result.metrics or \
+        "checkpoint_step" in result.metrics, result.metrics
