@@ -58,6 +58,11 @@ class Config:
     # (reference: task_manager.cc lineage pinning).
     max_lineage_bytes: int = 64 * 1024 * 1024
 
+    # Where object-store arena files live. Empty = auto: /dev/shm when
+    # available (tmpfs — mmap writes at memory speed, like plasma), else
+    # the session dir (disk-backed, ~10x slower puts).
+    object_store_dir: str = ""
+
     # --- memory monitor (reference: memory_monitor.h:52,
     # worker_killing_policy.h:34) ---
     # Kill workers when system memory usage exceeds this fraction;

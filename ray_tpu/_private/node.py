@@ -66,6 +66,14 @@ class NodeHandle:
             self.proc.wait(timeout=5)
         except Exception:
             pass
+        # A SIGKILLed raylet never unlinks its arena; do it here so dead
+        # clusters don't pin /dev/shm memory.
+        import os
+
+        try:
+            os.unlink(self.store_path)
+        except OSError:
+            pass
 
 
 class RuntimeNode:
@@ -110,9 +118,8 @@ class RuntimeNode:
             cmd.append("--head")
         proc, line = _spawn_with_ready(
             cmd, os.path.join(self.session_dir, "logs", f"raylet-{node_id[:8]}.log"))
-        host, port, nid = line.rsplit(":", 2)
-        handle = NodeHandle(proc, nid, host, int(port),
-                            os.path.join(self.session_dir, f"store-{nid[:12]}"))
+        host, port, nid, store_path = line.split(":", 3)
+        handle = NodeHandle(proc, nid, host, int(port), store_path)
         self.nodes.append(handle)
         return handle
 

@@ -136,7 +136,25 @@ class Raylet:
             resources = {"CPU": float(os.cpu_count() or 1)}
         self.total_resources = normalize_resources(resources)
         self.available = dict(self.total_resources)
-        self.store_path = os.path.join(session_dir, f"store-{self.node_id[:12]}")
+        # Arena on tmpfs when possible (reference: plasma allocates on
+        # /dev/shm; a disk-backed mmap makes every put run at disk speed).
+        store_dir = self.config.object_store_dir
+        if not store_dir:
+            # tmpfs must actually FIT the arena: a sparse file larger than
+            # /dev/shm SIGBUSes on first write past capacity (containers
+            # often cap /dev/shm at 64MB).
+            arena_size = int(self.total_resources.get(
+                "object_store_memory", self.config.object_store_memory))
+            store_dir = session_dir
+            try:
+                if os.access("/dev/shm", os.W_OK):
+                    st = os.statvfs("/dev/shm")
+                    if st.f_bavail * st.f_frsize >= arena_size + (64 << 20):
+                        store_dir = "/dev/shm"
+            except OSError:
+                pass
+        self.store_path = os.path.join(store_dir,
+                                       f"ray_tpu-store-{self.node_id[:12]}")
         self.store: ObjectStoreClient | None = None
         self.workers: dict[str, WorkerHandle] = {}
         self.idle_workers: deque[WorkerHandle] = deque()
@@ -246,6 +264,12 @@ class Raylet:
             await self.gcs_conn.close()
         if self.store:
             self.store.close()
+            # The arena may live on /dev/shm — unlink it so dead clusters
+            # don't pin tmpfs memory.
+            try:
+                os.unlink(self.store_path)
+            except OSError:
+                pass
 
     # ---------- gcs sync ----------
 
@@ -1075,7 +1099,9 @@ def main():
             is_head=args.head)
         host, port = await raylet.start(args.host, args.port)
         if args.ready_fd >= 0:
-            os.write(args.ready_fd, f"{host}:{port}:{raylet.node_id}\n".encode())
+            os.write(args.ready_fd,
+                     f"{host}:{port}:{raylet.node_id}:"
+                     f"{raylet.store_path}\n".encode())
             os.close(args.ready_fd)
         await asyncio.Event().wait()
 

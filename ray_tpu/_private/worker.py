@@ -316,27 +316,55 @@ class CoreWorker:
                 return
 
     def get(self, refs: list, timeout: float | None = None):
-        """refs: list of (ObjectID, owner Address). Returns list of values."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        """refs: list of (ObjectID, owner Address). Returns list of values.
+
+        All fetches run concurrently on the IO loop (one threadsafe
+        round-trip total; remote pulls overlap — reference: Get batches
+        plasma + remote fetches, core_worker.cc:1353)."""
+        async def fetch_all():
+            return await asyncio.gather(
+                *(self._fetch_object(oid, owner, timeout)
+                  for oid, owner in refs), return_exceptions=True)
+
+        fetched = self._run(fetch_all(),
+                            None if timeout is None else timeout + 5)
+        def release_unconsumed(upto: int):
+            # Drop shm pins this call acquired but will not hand out —
+            # every fetch from `upto` on, plus any consumed-but-unpinned
+            # earlier ones are already handled. A retried get re-pins.
+            for (oid, _), f in zip(refs[upto:], fetched[upto:]):
+                if not isinstance(f, BaseException) and f[2] is not None:
+                    self.store.release(oid)
+
+        first_err = next((f for f in fetched if isinstance(f, BaseException)),
+                         None)
+        if first_err is not None:
+            release_unconsumed(0)
+            raise first_err
         out = []
-        for oid, owner in refs:
-            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            meta, data, pin = self._run(
-                self._fetch_object(oid, owner, remaining),
-                None if remaining is None else remaining + 5)
-            kind, value = serialization.deserialize(meta, data)
-            if pin is not None and _has_buffers(meta):
-                self._pinned_reads.add(oid.hex())
-            elif pin is not None:
-                self.store.release(oid)
-            if kind == serialization.KIND_EXCEPTION:
-                cause, tb = value
-                if isinstance(cause, exc.RayTpuError):
-                    # System errors (actor death, object loss, OOM, ...)
-                    # propagate as themselves, matching the reference where
-                    # ray.get raises RayActorError etc. directly.
-                    raise cause
-                raise exc.TaskError(cause, tb)
+        for i, ((oid, _owner), (meta, data, pin)) in enumerate(
+                zip(refs, fetched)):
+            try:
+                kind, value = serialization.deserialize(meta, data)
+                if pin is not None and _has_buffers(meta):
+                    self._pinned_reads.add(oid.hex())
+                elif pin is not None:
+                    self.store.release(oid)
+                    pin = None
+                if kind == serialization.KIND_EXCEPTION:
+                    cause, tb = value
+                    if isinstance(cause, exc.RayTpuError):
+                        # System errors (actor death, object loss, OOM, ...)
+                        # propagate as themselves, matching the reference
+                        # where ray.get raises RayActorError etc. directly.
+                        raise cause
+                    raise exc.TaskError(cause, tb)
+            except BaseException:
+                if pin is not None:
+                    self._pinned_reads.discard(oid.hex())
+                    self.store.release(oid)
+                release_unconsumed(i + 1)
+                raise
             out.append(value)
         return out
 

@@ -23,6 +23,12 @@ from ray_tpu.models.moe import (
     MoEModel,
     moe_aux_loss,
 )
+from ray_tpu.models.dit import (
+    DiT,
+    DiTConfig,
+    ddim_sample,
+    ddpm_loss,
+)
 from ray_tpu.models.generate import Generator, SamplingParams, generate
 from ray_tpu.models.vit import (
     VIT_B16,
@@ -40,4 +46,5 @@ __all__ = [
     "moe_aux_loss",
     "Generator", "SamplingParams", "generate",
     "ViT", "ViTConfig", "VIT_B16", "VIT_L16", "VIT_TINY", "vit_loss",
+    "DiT", "DiTConfig", "ddpm_loss", "ddim_sample",
 ]

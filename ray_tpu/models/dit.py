@@ -1,0 +1,230 @@
+"""DiT: diffusion transformer (image generation), TPU-first.
+
+The reference framework ships no generative-image models (its model zoo is
+RL-oriented; diffusion appears only in release-test user code) — this is a
+framework-native family alongside the Llama decoder, MoE, and ViT: flax
+modules sized for the MXU (head_dim 64-128, bf16), flash attention from
+`ray_tpu.ops`, and a jittable DDPM noise-prediction loss + DDIM sampler so
+training runs under the same `pjit` train-step machinery
+(ray_tpu.train.spmd) as the language models.
+
+Architecture follows the DiT recipe (Peebles & Xie 2022, public): patchify
+→ N transformer blocks with adaptive layer norm conditioned on (timestep,
+class) → unpatchify to the noise prediction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class DiTConfig:
+    image_size: int = 32
+    channels: int = 3
+    patch_size: int = 4
+    d_model: int = 384
+    n_layers: int = 6
+    n_heads: int = 6
+    num_classes: int = 10          # 0 disables class conditioning
+    timesteps: int = 1000
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep embedding. t: (B,) float32 in [0, timesteps)."""
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class AdaLNBlock(nn.Module):
+    """Transformer block with adaLN-Zero conditioning (DiT block)."""
+
+    cfg: DiTConfig
+
+    @nn.compact
+    def __call__(self, x, cond):
+        cfg = self.cfg
+        B, S, D = x.shape
+        # 6 modulation vectors from the conditioning signal; the projection
+        # initializes to zero so each block starts as identity (adaLN-Zero).
+        mod = nn.Dense(6 * D, kernel_init=nn.initializers.zeros,
+                       dtype=jnp.float32, name="adaLN")(nn.silu(cond))
+        shift1, scale1, gate1, shift2, scale2, gate2 = jnp.split(
+            mod[:, None, :], 6, axis=-1)
+
+        h = nn.LayerNorm(use_bias=False, use_scale=False,
+                         dtype=jnp.float32)(x)
+        h = (h * (1 + scale1) + shift1).astype(cfg.dtype)
+        qkv = nn.Dense(3 * D, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv.reshape(B, S, 3 * cfg.n_heads, cfg.head_dim)
+                            .transpose(0, 2, 1, 3), 3, axis=1)
+        if cfg.attention == "flash":
+            attn = flash_attention(q, k, v)
+        else:
+            attn = mha_reference(q, k, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+        attn = nn.Dense(D, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.dtype, name="proj")(attn)
+        x = x + gate1.astype(cfg.dtype) * attn
+
+        h = nn.LayerNorm(use_bias=False, use_scale=False,
+                         dtype=jnp.float32)(x)
+        h = (h * (1 + scale2) + shift2).astype(cfg.dtype)
+        h = nn.Dense(4 * D, dtype=cfg.dtype, param_dtype=cfg.dtype,
+                     name="mlp_in")(h)
+        h = nn.Dense(D, dtype=cfg.dtype, param_dtype=cfg.dtype,
+                     name="mlp_out")(nn.gelu(h))
+        return x + gate2.astype(cfg.dtype) * h
+
+
+class DiT(nn.Module):
+    cfg: DiTConfig
+
+    @nn.compact
+    def __call__(self, images, t, labels=None):
+        """images: (B, H, W, C) noisy input; t: (B,) timesteps;
+        labels: (B,) int class ids or None. Returns predicted noise
+        (B, H, W, C) in float32."""
+        cfg = self.cfg
+        B, H, W, C = images.shape
+        p = cfg.patch_size
+        # Patchify: (B, H/p * W/p, p*p*C)
+        x = images.reshape(B, H // p, p, W // p, p, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, cfg.num_patches,
+                                                  p * p * C)
+        x = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.dtype,
+                     name="patch_embed")(x.astype(cfg.dtype))
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, cfg.num_patches, cfg.d_model), cfg.dtype)
+        x = x + pos
+
+        cond = nn.Dense(cfg.d_model, dtype=jnp.float32, name="t_embed")(
+            timestep_embedding(t, cfg.d_model))
+        if cfg.num_classes and labels is not None:
+            # Label dropout trains the unconditional branch for CFG; the
+            # extra row is the null class.
+            emb = nn.Embed(cfg.num_classes + 1, cfg.d_model,
+                           dtype=jnp.float32, name="label_embed")
+            cond = cond + emb(labels)
+
+        for i in range(cfg.n_layers):
+            x = AdaLNBlock(cfg, name=f"blocks_{i}")(x, cond)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_norm")(
+            x.astype(jnp.float32))
+        x = nn.Dense(p * p * C, kernel_init=nn.initializers.zeros,
+                     dtype=jnp.float32, name="final_proj")(x)
+        # Unpatchify.
+        x = x.reshape(B, H // p, W // p, p, p, C)
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H, W, C)
+
+
+# ---------------------------------------------------------------------------
+# DDPM training + DDIM sampling
+# ---------------------------------------------------------------------------
+
+
+def diffusion_schedule(cfg: DiTConfig):
+    """Cosine alpha-bar schedule (Nichol & Dhariwal)."""
+    t = jnp.linspace(0, 1, cfg.timesteps + 1)
+    f = jnp.cos((t + 0.008) / 1.008 * jnp.pi / 2) ** 2
+    alpha_bar = f / f[0]
+    return jnp.clip(alpha_bar, 1e-5, 1.0)
+
+
+def ddpm_loss(model: DiT, params, images, labels, rng,
+              label_drop_prob: float = 0.1):
+    """Noise-prediction MSE at uniformly sampled timesteps."""
+    cfg = model.cfg
+    B = images.shape[0]
+    rng_t, rng_n, rng_d = jax.random.split(rng, 3)
+    t = jax.random.randint(rng_t, (B,), 0, cfg.timesteps)
+    # Schedule has T+1 entries with alpha_bar[0] == 1 (zero noise); index
+    # t+1 so every training sample carries noise to predict.
+    alpha_bar = diffusion_schedule(cfg)[t + 1][:, None, None, None]
+    noise = jax.random.normal(rng_n, images.shape, jnp.float32)
+    noisy = jnp.sqrt(alpha_bar) * images + jnp.sqrt(1 - alpha_bar) * noise
+    if cfg.num_classes and labels is not None:
+        drop = jax.random.bernoulli(rng_d, label_drop_prob, (B,))
+        labels = jnp.where(drop, cfg.num_classes, labels)  # null class
+    pred = model.apply(params, noisy, t.astype(jnp.float32), labels)
+    return jnp.mean((pred - noise) ** 2)
+
+
+def ddim_sample(model: DiT, params, rng, *, num: int, steps: int = 50,
+                labels=None, guidance: float = 0.0):
+    """Deterministic DDIM sampler; classifier-free guidance when
+    guidance > 0 and labels given. Fixed shapes / lax.scan — jittable."""
+    cfg = model.cfg
+    alpha_bar = diffusion_schedule(cfg)
+    # Walk alpha_bar indices T..1; the final target index 0 (alpha_bar=1)
+    # is x0 itself, so no step is wasted on a no-op.
+    ts = jnp.linspace(cfg.timesteps, 1, steps).astype(jnp.int32)
+    shape = (num, cfg.image_size, cfg.image_size, cfg.channels)
+    x = jax.random.normal(rng, shape, jnp.float32)
+
+    null = None if labels is None else jnp.full_like(labels, cfg.num_classes)
+
+    def eps_fn(x, t_batch):
+        eps = model.apply(params, x, t_batch, labels)
+        if guidance > 0 and labels is not None:
+            eps_u = model.apply(params, x, t_batch, null)
+            eps = eps_u + (1 + guidance) * (eps - eps_u)
+        return eps
+
+    def body(x, i):
+        t = ts[i]
+        t_next = jnp.where(i + 1 < steps, ts[jnp.minimum(i + 1, steps - 1)], 0)
+        ab_t = alpha_bar[t]
+        ab_n = jnp.where(i + 1 < steps, alpha_bar[t_next], 1.0)
+        # Training conditions on t with noise level alpha_bar[t+1]; here the
+        # noise level is alpha_bar[t], so condition on t-1.
+        t_batch = jnp.full((num,), t - 1, jnp.float32)
+        eps = eps_fn(x, t_batch)
+        x0 = (x - jnp.sqrt(1 - ab_t) * eps) / jnp.sqrt(ab_t)
+        x0 = jnp.clip(x0, -3.0, 3.0)
+        x = jnp.sqrt(ab_n) * x0 + jnp.sqrt(1 - ab_n) * eps
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, jnp.arange(steps))
+    return x
+
+
+def count_dit_params(cfg: DiTConfig) -> int:
+    D = cfg.d_model
+    p2c = cfg.patch_size ** 2 * cfg.channels
+    per_block = (
+        6 * D * D + 6 * D          # adaLN kernel + bias
+        + 3 * D * D                # qkv (no bias)
+        + D * D                    # attn out proj (no bias)
+        + 4 * D * D + 4 * D        # mlp_in kernel + bias
+        + 4 * D * D + D)           # mlp_out kernel + bias
+    extra = (
+        p2c * D + D                # patch embed (+bias)
+        + cfg.num_patches * D      # positional embedding
+        + D * D + D                # t_embed
+        + ((cfg.num_classes + 1) * D if cfg.num_classes else 0)
+        + 2 * D                    # final_norm scale+bias
+        + D * p2c + p2c)           # final proj (+bias)
+    return cfg.n_layers * per_block + extra
