@@ -28,7 +28,7 @@ def init(address: str | None = None, *, resources: dict | None = None,
          labels: dict | None = None, num_cpus: float | None = None,
          object_store_memory: int | None = None, namespace: str | None = None,
          config: Config | None = None, ignore_reinit_error: bool = False,
-         log_to_driver: bool = True, runtime_env: dict | None = None,
+         log_to_driver: bool | None = None, runtime_env: dict | None = None,
          _head_raylet: tuple[str, int] | None = None,
          _store_path: str | None = None, _node_id: str | None = None):
     """Start (or connect to) a cluster and attach this process as a driver.
@@ -49,6 +49,8 @@ def init(address: str | None = None, *, resources: dict | None = None,
         cfg = config or Config()
         if object_store_memory:
             cfg.object_store_memory = int(object_store_memory)
+        if log_to_driver is not None:  # explicit kwarg wins over Config
+            cfg.log_to_driver = bool(log_to_driver)
         if address is None:
             node = RuntimeNode(cfg)
             gcs_host, gcs_port = node.start_gcs()

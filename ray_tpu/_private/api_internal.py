@@ -72,9 +72,16 @@ class ObjectRef:
                 pass
 
     def __reduce__(self):
-        # Nested-ref serialization: reconstructs on the far side without
-        # owner-side borrow accounting (round-1 simplification; the owner
-        # must keep the object alive, e.g. by holding the ref).
+        # Nested-ref serialization (ref inside a return value / argument
+        # payload): pin the object owner-side for the job lifetime so the
+        # far side can always resolve it — the round-1 stand-in for the
+        # reference's full borrower protocol (reference_count.cc). Without
+        # this, returning a put() ref from a task frees the object the
+        # moment the task's local variable dies.
+        cw = _core_worker
+        if cw is not None and self.owner is not None \
+                and self.owner.worker_id == cw.worker_id:
+            cw.pin_nested_ref(self.id.hex())
         return (_rebuild_object_ref,
                 (self.id.binary(), self.owner.to_wire() if self.owner else None))
 
@@ -356,6 +363,7 @@ class ActorClass:
             placement_group=pg_id,
             pg_bundle_index=bundle_index,
             runtime_env=_effective_runtime_env(self._opts["runtime_env"]),
+            max_concurrency=int(self._opts["max_concurrency"] or 1),
         )
         from ray_tpu.util import tracing
 

@@ -107,6 +107,9 @@ class TaskSpec:
     # W3C traceparent of the submitting span (reference: tracing context
     # propagates inside the TaskSpec, tracing_helper.py).
     trace_ctx: str = ""
+    # Actor creation only: how many tasks may execute concurrently on the
+    # actor (reference: max_concurrency / async actors, fiber.h).
+    max_concurrency: int = 1
 
     def to_wire(self):
         return [
@@ -115,7 +118,7 @@ class TaskSpec:
             self.retry_exceptions, self.owner, self.actor_id, self.actor_creation,
             self.actor_seq, self.max_restarts, self.max_task_retries, self.strategy,
             self.placement_group, self.pg_bundle_index, self.runtime_env,
-            self.trace_ctx,
+            self.trace_ctx, self.max_concurrency,
         ]
 
     @classmethod

@@ -392,6 +392,60 @@ class Raylet:
 
     # ---------- worker pool ----------
 
+    async def _tail_worker_log(self, w: WorkerHandle, log_path: str):
+        """Tail a worker's log file, publishing appended lines to the GCS
+        LOGS channel — the driver prints them (reference: log_monitor.py
+        tails per-pid worker logs and publishes via GCS pubsub)."""
+        pos = 0
+        carry = b""  # partial trailing line from the previous chunk
+
+        async def drain_once():
+            nonlocal pos, carry
+            try:
+                size = os.path.getsize(log_path)
+            except OSError:
+                return
+            while pos < size:
+                with open(log_path, "rb") as f:
+                    f.seek(pos)
+                    chunk = f.read(min(size - pos, 256 * 1024))
+                if not chunk:
+                    return
+                pos += len(chunk)
+                data = carry + chunk
+                # Keep an unterminated final line for the next read.
+                nl = data.rfind(b"\n")
+                if nl < 0:
+                    carry = data
+                    continue
+                carry = data[nl + 1:]
+                lines = data[:nl].decode("utf-8", "replace").splitlines()
+                for s in range(0, len(lines), 200):
+                    if self.gcs_conn and not self.gcs_conn.closed:
+                        await self.gcs_conn.call("Publish", {
+                            "channel": "LOGS",
+                            "message": {"worker_id": w.worker_id,
+                                        "node_id": self.node_id,
+                                        "pid": w.proc.pid,
+                                        "lines": lines[s:s + 200]}})
+
+        try:
+            while not w.dead:
+                await asyncio.sleep(0.3)
+                await drain_once()
+            # Final drain: exit flushes the worker's last buffered output.
+            await drain_once()
+            if carry and self.gcs_conn and not self.gcs_conn.closed:
+                await self.gcs_conn.call("Publish", {
+                    "channel": "LOGS",
+                    "message": {"worker_id": w.worker_id,
+                                "node_id": self.node_id, "pid": w.proc.pid,
+                                "lines": [carry.decode("utf-8", "replace")]}})
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass
+
     def _spawn_worker(self) -> WorkerHandle:
         from ray_tpu._private.ids import WorkerID
 
@@ -406,6 +460,9 @@ class Raylet:
             "RAY_TPU_GCS_PORT": str(self.gcs_port),
             "RAY_TPU_STORE_PATH": self.store_path,
             "RAY_TPU_SESSION_DIR": self.session_dir,
+            # Logs stream to the driver via the tail loop; block-buffered
+            # stdout would hold lines back for ~8KB.
+            "PYTHONUNBUFFERED": "1",
         })
         log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
@@ -417,6 +474,8 @@ class Raylet:
         log_file.close()
         w = WorkerHandle(proc, worker_id)
         self.workers[worker_id] = w
+        self._tasks.append(
+            asyncio.ensure_future(self._tail_worker_log(w, log_path)))
         return w
 
     def _kill_worker(self, w: WorkerHandle):

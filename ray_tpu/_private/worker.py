@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import itertools
 import logging
 import os
 import queue as _queue
@@ -120,9 +121,10 @@ class CoreWorker:
         self._lineage_bytes = 0
         self.actor_handles_state: dict[str, dict] = {}  # actor_id -> conn/seq/queue
         self._fn_cache: dict[str, object] = {}
-        self._put_index = 0
-        self._task_index = 0
-        self._current_task_id = TaskID.from_random()
+        self._put_counter = itertools.count(1)
+        self._task_counter = itertools.count(1)
+        self._default_task_id = TaskID.from_random()
+        self._exec_tls = threading.local()  # per-thread current task id
         # Pinned shm reads: objects whose zero-copy buffers escaped to user
         # code; we hold the shm ref for process lifetime (see module docs).
         self._pinned_reads: set[str] = set()
@@ -182,7 +184,10 @@ class CoreWorker:
             handlers={"Publish": self._on_gcs_publish},
             name=f"w{self.worker_id[:8]}->gcs",
             timeout=self.config.rpc_connect_timeout_s)
-        await self.gcs.call("Subscribe", {"channels": ["ACTOR"]})
+        channels = ["ACTOR"]
+        if self.is_driver and self.config.log_to_driver:
+            channels.append("LOGS")
+        await self.gcs.call("Subscribe", {"channels": channels})
         # The raylet pushes AssignActor/Exit over this same connection, so
         # it carries the worker's full handler table.
         self.raylet = await rpc.connect_retry(
@@ -264,9 +269,19 @@ class CoreWorker:
 
     # ---------- put / get / wait ----------
 
+    @property
+    def _current_task_id(self) -> TaskID:
+        # Thread-local: concurrent actor tasks (max_concurrency > 1) each
+        # carry their own task id for puts/lineage attribution.
+        return getattr(self._exec_tls, "task_id", None) or self._default_task_id
+
+    @_current_task_id.setter
+    def _current_task_id(self, value) -> None:
+        self._exec_tls.task_id = value
+
     def put(self, value) -> "tuple[ObjectID, Address]":
-        self._put_index += 1
-        oid = ObjectID.for_put(self._current_task_id, self._put_index)
+        oid = ObjectID.for_put(self._current_task_id,
+                               next(self._put_counter))
         sobj = serialization.serialize(value)
         self._run(self._store_owned(oid, sobj))
         return oid, self.address
@@ -525,9 +540,35 @@ class CoreWorker:
     # ---------- ref counting ----------
 
     def add_local_ref(self, oid_hex: str):
+        """Thread-safe: counts mutate on the IO loop only. Post order is
+        creation order per ref, so a later remove can never overtake its
+        add in the loop's FIFO."""
+        try:
+            self.loop.call_soon_threadsafe(self._add_local_ref_impl, oid_hex)
+        except RuntimeError:
+            pass
+
+    def _add_local_ref_impl(self, oid_hex: str):
         o = self.objects.get(oid_hex)
         if o is not None:
             o.local_refs += 1
+
+    def pin_nested_ref(self, oid_hex: str):
+        """Job-lifetime pin for a ref serialized into a payload (may be
+        called from exec threads; the count mutates on the IO loop)."""
+        self.add_local_ref(oid_hex)
+
+    def bump_submitted_ref(self, oid_hex: str):
+        """Thread-safe submitted_refs increment (submissions may originate
+        on concurrent actor exec threads)."""
+        def bump():
+            o = self.objects.get(oid_hex)
+            if o is not None:
+                o.submitted_refs += 1
+        try:
+            self.loop.call_soon_threadsafe(bump)
+        except RuntimeError:
+            pass
 
     def remove_local_ref(self, oid_hex: str):
         if self._shutdown:
@@ -584,9 +625,9 @@ class CoreWorker:
     # ---------- task submission (owner side) ----------
 
     def next_task_id(self) -> TaskID:
-        self._task_index += 1
         h = hashlib.sha1(
-            self._current_task_id.binary() + self._task_index.to_bytes(8, "big"))
+            self._current_task_id.binary()
+            + next(self._task_counter).to_bytes(8, "big"))
         return TaskID(h.digest()[:TaskID.SIZE])
 
     def serialize_args(self, args: tuple, kwargs: dict):
@@ -600,9 +641,7 @@ class CoreWorker:
             if isinstance(a, ObjectRef):
                 wire.append(["r", a.id.hex(), a.owner.to_wire() if a.owner else None])
                 deps.append(a.id.hex())
-                o = self.objects.get(a.id.hex())
-                if o is not None:
-                    o.submitted_refs += 1
+                self.bump_submitted_ref(a.id.hex())
             else:
                 sobj = serialization.serialize(a)
                 if sobj.total_size > self.config.max_inline_object_size:
@@ -611,9 +650,7 @@ class CoreWorker:
                     oid, owner = self.put(a)
                     wire.append(["r", oid.hex(), owner.to_wire()])
                     deps.append(oid.hex())
-                    o = self.objects.get(oid.hex())
-                    if o is not None:
-                        o.submitted_refs += 1
+                    self.bump_submitted_ref(oid.hex())
                 else:
                     wire.append(["v", sobj.meta, sobj.to_bytes()])
         return wire, list(kwargs.keys()), deps
@@ -924,6 +961,37 @@ class CoreWorker:
             self.loop.call_soon_threadsafe(
                 lambda f=fut, r=result: (not f.done()) and f.set_result(r))
 
+    def _start_actor_concurrency(self, max_concurrency: int) -> None:
+        """Spawn extra execution threads so up to max_concurrency actor
+        tasks run at once (reference: threaded actors / concurrency
+        groups). Delivery order from each caller is still FIFO — tasks are
+        STARTED in order and may then overlap, the reference's semantics
+        for concurrent actors."""
+        n = min(int(max_concurrency or 1), 64)
+        if n <= 1 or getattr(self, "_extra_exec_threads", None):
+            return
+        self._extra_exec_threads = []
+        for i in range(n - 1):
+            t = threading.Thread(target=self.execution_loop, daemon=True,
+                                 name=f"actor-exec-{i}")
+            t.start()
+            self._extra_exec_threads.append(t)
+
+    _actor_loop_lock = threading.Lock()
+
+    def _actor_async_loop(self) -> asyncio.AbstractEventLoop:
+        # Locked lazy init: concurrent first async calls must share ONE
+        # loop (async-actor code relies on single-loop interleaving).
+        with self._actor_loop_lock:
+            loop = getattr(self, "_actor_loop", None)
+            if loop is None:
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(target=loop.run_forever, daemon=True,
+                                     name="actor-asyncio")
+                t.start()
+                self._actor_loop = loop
+            return loop
+
     def _resolve_args(self, spec: TaskSpec):
         from ray_tpu._private.api_internal import ObjectRef
 
@@ -984,6 +1052,7 @@ class CoreWorker:
                     with tracing.execute_span(spec.name, spec.task_id,
                                               spec.trace_ctx):
                         self._actor_instance = cls(*args, **kwargs)
+                self._start_actor_concurrency(spec.max_concurrency)
                 return {"status": "ok", "results": []}
             if spec.actor_id:
                 fn = getattr(self._actor_instance, spec.name.split(".")[-1])
@@ -991,6 +1060,13 @@ class CoreWorker:
                 with tracing.execute_span(spec.name, spec.task_id,
                                           spec.trace_ctx):
                     result = fn(*args, **kwargs)
+                    if asyncio.iscoroutine(result):
+                        # async actor method: run on the actor's event
+                        # loop; concurrent calls (one per exec thread)
+                        # interleave at await points (reference: asyncio
+                        # actors, fiber.h).
+                        result = asyncio.run_coroutine_threadsafe(
+                            result, self._actor_async_loop()).result()
             else:
                 fn = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
@@ -1113,6 +1189,14 @@ class CoreWorker:
         }))
 
     async def _on_gcs_publish(self, conn, payload):
+        if payload.get("channel") == "LOGS":
+            # Worker stdout/stderr streamed to the driver (reference:
+            # log_monitor lines are printed with (pid=..., ip=...) prefixes).
+            msg = payload["message"]
+            prefix = f"(pid={msg.get('pid')}, node={msg.get('node_id', '')[:8]})"
+            for line in msg.get("lines", []):
+                print(f"{prefix} {line}", flush=True)
+            return
         if payload.get("channel") != "ACTOR":
             return
         msg = payload["message"]

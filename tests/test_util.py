@@ -174,3 +174,29 @@ def test_workflow_catch_exceptions(ray_start_regular, tmp_path):
         workflow.run(always_fails.step(), workflow_id="wf2",
                      storage=str(tmp_path / "wf"))
     assert workflow.get_status("wf2", storage=str(tmp_path / "wf")) == "FAILED"
+
+
+def test_worker_logs_stream_to_driver(ray_start_regular, capfd):
+    """print() inside a task shows up on the driver with a (pid=, node=)
+    prefix (parity: reference log_monitor → driver streaming)."""
+    import time
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def chatty():
+        print("log-streaming-sentinel-xyz")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 10
+    out = ""
+    while time.monotonic() < deadline:
+        out += capfd.readouterr().out
+        if "log-streaming-sentinel-xyz" in out:
+            break
+        time.sleep(0.2)
+    assert "log-streaming-sentinel-xyz" in out
+    line = next(l for l in out.splitlines()
+                if "log-streaming-sentinel-xyz" in l)
+    assert line.startswith("(pid=")
