@@ -70,6 +70,11 @@ class Config:
     memory_usage_threshold: float = 0.95
     memory_monitor_period_s: float = 1.0
 
+    # How long raylets/workers keep retrying to reach a restarting GCS
+    # before giving up (reference: raylets survive GCS restarts and resync,
+    # node_manager.cc:1168 NotifyGCSRestart).
+    gcs_reconnect_timeout_s: float = 60.0
+
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0

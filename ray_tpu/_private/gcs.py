@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from collections import defaultdict, deque
 
@@ -51,8 +52,15 @@ PG_REMOVED = "REMOVED"
 
 
 class GcsServer:
-    def __init__(self, config: Config | None = None):
+    def __init__(self, config: Config | None = None,
+                 persistence_path: str | None = None):
         self.config = config or Config()
+        # File-backed metadata persistence (the reference's Redis-backed
+        # gcs_table_storage role): tables snapshot here so a restarted GCS
+        # resumes with its actor/PG/KV/job state; raylets re-register
+        # (reference: NotifyGCSRestart resync, node_manager.cc:1168).
+        self.persistence_path = persistence_path
+        self._dirty = False
         self.nodes: dict[str, NodeInfo] = {}
         self.node_conns: dict[str, rpc.Connection] = {}
         self.kv: dict[str, dict[bytes, bytes]] = defaultdict(dict)
@@ -79,8 +87,26 @@ class GcsServer:
         except Exception:
             logger.info("native scheduler unavailable; using Python policies")
 
+    _MUTATING = {
+        "RegisterNode", "NotifyNodeDead", "KVPut", "KVDel", "RegisterActor",
+        "ActorReady", "ReportActorDeath", "KillActor", "RegisterJob",
+        "FinishJob", "CreatePlacementGroup", "RemovePlacementGroup",
+    }
+
     def _handlers(self):
-        return {
+        def wrap(name, fn):
+            if name not in self._MUTATING:
+                return fn
+
+            async def dirty(conn, payload, fn=fn):
+                try:
+                    return await fn(conn, payload)
+                finally:
+                    self.mark_dirty()
+
+            return dirty
+
+        return {name: wrap(name, fn) for name, fn in {
             "RegisterNode": self.handle_register_node,
             "Heartbeat": self.handle_heartbeat,
             "GetAllNodes": self.handle_get_all_nodes,
@@ -111,18 +137,134 @@ class GcsServer:
             "ListTaskEvents": self.handle_list_task_events,
             "GetClusterStatus": self.handle_get_cluster_status,
             "GetConfig": self.handle_get_config,
-        }
+        }.items()}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        if self.persistence_path:
+            self._load_state()
         addr = await self._server.start(host, port)
         self._health_task = asyncio.create_task(self._health_check_loop())
+        if self.persistence_path:
+            self._persist_task = asyncio.create_task(self._persist_loop())
+            asyncio.ensure_future(self._reap_restored_nodes())
         logger.info("GCS listening on %s:%s", *addr)
         return addr
 
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if getattr(self, "_persist_task", None):
+            self._persist_task.cancel()
         await self._server.stop()
+
+    # ---------- persistence ----------
+
+    def mark_dirty(self):
+        self._dirty = True
+
+    def _snapshot(self) -> dict:
+        actors = {}
+        for aid, a in self.actors.items():
+            a = dict(a)
+            if isinstance(a.get("dead_worker_ids"), set):
+                a["dead_worker_ids"] = sorted(a["dead_worker_ids"])
+            actors[aid] = a
+        return {
+            "kv": {ns: dict(table) for ns, table in self.kv.items()},
+            "actors": actors,
+            "named_actors": [[list(k), v] for k, v in self.named_actors.items()],
+            "jobs": self.jobs,
+            "placement_groups": self.placement_groups,
+            "nodes": [n.to_wire() for n in self.nodes.values()],
+        }
+
+    def _load_state(self):
+        try:
+            with open(self.persistence_path, "rb") as f:
+                snap = rpc.unpack(f.read())
+        except FileNotFoundError:
+            return  # first start of this session
+        except Exception:
+            logger.exception("GCS persistence read failed; starting fresh")
+            return
+
+        for ns, table in snap.get("kv", {}).items():
+            self.kv[ns] = {(k if isinstance(k, bytes) else k.encode()): v
+                           for k, v in table.items()}
+        for aid, a in snap.get("actors", {}).items():
+            a["dead_worker_ids"] = set(a.get("dead_worker_ids", ()))
+            self.actors[aid] = a
+        for k, v in snap.get("named_actors", []):
+            self.named_actors[tuple(k)] = v
+        self.jobs.update(snap.get("jobs", {}))
+        self.placement_groups.update(snap.get("placement_groups", {}))
+        for w in snap.get("nodes", []):
+            info = NodeInfo(
+                node_id=w["node_id"], host=w["host"],
+                raylet_port=w["raylet_port"],
+                total_resources=w["total_resources"],
+                available_resources=w["available_resources"],
+                labels=w.get("labels") or {}, store_path=w.get("store_path", ""),
+                is_head=w.get("is_head", False))
+            # Nodes come back when their raylet re-registers; stale-alive
+            # entries would mislead placement.
+            info.alive = False
+            self.nodes[info.node_id] = info
+        self._restored_unregistered = {
+            nid for nid, n in self.nodes.items() if not n.alive}
+        # Re-kick scheduling that died with the previous process.
+        for aid, a in self.actors.items():
+            if a["state"] in (ACTOR_PENDING, ACTOR_RESTARTING):
+                asyncio.get_event_loop().call_later(
+                    1.0, lambda aid=aid: asyncio.ensure_future(
+                        self._schedule_actor(aid)))
+        for pg_id, pg in self.placement_groups.items():
+            if pg["state"] == PG_PENDING:
+                asyncio.get_event_loop().call_later(
+                    1.0, lambda p=pg_id: asyncio.ensure_future(
+                        self._schedule_pg(p)))
+        logger.info("GCS state restored from %s (%d actors, %d kv ns, "
+                    "%d nodes)", self.persistence_path, len(self.actors),
+                    len(self.kv), len(self.nodes))
+
+    async def _reap_restored_nodes(self):
+        """Nodes restored from the snapshot that never re-registered are
+        dead: fail over their actors (restart elsewhere or mark DEAD) the
+        same way a live death would."""
+        grace = max(10.0, self.config.health_check_period_s
+                    * self.config.num_heartbeats_timeout * 3)
+        await asyncio.sleep(grace)
+        for nid in list(getattr(self, "_restored_unregistered", ())):
+            node = self.nodes.get(nid)
+            if node is None or node.alive:
+                continue
+            logger.warning("restored node %s never re-registered; failing "
+                           "over its actors", nid[:8])
+            for actor_id, a in list(self.actors.items()):
+                if a.get("node_id") == nid and a["state"] in (
+                        ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
+                    await self._on_actor_worker_death(
+                        actor_id, f"node {nid[:8]} lost across GCS restart")
+            self.mark_dirty()
+
+    async def _persist_loop(self):
+        while True:
+            await asyncio.sleep(0.5)
+            if not self._dirty:
+                continue
+            self._dirty = False
+            try:
+                snap = self._snapshot()  # consistent view, on the loop
+
+                def write(snap=snap):
+                    tmp = f"{self.persistence_path}.tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(rpc.pack(snap))
+                    os.replace(tmp, self.persistence_path)
+
+                await asyncio.to_thread(write)
+            except Exception:
+                logger.exception("GCS persistence write failed")
 
     # ---------- pubsub ----------
 
@@ -161,6 +303,8 @@ class GcsServer:
         )
         self.nodes[info.node_id] = info
         self.node_conns[info.node_id] = conn
+        if hasattr(self, "_restored_unregistered"):
+            self._restored_unregistered.discard(info.node_id)
         if self.native_sched is not None:
             self.native_sched.update_node(
                 info.node_id, total=info.total_resources,
@@ -231,6 +375,7 @@ class GcsServer:
             self.native_sched.update_node(node_id, available={}, alive=False)
         self.pending_demand.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id[:8], reason)
+        self.mark_dirty()
         await self.publish("NODE", {"event": "dead", "node_id": node_id, "reason": reason})
         # Actor fault tolerance: restart or kill actors that lived there
         # (reference: gcs_actor_manager.cc OnNodeDead).
@@ -399,6 +544,7 @@ class GcsServer:
         if self.native_sched is not None:
             self.native_sched.debit_node(node_id, placement_demand)
         a["node_id"] = node_id
+        self.mark_dirty()
         try:
             resp = await self.node_conns[node_id].call(
                 "CreateActor",
@@ -461,11 +607,13 @@ class GcsServer:
             a["restarts"] += 1
             a["state"] = ACTOR_RESTARTING
             a["address"] = None
+            self.mark_dirty()
             await self.publish("ACTOR", {"actor_id": actor_id, "state": ACTOR_RESTARTING,
                                          "reason": reason})
             asyncio.ensure_future(self._schedule_actor(actor_id))
         else:
             a["state"] = ACTOR_DEAD
+            self.mark_dirty()
             a["address"] = None
             a["death_cause"] = reason
             self.named_actors.pop((a["namespace"], a["name"]), None)
@@ -625,6 +773,7 @@ class GcsServer:
             pg["bundles"][idx]["node_id"] = node_id
             pg["bundles"][idx]["available"] = dict(pg["bundles"][idx]["resources"])
         pg["state"] = PG_CREATED
+        self.mark_dirty()
         await self.publish("PG", {"pg_id": pg_id, "state": PG_CREATED,
                                   "bundles": [(b["node_id"]) for b in pg["bundles"]]})
 
@@ -756,6 +905,7 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--config", default="")
+    parser.add_argument("--persist", default="")
     parser.add_argument("--ready-fd", type=int, default=-1)
     args = parser.parse_args()
 
@@ -764,7 +914,7 @@ def main():
 
     async def run():
         config = Config.from_json(args.config) if args.config else Config()
-        server = GcsServer(config)
+        server = GcsServer(config, persistence_path=args.persist or None)
         host, port = await server.start(args.host, args.port)
         if args.ready_fd >= 0:
             import os

@@ -90,16 +90,37 @@ class RuntimeNode:
         self.nodes: list[NodeHandle] = []
         self._atexit_registered = False
 
-    def start_gcs(self):
+    def start_gcs(self, port: int = 0):
+        self.gcs_persist_path = os.path.join(self.session_dir,
+                                             "gcs_state.msgpack")
         proc, line = _spawn_with_ready(
             [sys.executable, "-m", "ray_tpu._private.gcs",
-             f"--config={self.config.to_json()}"],
+             f"--config={self.config.to_json()}",
+             f"--port={port}",
+             f"--persist={self.gcs_persist_path}"],
             os.path.join(self.session_dir, "logs", "gcs.log"))
         self.gcs_proc = proc
-        host, port = line.rsplit(":", 1)
-        self.gcs_host, self.gcs_port = host, int(port)
+        host, port_s = line.rsplit(":", 1)
+        self.gcs_host, self.gcs_port = host, int(port_s)
         self._register_atexit()
-        return host, int(port)
+        return host, int(port_s)
+
+    def kill_gcs(self):
+        """SIGKILL the GCS (fault-injection; reference: GCS FT tests)."""
+        if self.gcs_proc is not None:
+            try:
+                self.gcs_proc.kill()
+                self.gcs_proc.wait(timeout=5)
+            except Exception:
+                pass
+            self.gcs_proc = None
+
+    def restart_gcs(self):
+        """Restart the GCS on the SAME port with its persisted state
+        (reference: GCS restarts with Redis persistence; raylets resync
+        via NotifyGCSRestart, node_manager.cc:1168)."""
+        assert self.gcs_port, "GCS never started"
+        return self.start_gcs(port=self.gcs_port)
 
     def attach_gcs(self, host: str, port: int):
         self.gcs_host, self.gcs_port = host, port

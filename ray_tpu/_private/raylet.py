@@ -173,6 +173,9 @@ class Raylet:
         self._num_leases_granted = 0
         # Recently-rejected infeasible demands, kept ~10s for the autoscaler.
         self._infeasible_demand: list[tuple[float, dict]] = []
+        # Actor deaths observed while the GCS was unreachable; replayed
+        # after reconnection (the snapshot restores such actors as ALIVE).
+        self._pending_death_reports: list[dict] = []
         # Native C++ scheduling core mirrors the GCS-fed cluster view for
         # spillback decisions (src/scheduler.cc; Python policy is fallback).
         self._native_sched = None
@@ -296,12 +299,68 @@ class Raylet:
                     self._sync_native_view()
                     # A fresher view may unblock queued leases via spillback.
                     self._pump_pending_leases()
+                else:
+                    # A LIVE GCS answering not-ok has declared this node
+                    # dead (missed heartbeats) and may already have failed
+                    # actors over; resurrecting would fork them. Exit like
+                    # the reference's stale raylet. (A RESTARTED GCS is
+                    # reached via the ConnectionLost path below instead.)
+                    logger.error("GCS declared node %s dead; raylet exiting",
+                                 self.node_id[:8])
+                    os._exit(1)
             except rpc.ConnectionLost:
-                logger.error("lost GCS connection; raylet %s exiting", self.node_id[:8])
-                os._exit(1)
+                logger.warning("lost GCS connection; raylet %s reconnecting",
+                               self.node_id[:8])
+                if not await self._reconnect_gcs():
+                    logger.error("GCS unreachable for %.0fs; raylet %s exiting",
+                                 self.config.gcs_reconnect_timeout_s,
+                                 self.node_id[:8])
+                    os._exit(1)
             except Exception:
                 pass
             await asyncio.sleep(period)
+
+    async def _reconnect_gcs(self) -> bool:
+        """Re-establish the GCS session after a GCS restart: fresh
+        connection, re-registration under the SAME node id (leases, PG
+        bundles, and the object store all survive in this process)."""
+        deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                conn = await rpc.connect_retry(
+                    self.gcs_host, self.gcs_port,
+                    handlers={**self._handlers(), "Publish": self._on_publish},
+                    name=f"raylet-{self.node_id[:8]}->gcs",
+                    timeout=min(5.0, self.config.rpc_connect_timeout_s))
+                resp = await conn.call("RegisterNode", {
+                    "node_id": self.node_id,
+                    "host": self.host,
+                    "raylet_port": self.port,
+                    "total_resources": self.total_resources,
+                    "labels": self.labels,
+                    "store_path": self.store_path,
+                    "is_head": self.is_head,
+                }, timeout=self.config.rpc_call_timeout_s)
+                if resp.get("ok"):
+                    old, self.gcs_conn = self.gcs_conn, conn
+                    if old is not None and not old.closed:
+                        await old.close()
+                    await conn.call("Subscribe", {"channels": ["NODE"]})
+                    while self._pending_death_reports:
+                        report = self._pending_death_reports.pop(0)
+                        try:
+                            await conn.call("ReportActorDeath", report)
+                        except Exception:
+                            self._pending_death_reports.insert(0, report)
+                            break
+                    logger.info("raylet %s re-registered with GCS",
+                                self.node_id[:8])
+                    return True
+                await conn.close()
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+        return False
 
     async def _on_publish(self, conn, payload):
         if payload.get("channel") == "NODE" and payload["message"].get("event") == "dead":
@@ -381,12 +440,15 @@ class Raylet:
         if w.leased:
             self._release_lease_resources(w)
         if w.actor_id:
+            report = {"actor_id": w.actor_id, "reason": reason,
+                      "worker_id": w.worker_id}
             try:
-                await self.gcs_conn.call("ReportActorDeath", {
-                    "actor_id": w.actor_id, "reason": reason,
-                    "worker_id": w.worker_id})
+                await self.gcs_conn.call("ReportActorDeath", report)
             except Exception:
-                pass
+                # GCS down: queue it — a restarted GCS restores the actor
+                # as ALIVE from its snapshot, so the death must be replayed
+                # after reconnecting or the actor never recovers.
+                self._pending_death_reports.append(report)
         logger.warning("worker %s died: %s", w.worker_id[:8], reason)
         self._pump_pending_leases()
 

@@ -1,0 +1,108 @@
+"""GCS fault tolerance: kill + restart the control plane mid-run.
+
+Parity: reference python/ray/tests/test_gcs_fault_tolerance.py — the GCS
+restarts with persisted state (Redis there, msgpack snapshot here), raylets
+re-register under the same node id, live actors keep serving (actor calls
+never touch the GCS), and new work schedules after recovery.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import Config
+
+
+@pytest.fixture
+def ft_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cfg = Config()
+    cfg.health_check_period_s = 0.2
+    cfg.num_heartbeats_timeout = 10
+    cfg.gcs_reconnect_timeout_s = 30.0
+    cluster = Cluster(initialize_head=True, connect=True,
+                      head_node_args={"num_cpus": 4}, config=cfg)
+    yield cluster
+    cluster.shutdown()
+
+
+def test_gcs_restart_preserves_cluster(ft_cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="survivor").remote()
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+    ray_tpu.get(ray_tpu.put("kv-sentinel"))  # exercise the data plane too
+    time.sleep(1.0)  # let the persistence loop snapshot the state
+
+    node = ft_cluster._node
+    node.kill_gcs()
+
+    # Actor calls go direct worker-to-worker: they keep working with the
+    # control plane DOWN (the reference's key resilience property).
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 2
+
+    node.restart_gcs()
+
+    # Raylet re-registers; driver reconnects; new tasks schedule.
+    deadline = time.monotonic() + 30
+    alive = []
+    while time.monotonic() < deadline:
+        try:
+            alive = [n for n in ray_tpu.nodes() if n["alive"]]
+            if alive:
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert alive, "raylet never re-registered after GCS restart"
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(41), timeout=90) == 42
+    # Existing actor still reachable AND still findable by name (the actor
+    # directory was persisted).
+    assert ray_tpu.get(c.inc.remote(), timeout=60) == 3
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            again = ray_tpu.get_actor("survivor")
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        raise AssertionError("named actor lost after GCS restart")
+    assert ray_tpu.get(again.inc.remote(), timeout=60) == 4
+
+
+def test_gcs_restart_preserves_kv(ft_cluster):
+    from ray_tpu._private.api_internal import get_core_worker
+
+    cw = get_core_worker()
+    cw._run(cw.gcs.call("KVPut", {"ns": "t", "key": b"k", "value": b"v1"}))
+    time.sleep(1.0)  # snapshot interval
+
+    node = ft_cluster._node
+    node.kill_gcs()
+    node.restart_gcs()
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            got = cw._run(cw.gcs.call("KVGet", {"ns": "t", "key": b"k"}))
+            if got.get("value") == b"v1":
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise AssertionError("KV entry lost across GCS restart")
