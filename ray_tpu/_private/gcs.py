@@ -155,6 +155,15 @@ class GcsServer:
             self._health_task.cancel()
         if getattr(self, "_persist_task", None):
             self._persist_task.cancel()
+        if self.persistence_path and self._dirty:
+            # Flush acknowledged mutations from the last <0.5s window.
+            try:
+                tmp = f"{self.persistence_path}.tmp"
+                with open(tmp, "wb") as f:
+                    f.write(rpc.pack(self._snapshot()))
+                os.replace(tmp, self.persistence_path)
+            except Exception:
+                logger.exception("final GCS persistence flush failed")
         await self._server.stop()
 
     # ---------- persistence ----------
@@ -163,6 +172,11 @@ class GcsServer:
         self._dirty = True
 
     def _snapshot(self) -> dict:
+        import copy
+
+        return copy.deepcopy(self._snapshot_live())
+
+    def _snapshot_live(self) -> dict:
         actors = {}
         for aid, a in self.actors.items():
             a = dict(a)

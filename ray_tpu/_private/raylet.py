@@ -274,6 +274,32 @@ class Raylet:
             except OSError:
                 pass
 
+    async def _reconcile_actors(self, conn) -> None:
+        """After an outage the GCS may have failed our actors over
+        elsewhere (restored-node reaper). Kill any local actor worker the
+        directory no longer maps to THIS worker — otherwise two live
+        copies of a stateful actor serve callers (actor forking)."""
+        for w in list(self.workers.values()):
+            if not w.actor_id or w.dead:
+                continue
+            try:
+                resp = await conn.call("GetActorInfo",
+                                       {"actor_id": w.actor_id})
+            except Exception:
+                continue
+            addr = resp.get("address") if resp.get("found") else None
+            # Address wire = [host, port, worker_id, node_id]; the actor's
+            # CoreWorker id equals our WorkerHandle id (set via env).
+            ours = bool(addr) and addr[2] == w.worker_id
+            if resp.get("found") and resp.get("state") == "ALIVE" and ours:
+                continue
+            logger.warning(
+                "killing stale actor worker %s (actor %s now %s elsewhere)",
+                w.worker_id[:8], w.actor_id[:8],
+                resp.get("state", "unknown"))
+            self._release_lease_resources(w)
+            self._kill_worker(w)
+
     # ---------- gcs sync ----------
 
     async def _heartbeat_loop(self):
@@ -353,6 +379,7 @@ class Raylet:
                         except Exception:
                             self._pending_death_reports.insert(0, report)
                             break
+                    await self._reconcile_actors(conn)
                     logger.info("raylet %s re-registered with GCS",
                                 self.node_id[:8])
                     return True

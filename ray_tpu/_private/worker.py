@@ -544,38 +544,52 @@ class CoreWorker:
 
     async def _reconnect_gcs(self):
         """Re-establish the GCS connection after a GCS restart; RPCs issued
-        during the gap fail and their callers retry."""
-        deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s
-        while not self._shutdown and time.monotonic() < deadline:
-            try:
-                conn = await rpc.connect_retry(
-                    self.gcs_host, self.gcs_port,
-                    handlers={"Publish": self._on_gcs_publish},
-                    name=f"w{self.worker_id[:8]}->gcs",
-                    timeout=min(5.0, self.config.rpc_connect_timeout_s))
-                await conn.call("Subscribe",
-                                {"channels": self._gcs_channels})
-                self.gcs = conn
-                conn.on_close(lambda: (not self._shutdown)
-                              and self._spawn(self._reconnect_gcs()))
-                if self.is_driver:
-                    # Re-arm the session-teardown hook (owns_cluster
-                    # sessions die with their driver connection).
-                    await conn.call("RegisterJob", {
-                        "job_id": self.job_id,
-                        "driver_address": self.address.to_wire(),
-                        "entrypoint": " ".join(os.sys.argv),
-                        "owns_cluster": self.owns_cluster,
-                    })
-                logger.info("reconnected to GCS")
-                return
-            except Exception:
-                await asyncio.sleep(0.5)
-        if not self._shutdown:
-            logger.error(
-                "gave up reconnecting to GCS after %.0fs; control-plane "
-                "operations will fail until restart",
-                self.config.gcs_reconnect_timeout_s)
+        during the gap fail and their callers retry. Guarded so on_close
+        flaps never run two loops at once; on_close is armed only after
+        the FULL re-handshake (subscribe + job registration) succeeds."""
+        if getattr(self, "_gcs_reconnecting", False):
+            return
+        self._gcs_reconnecting = True
+        try:
+            deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s
+            while not self._shutdown and time.monotonic() < deadline:
+                conn = None
+                try:
+                    conn = await rpc.connect_retry(
+                        self.gcs_host, self.gcs_port,
+                        handlers={"Publish": self._on_gcs_publish},
+                        name=f"w{self.worker_id[:8]}->gcs",
+                        timeout=min(5.0, self.config.rpc_connect_timeout_s))
+                    await conn.call("Subscribe",
+                                    {"channels": self._gcs_channels})
+                    if self.is_driver:
+                        # Re-arm the session-teardown hook (owns_cluster
+                        # sessions die with their driver connection).
+                        await conn.call("RegisterJob", {
+                            "job_id": self.job_id,
+                            "driver_address": self.address.to_wire(),
+                            "entrypoint": " ".join(os.sys.argv),
+                            "owns_cluster": self.owns_cluster,
+                        })
+                    self.gcs = conn
+                    conn.on_close(lambda: (not self._shutdown)
+                                  and self._spawn(self._reconnect_gcs()))
+                    logger.info("reconnected to GCS")
+                    return
+                except Exception:
+                    if conn is not None:
+                        try:
+                            await conn.close()
+                        except Exception:
+                            pass
+                    await asyncio.sleep(0.5)
+            if not self._shutdown:
+                logger.error(
+                    "gave up reconnecting to GCS after %.0fs; control-plane "
+                    "operations will fail until restart",
+                    self.config.gcs_reconnect_timeout_s)
+        finally:
+            self._gcs_reconnecting = False
 
     # ---------- ref counting ----------
 

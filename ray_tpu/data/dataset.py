@@ -544,6 +544,33 @@ class Dataset:
                     else jax.device_put(v) for k, v in batch.items()}
             yield arrs
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device: str | None = None,
+                           drop_last: bool = False) -> Iterator:
+        """Batches as torch tensors (parity: Dataset.iter_torch_batches —
+        the torch-side ingest path; numeric columns become tensors, other
+        columns pass through)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                arr = np.asarray(v)
+                if arr.dtype.kind in "biuf":
+                    t = torch.from_numpy(np.ascontiguousarray(arr))
+                    if dtypes is not None:
+                        want = dtypes.get(k) if isinstance(dtypes, dict) \
+                            else dtypes
+                        if want is not None:
+                            t = t.to(want)
+                    if device:
+                        t = t.to(device)
+                    out[k] = t
+                else:
+                    out[k] = arr
+            yield out
+
     def take(self, n: int = 20) -> list:
         out = []
         for r in self.iter_rows():

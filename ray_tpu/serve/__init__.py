@@ -36,8 +36,27 @@ def _get_controller():
 
 def run(target: Deployment, *, name: str | None = None,
         route_prefix: str | None = None) -> DeploymentHandle:
-    """Deploy and return a handle (parity: serve.run api.py:465)."""
+    """Deploy and return a handle (parity: serve.run api.py:465).
+
+    Deployment-graph composition (parity: python/ray/dag +
+    deployment_graph_build.py): bound Deployments appearing in another
+    deployment's init args deploy first and arrive as DeploymentHandles —
+    `serve.run(Ensemble.bind(ModelA.bind(), ModelB.bind()))` gives the
+    Ensemble replicas live handles to A and B.
+    """
     controller = _get_controller()
+    return _deploy_tree(target, controller, route_prefix)
+
+
+def _deploy_tree(target: Deployment, controller,
+                 route_prefix: str | None = None) -> DeploymentHandle:
+    def resolve(a):
+        if isinstance(a, Deployment):
+            return _deploy_tree(a, controller)  # children get no route
+        return a
+
+    init_args = tuple(resolve(a) for a in target._init_args)
+    init_kwargs = {k: resolve(v) for k, v in target._init_kwargs.items()}
     cfg = target._config
     asc = None
     if cfg.autoscaling_config is not None:
@@ -45,7 +64,7 @@ def run(target: Deployment, *, name: str | None = None,
     ray_tpu.get(controller.deploy.remote(
         cfg.name,
         serialization.dumps_func(target._target),
-        serialization.dumps_func((target._init_args, target._init_kwargs)),
+        serialization.dumps_func((init_args, init_kwargs)),
         cfg.num_replicas,
         cfg.ray_actor_options,
         asc,

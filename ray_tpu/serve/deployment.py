@@ -290,6 +290,15 @@ class DeploymentHandle:
         self._inflight: list = []
         self._reaper: threading.Thread | None = None
 
+    def __reduce__(self):
+        # Handles travel into replicas (deployment-graph composition) and
+        # rebuild with fresh router state there — the lock/queues are
+        # process-local; the batching CONFIG survives the trip.
+        batching = (self._batchq.max_batch_size, self._batchq.timeout) \
+            if self._batchq is not None else None
+        return (_rebuild_handle, (self.deployment_name, self._controller,
+                                  self._method, self._model_id, batching))
+
     def options(self, method_name: str | None = None,
                 batching: tuple[int, float] | None = None,
                 multiplexed_model_id: str | None = None
@@ -463,3 +472,8 @@ class DeploymentHandle:
                 slot._resolve_error(e)
         finally:
             self._done(idx)
+
+
+def _rebuild_handle(name, controller, method, model_id, batching=None):
+    return DeploymentHandle(name, controller, method, batching=batching,
+                            multiplexed_model_id=model_id)

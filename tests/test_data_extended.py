@@ -180,3 +180,23 @@ def test_distributed_sort_by_column(ray_start_regular):
     ds = rd.from_items(rows, override_num_blocks=4).sort(key="k")
     got = [r["k"] for r in ds.take_all()]
     assert got == sorted(got)
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import torch
+
+    import ray_tpu.data as rd
+
+    ds = rd.from_items([{"x": float(i), "label": i % 3} for i in range(20)])
+    batches = list(ds.iter_torch_batches(batch_size=8))
+    assert len(batches) == 3
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    assert batches[0]["x"].shape == (8,)
+    total = torch.cat([b["x"] for b in batches])
+    assert total.tolist() == [float(i) for i in range(20)]
+    # dtype override
+    b = next(iter(ds.iter_torch_batches(batch_size=4,
+                                        dtypes={"x": torch.float16,
+                                                "label": torch.long})))
+    assert b["x"].dtype == torch.float16
+    assert b["label"].dtype == torch.long

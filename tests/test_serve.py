@@ -184,3 +184,38 @@ def test_route_prefix(serve_cluster):
     with urllib.request.urlopen(req, timeout=60) as resp:
         body = json.load(resp)
     assert body["result"] == {"got": {"k": 1}}
+
+
+def test_deployment_graph_composition(ray_start_regular):
+    """serve.run of a bound graph deploys children first and hands the
+    parent live handles (parity: deployment-graph DAG composition)."""
+    from ray_tpu import serve
+
+    @serve.deployment(name="adder")
+    class Adder:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment(name="doubler")
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment(name="ensemble")
+    class Ensemble:
+        def __init__(self, adder, doubler):
+            self.adder = adder
+            self.doubler = doubler
+
+        def __call__(self, x):
+            a = self.adder.remote(x).result(timeout=30)
+            d = self.doubler.remote(x).result(timeout=30)
+            return a + d
+
+    try:
+        handle = serve.run(Ensemble.bind(Adder.bind(), Doubler.bind()))
+        # (5+1) + (5*2) = 16, through two nested deployment calls.
+        assert handle.remote(5).result(timeout=60) == 16
+        assert set(serve.status()) >= {"adder", "doubler", "ensemble"}
+    finally:
+        serve.shutdown()
