@@ -1,0 +1,165 @@
+// C++ frontend for ray_tpu (reference: cpp/include/ray/api.h — the
+// standalone C++ worker API `ray::Task(...).Remote()`).
+//
+// Design: the reference embeds a full CoreWorker in the C++ process and
+// registers native functions. Here the C++ frontend is a *cross-language
+// client*: it speaks the msgpack client protocol to a ClientServer
+// (ray_tpu/util/client/server.py) and invokes Python functions/actors by
+// qualified name — the same shape as the reference's cross-language
+// descriptors (reference: python/ray/cross_language.py). Values cross the
+// boundary as msgpack structures (reference: msgpack cross-language
+// serialization, python/ray/includes/serialization.pxi).
+//
+// Usage:
+//   ray::tpu::Client c("127.0.0.1", 10001);
+//   auto ref = c.Put(ray::tpu::Value::Int(41));
+//   auto out = c.Call("mymodule:add", {ref.AsValue(), ray::tpu::Value::Int(1)});
+//   int64_t v = c.Get(out).AsInt();        // 42
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray {
+namespace tpu {
+
+class Client;
+
+// A msgpack-representable value: the cross-language data model.
+class Value {
+ public:
+  enum class Type { Nil, Bool, Int, Double, Str, Bin, List, Map, Ref };
+
+  Value() : type_(Type::Nil) {}
+
+  static Value Nil() { return Value(); }
+  static Value Boolean(bool b);
+  static Value Int(int64_t i);
+  static Value Dbl(double d);
+  static Value Str(std::string s);
+  static Value Bin(std::string bytes);
+  static Value List(std::vector<Value> items);
+  static Value Map(std::map<std::string, Value> entries);
+
+  Type type() const { return type_; }
+  bool IsNil() const { return type_ == Type::Nil; }
+  bool AsBool() const;
+  int64_t AsInt() const;
+  double AsDouble() const;  // accepts Int too
+  const std::string& AsStr() const;
+  const std::string& AsBin() const;
+  const std::vector<Value>& AsList() const;
+  const std::map<std::string, Value>& AsMap() const;
+
+  bool operator==(const Value& other) const;
+
+  std::string Repr() const;  // debug printout
+
+ private:
+  friend class Codec;
+  friend class Client;
+  Type type_;
+  bool b_ = false;
+  int64_t i_ = 0;
+  double d_ = 0.0;
+  std::string s_;                       // Str/Bin/Ref(hex)
+  std::vector<Value> list_;
+  std::map<std::string, Value> map_;
+};
+
+// Handle to an object owned by the server-side driver.
+class ObjectRef {
+ public:
+  ObjectRef() = default;
+  explicit ObjectRef(std::string hex) : hex_(std::move(hex)) {}
+  const std::string& Hex() const { return hex_; }
+  bool Valid() const { return !hex_.empty(); }
+  // Marker form accepted inside Call() args: resolved to the real object
+  // server-side before the task runs.
+  Value AsValue() const;
+
+ private:
+  std::string hex_;
+};
+
+// Handle to an actor created (or looked up) through the proxy.
+class ActorHandle {
+ public:
+  ActorHandle() = default;
+  ActorHandle(std::string id_hex, std::string class_name)
+      : id_hex_(std::move(id_hex)), class_name_(std::move(class_name)) {}
+  const std::string& IdHex() const { return id_hex_; }
+  const std::string& ClassName() const { return class_name_; }
+  bool Valid() const { return !id_hex_.empty(); }
+
+ private:
+  std::string id_hex_;
+  std::string class_name_;
+};
+
+struct CallOptions {
+  // Subset of @ray_tpu.remote options that travel cross-language.
+  std::map<std::string, double> resources;  // {"CPU": 1, "TPU": 4, ...}
+  int num_returns = 1;
+  int max_retries = 0;
+  std::string name;       // task/actor name
+  std::string lifetime;   // "" or "detached" (actors)
+  int max_restarts = 0;   // actors
+};
+
+class RayError : public std::runtime_error {
+ public:
+  explicit RayError(const std::string& what) : std::runtime_error(what) {}
+};
+
+// One connection to a ClientServer; methods are thread-safe (a mutex
+// serializes the socket - the protocol is request/response).
+class Client {
+ public:
+  Client(const std::string& host, int port, double connect_timeout_s = 10.0);
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  ObjectRef Put(const Value& v);
+  Value Get(const ObjectRef& ref, double timeout_s = -1.0);
+  std::vector<Value> Get(const std::vector<ObjectRef>& refs,
+                         double timeout_s = -1.0);
+  // Returns (ready, not_ready).
+  std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Wait(
+      const std::vector<ObjectRef>& refs, int num_returns,
+      double timeout_s = -1.0);
+
+  // Invoke a Python function by qualified name ("module:function").
+  ObjectRef Call(const std::string& qualified_name, std::vector<Value> args,
+                 const CallOptions& opts = {});
+  std::vector<ObjectRef> CallMulti(const std::string& qualified_name,
+                                   std::vector<Value> args,
+                                   const CallOptions& opts);
+
+  ActorHandle CreateActor(const std::string& qualified_class,
+                          std::vector<Value> args, const CallOptions& opts = {});
+  ObjectRef CallMethod(const ActorHandle& actor, const std::string& method,
+                       std::vector<Value> args);
+  ActorHandle GetActor(const std::string& name, const std::string& ns = "");
+  void Kill(const ActorHandle& actor, bool no_restart = true);
+
+  void Release(const ObjectRef& ref);  // drop the server-side pin
+  std::map<std::string, double> ClusterResources();
+  const std::string& SessionId() const { return session_id_; }
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+  std::string session_id_;
+  Value Rpc(const std::string& method, const Value& payload,
+            double timeout_s = 60.0);
+};
+
+}  // namespace tpu
+}  // namespace ray

@@ -1,0 +1,567 @@
+// Implementation of the ray_tpu C++ client (see include/ray_tpu/api.h).
+//
+// Wire protocol (must match ray_tpu/_private/rpc.py): 4-byte big-endian
+// frame length, then a msgpack array [msg_type, seq, method, payload].
+// msg_type: 0=request, 1=response-ok, 2=response-error, 3=notify.
+// The msgpack codec below implements exactly the subset both sides use.
+
+#include "ray_tpu/api.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <mutex>
+#include <sstream>
+
+namespace ray {
+namespace tpu {
+
+// ---------------------------------------------------------------------------
+// Value
+// ---------------------------------------------------------------------------
+
+Value Value::Boolean(bool b) {
+  Value v; v.type_ = Type::Bool; v.b_ = b; return v;
+}
+Value Value::Int(int64_t i) {
+  Value v; v.type_ = Type::Int; v.i_ = i; return v;
+}
+Value Value::Dbl(double d) {
+  Value v; v.type_ = Type::Double; v.d_ = d; return v;
+}
+Value Value::Str(std::string s) {
+  Value v; v.type_ = Type::Str; v.s_ = std::move(s); return v;
+}
+Value Value::Bin(std::string bytes) {
+  Value v; v.type_ = Type::Bin; v.s_ = std::move(bytes); return v;
+}
+Value Value::List(std::vector<Value> items) {
+  Value v; v.type_ = Type::List; v.list_ = std::move(items); return v;
+}
+Value Value::Map(std::map<std::string, Value> entries) {
+  Value v; v.type_ = Type::Map; v.map_ = std::move(entries); return v;
+}
+
+static void TypeCheck(bool ok, const char* want) {
+  if (!ok) throw RayError(std::string("Value: not a ") + want);
+}
+
+bool Value::AsBool() const { TypeCheck(type_ == Type::Bool, "bool"); return b_; }
+int64_t Value::AsInt() const { TypeCheck(type_ == Type::Int, "int"); return i_; }
+double Value::AsDouble() const {
+  if (type_ == Type::Int) return static_cast<double>(i_);
+  TypeCheck(type_ == Type::Double, "double");
+  return d_;
+}
+const std::string& Value::AsStr() const {
+  TypeCheck(type_ == Type::Str, "string"); return s_;
+}
+const std::string& Value::AsBin() const {
+  TypeCheck(type_ == Type::Bin, "bytes"); return s_;
+}
+const std::vector<Value>& Value::AsList() const {
+  TypeCheck(type_ == Type::List, "list"); return list_;
+}
+const std::map<std::string, Value>& Value::AsMap() const {
+  TypeCheck(type_ == Type::Map, "map"); return map_;
+}
+
+bool Value::operator==(const Value& o) const {
+  if (type_ != o.type_) return false;
+  switch (type_) {
+    case Type::Nil: return true;
+    case Type::Bool: return b_ == o.b_;
+    case Type::Int: return i_ == o.i_;
+    case Type::Double: return d_ == o.d_;
+    case Type::Str:
+    case Type::Bin:
+    case Type::Ref: return s_ == o.s_;
+    case Type::List: return list_ == o.list_;
+    case Type::Map: return map_ == o.map_;
+  }
+  return false;
+}
+
+std::string Value::Repr() const {
+  std::ostringstream out;
+  switch (type_) {
+    case Type::Nil: out << "nil"; break;
+    case Type::Bool: out << (b_ ? "true" : "false"); break;
+    case Type::Int: out << i_; break;
+    case Type::Double: out << d_; break;
+    case Type::Str: out << '"' << s_ << '"'; break;
+    case Type::Bin: out << "bin<" << s_.size() << ">"; break;
+    case Type::Ref: out << "ref<" << s_ << ">"; break;
+    case Type::List: {
+      out << "[";
+      for (size_t i = 0; i < list_.size(); ++i)
+        out << (i ? ", " : "") << list_[i].Repr();
+      out << "]";
+      break;
+    }
+    case Type::Map: {
+      out << "{";
+      bool first = true;
+      for (const auto& kv : map_) {
+        out << (first ? "" : ", ") << kv.first << ": " << kv.second.Repr();
+        first = false;
+      }
+      out << "}";
+      break;
+    }
+  }
+  return out.str();
+}
+
+Value ObjectRef::AsValue() const {
+  return Value::Map({{"__client_ref__", Value::Str(hex_)}});
+}
+
+// ---------------------------------------------------------------------------
+// msgpack codec
+// ---------------------------------------------------------------------------
+
+class Codec {
+ public:
+  static void Pack(const Value& v, std::string* out) {
+    switch (v.type_) {
+      case Value::Type::Nil: out->push_back('\xc0'); break;
+      case Value::Type::Bool:
+        out->push_back(v.b_ ? '\xc3' : '\xc2');
+        break;
+      case Value::Type::Int: PackInt(v.i_, out); break;
+      case Value::Type::Double: {
+        out->push_back('\xcb');
+        uint64_t bits;
+        std::memcpy(&bits, &v.d_, 8);
+        PushBE(bits, 8, out);
+        break;
+      }
+      case Value::Type::Str: {
+        size_t n = v.s_.size();
+        if (n <= 31) {
+          out->push_back(static_cast<char>(0xa0 | n));
+        } else if (n <= 0xff) {
+          out->push_back('\xd9');
+          out->push_back(static_cast<char>(n));
+        } else if (n <= 0xffff) {
+          out->push_back('\xda');
+          PushBE(n, 2, out);
+        } else {
+          out->push_back('\xdb');
+          PushBE(n, 4, out);
+        }
+        out->append(v.s_);
+        break;
+      }
+      case Value::Type::Bin: {
+        size_t n = v.s_.size();
+        if (n <= 0xff) {
+          out->push_back('\xc4');
+          out->push_back(static_cast<char>(n));
+        } else if (n <= 0xffff) {
+          out->push_back('\xc5');
+          PushBE(n, 2, out);
+        } else {
+          out->push_back('\xc6');
+          PushBE(n, 4, out);
+        }
+        out->append(v.s_);
+        break;
+      }
+      case Value::Type::Ref:  // encoded as its marker map by callers
+        throw RayError("cannot pack raw Ref value");
+      case Value::Type::List: {
+        size_t n = v.list_.size();
+        if (n <= 15) {
+          out->push_back(static_cast<char>(0x90 | n));
+        } else if (n <= 0xffff) {
+          out->push_back('\xdc');
+          PushBE(n, 2, out);
+        } else {
+          out->push_back('\xdd');
+          PushBE(n, 4, out);
+        }
+        for (const auto& item : v.list_) Pack(item, out);
+        break;
+      }
+      case Value::Type::Map: {
+        size_t n = v.map_.size();
+        if (n <= 15) {
+          out->push_back(static_cast<char>(0x80 | n));
+        } else if (n <= 0xffff) {
+          out->push_back('\xde');
+          PushBE(n, 2, out);
+        } else {
+          out->push_back('\xdf');
+          PushBE(n, 4, out);
+        }
+        for (const auto& kv : v.map_) {
+          Pack(Value::Str(kv.first), out);
+          Pack(kv.second, out);
+        }
+        break;
+      }
+    }
+  }
+
+  static Value Unpack(const std::string& data, size_t* pos) {
+    if (*pos >= data.size()) throw RayError("msgpack: truncated");
+    uint8_t tag = static_cast<uint8_t>(data[(*pos)++]);
+    if (tag <= 0x7f) return Value::Int(tag);                 // pos fixint
+    if (tag >= 0xe0) return Value::Int(static_cast<int8_t>(tag));  // neg fixint
+    if (tag >= 0xa0 && tag <= 0xbf) return TakeStr(data, pos, tag & 0x1f);
+    if (tag >= 0x90 && tag <= 0x9f) return TakeList(data, pos, tag & 0x0f);
+    if (tag >= 0x80 && tag <= 0x8f) return TakeMap(data, pos, tag & 0x0f);
+    switch (tag) {
+      case 0xc0: return Value::Nil();
+      case 0xc2: return Value::Boolean(false);
+      case 0xc3: return Value::Boolean(true);
+      case 0xc4: return TakeBin(data, pos, TakeBE(data, pos, 1));
+      case 0xc5: return TakeBin(data, pos, TakeBE(data, pos, 2));
+      case 0xc6: return TakeBin(data, pos, TakeBE(data, pos, 4));
+      case 0xca: {  // float32
+        uint32_t bits = static_cast<uint32_t>(TakeBE(data, pos, 4));
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value::Dbl(f);
+      }
+      case 0xcb: {  // float64
+        uint64_t bits = TakeBE(data, pos, 8);
+        double d;
+        std::memcpy(&d, &bits, 8);
+        return Value::Dbl(d);
+      }
+      case 0xcc: return Value::Int(static_cast<int64_t>(TakeBE(data, pos, 1)));
+      case 0xcd: return Value::Int(static_cast<int64_t>(TakeBE(data, pos, 2)));
+      case 0xce: return Value::Int(static_cast<int64_t>(TakeBE(data, pos, 4)));
+      case 0xcf: return Value::Int(static_cast<int64_t>(TakeBE(data, pos, 8)));
+      case 0xd0: return Value::Int(static_cast<int8_t>(TakeBE(data, pos, 1)));
+      case 0xd1: return Value::Int(static_cast<int16_t>(TakeBE(data, pos, 2)));
+      case 0xd2: return Value::Int(static_cast<int32_t>(TakeBE(data, pos, 4)));
+      case 0xd3: return Value::Int(static_cast<int64_t>(TakeBE(data, pos, 8)));
+      case 0xd9: return TakeStr(data, pos, TakeBE(data, pos, 1));
+      case 0xda: return TakeStr(data, pos, TakeBE(data, pos, 2));
+      case 0xdb: return TakeStr(data, pos, TakeBE(data, pos, 4));
+      case 0xdc: return TakeList(data, pos, TakeBE(data, pos, 2));
+      case 0xdd: return TakeList(data, pos, TakeBE(data, pos, 4));
+      case 0xde: return TakeMap(data, pos, TakeBE(data, pos, 2));
+      case 0xdf: return TakeMap(data, pos, TakeBE(data, pos, 4));
+      default:
+        throw RayError("msgpack: unsupported tag " + std::to_string(tag));
+    }
+  }
+
+ private:
+  static void PushBE(uint64_t v, int nbytes, std::string* out) {
+    for (int i = nbytes - 1; i >= 0; --i)
+      out->push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+  }
+  static void PackInt(int64_t i, std::string* out) {
+    if (i >= 0 && i <= 0x7f) {
+      out->push_back(static_cast<char>(i));
+    } else if (i < 0 && i >= -32) {
+      out->push_back(static_cast<char>(i));
+    } else if (i >= 0) {
+      out->push_back('\xcf');
+      PushBE(static_cast<uint64_t>(i), 8, out);
+    } else {
+      out->push_back('\xd3');
+      PushBE(static_cast<uint64_t>(i), 8, out);
+    }
+  }
+  static uint64_t TakeBE(const std::string& d, size_t* pos, int nbytes) {
+    if (*pos + nbytes > d.size()) throw RayError("msgpack: truncated");
+    uint64_t v = 0;
+    for (int i = 0; i < nbytes; ++i)
+      v = (v << 8) | static_cast<uint8_t>(d[(*pos)++]);
+    return v;
+  }
+  static Value TakeStr(const std::string& d, size_t* pos, uint64_t n) {
+    if (*pos + n > d.size()) throw RayError("msgpack: truncated str");
+    Value v = Value::Str(d.substr(*pos, n));
+    *pos += n;
+    return v;
+  }
+  static Value TakeBin(const std::string& d, size_t* pos, uint64_t n) {
+    if (*pos + n > d.size()) throw RayError("msgpack: truncated bin");
+    Value v = Value::Bin(d.substr(*pos, n));
+    *pos += n;
+    return v;
+  }
+  static Value TakeList(const std::string& d, size_t* pos, uint64_t n) {
+    std::vector<Value> items;
+    items.reserve(n);
+    for (uint64_t i = 0; i < n; ++i) items.push_back(Unpack(d, pos));
+    return Value::List(std::move(items));
+  }
+  static Value TakeMap(const std::string& d, size_t* pos, uint64_t n) {
+    std::map<std::string, Value> entries;
+    for (uint64_t i = 0; i < n; ++i) {
+      Value key = Unpack(d, pos);
+      Value val = Unpack(d, pos);
+      // Non-string keys (possible through GCS passthrough) are stringified.
+      std::string ks = key.type() == Value::Type::Str ? key.AsStr() : key.Repr();
+      entries.emplace(std::move(ks), std::move(val));
+    }
+    return Value::Map(std::move(entries));
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Socket transport
+// ---------------------------------------------------------------------------
+
+struct Client::Impl {
+  int fd = -1;
+  uint64_t seq = 0;
+  std::mutex mu;
+
+  ~Impl() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  void Connect(const std::string& host, int port, double timeout_s) {
+    struct addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    int rc = ::getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res);
+    if (rc != 0)
+      throw RayError("resolve " + host + ": " + gai_strerror(rc));
+    RayError last("connect failed");
+    for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+      fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+        ::freeaddrinfo(res);
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return;
+      }
+      last = RayError(std::string("connect: ") + std::strerror(errno));
+      ::close(fd);
+      fd = -1;
+    }
+    ::freeaddrinfo(res);
+    (void)timeout_s;
+    throw last;
+  }
+
+  void SendAll(const char* data, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t w = ::send(fd, data + off, n - off, MSG_NOSIGNAL);
+      if (w <= 0) throw RayError("connection lost (send)");
+      off += static_cast<size_t>(w);
+    }
+  }
+
+  void RecvAll(char* data, size_t n, double timeout_s) {
+    size_t off = 0;
+    while (off < n) {
+      if (timeout_s > 0) {
+        struct pollfd pfd{fd, POLLIN, 0};
+        int pr = ::poll(&pfd, 1, static_cast<int>(timeout_s * 1000));
+        if (pr == 0) throw RayError("rpc timeout");
+        if (pr < 0) throw RayError("connection lost (poll)");
+      }
+      ssize_t r = ::recv(fd, data + off, n - off, 0);
+      if (r <= 0) throw RayError("connection lost (recv)");
+      off += static_cast<size_t>(r);
+    }
+  }
+};
+
+Client::Client(const std::string& host, int port, double connect_timeout_s)
+    : impl_(new Impl()) {
+  impl_->Connect(host, port, connect_timeout_s);
+  Value resp = Rpc("ClientPing", Value::Map({}));
+  session_id_ = resp.AsMap().at("session").AsStr();
+}
+
+Client::~Client() = default;
+
+Value Client::Rpc(const std::string& method, const Value& payload,
+                  double timeout_s) {
+  std::lock_guard<std::mutex> lock(impl_->mu);
+  uint64_t seq = ++impl_->seq;
+  Value frame = Value::List({Value::Int(0), Value::Int(seq),
+                             Value::Str(method), payload});
+  std::string body;
+  Codec::Pack(frame, &body);
+  char hdr[4] = {static_cast<char>((body.size() >> 24) & 0xff),
+                 static_cast<char>((body.size() >> 16) & 0xff),
+                 static_cast<char>((body.size() >> 8) & 0xff),
+                 static_cast<char>(body.size() & 0xff)};
+  impl_->SendAll(hdr, 4);
+  impl_->SendAll(body.data(), body.size());
+
+  // Request/response over one socket: frames come back in order, but skip
+  // anything that is not the answer to our seq (defensive).
+  while (true) {
+    char rhdr[4];
+    impl_->RecvAll(rhdr, 4, timeout_s);
+    uint32_t len = (static_cast<uint32_t>(static_cast<uint8_t>(rhdr[0])) << 24) |
+                   (static_cast<uint32_t>(static_cast<uint8_t>(rhdr[1])) << 16) |
+                   (static_cast<uint32_t>(static_cast<uint8_t>(rhdr[2])) << 8) |
+                   static_cast<uint32_t>(static_cast<uint8_t>(rhdr[3]));
+    std::string rbody(len, '\0');
+    impl_->RecvAll(rbody.data(), len, timeout_s);
+    size_t pos = 0;
+    Value resp = Codec::Unpack(rbody, &pos);
+    const auto& arr = resp.AsList();
+    int64_t msg_type = arr[0].AsInt();
+    uint64_t rseq = static_cast<uint64_t>(arr[1].AsInt());
+    if (rseq != seq) continue;
+    if (msg_type == 2) {
+      throw RayError("server error in " + method + ": " +
+                     (arr[3].type() == Value::Type::Str ? arr[3].AsStr()
+                                                        : arr[3].Repr()));
+    }
+    return arr[3];
+  }
+}
+
+static Value OptsToValue(const CallOptions& opts) {
+  std::map<std::string, Value> m;
+  if (!opts.resources.empty()) {
+    std::map<std::string, Value> res;
+    for (const auto& kv : opts.resources) res[kv.first] = Value::Dbl(kv.second);
+    m["resources"] = Value::Map(std::move(res));
+  }
+  if (opts.num_returns != 1) m["num_returns"] = Value::Int(opts.num_returns);
+  if (opts.max_retries != 0) m["max_retries"] = Value::Int(opts.max_retries);
+  if (!opts.name.empty()) m["name"] = Value::Str(opts.name);
+  if (!opts.lifetime.empty()) m["lifetime"] = Value::Str(opts.lifetime);
+  if (opts.max_restarts != 0) m["max_restarts"] = Value::Int(opts.max_restarts);
+  return Value::Map(std::move(m));
+}
+
+static std::vector<ObjectRef> RefsFrom(const Value& resp) {
+  std::vector<ObjectRef> out;
+  for (const auto& h : resp.AsMap().at("refs").AsList())
+    out.emplace_back(h.AsStr());
+  return out;
+}
+
+ObjectRef Client::Put(const Value& v) {
+  Value resp = Rpc("ClientPut", Value::Map({{"codec", Value::Str("msgpack")},
+                                            {"value", v}}));
+  return RefsFrom(resp)[0];
+}
+
+std::vector<Value> Client::Get(const std::vector<ObjectRef>& refs,
+                               double timeout_s) {
+  std::vector<Value> hexes;
+  for (const auto& r : refs) hexes.push_back(Value::Str(r.Hex()));
+  std::map<std::string, Value> payload{
+      {"codec", Value::Str("msgpack")}, {"refs", Value::List(hexes)}};
+  if (timeout_s >= 0) payload["timeout"] = Value::Dbl(timeout_s);
+  Value resp = Rpc("ClientGet", Value::Map(std::move(payload)),
+                   timeout_s >= 0 ? timeout_s + 30.0 : 600.0);
+  const auto& m = resp.AsMap();
+  if (!m.at("ok").AsBool())
+    throw RayError("task error: " + m.at("error_str").AsStr());
+  std::vector<Value> out;
+  for (const auto& v : m.at("values").AsList()) out.push_back(v);
+  return out;
+}
+
+Value Client::Get(const ObjectRef& ref, double timeout_s) {
+  return Get(std::vector<ObjectRef>{ref}, timeout_s)[0];
+}
+
+std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> Client::Wait(
+    const std::vector<ObjectRef>& refs, int num_returns, double timeout_s) {
+  std::vector<Value> hexes;
+  for (const auto& r : refs) hexes.push_back(Value::Str(r.Hex()));
+  std::map<std::string, Value> payload{
+      {"refs", Value::List(hexes)}, {"num_returns", Value::Int(num_returns)}};
+  if (timeout_s >= 0) payload["timeout"] = Value::Dbl(timeout_s);
+  Value resp = Rpc("ClientWait", Value::Map(std::move(payload)),
+                   timeout_s >= 0 ? timeout_s + 30.0 : 600.0);
+  const auto& m = resp.AsMap();
+  std::pair<std::vector<ObjectRef>, std::vector<ObjectRef>> out;
+  for (const auto& h : m.at("ready").AsList()) out.first.emplace_back(h.AsStr());
+  for (const auto& h : m.at("not_ready").AsList())
+    out.second.emplace_back(h.AsStr());
+  return out;
+}
+
+std::vector<ObjectRef> Client::CallMulti(const std::string& qualified_name,
+                                         std::vector<Value> args,
+                                         const CallOptions& opts) {
+  Value resp = Rpc("ClientTask",
+                   Value::Map({{"codec", Value::Str("msgpack")},
+                               {"name", Value::Str(qualified_name)},
+                               {"margs", Value::List(std::move(args))},
+                               {"opts", OptsToValue(opts)}}));
+  return RefsFrom(resp);
+}
+
+ObjectRef Client::Call(const std::string& qualified_name,
+                       std::vector<Value> args, const CallOptions& opts) {
+  return CallMulti(qualified_name, std::move(args), opts)[0];
+}
+
+ActorHandle Client::CreateActor(const std::string& qualified_class,
+                                std::vector<Value> args,
+                                const CallOptions& opts) {
+  Value resp = Rpc("ClientActorCreate",
+                   Value::Map({{"codec", Value::Str("msgpack")},
+                               {"name", Value::Str(qualified_class)},
+                               {"margs", Value::List(std::move(args))},
+                               {"opts", OptsToValue(opts)},
+                               {"detached", Value::Boolean(
+                                   opts.lifetime == "detached")}}));
+  const auto& m = resp.AsMap();
+  return ActorHandle(m.at("actor_id").AsStr(), m.at("class_name").AsStr());
+}
+
+ObjectRef Client::CallMethod(const ActorHandle& actor, const std::string& method,
+                             std::vector<Value> args) {
+  Value resp = Rpc("ClientActorCall",
+                   Value::Map({{"codec", Value::Str("msgpack")},
+                               {"actor", Value::Str(actor.IdHex())},
+                               {"class_name", Value::Str(actor.ClassName())},
+                               {"method", Value::Str(method)},
+                               {"margs", Value::List(std::move(args))}}));
+  return RefsFrom(resp)[0];
+}
+
+ActorHandle Client::GetActor(const std::string& name, const std::string& ns) {
+  std::map<std::string, Value> payload{{"name", Value::Str(name)}};
+  if (!ns.empty()) payload["namespace"] = Value::Str(ns);
+  Value resp = Rpc("ClientGetActor", Value::Map(std::move(payload)));
+  const auto& m = resp.AsMap();
+  return ActorHandle(m.at("actor_id").AsStr(), m.at("class_name").AsStr());
+}
+
+void Client::Kill(const ActorHandle& actor, bool no_restart) {
+  Rpc("ClientKill", Value::Map({{"actor", Value::Str(actor.IdHex())},
+                                {"class_name", Value::Str(actor.ClassName())},
+                                {"no_restart", Value::Boolean(no_restart)}}));
+}
+
+void Client::Release(const ObjectRef& ref) {
+  Rpc("ClientRelease",
+      Value::Map({{"refs", Value::List({Value::Str(ref.Hex())})}}));
+}
+
+std::map<std::string, double> Client::ClusterResources() {
+  Value resp = Rpc("ClientClusterInfo", Value::Map({}));
+  std::map<std::string, double> out;
+  for (const auto& kv : resp.AsMap().at("resources").AsMap())
+    out[kv.first] = kv.second.AsDouble();
+  return out;
+}
+
+}  // namespace tpu
+}  // namespace ray

@@ -1,0 +1,100 @@
+// End-to-end test for the C++ frontend. Run by tests/test_cpp_client.py:
+//   client_test <host> <port>
+// Calls Python functions in tests/cpp_test_module.py through the client
+// proxy and prints CPP_CLIENT_OK on success.
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "ray_tpu/api.h"
+
+using ray::tpu::ActorHandle;
+using ray::tpu::CallOptions;
+using ray::tpu::Client;
+using ray::tpu::ObjectRef;
+using ray::tpu::Value;
+
+#define CHECK(cond)                                                 \
+  do {                                                              \
+    if (!(cond)) {                                                  \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__, \
+                   __LINE__, #cond);                                \
+      std::exit(1);                                                 \
+    }                                                               \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: client_test <host> <port>\n");
+    return 2;
+  }
+  Client client(argv[1], std::atoi(argv[2]));
+  CHECK(!client.SessionId().empty());
+
+  // Put / Get round trip of a nested structure.
+  Value payload = Value::Map({
+      {"ints", Value::List({Value::Int(1), Value::Int(-2), Value::Int(1 << 20)})},
+      {"pi", Value::Dbl(3.5)},
+      {"name", Value::Str("tpu")},
+      {"blob", Value::Bin(std::string("\x00\x01\x02", 3))},
+      {"flag", Value::Boolean(true)},
+      {"none", Value::Nil()},
+  });
+  ObjectRef ref = client.Put(payload);
+  Value back = client.Get(ref);
+  CHECK(back == payload);
+
+  // Cross-language task: Python function by qualified name.
+  ObjectRef sum = client.Call("tests.cpp_test_module:add",
+                              {Value::Int(40), Value::Int(2)});
+  CHECK(client.Get(sum).AsInt() == 42);
+
+  // Ref passed as a task argument resolves server-side.
+  ObjectRef doubled =
+      client.Call("tests.cpp_test_module:double_dict", {ref.AsValue()});
+  Value dd = client.Get(doubled);
+  CHECK(dd.AsMap().at("pi").AsDouble() == 7.0);
+
+  // Wait.
+  auto ready_pair = client.Wait({sum, doubled}, 2, 10.0);
+  CHECK(ready_pair.first.size() == 2);
+
+  // Task errors surface as exceptions.
+  bool threw = false;
+  try {
+    client.Get(client.Call("tests.cpp_test_module:boom", {}));
+  } catch (const ray::tpu::RayError& e) {
+    threw = std::string(e.what()).find("bang") != std::string::npos;
+  }
+  CHECK(threw);
+
+  // Actor lifecycle.
+  ActorHandle counter = client.CreateActor("tests.cpp_test_module:Counter",
+                                           {Value::Int(10)});
+  CHECK(client.Get(client.CallMethod(counter, "inc", {Value::Int(5)})).AsInt() ==
+        15);
+  CHECK(client.Get(client.CallMethod(counter, "inc", {Value::Int(1)})).AsInt() ==
+        16);
+  client.Kill(counter);
+
+  // Named actor lookup.
+  CallOptions opts;
+  opts.name = "cpp-named";
+  opts.lifetime = "detached";
+  ActorHandle named =
+      client.CreateActor("tests.cpp_test_module:Counter", {Value::Int(0)}, opts);
+  client.Get(client.CallMethod(named, "inc", {Value::Int(3)}));
+  ActorHandle found = client.GetActor("cpp-named");
+  CHECK(client.Get(client.CallMethod(found, "inc", {Value::Int(1)})).AsInt() ==
+        4);
+  client.Kill(found);
+
+  // Cluster info.
+  auto resources = client.ClusterResources();
+  CHECK(resources.count("CPU") == 1);
+
+  client.Release(ref);
+  std::printf("CPP_CLIENT_OK\n");
+  return 0;
+}

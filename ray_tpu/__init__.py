@@ -22,6 +22,7 @@ __version__ = "0.1.0"
 _init_lock = threading.RLock()
 _runtime_node = None  # RuntimeNode when this process started the cluster
 _driver_core_worker = None
+_client_ctx = None  # ClientContext when attached via address="client://..."
 
 
 def init(address: str | None = None, *, resources: dict | None = None,
@@ -35,14 +36,41 @@ def init(address: str | None = None, *, resources: dict | None = None,
 
     address=None starts a local head (GCS + raylet) like the reference's
     `ray.init()`; address="host:port" connects to an existing GCS
-    (the reference's ray.init(address=...)).
+    (the reference's ray.init(address=...)); address="client://host:port"
+    attaches as a remote client through a proxy (the reference's `ray://`).
     """
-    global _runtime_node, _driver_core_worker
+    global _runtime_node, _driver_core_worker, _client_ctx
     from ray_tpu._private.node import RuntimeNode
     from ray_tpu._private.worker import CoreWorker
 
+    if address is not None and address.startswith("client://"):
+        from ray_tpu.util.client.worker import ClientContext
+
+        unsupported = {
+            "resources": resources, "labels": labels, "num_cpus": num_cpus,
+            "object_store_memory": object_store_memory,
+            "namespace": namespace, "runtime_env": runtime_env,
+        }
+        bad = [k for k, v in unsupported.items() if v is not None]
+        if bad:
+            raise ValueError(
+                f"init(address='client://...') does not support {bad}; these "
+                "are driver/cluster options — set them on the server side")
+        with _init_lock:
+            if _client_ctx is not None or _driver_core_worker is not None:
+                if ignore_reinit_error:
+                    return
+                raise exceptions.RayTpuError("ray_tpu.init() called twice")
+            target = address[len("client://"):]
+            host, sep, port_s = target.rpartition(":")
+            if not sep or not port_s.isdigit():
+                raise ValueError(
+                    f"client address must be client://host:port, got {address!r}")
+            _client_ctx = ClientContext(host, int(port_s))
+            return
+
     with _init_lock:
-        if _driver_core_worker is not None:
+        if _driver_core_worker is not None or _client_ctx is not None:
             if ignore_reinit_error:
                 return
             raise exceptions.RayTpuError("ray_tpu.init() called twice")
@@ -134,12 +162,17 @@ def _query_nodes(gcs_host: str, gcs_port: int, cfg: Config) -> list[dict]:
 
 
 def is_initialized() -> bool:
-    return api_internal.core_worker_or_none() is not None
+    return (api_internal.core_worker_or_none() is not None
+            or _client_ctx is not None)
 
 
 def shutdown():
-    global _runtime_node, _driver_core_worker
+    global _runtime_node, _driver_core_worker, _client_ctx
     with _init_lock:
+        if _client_ctx is not None:
+            _client_ctx.close()
+            _client_ctx = None
+            return
         cw = api_internal.core_worker_or_none()
         if cw is not None:
             cw.shutdown()
@@ -153,20 +186,42 @@ def shutdown():
             _runtime_node = None
 
 
+def _client_mode():
+    """The active ClientContext, or None when a local CoreWorker exists.
+
+    Mirrors the reference's client_mode_hook dispatch
+    (reference: python/ray/_private/client_mode_hook.py): a worker-side
+    CoreWorker always wins so library code running *on* the cluster is
+    unaffected by a client connection in the same process.
+    """
+    if api_internal.core_worker_or_none() is not None:
+        return None
+    return _client_ctx
+
+
 def remote(*args, **kwargs):
     """@ray_tpu.remote decorator for functions and classes."""
     if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        ctx = _client_mode()
+        if ctx is not None:
+            return ctx.remote(args[0], {})
         return api_internal.make_remote(args[0], {})
     if args:
         raise TypeError("@ray_tpu.remote takes keyword options only")
 
     def wrap(obj):
+        ctx = _client_mode()
+        if ctx is not None:
+            return ctx.remote(obj, kwargs)
         return api_internal.make_remote(obj, kwargs)
 
     return wrap
 
 
 def put(value: Any) -> ObjectRef:
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.put(value)
     cw = api_internal.get_core_worker()
     if isinstance(value, ObjectRef):
         raise TypeError("ray_tpu.put() of an ObjectRef is not allowed")
@@ -175,6 +230,9 @@ def put(value: Any) -> ObjectRef:
 
 
 def get(refs, timeout: float | None = None):
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.get(refs, timeout=timeout)
     cw = api_internal.get_core_worker()
     single = isinstance(refs, ObjectRef)
     if single:
@@ -189,6 +247,9 @@ def get(refs, timeout: float | None = None):
 
 def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
          timeout: float | None = None):
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.wait(refs, num_returns=num_returns, timeout=timeout)
     cw = api_internal.get_core_worker()
     refs = list(refs)
     if num_returns > len(refs):
@@ -200,7 +261,10 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
     return [refs[i] for i in ready_idx], [refs[i] for i in not_ready_idx]
 
 
-def kill(actor: ActorHandle, *, no_restart: bool = True):
+def kill(actor, *, no_restart: bool = True):
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.kill(actor, no_restart=no_restart)
     cw = api_internal.get_core_worker()
     if not isinstance(actor, ActorHandle):
         raise TypeError("ray_tpu.kill() takes an ActorHandle")
@@ -210,6 +274,9 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 def cancel(ref: ObjectRef, *, force: bool = False):
     """Best-effort cancellation of a pending task (running-task interrupt
     lands with the richer cancel path; reference: worker.py:2850)."""
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.cancel(ref, force=force)
     cw = api_internal.get_core_worker()
     task_id = ref.id.task_id().hex()
 
@@ -231,6 +298,9 @@ def cancel(ref: ObjectRef, *, force: bool = False):
 
 
 def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.get_actor(name, namespace=namespace)
     cw = api_internal.get_core_worker()
     resp = cw._run(cw.gcs.call("GetNamedActor", {
         "name": name, "namespace": namespace or "default"}))
@@ -242,11 +312,17 @@ def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
 
 
 def nodes() -> list[dict]:
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.nodes()
     cw = api_internal.get_core_worker()
     return cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"]
 
 
 def cluster_resources() -> dict:
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.cluster_resources()
     total: dict[str, float] = {}
     for n in nodes():
         if n["alive"]:
@@ -256,6 +332,9 @@ def cluster_resources() -> dict:
 
 
 def available_resources() -> dict:
+    ctx = _client_mode()
+    if ctx is not None:
+        return ctx.available_resources()
     total: dict[str, float] = {}
     for n in nodes():
         if n["alive"]:

@@ -32,6 +32,13 @@ def get_core_worker():
     return _core_worker
 
 
+def _client_fallback():
+    """Active ClientContext when this process has no CoreWorker, else None."""
+    import ray_tpu
+
+    return ray_tpu._client_mode()
+
+
 def core_worker_or_none():
     return _core_worker
 
@@ -205,6 +212,15 @@ class RemoteFunction:
         return rf
 
     def remote(self, *args, **kwargs):
+        ctx = _client_fallback()
+        if ctx is not None:
+            # Decorated before init(address="client://..."): route through
+            # the client context at call time (reference: client_mode_hook).
+            # Cache keyed by context — a reconnect gets a fresh wrapper.
+            cached = getattr(self, "_client_rf", None)
+            if cached is None or cached[0] is not ctx:
+                cached = self._client_rf = (ctx, ctx.remote(self._fn, self._opts))
+            return cached[1].remote(*args, **kwargs)
         cw = get_core_worker()
         func_key = self._func_keys.get(cw.job_id)
         if func_key is None:
@@ -334,6 +350,12 @@ class ActorClass:
         return ac
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        ctx = _client_fallback()
+        if ctx is not None:
+            cached = getattr(self, "_client_ac", None)
+            if cached is None or cached[0] is not ctx:
+                cached = self._client_ac = (ctx, ctx.remote(self._cls, self._opts))
+            return cached[1].remote(*args, **kwargs)
         cw = get_core_worker()
         class_key = self._class_keys.get(cw.job_id)
         if class_key is None:

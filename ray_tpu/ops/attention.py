@@ -118,6 +118,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
+def _operand_vma(*arrays) -> frozenset:
+    """Union of mesh axes the operands vary over (empty outside shard_map)."""
+    vma: frozenset = frozenset()
+    for a in arrays:
+        t = jax.typeof(a)
+        vma = vma | getattr(t, "vma", frozenset())
+    return vma
+
+
 def _flash_forward(q, k, v, sm_scale: float, causal: bool,
                    block_q: int, block_k: int,
                    kv_valid_len: int | None = None):
@@ -166,8 +175,12 @@ def _flash_forward(q, k, v, sm_scale: float, causal: bool,
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
+            # vma: under shard_map (ring/Ulysses wrappers) outputs vary
+            # over the same mesh axes as the operands; required when the
+            # kernel is called with check_vma=True (the default).
+            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype, vma=_operand_vma(q, k, v)),
+            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32,
+                                 vma=_operand_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32) if pltpu else None,

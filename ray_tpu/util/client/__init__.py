@@ -1,0 +1,8 @@
+"""Remote-driver client mode (reference: python/ray/util/client/ — the
+`ray://` proxy). Connect via ray_tpu.init(address="client://host:port");
+serve with ray_tpu.util.client.server.serve() from any driver process."""
+
+from ray_tpu.util.client.common import ClientActorHandle, ClientObjectRef
+from ray_tpu.util.client.worker import ClientContext
+
+__all__ = ["ClientActorHandle", "ClientContext", "ClientObjectRef"]

@@ -1,0 +1,190 @@
+"""Shared wire types for the client protocol (reference:
+python/ray/util/client/common.py — ClientObjectRef/ClientActorRef).
+
+Cross-process pickling: values crossing the client<->server boundary may
+contain ObjectRefs / ActorHandles. Each side swaps its own ref types for
+resolvable markers before pickling, so the other side reconstructs the
+right kind of handle:
+
+  server -> client: real ObjectRef  -> ClientObjectRef (registered in the
+                    session ref table so the server keeps it alive)
+  client -> server: ClientObjectRef -> the session's real ObjectRef
+                    (resolved through a contextvar set per request)
+"""
+
+from __future__ import annotations
+
+import contextvars
+import io
+import pickle
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    cloudpickle = None
+
+# Set by the server around every request dispatch so client-ref markers
+# deserialize to that session's real refs.
+current_session: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_client_session", default=None)
+
+# Set in the client process (the ClientContext) so server-ref markers
+# deserialize to ClientObjectRef bound to that connection.
+current_client: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_client_context", default=None)
+
+
+class ClientObjectRef:
+    """Client-side handle to an object owned by the server-side driver."""
+
+    __slots__ = ("hex", "_ctx", "__weakref__")
+
+    def __init__(self, ref_hex: str, ctx=None):
+        self.hex = ref_hex
+        self._ctx = ctx
+
+    def binary(self) -> bytes:
+        return bytes.fromhex(self.hex)
+
+    def __hash__(self):
+        return hash(self.hex)
+
+    def __eq__(self, other):
+        return isinstance(other, ClientObjectRef) and other.hex == self.hex
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.hex[:16]})"
+
+    def __reduce__(self):
+        # Pickled client->server inside task args: resolve to the real ref.
+        return (_resolve_ref_on_server, (self.hex,))
+
+    def __del__(self):
+        ctx = self._ctx
+        if ctx is not None:
+            try:
+                ctx._release(self.hex)
+            except Exception:
+                pass
+
+
+class ClientActorHandle:
+    """Client-side handle to an actor created through the proxy."""
+
+    def __init__(self, actor_hex: str, class_name: str, ctx=None):
+        self._actor_hex = actor_hex
+        self._class_name = class_name
+        self._ctx = ctx
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientActorMethod(self, name, 1)
+
+    def __reduce__(self):
+        return (_resolve_actor_on_server, (self._actor_hex, self._class_name))
+
+    def __repr__(self):
+        return f"ClientActorHandle({self._class_name}, {self._actor_hex[:12]})"
+
+
+class _ClientActorMethod:
+    def __init__(self, handle: ClientActorHandle, name: str, num_returns: int):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **opts):
+        return _ClientActorMethod(self._handle, self._name,
+                                  opts.get("num_returns", self._num_returns))
+
+    def remote(self, *args, **kwargs):
+        ctx = self._handle._ctx
+        if ctx is None:
+            raise RuntimeError("actor handle is not bound to a client")
+        return ctx._actor_call(self._handle._actor_hex, self._name,
+                               args, kwargs, self._num_returns)
+
+
+def _active_client():
+    """The client context for this process: the contextvar when set, else
+    the process-global one (unpickling can happen on any thread, and
+    contextvars don't cross threads)."""
+    ctx = current_client.get()
+    if ctx is not None:
+        return ctx
+    try:
+        import ray_tpu
+
+        return ray_tpu._client_ctx
+    except Exception:
+        return None
+
+
+def _resolve_ref_on_server(ref_hex: str):
+    session = current_session.get()
+    if session is None:
+        # Unpickled in a plain client process (e.g. a round trip): rebuild
+        # a client ref bound to the active context.
+        return ClientObjectRef(ref_hex, _active_client())
+    return session.resolve_ref(ref_hex)
+
+
+def _resolve_actor_on_server(actor_hex: str, class_name: str):
+    session = current_session.get()
+    if session is None:
+        return ClientActorHandle(actor_hex, class_name, _active_client())
+    return session.resolve_actor(actor_hex, class_name)
+
+
+def _rebuild_client_ref(ref_hex: str):
+    """Server->client marker: becomes a ClientObjectRef on the client."""
+    session = current_session.get()
+    if session is not None:  # value bounced back to the server
+        return session.resolve_ref(ref_hex)
+    return ClientObjectRef(ref_hex, _active_client())
+
+
+def _rebuild_client_actor(actor_hex: str, class_name: str):
+    session = current_session.get()
+    if session is not None:
+        return session.resolve_actor(actor_hex, class_name)
+    return ClientActorHandle(actor_hex, class_name, _active_client())
+
+
+class ServerPickler(pickle.Pickler):
+    """Server-side pickler: swaps real refs for client markers, pinning
+    each emitted ref in the session table so it survives until the client
+    releases it."""
+
+    def __init__(self, file, session):
+        super().__init__(file, protocol=5)
+        self.session = session
+
+    def reducer_override(self, obj):
+        from ray_tpu._private.api_internal import ActorHandle, ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            self.session.pin_ref(obj)
+            return (_rebuild_client_ref, (obj.hex(),))
+        if isinstance(obj, ActorHandle):
+            return (_rebuild_client_actor, (obj._id_hex, obj._class_name))
+        return NotImplemented
+
+
+def server_dumps(value, session) -> bytes:
+    buf = io.BytesIO()
+    ServerPickler(buf, session).dump(value)
+    return buf.getvalue()
+
+
+def client_dumps(value) -> bytes:
+    """Client-side serialization; cloudpickle so lambdas/closures work in
+    task args the same as on a cluster driver."""
+    if cloudpickle is not None:
+        return cloudpickle.dumps(value, protocol=5)
+    return pickle.dumps(value, protocol=5)
+
+
+def loads(data: bytes):
+    return pickle.loads(data)

@@ -1,0 +1,378 @@
+"""Client proxy server: hosts remote drivers over the msgpack RPC protocol.
+
+Re-design of the reference Ray Client server (reference:
+python/ray/util/client/server/server.py + proxier.py — a gRPC proxy that
+runs a server-side driver per remote client). Here the proxy lives inside
+any process that has called ray_tpu.init() (typically the head node); each
+client connection gets a Session that tracks the refs and actors created
+on the client's behalf, released on disconnect.
+
+Two value codecs per request:
+  "pickle"  — Python clients: cloudpickled blobs, refs swapped via
+              common.ServerPickler markers.
+  "msgpack" — cross-language clients (the C++ frontend, cpp/): values are
+              plain msgpack structures carried inside the RPC payload
+              (reference: msgpack cross-language serialization path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import importlib
+import logging
+import threading
+import uuid
+
+from ray_tpu._private import rpc
+from ray_tpu.util.client import common
+
+logger = logging.getLogger(__name__)
+
+
+def _resolve_qualified(name: str):
+    """Resolve "module:attr" or "module.attr" to a Python object
+    (reference: cross-language function descriptors,
+    python/ray/cross_language.py)."""
+    if ":" in name:
+        mod_name, attr = name.split(":", 1)
+    else:
+        mod_name, _, attr = name.rpartition(".")
+        if not mod_name:
+            raise ValueError(f"qualified name required, got {name!r}")
+    obj = importlib.import_module(mod_name)
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+class Session:
+    """Per-connection server state: pinned refs + actors owned by one client."""
+
+    def __init__(self, server: "ClientServer", conn: rpc.Connection):
+        self.server = server
+        self.conn = conn
+        self.id = uuid.uuid4().hex[:12]
+        self.refs: dict[str, object] = {}      # hex -> ObjectRef (pins it)
+        self.actors: dict[str, object] = {}    # hex -> ActorHandle
+        self.detached: set[str] = set()        # actor hexes to keep on close
+        self.func_cache: dict[str, object] = {}  # key -> fn/class
+
+    def pin_ref(self, ref) -> None:
+        self.refs.setdefault(ref.hex(), ref)
+
+    def resolve_ref(self, ref_hex: str):
+        ref = self.refs.get(ref_hex)
+        if ref is None:
+            raise KeyError(f"client session {self.id}: unknown ref {ref_hex[:16]}")
+        return ref
+
+    def resolve_actor(self, actor_hex: str, class_name: str):
+        handle = self.actors.get(actor_hex)
+        if handle is None:
+            from ray_tpu._private.api_internal import ActorHandle
+            from ray_tpu._private.ids import ActorID
+
+            handle = ActorHandle(ActorID.from_hex(actor_hex), class_name)
+            self.actors[actor_hex] = handle
+        return handle
+
+    def close(self) -> None:
+        import ray_tpu
+
+        self.refs.clear()
+        for hex_id, handle in self.actors.items():
+            if hex_id in self.detached:
+                continue
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+        self.actors.clear()
+
+
+class ClientServer:
+    """Serves remote clients against this process's driver CoreWorker."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 10001):
+        from ray_tpu._private.api_internal import get_core_worker
+
+        self.cw = get_core_worker()  # raises if init() not called
+        self.requested_host, self.requested_port = host, port
+        self.host = self.port = None
+        self._sessions: dict[int, Session] = {}
+        self._server = rpc.RpcServer(self._handlers(), name="client-server",
+                                     on_connect=self._on_connect)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> tuple[str, int]:
+        self._thread = threading.Thread(
+            target=self._run, name="ray-tpu-client-server", daemon=True)
+        self._thread.start()
+        self._started.wait(10.0)
+        if self.port is None:
+            raise RuntimeError("client server failed to start")
+        return self.host, self.port
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def go():
+            self.host, self.port = await self._server.start(
+                self.requested_host, self.requested_port)
+            self._started.set()
+
+        self._loop.run_until_complete(go())
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    def stop(self):
+        if self._loop is None:
+            return
+
+        async def down():
+            for s in list(self._sessions.values()):
+                s.close()
+            self._sessions.clear()
+            await self._server.stop()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(down(), self._loop)
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    def _on_connect(self, conn: rpc.Connection):
+        session = Session(self, conn)
+        self._sessions[id(conn)] = session
+
+        def gone():
+            s = self._sessions.pop(id(conn), None)
+            if s is not None:
+                # Session teardown calls into the cluster; keep it off the
+                # RPC loop.
+                threading.Thread(target=s.close, daemon=True).start()
+
+        conn.on_close(gone)
+
+    def _session(self, conn) -> Session:
+        s = self._sessions.get(id(conn))
+        if s is None:
+            raise rpc.RpcError("no session for connection")
+        return s
+
+    # -- request plumbing --------------------------------------------------
+
+    def _handlers(self):
+        return {
+            "ClientPing": self._ping,
+            "ClientPut": self._wrap(self._put),
+            "ClientGet": self._wrap(self._get),
+            "ClientWait": self._wrap(self._wait),
+            "ClientRegisterFunction": self._wrap(self._register_function),
+            "ClientTask": self._wrap(self._task),
+            "ClientActorCreate": self._wrap(self._actor_create),
+            "ClientActorCall": self._wrap(self._actor_call),
+            "ClientKill": self._wrap(self._kill),
+            "ClientCancel": self._wrap(self._cancel),
+            "ClientRelease": self._wrap(self._release),
+            "ClientGetActor": self._wrap(self._get_actor),
+            "ClientClusterInfo": self._wrap(self._cluster_info),
+            "ClientGcsCall": self._wrap(self._gcs_call),
+        }
+
+    async def _ping(self, conn, payload):
+        return {"ok": True, "session": self._session(conn).id}
+
+    def _wrap(self, fn):
+        async def handler(conn, payload):
+            session = self._session(conn)
+            token = common.current_session.set(session)
+            try:
+                # Blocking cluster calls run off the RPC loop so one slow
+                # client get() cannot stall every session.
+                return await asyncio.to_thread(fn, session, payload or {})
+            finally:
+                common.current_session.reset(token)
+        return handler
+
+    # -- value codecs ------------------------------------------------------
+
+    def _load_args(self, session, payload):
+        codec = payload.get("codec", "pickle")
+        if codec == "msgpack":
+            resolve = lambda v: self._resolve_markers(session, v)
+            return (tuple(resolve(a) for a in (payload.get("margs") or [])),
+                    {k: resolve(v)
+                     for k, v in (payload.get("mkwargs") or {}).items()})
+        blob = payload["args"]
+        args, kwargs = common.loads(blob)
+        return args, kwargs
+
+    def _resolve_markers(self, session, value):
+        """Swap {"__client_ref__": hex} markers in msgpack args for the
+        session's real ObjectRefs (cross-language ref passing)."""
+        if isinstance(value, dict):
+            if set(value.keys()) == {"__client_ref__"}:
+                return session.resolve_ref(value["__client_ref__"])
+            return {k: self._resolve_markers(session, v)
+                    for k, v in value.items()}
+        if isinstance(value, (list, tuple)):
+            return [self._resolve_markers(session, v) for v in value]
+        return value
+
+    def _dump_value(self, session, value, codec: str):
+        if codec == "msgpack":
+            return value  # carried natively in the RPC frame
+        return common.server_dumps(value, session)
+
+    def _new_refs(self, session, refs) -> list[str]:
+        single = not isinstance(refs, list)
+        if single:
+            refs = [refs]
+        out = []
+        for r in refs:
+            session.pin_ref(r)
+            out.append(r.hex())
+        return out
+
+    # -- operations --------------------------------------------------------
+
+    def _put(self, session, payload):
+        import ray_tpu
+
+        if payload.get("codec") == "msgpack":
+            value = payload.get("value")
+        else:
+            value = common.loads(payload["data"])
+        ref = ray_tpu.put(value)
+        return {"refs": self._new_refs(session, ref)}
+
+    def _get(self, session, payload):
+        import ray_tpu
+
+        codec = payload.get("codec", "pickle")
+        refs = [session.resolve_ref(h) for h in payload["refs"]]
+        try:
+            values = ray_tpu.get(refs, timeout=payload.get("timeout"))
+        except Exception as e:  # ship the exception for client-side re-raise
+            if codec == "msgpack":
+                return {"ok": False, "error_str": f"{type(e).__name__}: {e}"}
+            return {"ok": False, "error": common.server_dumps(e, session)}
+        return {"ok": True,
+                "values": [self._dump_value(session, v, codec) for v in values]}
+
+    def _wait(self, session, payload):
+        import ray_tpu
+
+        refs = [session.resolve_ref(h) for h in payload["refs"]]
+        ready, not_ready = ray_tpu.wait(
+            refs, num_returns=payload.get("num_returns", 1),
+            timeout=payload.get("timeout"))
+        return {"ready": [r.hex() for r in ready],
+                "not_ready": [r.hex() for r in not_ready]}
+
+    def _register_function(self, session, payload):
+        fn = common.loads(payload["fn"])
+        key = uuid.uuid4().hex
+        session.func_cache[key] = fn
+        return {"key": key}
+
+    def _resolve_callable(self, session, payload):
+        from ray_tpu._private.api_internal import (ActorClass, RemoteFunction,
+                                                   make_remote)
+
+        if payload.get("name"):
+            obj = _resolve_qualified(payload["name"])
+        else:
+            obj = session.func_cache[payload["key"]]
+        if payload.get("opts_pkl") is not None:
+            opts = common.loads(payload["opts_pkl"])
+        else:
+            opts = payload.get("opts") or {}
+        if isinstance(obj, (RemoteFunction, ActorClass)):
+            return obj.options(**opts) if opts else obj
+        return make_remote(obj, opts)
+
+    def _task(self, session, payload):
+        rf = self._resolve_callable(session, payload)
+        args, kwargs = self._load_args(session, payload)
+        refs = rf.remote(*args, **kwargs)
+        return {"refs": self._new_refs(session, refs)}
+
+    def _actor_create(self, session, payload):
+        from ray_tpu._private.api_internal import ActorClass
+
+        ac = self._resolve_callable(session, payload)
+        if not isinstance(ac, ActorClass):
+            raise TypeError("ClientActorCreate requires a class")
+        args, kwargs = self._load_args(session, payload)
+        handle = ac.remote(*args, **kwargs)
+        session.actors[handle._id_hex] = handle
+        if payload.get("detached"):
+            session.detached.add(handle._id_hex)
+        return {"actor_id": handle._id_hex, "class_name": handle._class_name}
+
+    def _actor_call(self, session, payload):
+        handle = session.resolve_actor(payload["actor"],
+                                       payload.get("class_name", "Actor"))
+        method = getattr(handle, payload["method"])
+        if payload.get("num_returns", 1) != 1:
+            method = method.options(num_returns=payload["num_returns"])
+        args, kwargs = self._load_args(session, payload)
+        refs = method.remote(*args, **kwargs)
+        return {"refs": self._new_refs(session, refs)}
+
+    def _kill(self, session, payload):
+        import ray_tpu
+
+        handle = session.resolve_actor(payload["actor"],
+                                       payload.get("class_name", "Actor"))
+        ray_tpu.kill(handle, no_restart=payload.get("no_restart", True))
+        session.actors.pop(payload["actor"], None)
+        return {}
+
+    def _cancel(self, session, payload):
+        import ray_tpu
+
+        ref = session.resolve_ref(payload["ref"])
+        ray_tpu.cancel(ref, force=payload.get("force", False))
+        return {}
+
+    def _release(self, session, payload):
+        for h in payload.get("refs", []):
+            session.refs.pop(h, None)
+        return {}
+
+    def _get_actor(self, session, payload):
+        import ray_tpu
+
+        handle = ray_tpu.get_actor(payload["name"],
+                                   namespace=payload.get("namespace"))
+        session.actors[handle._id_hex] = handle
+        session.detached.add(handle._id_hex)  # named actors are not ours
+        return {"actor_id": handle._id_hex, "class_name": handle._class_name}
+
+    def _cluster_info(self, session, payload):
+        import ray_tpu
+
+        return {"nodes": ray_tpu.nodes(),
+                "resources": ray_tpu.cluster_resources(),
+                "available": ray_tpu.available_resources()}
+
+    def _gcs_call(self, session, payload):
+        cw = self.cw
+        return cw._run(cw.gcs.call(payload["method"], payload.get("payload")))
+
+
+def serve(host: str = "0.0.0.0", port: int = 10001) -> ClientServer:
+    """Start a client proxy in this (already-initialized) driver process."""
+    server = ClientServer(host, port)
+    server.start()
+    return server

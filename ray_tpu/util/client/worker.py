@@ -1,0 +1,236 @@
+"""Client-side driver: talks to a ClientServer over msgpack RPC.
+
+Re-design of the reference Ray Client worker (reference:
+python/ray/util/client/worker.py — the `ray://` driver that proxies the
+public API over gRPC). Connect with
+ray_tpu.init(address="client://host:port"); the public API then routes
+through the ClientContext here instead of a local CoreWorker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Sequence
+
+from ray_tpu import exceptions
+from ray_tpu._private import rpc
+from ray_tpu.util.client import common
+from ray_tpu.util.client.common import ClientActorHandle, ClientObjectRef
+
+_OP_TIMEOUT = 60.0
+
+
+class ClientRemoteFunction:
+    def __init__(self, ctx: "ClientContext", fn, opts: dict):
+        self._ctx = ctx
+        self._fn = fn
+        self._opts = opts
+        self._key: str | None = None
+
+    def options(self, **opts):
+        merged = dict(self._opts)
+        merged.update(opts)
+        rf = ClientRemoteFunction(self._ctx, self._fn, merged)
+        rf._key = self._key
+        return rf
+
+    def remote(self, *args, **kwargs):
+        if self._key is None:
+            self._key = self._ctx._register_function(self._fn)
+        return self._ctx._task(self._key, args, kwargs, self._opts)
+
+    def __call__(self, *a, **k):
+        raise TypeError("remote function cannot be called directly; use .remote()")
+
+
+class ClientActorClass:
+    def __init__(self, ctx: "ClientContext", cls, opts: dict):
+        self._ctx = ctx
+        self._cls = cls
+        self._opts = opts
+        self._key: str | None = None
+
+    def options(self, **opts):
+        merged = dict(self._opts)
+        merged.update(opts)
+        ac = ClientActorClass(self._ctx, self._cls, merged)
+        ac._key = self._key
+        return ac
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        if self._key is None:
+            self._key = self._ctx._register_function(self._cls)
+        return self._ctx._actor_create(self._key, args, kwargs, self._opts)
+
+    def __call__(self, *a, **k):
+        raise TypeError("actor class cannot be instantiated directly; use .remote()")
+
+
+class ClientContext:
+    """One connection to a client proxy; owns a background RPC loop."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+        self.host, self.port = host, port
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="ray-tpu-client", daemon=True)
+        self._thread.start()
+        self._conn: rpc.Connection = self._call_soon(
+            rpc.connect_retry(host, port, name="client", timeout=connect_timeout),
+            timeout=connect_timeout + 5.0)
+        self._token = common.current_client.set(self)
+        self._closed = False
+        self.session_id = self._rpc("ClientPing", {})["session"]
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _call_soon(self, coro, timeout=_OP_TIMEOUT):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    def _rpc(self, method: str, payload, timeout=_OP_TIMEOUT):
+        """timeout=None blocks indefinitely (get()/wait() semantics match
+        the local driver path)."""
+        if self._closed:
+            raise exceptions.RayTpuError("client connection is closed")
+        try:
+            return self._call_soon(
+                self._conn.call(method, payload, timeout=timeout),
+                timeout=timeout + 5.0 if timeout is not None else None)
+        except rpc.ConnectionLost:
+            self._closed = True
+            raise exceptions.RayTpuError(
+                f"lost connection to client server {self.host}:{self.port}")
+
+    def _release(self, ref_hex: str):
+        if self._closed or not self._loop.is_running():
+            return
+
+        async def send():
+            try:
+                await self._conn.notify("ClientRelease", {"refs": [ref_hex]})
+            except Exception:
+                pass
+        try:
+            asyncio.run_coroutine_threadsafe(send(), self._loop)
+        except RuntimeError:
+            pass
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            common.current_client.reset(self._token)
+        except (ValueError, LookupError):
+            # close() may run on a different thread than __init__ set the
+            # contextvar on; the process-global fallback in common.py makes
+            # the var cosmetic, so a cross-thread reset is safely skipped.
+            pass
+        try:
+            self._call_soon(self._conn.close(), timeout=5.0)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(5.0)
+
+    def _wire_refs(self, payload) -> list[ClientObjectRef]:
+        return [ClientObjectRef(h, self) for h in payload["refs"]]
+
+    # -- API surface -------------------------------------------------------
+
+    def remote(self, obj, opts: dict):
+        if isinstance(obj, type):
+            return ClientActorClass(self, obj, opts)
+        if callable(obj):
+            return ClientRemoteFunction(self, obj, opts)
+        raise TypeError("@ray_tpu.remote requires a function or class")
+
+    def put(self, value: Any) -> ClientObjectRef:
+        if isinstance(value, ClientObjectRef):
+            raise TypeError("ray_tpu.put() of an ObjectRef is not allowed")
+        resp = self._rpc("ClientPut", {"data": common.client_dumps(value)})
+        return self._wire_refs(resp)[0]
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ClientObjectRef)
+        if single:
+            refs = [refs]
+        refs = list(refs)
+        for r in refs:
+            if not isinstance(r, ClientObjectRef):
+                raise TypeError(f"ray_tpu.get() takes ObjectRefs, got {type(r)}")
+        resp = self._rpc("ClientGet",
+                         {"refs": [r.hex for r in refs], "timeout": timeout},
+                         timeout=None if timeout is None else timeout + 30.0)
+        if not resp["ok"]:
+            raise common.loads(resp["error"])
+        values = [common.loads(v) for v in resp["values"]]
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ClientObjectRef], *, num_returns=1,
+             timeout=None):
+        refs = list(refs)
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        resp = self._rpc("ClientWait", {
+            "refs": [r.hex for r in refs], "num_returns": num_returns,
+            "timeout": timeout},
+            timeout=None if timeout is None else timeout + 30.0)
+        by_hex = {r.hex: r for r in refs}
+        return ([by_hex[h] for h in resp["ready"]],
+                [by_hex[h] for h in resp["not_ready"]])
+
+    def _register_function(self, fn) -> str:
+        return self._rpc("ClientRegisterFunction",
+                         {"fn": common.client_dumps(fn)})["key"]
+
+    def _task(self, key: str, args, kwargs, opts):
+        resp = self._rpc("ClientTask", {
+            "key": key, "args": common.client_dumps((args, kwargs)),
+            "opts_pkl": common.client_dumps(opts)})
+        refs = self._wire_refs(resp)
+        if opts.get("num_returns", 1) == 1:
+            return refs[0]
+        return refs
+
+    def _actor_create(self, key: str, args, kwargs, opts) -> ClientActorHandle:
+        resp = self._rpc("ClientActorCreate", {
+            "key": key, "args": common.client_dumps((args, kwargs)),
+            "opts_pkl": common.client_dumps(opts),
+            "detached": opts.get("lifetime") == "detached"})
+        return ClientActorHandle(resp["actor_id"], resp["class_name"], self)
+
+    def _actor_call(self, actor_hex: str, method: str, args, kwargs,
+                    num_returns: int):
+        resp = self._rpc("ClientActorCall", {
+            "actor": actor_hex, "method": method,
+            "args": common.client_dumps((args, kwargs)),
+            "num_returns": num_returns})
+        refs = self._wire_refs(resp)
+        return refs[0] if num_returns == 1 else refs
+
+    def kill(self, actor: ClientActorHandle, *, no_restart: bool = True):
+        if not isinstance(actor, ClientActorHandle):
+            raise TypeError("ray_tpu.kill() takes an ActorHandle")
+        self._rpc("ClientKill", {"actor": actor._actor_hex,
+                                 "class_name": actor._class_name,
+                                 "no_restart": no_restart})
+
+    def cancel(self, ref: ClientObjectRef, *, force: bool = False):
+        self._rpc("ClientCancel", {"ref": ref.hex, "force": force})
+
+    def get_actor(self, name: str, namespace: str | None = None):
+        resp = self._rpc("ClientGetActor",
+                         {"name": name, "namespace": namespace})
+        return ClientActorHandle(resp["actor_id"], resp["class_name"], self)
+
+    def nodes(self) -> list[dict]:
+        return self._rpc("ClientClusterInfo", {})["nodes"]
+
+    def cluster_resources(self) -> dict:
+        return self._rpc("ClientClusterInfo", {})["resources"]
+
+    def available_resources(self) -> dict:
+        return self._rpc("ClientClusterInfo", {})["available"]

@@ -1,0 +1,31 @@
+"""Python targets invoked by the C++ client test (cpp/test/client_test.cc)
+through cross-language qualified-name descriptors."""
+
+from __future__ import annotations
+
+
+def add(x, y):
+    return x + y
+
+
+def double_dict(d):
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = v * 2
+        else:
+            out[k] = v
+    return out
+
+
+def boom():
+    raise ValueError("bang")
+
+
+class Counter:
+    def __init__(self, start):
+        self.v = start
+
+    def inc(self, n=1):
+        self.v += n
+        return self.v

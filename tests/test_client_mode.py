@@ -1,0 +1,122 @@
+"""Client proxy (`client://`) + C++ frontend tests (reference test model:
+python/ray/tests/test_client.py, test_client_builder.py)."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _server_main(port_q):
+    import ray_tpu
+    from ray_tpu.util.client.server import serve
+
+    ray_tpu.init(num_cpus=4)
+    s = serve(host="127.0.0.1", port=0)
+    port_q.put(s.port)
+    time.sleep(300)
+
+
+@pytest.fixture(scope="module")
+def client_cluster():
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    proc = ctx.Process(target=_server_main, args=(q,), daemon=True)
+    proc.start()
+    port = q.get(timeout=90)
+    yield "127.0.0.1", port
+    proc.terminate()
+    proc.join(10)
+
+
+@pytest.fixture()
+def client(client_cluster):
+    import ray_tpu
+
+    host, port = client_cluster
+    ray_tpu.init(address=f"client://{host}:{port}")
+    yield
+    ray_tpu.shutdown()
+
+
+def test_client_put_get_task_actor(client):
+    import ray_tpu
+
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    out = mul.remote(6, ray_tpu.put(7))
+    assert ray_tpu.get(out) == 42
+
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    acc = Acc.remote()
+    assert ray_tpu.get(acc.add.remote(3)) == 3
+    assert ray_tpu.get(acc.add.remote(4)) == 7
+    ray_tpu.kill(acc)
+
+
+def test_client_nested_refs_and_errors(client):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def produce():
+        import ray_tpu as rt
+
+        return [rt.put(11), rt.put(22)]
+
+    inner = ray_tpu.get(produce.remote())
+    assert ray_tpu.get(inner) == [11, 22]
+
+    @ray_tpu.remote
+    def fail():
+        raise RuntimeError("client boom")
+
+    with pytest.raises(Exception, match="client boom"):
+        ray_tpu.get(fail.remote())
+
+
+def test_client_wait_and_cluster_info(client):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    refs = [quick.remote() for _ in range(4)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=4, timeout=30)
+    assert len(ready) == 4 and not not_ready
+    assert ray_tpu.cluster_resources().get("CPU", 0) >= 4
+    assert len(ray_tpu.nodes()) == 1
+
+
+def test_cpp_client_end_to_end(client_cluster):
+    """Build (if needed) and run the C++ frontend against the proxy."""
+    host, port = client_cluster
+    binary = os.path.join(REPO, "cpp", "build", "client_test")
+    if not os.path.exists(binary):
+        r = subprocess.run(["make"], cwd=os.path.join(REPO, "cpp"),
+                           capture_output=True, text=True, timeout=180)
+        assert r.returncode == 0, f"cpp build failed:\n{r.stdout}\n{r.stderr}"
+    r = subprocess.run([binary, host, str(port)], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, f"cpp client failed:\n{r.stdout}\n{r.stderr}"
+    assert "CPP_CLIENT_OK" in r.stdout
