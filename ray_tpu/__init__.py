@@ -130,6 +130,11 @@ def init(address: str | None = None, *, resources: dict | None = None,
             is_driver=True, config=cfg, owns_cluster=address is None)
         _driver_core_worker = cw
         api_internal.set_core_worker(cw)
+        if _runtime_node is not None:
+            from ray_tpu._private.usage_stats import UsageStatsReporter
+
+            cw._usage_reporter = UsageStatsReporter(_runtime_node.session_dir)
+            cw._usage_reporter.start()
         if runtime_env is not None:
             from ray_tpu.runtime_env import set_job_runtime_env
 

@@ -139,6 +139,27 @@ def read_parquet(paths: str | list, *, override_num_blocks: int | None = None
     return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
+def from_arrow(table, *, override_num_blocks: int | None = None) -> Dataset:
+    """From a pyarrow Table (reference: data/read_api.py from_arrow)."""
+    return from_items(table.to_pylist(), override_num_blocks=override_num_blocks)
+
+
+def read_binary_files(paths: str | list, *, include_paths: bool = False,
+                      override_num_blocks: int | None = None) -> Dataset:
+    """One row per file with raw bytes (reference:
+    data/read_api.py read_binary_files)."""
+
+    def read_one(p, include_paths=include_paths):
+        with open(p, "rb") as f:
+            data = f.read()
+        row = {"bytes": data}
+        if include_paths:
+            row["path"] = p
+        return [row]
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
+
+
 def _expand(paths: str | list) -> list:
     if isinstance(paths, str):
         paths = [paths]
@@ -151,6 +172,11 @@ def _expand(paths: str | list) -> list:
 
 __all__ = [
     "Dataset", "DataIterator", "GroupedData", "from_items", "range",
-    "range_tensor", "from_numpy", "from_pandas", "read_text", "read_json",
-    "read_csv", "read_numpy", "read_parquet",
+    "range_tensor", "from_numpy", "from_pandas", "from_arrow", "read_text",
+    "read_json", "read_csv", "read_numpy", "read_parquet",
+    "read_binary_files",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu('data')
+del _rlu

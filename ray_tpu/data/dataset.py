@@ -374,7 +374,44 @@ class Dataset:
                             ) -> Iterator[Any]:
         """The streaming loop: push blocks through stages with bounded
         in-flight remote tasks (reference: streaming_executor.py:217
-        scheduling loop + ExecutionResources backpressure :280)."""
+        scheduling loop + ExecutionResources backpressure :280).
+        Execution stats (wall time, blocks, rows) land in self._last_stats
+        for Dataset.stats()."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        n_blocks = n_rows = 0
+        try:
+            for blk in self._iter_output_blocks_inner(max_in_flight):
+                n_blocks += 1
+                try:
+                    n_rows += len(blk)
+                except TypeError:
+                    pass
+                yield blk
+        finally:
+            # finally: early-terminated consumption (take/limit breaking out
+            # of the generator) still records what ran.
+            self._last_stats = {
+                "wall_s": round(_time.perf_counter() - t0, 4),
+                "output_blocks": n_blocks,
+                "output_rows": n_rows,
+                "stages": [st.name for st in self._stages],
+            }
+
+    def stats(self) -> str:
+        """Execution summary of the last run (reference: Dataset.stats() —
+        data/_internal/stats.py; per-stage timing there, end-to-end here)."""
+        s = getattr(self, "_last_stats", None)
+        if s is None:
+            return "Dataset not executed yet; call materialize()/take()/... first."
+        stages = " -> ".join(s["stages"]) or "(read only)"
+        return (f"Stages: {stages}\n"
+                f"Output: {s['output_blocks']} blocks, {s['output_rows']} rows\n"
+                f"Wall time: {s['wall_s']}s")
+
+    def _iter_output_blocks_inner(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+                                  ) -> Iterator[Any]:
         from ray_tpu._private import serialization
 
         def resolve_sources() -> Iterator:

@@ -333,11 +333,14 @@ def ring_attention(q, k, v, axis: str = "sp", *, causal: bool = False,
         v_nxt = lax.ppermute(v_cur, axis, perm)
         return k_nxt, v_nxt, acc, m_new, l_run
 
-    # pvary: mark the carries as varying over the ring axis so the scan
-    # carry types match (shard_map's varying-axis type system).
-    acc0 = lax.pvary(jnp.zeros((B, H, S, D), jnp.float32), (axis,))
-    m0 = lax.pvary(jnp.full((B, H, S, 1), _NEG_INF, jnp.float32), (axis,))
-    l0 = lax.pvary(jnp.zeros((B, H, S, 1), jnp.float32), (axis,))
+    # Mark the carries as varying over the ring axis so the scan carry
+    # types match (shard_map's varying-axis type system). pcast is the
+    # current spelling; fall back to pvary on older JAX.
+    _vary = (lambda x: lax.pcast(x, axis, to="varying")) \
+        if hasattr(lax, "pcast") else (lambda x: lax.pvary(x, (axis,)))
+    acc0 = _vary(jnp.zeros((B, H, S, D), jnp.float32))
+    m0 = _vary(jnp.full((B, H, S, 1), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, S, 1), jnp.float32))
     _, _, acc, _, l = lax.fori_loop(0, n, body, (k, v, acc0, m0, l0))
     out = acc / jnp.where(l == 0.0, 1.0, l)
     return out.astype(q.dtype)

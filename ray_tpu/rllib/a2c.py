@@ -1,0 +1,157 @@
+"""A2C: synchronous advantage actor-critic.
+
+Parity: reference rllib/algorithms/a2c/ — synchronous variant of A3C:
+every iteration all rollout workers sample with the current policy, the
+learner does ONE gradient step on the combined batch (no PPO-style
+minibatch epochs, no clipping), then weights broadcast back.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.ppo import RolloutWorker, init_policy_params, numpy_forward
+
+
+@dataclass
+class A2CConfig:
+    """Fluent config (parity: rllib A2CConfig)."""
+
+    env: Any = "CartPole-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 256
+    gamma: float = 0.99
+    lam: float = 1.0              # GAE(λ=1) = Monte-Carlo advantages
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    lr: float = 1e-3
+    hidden_size: int = 64
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: int | None = None, **kw):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown A2C option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "A2C":
+        return A2C(self)
+
+
+class A2C:
+    """Algorithm driver: sample (sync, all workers) → one gradient step."""
+
+    def __init__(self, config: A2CConfig):
+        self.config = config
+        probe = make_env(config.env)
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        self.params = init_policy_params(
+            self.obs_size, self.num_actions, config.hidden_size, config.seed)
+        # PPO's worker computes GAE with (gamma, lam) — with lam=1 that is
+        # the plain discounted advantage A2C wants.
+        self.workers = [
+            RolloutWorker.remote(config.env, i, config.gamma, config.lam)
+            for i in range(config.num_rollout_workers)]
+        self._update = None
+        self.iteration = 0
+        self.total_steps = 0
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def loss_fn(params, batch):
+            h = jnp.tanh(batch["obs"] @ params["h1"]["w"] + params["h1"]["b"])
+            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+            logits = h @ params["pi"]["w"] + params["pi"]["b"]
+            value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pi_loss = -(logp * adv).mean()
+            vf_loss = ((value - batch["returns"]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pi_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch)
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        import jax
+
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        t0 = time.time()
+        host_params = jax.tree_util.tree_map(np.asarray, self.params)
+        batches = ray_tpu.get(
+            [w.sample.remote(host_params, cfg.rollout_fragment_length)
+             for w in self.workers], timeout=600)
+        batch = {k: np.concatenate([b[k] for b in batches])
+                 for k in ("obs", "actions", "advantages", "returns")}
+        episode_returns = sum((b["episode_returns"] for b in batches), [])
+        self.params, self._opt_state, loss, aux = self._update(
+            self.params, self._opt_state, batch)
+        n = len(batch["obs"])
+        self.total_steps += n
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(episode_returns))
+            if episode_returns else 0.0,
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_this_iter": n,
+            "timesteps_total": self.total_steps,
+            "iter_time_s": round(time.time() - t0, 3),
+            **{k: float(v) for k, v in aux.items()},
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def get_policy_params(self) -> dict:
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def compute_single_action(self, obs) -> int:
+        logits, _ = numpy_forward(self.get_policy_params(), obs[None, :])
+        return int(np.argmax(logits[0]))

@@ -1,0 +1,254 @@
+"""Offline RL: behavior cloning (BC) and MARWIL from logged experience.
+
+Parity: reference rllib/offline/ (json_reader.py, the BC and MARWIL
+algorithms under rllib/algorithms/{bc,marwil}/). Experience is consumed
+from JSONL sample files or a ray_tpu.data Dataset; no environment
+interaction is needed to train (an env is only used for optional
+evaluation rollouts).
+
+MARWIL (Wang et al. 2018) generalizes BC: actions are weighted by
+exp(beta * advantage); beta=0 reduces to plain BC (reference:
+rllib/algorithms/marwil/marwil.py — BC subclasses MARWIL with beta=0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.ppo import init_policy_params, numpy_forward
+
+
+def write_offline_json(path: str, batches: list[dict]) -> None:
+    """Log sample batches to a JSONL file readable by JsonReader
+    (reference: rllib/offline/json_writer.py)."""
+    with open(path, "w") as f:
+        for b in batches:
+            f.write(json.dumps({
+                "obs": np.asarray(b["obs"], np.float32).tolist(),
+                "actions": np.asarray(b["actions"], np.int32).tolist(),
+                "rewards": np.asarray(b.get(
+                    "rewards", np.zeros(len(b["obs"]))), np.float32).tolist(),
+                "dones": np.asarray(b.get(
+                    "dones", np.zeros(len(b["obs"]))), np.float32).tolist(),
+            }) + "\n")
+
+
+class JsonReader:
+    """Reads logged experience (reference: rllib/offline/json_reader.py)."""
+
+    def __init__(self, path: str):
+        self.paths = ([os.path.join(path, p) for p in sorted(os.listdir(path))]
+                      if os.path.isdir(path) else [path])
+
+    def read_all(self) -> dict:
+        fields = {"obs": [], "actions": [], "rewards": [], "dones": []}
+        for p in self.paths:
+            with open(p) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    rec = json.loads(line)
+                    for k in fields:
+                        fields[k].extend(rec.get(k, []))
+        return {
+            "obs": np.asarray(fields["obs"], np.float32),
+            "actions": np.asarray(fields["actions"], np.int32),
+            "rewards": np.asarray(fields["rewards"], np.float32),
+            "dones": np.asarray(fields["dones"], np.float32),
+        }
+
+
+@dataclass
+class MARWILConfig:
+    """Fluent config (parity: rllib MARWILConfig)."""
+
+    env: Any = "CartPole-v1"   # for obs/action spaces + optional eval
+    input_path: str | None = None   # JSONL file/dir of logged experience
+    input_dataset: Any = None       # or a ray_tpu.data Dataset of records
+    beta: float = 1.0               # 0 => behavior cloning
+    gamma: float = 0.99
+    vf_coeff: float = 1.0
+    train_batch_size: int = 512
+    num_sgd_iter_per_train: int = 10
+    lr: float = 1e-3
+    hidden_size: int = 64
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def offline_data(self, input_path: str | None = None, input_dataset=None):
+        self.input_path = input_path
+        self.input_dataset = input_dataset
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown MARWIL option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+@dataclass
+class BCConfig(MARWILConfig):
+    """Behavior cloning = MARWIL with beta=0 (reference: rllib BC)."""
+
+    beta: float = 0.0
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class MARWIL:
+    """Offline learner: advantage-weighted action imitation."""
+
+    def __init__(self, config: MARWILConfig):
+        self.config = config
+        probe = make_env(config.env)
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        self.params = init_policy_params(
+            self.obs_size, self.num_actions, config.hidden_size, config.seed)
+        self.data = self._load_data()
+        self._update = None
+        self.iteration = 0
+
+    def _load_data(self) -> dict:
+        cfg = self.config
+        if cfg.input_dataset is not None:
+            rows = cfg.input_dataset.take_all() \
+                if hasattr(cfg.input_dataset, "take_all") else list(cfg.input_dataset)
+            return {
+                "obs": np.asarray([r["obs"] for r in rows], np.float32),
+                "actions": np.asarray([r["actions"] for r in rows], np.int32),
+                "rewards": np.asarray(
+                    [r.get("rewards", 0.0) for r in rows], np.float32),
+                "dones": np.asarray(
+                    [r.get("dones", 0.0) for r in rows], np.float32),
+            }
+        if cfg.input_path is not None:
+            return JsonReader(cfg.input_path).read_all()
+        raise ValueError("MARWIL/BC needs input_path or input_dataset")
+
+    def _returns(self) -> np.ndarray:
+        """Discounted reward-to-go per step (targets for the value head and
+        the MARWIL advantage baseline)."""
+        cfg = self.config
+        rews, dones = self.data["rewards"], self.data["dones"]
+        out = np.zeros(len(rews), np.float32)
+        acc = 0.0
+        for t in range(len(rews) - 1, -1, -1):
+            acc = rews[t] + cfg.gamma * acc * (1.0 - dones[t])
+            out[t] = acc
+        return out
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def loss_fn(params, batch):
+            h = jnp.tanh(batch["obs"] @ params["h1"]["w"] + params["h1"]["b"])
+            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+            logits = h @ params["pi"]["w"] + params["pi"]["b"]
+            value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            if cfg.beta == 0.0:
+                weight = jnp.ones_like(logp)     # plain BC
+                vf_loss = jnp.zeros(())
+            else:
+                adv = batch["returns"] - value
+                weight = jnp.exp(cfg.beta * jax.lax.stop_gradient(
+                    adv / (jnp.abs(adv).mean() + 1e-8)))
+                vf_loss = (adv ** 2).mean()
+            pi_loss = -(weight * logp).mean()
+            total = pi_loss + cfg.vf_coeff * vf_loss
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "mean_weight": weight.mean()}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch)
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        if self._update is None:
+            self._build_update()
+            self._ret = self._returns()
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        n = len(self.data["obs"])
+        t0 = time.time()
+        last_aux, losses = {}, []
+        for _ in range(cfg.num_sgd_iter_per_train):
+            idx = rng.integers(0, n, min(cfg.train_batch_size, n))
+            mb = {"obs": self.data["obs"][idx],
+                  "actions": self.data["actions"][idx],
+                  "returns": self._ret[idx]}
+            self.params, self._opt_state, loss, last_aux = self._update(
+                self.params, self._opt_state, mb)
+            losses.append(float(loss))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "loss": float(np.mean(losses)),
+            "num_samples": n,
+            "iter_time_s": round(time.time() - t0, 3),
+            **{k: float(v) for k, v in last_aux.items()},
+        }
+
+    def evaluate(self, num_episodes: int = 5) -> dict:
+        """Greedy-policy rollouts in the config env."""
+        env = make_env(self.config.env)
+        params = self.get_policy_params()
+        returns = []
+        for ep in range(num_episodes):
+            obs = env.reset(seed=10_000 + ep)
+            done, total = False, 0.0
+            while not done:
+                logits, _ = numpy_forward(params, obs[None, :])
+                obs, r, done, _ = env.step(int(np.argmax(logits[0])))
+                total += r
+            returns.append(total)
+        return {"episode_reward_mean": float(np.mean(returns)),
+                "episodes": num_episodes}
+
+    def stop(self):
+        pass
+
+    def get_policy_params(self) -> dict:
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def compute_single_action(self, obs) -> int:
+        logits, _ = numpy_forward(self.get_policy_params(), obs[None, :])
+        return int(np.argmax(logits[0]))
+
+
+class BC(MARWIL):
+    """Behavior cloning (reference: rllib/algorithms/bc/)."""

@@ -36,6 +36,18 @@ def cmd_start(args):
                 "node_id": handle.node_id,
                 "store_path": handle.store_path,
                 "session_dir": node.session_dir}
+        if getattr(args, "client_server_port", None) is not None:
+            # Host a client proxy in the head supervisor (reference:
+            # `ray start --head --ray-client-server-port`).
+            import ray_tpu
+            from ray_tpu.util.client.server import serve as client_serve
+
+            ray_tpu.init(address=f"{host}:{port}",
+                         _head_raylet=(handle.host, handle.port),
+                         _store_path=handle.store_path,
+                         _node_id=handle.node_id)
+            cs = client_serve(port=args.client_server_port)
+            info["client_server"] = f"{cs.host}:{cs.port}"
         with open(args.state_file, "w") as f:
             json.dump(info, f)
         print(json.dumps(info))
@@ -176,6 +188,9 @@ def main():
     p.add_argument("--address", default="")
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--resources", default="")
+    p.add_argument("--client-server-port", type=int, default=None,
+                   help="serve remote client:// drivers on this port "
+                        "(reference: --ray-client-server-port)")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop", help="stop local daemons")

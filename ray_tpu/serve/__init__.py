@@ -20,6 +20,7 @@ from ray_tpu.serve.deployment import (
     Deployment,
     DeploymentHandle,
     DeploymentResponse,
+    DeploymentResponseGenerator,
     deployment,
     get_multiplexed_model_id,
     multiplexed,
@@ -115,6 +116,10 @@ def batch(_fn=None, *, max_batch_size: int = 8,
 
 
 class _ProxyHandler(BaseHTTPRequestHandler):
+    # Chunked transfer (streaming) is an HTTP/1.1 construct; the stdlib
+    # default of HTTP/1.0 would make strict clients read the chunk framing
+    # as body bytes.
+    protocol_version = "HTTP/1.1"
     handles: dict[str, DeploymentHandle] = {}
     # Cached route table {prefix: deployment}; refreshed on a TTL, not per
     # request (reference: proxies get route updates pushed via long-poll).
@@ -142,7 +147,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         # Route by longest matching route_prefix (reference: proxy_router);
         # falls back to /<deployment-name>.
-        path = self.path.split("?")[0]
+        path, _, query = self.path.partition("?")
         name = None
         best_len = -1
         for prefix, dep in self._route_table().items():
@@ -156,6 +161,8 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             handle = self.handles[name] = get_deployment_handle(name)
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length) if length else b"{}"
+        if "stream=1" in query:
+            return self._respond_stream(handle, body)
         try:
             payload = json.loads(body) if body else {}
             result = handle.remote(payload).result(timeout=60)
@@ -168,6 +175,37 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _respond_stream(self, handle, body: bytes):
+        """Chunked transfer for generator deployments (?stream=1): one JSON
+        line per yielded chunk (reference: serve StreamingResponse over the
+        uvicorn proxy)."""
+        gen = None
+        try:
+            payload = json.loads(body) if body else {}
+            gen = handle.options(stream=True).remote(payload)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonl")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for chunk in gen:
+                line = (json.dumps({"chunk": chunk}) + "\n").encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+        except Exception as e:  # noqa: BLE001
+            try:
+                data = json.dumps({"error": str(e)}).encode()
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            except Exception:
+                pass
+        finally:
+            # Client disconnect / handler error mid-stream: release the
+            # replica-side generator and the router's outstanding count.
+            if gen is not None:
+                gen.cancel()
 
     do_GET = do_POST
 
@@ -186,6 +224,10 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> int:
 __all__ = [
     "deployment", "run", "get_deployment_handle", "status", "delete",
     "shutdown", "batch", "start_http_proxy", "Deployment",
-    "DeploymentHandle", "DeploymentResponse", "AutoscalingConfig",
-    "multiplexed", "get_multiplexed_model_id",
+    "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
+    "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu('serve')
+del _rlu

@@ -133,6 +133,55 @@ class ReplicaActor:
     def health_check(self):
         return True
 
+    # -- streaming (reference: serve streaming responses / generator
+    # deployments, serve/handle.py DeploymentResponseGenerator). The
+    # generator lives on the replica; the client pulls chunks with
+    # follow-up actor calls, so memory stays bounded on both sides. --
+
+    def start_stream(self, method: str, args, kwargs, model_id: str = ""):
+        import uuid
+
+        fn = self._instance if method == "__call__" \
+            else getattr(self._instance, method)
+        # Calling a generator function only CREATES the generator — the
+        # body runs inside next(), so the model id must be active around
+        # every next_chunks pull, not just here. Stored per-stream.
+        gen = fn(*args, **(kwargs or {}))
+        if not hasattr(gen, "__next__"):
+            gen = iter(gen)
+        sid = uuid.uuid4().hex
+        if not hasattr(self, "_streams"):
+            self._streams = {}
+        self._streams[sid] = (gen, model_id)
+        return sid
+
+    def next_chunks(self, stream_id: str, max_chunks: int = 8):
+        import ray_tpu.serve.deployment as _dep
+
+        entry = self._streams.get(stream_id)
+        if entry is None:
+            raise KeyError(f"unknown stream {stream_id}")
+        gen, model_id = entry
+        values, done = [], False
+        _dep._current_model_id = model_id
+        try:
+            for _ in range(max_chunks):
+                try:
+                    values.append(next(gen))
+                except StopIteration:
+                    done = True
+                    del self._streams[stream_id]
+                    break
+        finally:
+            _dep._current_model_id = ""
+        return {"values": values, "done": done}
+
+    def cancel_stream(self, stream_id: str):
+        entry = self._streams.pop(stream_id, None)
+        if entry is not None and hasattr(entry[0], "close"):
+            entry[0].close()
+        return True
+
 
 class Deployment:
     """The declarative object produced by @serve.deployment."""
@@ -230,6 +279,7 @@ class DeploymentResponse:
         self._value = None
         self._error: BaseException | None = None
         self._ref = None
+        self._retry: Callable | None = None  # death-retry hook (handle sets)
 
     def _resolve_ref(self, ref):
         self._ref = ref
@@ -244,13 +294,69 @@ class DeploymentResponse:
         self._event.set()
 
     def result(self, timeout: float | None = 60.0):
+        start = time.monotonic()
+
+        def remaining():
+            if timeout is None:
+                return None
+            return max(0.1, timeout - (time.monotonic() - start))
+
         if not self._event.wait(timeout):
             raise TimeoutError("deployment response timed out")
         if self._error is not None:
             raise self._error
         if self._ref is not None:
-            return ray_tpu.get(self._ref, timeout=timeout)
+            try:
+                return ray_tpu.get(self._ref, timeout=remaining())
+            except ray_tpu.exceptions.ActorError:
+                # Replica died mid-request: retry on another replica within
+                # the caller's ORIGINAL timeout budget (reference: handles
+                # retry system-level replica failures).
+                if self._retry is not None:
+                    retry, self._retry = self._retry, None
+                    return retry(remaining())
+                raise
         return self._value
+
+
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment response (reference:
+    serve/handle.py DeploymentResponseGenerator for generator handlers)."""
+
+    def __init__(self, handle: "DeploymentHandle", idx: int, replica,
+                 stream_id: str):
+        self._handle = handle
+        self._idx = idx
+        self._replica = replica
+        self._sid = stream_id
+        self._buffer: list = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._buffer:
+            if self._done:
+                raise StopIteration
+            chunk = ray_tpu.get(
+                self._replica.next_chunks.remote(self._sid), timeout=60)
+            self._buffer.extend(chunk["values"])
+            if chunk["done"]:
+                self._done = True
+                self._handle._done(self._idx)
+                if not self._buffer:
+                    raise StopIteration
+        return self._buffer.pop(0)
+
+    def cancel(self):
+        if not self._done:
+            self._done = True
+            self._handle._done(self._idx)
+            try:
+                self._replica.cancel_stream.remote(self._sid)
+            except Exception:
+                pass
 
 
 class _RouterState:
@@ -274,11 +380,12 @@ class DeploymentHandle:
     def __init__(self, deployment_name: str, controller, method: str = "__call__",
                  batching: tuple[int, float] | None = None,
                  multiplexed_model_id: str = "",
-                 router: _RouterState | None = None):
+                 router: _RouterState | None = None, stream: bool = False):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method = method
         self._model_id = multiplexed_model_id
+        self._stream = stream
         self._router = router or _RouterState()
         self._batchq: _BatchQueue | None = None
         if batching:
@@ -301,14 +408,15 @@ class DeploymentHandle:
 
     def options(self, method_name: str | None = None,
                 batching: tuple[int, float] | None = None,
-                multiplexed_model_id: str | None = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: str | None = None,
+                stream: bool | None = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name, self._controller,
             method_name or self._method, batching,
             self._model_id if multiplexed_model_id is None
             else multiplexed_model_id,
-            router=self._router)
+            router=self._router,
+            stream=self._stream if stream is None else stream)
 
     # Routing state lives on the shared router; these aliases keep the
     # method bodies below reading naturally.
@@ -403,7 +511,9 @@ class DeploymentHandle:
 
     # -- request path --
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            return self._remote_stream(args, kwargs)
         resp = DeploymentResponse()
         if self._batchq is not None:
             self._batchq.add((args, kwargs), resp)
@@ -417,6 +527,35 @@ class DeploymentHandle:
                     self._model_replicas.setdefault(
                         self._model_id, set()).add(idx)
             resp._resolve_ref(ref)
+
+            def retry_on_death(timeout):
+                # The dead replica's cached set is stale: refresh and
+                # resubmit. The controller needs a few health-check ticks
+                # to replace dead replicas, so back off between attempts
+                # (reference: handles retry system-level replica failures
+                # until the deployment is available again).
+                deadline = time.monotonic() + (timeout or 60.0)
+                last_err = None
+                while time.monotonic() < deadline:
+                    self._last_refresh = 0.0
+                    try:
+                        r_idx, r_replica = self._pick_replica()
+                    except RuntimeError as e:  # no replicas yet
+                        last_err = e
+                        time.sleep(1.0)
+                        continue
+                    try:
+                        return ray_tpu.get(r_replica.handle_request.remote(
+                            self._method, list(args), kwargs, self._model_id),
+                            timeout=max(1.0, deadline - time.monotonic()))
+                    except ray_tpu.exceptions.ActorError as e:
+                        last_err = e
+                        time.sleep(1.0)
+                    finally:
+                        self._done(r_idx)
+                raise last_err or TimeoutError("deployment retry timed out")
+
+            resp._retry = retry_on_death
             with self._lock:
                 self._inflight.append((idx, ref))
             self._ensure_reaper()
@@ -425,6 +564,16 @@ class DeploymentHandle:
             self._done(idx)
         self._report_load()
         return resp
+
+    def _remote_stream(self, args, kwargs) -> DeploymentResponseGenerator:
+        idx, replica = self._pick_replica()
+        try:
+            sid = ray_tpu.get(replica.start_stream.remote(
+                self._method, list(args), kwargs, self._model_id), timeout=60)
+        except BaseException:
+            self._done(idx)
+            raise
+        return DeploymentResponseGenerator(self, idx, replica, sid)
 
     def _ensure_reaper(self):
         if self._reaper is None or not self._reaper.is_alive():

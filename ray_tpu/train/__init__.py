@@ -22,3 +22,7 @@ __all__ = [
     "TrainState", "make_train_step", "shard_train_step", "init_sharded_state",
     "state_specs_from_rules",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu('train')
+del _rlu

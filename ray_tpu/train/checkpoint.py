@@ -45,6 +45,15 @@ class Checkpoint:
                 json.dump(metrics, f)
         return cls(path)
 
+    @classmethod
+    def from_dict(cls, data: dict, path: str | None = None) -> "Checkpoint":
+        """Dict-backed checkpoint (reference: air/checkpoint.py
+        Checkpoint.from_dict) — stored as a pytree directory."""
+        return cls.from_pytree(dict(data), path)
+
+    def to_dict(self) -> dict:
+        return dict(self.to_pytree())
+
     def to_pytree(self, template: Any | None = None) -> Any:
         return restore_pytree(os.path.join(self.path, "state"), template)
 

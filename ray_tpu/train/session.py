@@ -39,10 +39,16 @@ def _get_session() -> _Session:
 
 
 def report(metrics: dict, checkpoint=None) -> None:
-    """Stream metrics (and optionally a Checkpoint) to the trainer."""
+    """Stream metrics (and optionally a Checkpoint) to the trainer.
+    A plain dict is wrapped via Checkpoint.from_dict (reference: air
+    Checkpoint dict form)."""
     s = _get_session()
     payload = {"metrics": dict(metrics), "rank": s.rank}
     if checkpoint is not None:
+        if isinstance(checkpoint, dict):
+            from ray_tpu.train.checkpoint import Checkpoint
+
+            checkpoint = Checkpoint.from_dict(checkpoint)
         payload["checkpoint_path"] = checkpoint.path
     s.report_queue.put(payload)
 

@@ -1,10 +1,15 @@
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
+    ResourceChangingScheduler,
 )
 from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -16,6 +21,12 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "ASHAScheduler", "FIFOScheduler",
-    "PopulationBasedTraining", "MedianStoppingRule", "uniform", "loguniform",
-    "choice", "randint", "quniform", "grid_search",
+    "HyperBandScheduler", "PopulationBasedTraining", "MedianStoppingRule",
+    "ResourceChangingScheduler", "Searcher", "BasicVariantGenerator",
+    "TPESearcher", "uniform", "loguniform", "choice", "randint", "quniform",
+    "grid_search",
 ]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu('tune')
+del _rlu

@@ -15,7 +15,8 @@ from typing import Any
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
-EXPLOIT = "EXPLOIT"  # PBT: restart from better trial's checkpoint
+EXPLOIT = "EXPLOIT"      # PBT: restart from better trial's checkpoint
+REALLOCATE = "REALLOCATE"  # ResourceChanging: restart with new resources
 
 
 class FIFOScheduler:
@@ -130,6 +131,93 @@ class PopulationBasedTraining:
                     shift = self.rng.choice([-1, 1])
                     out[key] = spec[max(0, min(len(spec) - 1, idx + shift))]
         return out
+
+
+class HyperBandScheduler:
+    """HyperBand (Li et al. 2017): several successive-halving brackets with
+    staggered starting budgets, so some trials get long uninterrupted runs
+    while others are aggressively halved (reference:
+    tune/schedulers/hyperband.py; this is the async formulation — each
+    bracket behaves like ASHA with grace_period scaled by rf^s)."""
+
+    def __init__(self, *, metric: str, mode: str = "max", max_t: int = 81,
+                 reduction_factor: int = 3):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        self.s_max = max(1, int(math.log(max_t) / math.log(reduction_factor)))
+        # bracket s -> {milestone -> recorded signed metrics}
+        self.brackets: list[dict[int, list[float]]] = [
+            {} for _ in range(self.s_max)]
+        self._assignment: dict[Any, int] = {}
+        self._next_bracket = 0
+
+    def _bracket_of(self, trial) -> int:
+        tid = trial.trial_id
+        if tid not in self._assignment:
+            self._assignment[tid] = self._next_bracket
+            self._next_bracket = (self._next_bracket + 1) % self.s_max
+        return self._assignment[tid]
+
+    def _milestones(self, s: int) -> list[int]:
+        out, t = [], self.rf ** s
+        while t < self.max_t:
+            out.append(t)
+            t *= self.rf
+        return out
+
+    def on_result(self, trial, metric_value: float, iteration: int) -> str:
+        if iteration >= self.max_t:
+            return STOP
+        s = self._bracket_of(trial)
+        for m in self._milestones(s):
+            if iteration == m:
+                sign = metric_value if self.mode == "max" else -metric_value
+                recorded = self.brackets[s].setdefault(m, [])
+                recorded.append(sign)
+                k = max(1, len(recorded) // self.rf)
+                top_k = sorted(recorded, reverse=True)[:k]
+                if sign < top_k[-1]:
+                    return STOP
+        return CONTINUE
+
+    def exploit_target(self, trial, trials):
+        return None
+
+
+class ResourceChangingScheduler:
+    """Wraps a base scheduler and grows/shrinks a trial's resources
+    mid-run (reference: tune/schedulers/resource_changing_scheduler.py).
+    `resources_allocation_function(trial, metric_value, iteration)
+    -> dict | None` returns the new resource dict (None = keep current);
+    a different allocation restarts the trial from its last checkpoint
+    with those resources."""
+
+    def __init__(self, base_scheduler=None,
+                 resources_allocation_function=None):
+        self.base = base_scheduler or FIFOScheduler()
+        self.alloc_fn = resources_allocation_function
+        self.metric = getattr(self.base, "metric", None)
+        self.mode = getattr(self.base, "mode", "max")
+
+    def on_result(self, trial, metric_value: float, iteration: int) -> str:
+        decision = self.base.on_result(trial, metric_value, iteration)
+        if decision != CONTINUE or self.alloc_fn is None:
+            return decision
+        new_res = self.alloc_fn(trial, metric_value, iteration)
+        if new_res and new_res != getattr(trial, "resources", None):
+            trial.pending_resources = dict(new_res)
+            return REALLOCATE
+        return CONTINUE
+
+    def exploit_target(self, trial, trials):
+        return self.base.exploit_target(trial, trials)
+
+    def perturb(self, config: dict) -> dict:
+        return self.base.perturb(config) if hasattr(self.base, "perturb") \
+            else dict(config)
 
 
 class MedianStoppingRule:

@@ -33,6 +33,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int | None = None
     scheduler: Any = None
+    search_alg: Any = None   # a tune.search.Searcher; None = expand upfront
     seed: int | None = None
 
 
@@ -47,6 +48,8 @@ class Trial:
         self.metrics_history: list[dict] = []
         self.checkpoint: Checkpoint | None = None
         self.error: str | None = None
+        self.resources: dict | None = None       # None = controller default
+        self.pending_resources: dict | None = None  # set by REALLOCATE
 
     def best_metric(self, metric: str, mode: str):
         vals = [m[metric] for m in self.metrics_history if metric in m]
@@ -152,12 +155,16 @@ class Tuner:
                 t.error = None
             trials.append(t)
         tc = TuneConfig(**state.get("tune_config", {}))
+        from ray_tpu._private import serialization as _ser
+
         sched_path = os.path.join(path, "scheduler.pkl")
         if os.path.exists(sched_path):
-            from ray_tpu._private import serialization as _ser
-
             with open(sched_path, "rb") as f:
                 tc.scheduler = _ser.loads_func(f.read())
+        searcher_path = os.path.join(path, "searcher.pkl")
+        if os.path.exists(searcher_path):
+            with open(searcher_path, "rb") as f:
+                tc.search_alg = _ser.loads_func(f.read())
         rc = RunConfig(storage_path=os.path.dirname(path.rstrip("/")),
                        name=os.path.basename(path.rstrip("/")))
         return cls(trainable, param_space=state.get("param_space", {}),
@@ -166,8 +173,11 @@ class Tuner:
                    _restored_trials=trials)
 
     def fit(self) -> ResultGrid:
+        searcher = self.tune_config.search_alg
         if self._restored_trials is not None:
             trials = self._restored_trials
+        elif searcher is not None:
+            trials = []  # created lazily from searcher.suggest()
         else:
             cfgs = generate_variants(self._param_space,
                                      self.tune_config.num_samples,
@@ -176,10 +186,12 @@ class Tuner:
                       for i, c in enumerate(cfgs)]
         scheduler = self.tune_config.scheduler or FIFOScheduler()
         metric = self.tune_config.metric
-        max_conc = self.tune_config.max_concurrent_trials or len(trials)
+        default_conc = max(1, len(trials)) if searcher is None else 2
+        max_conc = self.tune_config.max_concurrent_trials or default_conc
         controller = _TuneController(
             self._trainable, trials, scheduler, metric,
             self.tune_config.mode, max_conc, self._resources,
+            searcher=searcher,
             experiment_dir=self._experiment_dir(),
             experiment_state={
                 "param_space": self._param_space,
@@ -203,7 +215,8 @@ class _TuneController:
     """Polling event loop (reference: tune_controller.py)."""
 
     def __init__(self, trainable, trials, scheduler, metric, mode,
-                 max_concurrent, resources, experiment_dir: str | None = None,
+                 max_concurrent, resources, searcher=None,
+                 experiment_dir: str | None = None,
                  experiment_state: dict | None = None):
         self.trainable_blob = serialization.dumps_func(trainable)
         self.trials: list[Trial] = trials
@@ -212,8 +225,25 @@ class _TuneController:
         self.mode = mode
         self.max_concurrent = max_concurrent
         self.resources = resources
+        self.searcher = searcher
         self.experiment_dir = experiment_dir
         self.experiment_state = experiment_state or {}
+
+    def _notify_searcher(self, trial: Trial) -> None:
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(trial.trial_id, trial.config,
+                                            trial.last_metric)
+
+    def _next_from_searcher(self) -> Trial | None:
+        if self.searcher is None:
+            return None
+        tid = f"trial_{len(self.trials):04d}_{uuid.uuid4().hex[:6]}"
+        cfg = self.searcher.suggest(tid)
+        if cfg is None:
+            return None
+        t = Trial(tid, cfg)
+        self.trials.append(t)
+        return t
 
     def _save_experiment_state(self, force: bool = False):
         """Durable experiment snapshot for Tuner.restore, throttled to one
@@ -252,17 +282,22 @@ class _TuneController:
             json.dump(state, f, default=_plain)
         os.replace(tmp, os.path.join(self.experiment_dir,
                                      "experiment_state.json"))
-        # The scheduler (ASHA rungs, PBT state) rides along as a pickle so
-        # restore resumes under the SAME scheduling policy.
+        # The scheduler (ASHA rungs, PBT state) and searcher (TPE
+        # observations) ride along as pickles so restore resumes under the
+        # SAME policy with its accumulated state.
         sched_blob = serialization.dumps_func(self.scheduler)
         with open(os.path.join(self.experiment_dir, "scheduler.pkl"),
                   "wb") as f:
             f.write(sched_blob)
+        if self.searcher is not None:
+            with open(os.path.join(self.experiment_dir, "searcher.pkl"),
+                      "wb") as f:
+                f.write(serialization.dumps_func(self.searcher))
 
     def _start_trial(self, trial: Trial, restore_from: Checkpoint | None = None):
-        opts = {"num_cpus": self.resources.get("CPU", 1),
-                "resources": {k: v for k, v in self.resources.items()
-                              if k != "CPU"}}
+        res = trial.resources or self.resources
+        opts = {"num_cpus": res.get("CPU", 1),
+                "resources": {k: v for k, v in res.items() if k != "CPU"}}
         trial.actor = TrainWorker.options(**opts).remote(0, 1, {})
         cfg = dict(trial.config)
         if restore_from is not None:
@@ -284,13 +319,24 @@ class _TuneController:
         # PENDING ones (fresh or reset by Tuner.restore) run.
         pending = [t for t in self.trials if t.status == "PENDING"]
         running: list[Trial] = []
+        searcher_done = False
         self._save_experiment_state()
-        while pending or running:
-            while pending and len(running) < self.max_concurrent:
-                t = pending.pop(0)
+        while True:
+            while len(running) < self.max_concurrent:
+                if pending:
+                    t = pending.pop(0)
+                elif not searcher_done:
+                    t = self._next_from_searcher()
+                    if t is None:
+                        searcher_done = True
+                        break
+                else:
+                    break
                 # A restored trial resumes from its last checkpoint.
                 self._start_trial(t, restore_from=t.checkpoint)
                 running.append(t)
+            if not running and not pending:
+                break
             polls = ray_tpu.get([t.actor.poll.remote() for t in running],
                                 timeout=300)
             for trial, p in zip(list(running), polls):
@@ -312,9 +358,11 @@ class _TuneController:
                     self._stop_trial(trial,
                                      "ERROR" if p["error"] else "TERMINATED")
                     running.remove(trial)
+                    self._notify_searcher(trial)
                 elif decision == STOP:
                     self._stop_trial(trial, "TERMINATED")
                     running.remove(trial)
+                    self._notify_searcher(trial)
                 elif decision == EXPLOIT:
                     target = self.scheduler.exploit_target(trial, self.trials)
                     if target is not None and target.checkpoint is not None:
@@ -322,6 +370,13 @@ class _TuneController:
                         self._stop_trial(trial, "PAUSED")
                         trial.config = self.scheduler.perturb(target.config)
                         self._start_trial(trial, restore_from=target.checkpoint)
+                elif decision == sched_mod.REALLOCATE and trial.checkpoint:
+                    # ResourceChanging: restart from the last checkpoint
+                    # with the scheduler's new allocation.
+                    self._stop_trial(trial, "PAUSED")
+                    trial.resources = trial.pending_resources
+                    trial.pending_resources = None
+                    self._start_trial(trial, restore_from=trial.checkpoint)
             self._save_experiment_state()
             if running or pending:
                 time.sleep(0.05)

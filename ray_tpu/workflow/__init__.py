@@ -199,3 +199,7 @@ def delete(workflow_id: str, *, storage: str | None = None) -> None:
 
 __all__ = ["step", "run", "run_async", "get_output", "get_status",
            "list_workflows", "delete", "Step", "StepFunction"]
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+_rlu('workflow')
+del _rlu

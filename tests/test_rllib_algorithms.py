@@ -1,0 +1,133 @@
+"""APPO / A2C / BC / MARWIL / prioritized replay tests (parity: reference
+per-algorithm test files under rllib/algorithms/*/tests/)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (A2C, APPO, BC, MARWIL, A2CConfig, APPOConfig,
+                           BCConfig, MARWILConfig, PrioritizedReplayBuffer,
+                           get_model, write_offline_json)
+
+
+def test_model_catalog_contract():
+    spec = get_model("mlp")
+    params = spec.init_params(4, 2, 32, 0)
+    logits, value = spec.numpy_forward(params, np.zeros((3, 4), np.float32))
+    assert logits.shape == (3, 2) and value.shape == (3,)
+    spec2 = get_model("resmlp")
+    p2 = spec2.init_params(4, 2, 32, 0)
+    l2, v2 = spec2.numpy_forward(p2, np.zeros((5, 4), np.float32))
+    assert l2.shape == (5, 2) and v2.shape == (5,)
+    with pytest.raises(ValueError, match="unknown model"):
+        get_model("nope")
+
+
+def test_prioritized_replay_weights_and_updates():
+    buf = PrioritizedReplayBuffer(capacity=64, obs_size=3, seed=0)
+    batch = {
+        "obs": np.random.randn(32, 3).astype(np.float32),
+        "next_obs": np.random.randn(32, 3).astype(np.float32),
+        "actions": np.zeros(32, np.int32),
+        "rewards": np.ones(32, np.float32),
+        "dones": np.zeros(32, np.float32),
+    }
+    buf.add_batch(batch)
+    out = buf.sample(16)
+    assert out["weights"].shape == (16,)
+    assert out["weights"].max() <= 1.0 + 1e-6
+    # Push one index's priority up; it should dominate sampling.
+    target = int(out["indices"][0])
+    buf.update_priorities(np.array([target]), np.array([100.0]))
+    hits = sum(target in buf.sample(8)["indices"] for _ in range(20))
+    assert hits >= 15
+
+
+def test_a2c_learns_cartpole(ray_start_regular):
+    algo = (A2CConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(rollout_fragment_length=256, lr=2e-3)
+            .build())
+    try:
+        first = algo.train()
+        last = first
+        for _ in range(6):
+            last = algo.train()
+        assert last["training_iteration"] == 7
+        assert last["timesteps_total"] >= 7 * 512
+        assert last["episode_reward_mean"] > first["episode_reward_mean"]
+    finally:
+        algo.stop()
+
+
+def test_appo_learns_cartpole(ray_start_regular):
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(rollout_fragment_length=128, num_fragments_per_iter=4,
+                      lr=1e-3)
+            .build())
+    try:
+        first = algo.train()
+        last = first
+        for _ in range(5):
+            last = algo.train()
+        assert last["training_iteration"] == 6
+        assert "mean_ratio" in last
+        assert last["episode_reward_mean"] > 15  # learning signal on CartPole
+    finally:
+        algo.stop()
+
+
+@pytest.fixture()
+def logged_experience(tmp_path):
+    """Synthetic expert data for CartPole: the 'lean-toward-the-pole'
+    heuristic (push in the direction the pole falls) is a strong expert."""
+    from ray_tpu.rllib.env import CartPole
+
+    env = CartPole()
+    batches = []
+    for ep in range(30):
+        obs = env.reset(seed=ep)
+        obs_l, act_l, rew_l, done_l = [], [], [], []
+        done = False
+        while not done:
+            action = 1 if obs[2] + 0.5 * obs[3] > 0 else 0
+            nxt, r, done, _ = env.step(action)
+            obs_l.append(obs.tolist())
+            act_l.append(action)
+            rew_l.append(r)
+            done_l.append(float(done))
+            obs = nxt
+        batches.append({"obs": obs_l, "actions": act_l, "rewards": rew_l,
+                        "dones": done_l})
+    path = str(tmp_path / "expert.jsonl")
+    write_offline_json(path, batches)
+    return path
+
+
+def test_bc_clones_expert(logged_experience):
+    algo = (BCConfig()
+            .environment("CartPole-v1")
+            .offline_data(input_path=logged_experience)
+            .training(num_sgd_iter_per_train=40, lr=3e-3)
+            .build())
+    for _ in range(5):
+        result = algo.train()
+    assert result["training_iteration"] == 5
+    ev = algo.evaluate(num_episodes=3)
+    # The heuristic expert balances for hundreds of steps; a faithful clone
+    # should stay up far longer than random (~20).
+    assert ev["episode_reward_mean"] > 100
+
+
+def test_marwil_beta_weighting(logged_experience):
+    algo = (MARWILConfig()
+            .environment("CartPole-v1")
+            .offline_data(input_path=logged_experience)
+            .training(beta=1.0, num_sgd_iter_per_train=10)
+            .build())
+    result = algo.train()
+    assert result["num_samples"] > 500
+    assert "mean_weight" in result
+    assert np.isfinite(result["loss"])

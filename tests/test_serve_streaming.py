@@ -1,0 +1,97 @@
+"""Serve streaming responses + replica-death retry + Data stats/readers
+(reference test models: serve/tests/test_streaming_response.py,
+test_replica_failure.py; data/tests/test_stats.py)."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture()
+def serve_cluster(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def test_streaming_handle(serve_cluster):
+    @serve.deployment
+    def tokens(payload):
+        for i in range(int(payload.get("n", 5))):
+            yield f"tok{i}"
+
+    serve.run(tokens.bind())
+    handle = serve.get_deployment_handle("tokens")
+    out = list(handle.options(stream=True).remote({"n": 7}))
+    assert out == [f"tok{i}" for i in range(7)]
+
+
+def test_streaming_http_chunked(serve_cluster):
+    @serve.deployment
+    def counter(payload):
+        for i in range(int(payload.get("n", 3))):
+            yield i * 10
+
+    serve.run(counter.bind())
+    port = serve.start_http_proxy(port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/counter?stream=1",
+        data=json.dumps({"n": 4}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        lines = [json.loads(ln) for ln in r.read().decode().splitlines() if ln]
+    assert [d["chunk"] for d in lines] == [0, 10, 20, 30]
+
+
+def test_retry_on_replica_death(serve_cluster):
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, payload):
+            return {"pid": os.getpid(), "v": payload["v"]}
+
+    serve.run(Echo.bind())
+    handle = serve.get_deployment_handle("Echo")
+    # Warm the router, then kill one replica out from under it: the
+    # in-flight response retries on a survivor instead of failing.
+    assert handle.remote({"v": 1}).result(timeout=60)["v"] == 1
+    controller = serve._get_controller()
+    replicas = ray_tpu.get(controller.get_replicas.remote("Echo"))
+    resp = handle.remote({"v": 2})
+    ray_tpu.kill(replicas[0])
+    ray_tpu.kill(replicas[1])
+    # At least one of the two kills lands on the serving replica; retry
+    # must reroute once the controller restarts replicas.
+    out = resp.result(timeout=120)
+    assert out["v"] == 2
+
+
+def test_data_stats_and_new_readers(ray_start_regular, tmp_path):
+    from ray_tpu import data
+
+    ds = data.range(100).map(lambda x: x * 2)
+    total = ds.sum()
+    assert total == sum(x * 2 for x in range(100))
+    s = ds.stats()
+    assert "blocks" in s and "Wall time" in s
+
+    # read_binary_files
+    p1 = tmp_path / "a.bin"
+    p2 = tmp_path / "b.bin"
+    p1.write_bytes(b"\x01\x02")
+    p2.write_bytes(b"\x03")
+    bds = data.read_binary_files([str(p1), str(p2)], include_paths=True)
+    rows = sorted(bds.take_all(), key=lambda r: r["path"])
+    assert rows[0]["bytes"] == b"\x01\x02" and rows[1]["bytes"] == b"\x03"
+
+    # from_arrow (gated on pyarrow presence)
+    try:
+        import pyarrow as pa
+    except ImportError:
+        return
+    t = pa.table({"x": [1, 2, 3]})
+    assert data.from_arrow(t).count() == 3
