@@ -29,7 +29,28 @@ from ray_tpu.models.dit import (
     ddim_sample,
     ddpm_loss,
 )
+from ray_tpu.models.encoder import (
+    BERT_BASE,
+    BERT_LARGE,
+    T5_BASE,
+    T5_LARGE,
+    TINY_ENCDEC,
+    TINY_ENCODER,
+    EncDecConfig,
+    Encoder,
+    EncoderConfig,
+    EncoderDecoder,
+    mlm_loss,
+    seq2seq_loss,
+)
 from ray_tpu.models.generate import Generator, SamplingParams, generate
+from ray_tpu.models.ssm import (
+    MAMBA_130M,
+    MAMBA_790M,
+    TINY_SSM,
+    SSMConfig,
+    SSMModel,
+)
 from ray_tpu.models.vit import (
     VIT_B16,
     VIT_L16,
@@ -47,4 +68,8 @@ __all__ = [
     "Generator", "SamplingParams", "generate",
     "ViT", "ViTConfig", "VIT_B16", "VIT_L16", "VIT_TINY", "vit_loss",
     "DiT", "DiTConfig", "ddpm_loss", "ddim_sample",
+    "Encoder", "EncoderConfig", "BERT_BASE", "BERT_LARGE", "TINY_ENCODER",
+    "mlm_loss", "EncoderDecoder", "EncDecConfig", "T5_BASE", "T5_LARGE",
+    "TINY_ENCDEC", "seq2seq_loss",
+    "SSMModel", "SSMConfig", "MAMBA_130M", "MAMBA_790M", "TINY_SSM",
 ]

@@ -1,0 +1,236 @@
+"""Bidirectional encoder (BERT-family) and encoder-decoder (T5-family),
+TPU-first.
+
+The reference ships no model implementations (fine-tunes run through
+external torch engines — reference: release/release_tests.yaml ML gates);
+here the encoder families round out the model zoo next to the Llama
+decoder, MoE, ViT, and DiT. Same conventions as models/llama.py:
+flax.linen, (batch, seq, d_model) activations, bf16-friendly params,
+f32 norms, parameter names aligned with ray_tpu.parallel rules so
+TP/FSDP shardings apply by rule.
+
+Masked-LM objective for the encoder; prefix-LM / seq2seq cross-entropy
+for the encoder-decoder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# Shared blocks
+# ---------------------------------------------------------------------------
+
+
+class _Attention(nn.Module):
+    """Full (bidirectional or causal or cross) attention. Encoder work is
+    large dense batched matmuls — exactly MXU shape; masking is additive
+    so XLA fuses it into the softmax."""
+
+    d_model: int
+    n_heads: int
+    dtype: Any
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, kv=None, mask=None):
+        # x: (B, S, D) queries; kv: keys/values source (defaults to x).
+        kv = x if kv is None else kv
+        B, Sq, _ = x.shape
+        Sk = kv.shape[1]
+        H = self.n_heads
+        Dh = self.d_model // H
+        dense = lambda n, name: nn.Dense(n, use_bias=False, dtype=self.dtype,
+                                         param_dtype=self.dtype, name=name)
+        q = dense(H * Dh, "q_proj")(x).reshape(B, Sq, H, Dh)
+        k = dense(H * Dh, "k_proj")(kv).reshape(B, Sk, H, Dh)
+        v = dense(H * Dh, "v_proj")(kv).reshape(B, Sk, H, Dh)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / jnp.sqrt(Dh)
+        if self.causal:
+            cm = jnp.tril(jnp.ones((Sq, Sk), bool))
+            s = jnp.where(cm[None, None], s, -1e30)
+        if mask is not None:  # (B, Sk) valid-token mask
+            s = jnp.where(mask[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        out = out.reshape(B, Sq, H * Dh).astype(self.dtype)
+        return dense(self.d_model, "o_proj")(out)
+
+
+class _MLP(nn.Module):
+    d_model: int
+    d_ff: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype,
+                     param_dtype=self.dtype, name="up_proj")(x)
+        h = jax.nn.gelu(h)
+        return nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                        param_dtype=self.dtype, name="down_proj")(h)
+
+
+def _norm(name):
+    return nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name=name)
+
+
+# ---------------------------------------------------------------------------
+# Encoder (BERT-family)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+BERT_BASE = EncoderConfig()
+BERT_LARGE = EncoderConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)
+TINY_ENCODER = EncoderConfig(vocab_size=256, d_model=64, n_layers=2,
+                             n_heads=4, d_ff=128, max_seq_len=64,
+                             dtype=jnp.float32)
+
+
+class EncoderBlock(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        c = self.cfg
+        h = _norm("ln_attn")(x).astype(c.dtype)
+        x = x + _Attention(c.d_model, c.n_heads, c.dtype, name="attn")(
+            h, mask=mask)
+        h = _norm("ln_mlp")(x).astype(c.dtype)
+        return x + _MLP(c.d_model, c.d_ff, c.dtype, name="mlp")(h)
+
+
+class Encoder(nn.Module):
+    """Bidirectional transformer encoder with an MLM head.
+
+    __call__ returns (B, S, D) features; `mlm_logits` projects to vocab
+    with the tied embedding; `pooled` mean-pools valid tokens for
+    classification heads.
+    """
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, tokens, mask=None):
+        c = self.cfg
+        embed = nn.Embed(c.vocab_size, c.d_model, dtype=c.dtype,
+                         param_dtype=c.dtype, name="tok_embed")
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (c.max_seq_len, c.d_model), c.dtype)
+        S = tokens.shape[1]
+        x = embed(tokens) + pos[None, :S]
+        if mask is None:
+            mask = jnp.ones(tokens.shape, bool)
+        for i in range(c.n_layers):
+            x = EncoderBlock(c, name=f"layer_{i}")(x, mask)
+        x = _norm("ln_final")(x)
+        # Tied-embedding MLM logits.
+        logits = embed.attend(x.astype(c.dtype))
+        return x, logits
+
+    @staticmethod
+    def pooled(features, mask):
+        m = mask[..., None].astype(features.dtype)
+        return (features * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+
+
+def mlm_loss(logits, targets, mlm_mask):
+    """Cross-entropy only at masked positions (the BERT objective).
+    One CE implementation lives in models/llama.py; this masks it."""
+    from ray_tpu.models.llama import cross_entropy_loss
+
+    return cross_entropy_loss(logits, targets, mask=mlm_mask)
+
+
+# ---------------------------------------------------------------------------
+# Encoder-decoder (T5-family)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EncDecConfig:
+    vocab_size: int = 32128
+    d_model: int = 768
+    n_layers: int = 12          # per stack
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+T5_BASE = EncDecConfig()
+T5_LARGE = EncDecConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)
+TINY_ENCDEC = EncDecConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                           d_ff=128, max_seq_len=64, dtype=jnp.float32)
+
+
+class DecoderBlock(nn.Module):
+    cfg: EncDecConfig
+
+    @nn.compact
+    def __call__(self, x, enc, enc_mask):
+        c = self.cfg
+        h = _norm("ln_self")(x).astype(c.dtype)
+        x = x + _Attention(c.d_model, c.n_heads, c.dtype, causal=True,
+                           name="self_attn")(h)
+        h = _norm("ln_cross")(x).astype(c.dtype)
+        x = x + _Attention(c.d_model, c.n_heads, c.dtype,
+                           name="cross_attn")(h, kv=enc, mask=enc_mask)
+        h = _norm("ln_mlp")(x).astype(c.dtype)
+        return x + _MLP(c.d_model, c.d_ff, c.dtype, name="mlp")(h)
+
+
+class EncoderDecoder(nn.Module):
+    """Seq2seq transformer: bidirectional encoder, causal decoder with
+    cross-attention (the T5 shape, pre-norm)."""
+
+    cfg: EncDecConfig
+
+    @nn.compact
+    def __call__(self, src_tokens, tgt_tokens, src_mask=None):
+        c = self.cfg
+        embed = nn.Embed(c.vocab_size, c.d_model, dtype=c.dtype,
+                         param_dtype=c.dtype, name="tok_embed")
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (c.max_seq_len, c.d_model), c.dtype)
+        if src_mask is None:
+            src_mask = jnp.ones(src_tokens.shape, bool)
+        x = embed(src_tokens) + pos[None, : src_tokens.shape[1]]
+        for i in range(c.n_layers):
+            x = EncoderBlock(
+                EncoderConfig(vocab_size=c.vocab_size, d_model=c.d_model,
+                              n_heads=c.n_heads, d_ff=c.d_ff,
+                              max_seq_len=c.max_seq_len, dtype=c.dtype),
+                name=f"enc_{i}")(x, src_mask)
+        enc = _norm("ln_enc")(x).astype(c.dtype)
+
+        y = embed(tgt_tokens) + pos[None, : tgt_tokens.shape[1]]
+        for i in range(c.n_layers):
+            y = DecoderBlock(c, name=f"dec_{i}")(y, enc, src_mask)
+        y = _norm("ln_dec")(y)
+        return embed.attend(y.astype(c.dtype))
+
+
+def seq2seq_loss(logits, targets, mask=None):
+    """Alias of the shared CE (models/llama.py) under the seq2seq name."""
+    from ray_tpu.models.llama import cross_entropy_loss
+
+    return cross_entropy_loss(logits, targets, mask=mask)

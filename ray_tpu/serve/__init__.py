@@ -181,6 +181,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         line per yielded chunk (reference: serve StreamingResponse over the
         uvicorn proxy)."""
         gen = None
+        started = False
         try:
             payload = json.loads(body) if body else {}
             gen = handle.options(stream=True).remote(payload)
@@ -188,19 +189,26 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", "application/jsonl")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
+            started = True
             for chunk in gen:
                 line = (json.dumps({"chunk": chunk}) + "\n").encode()
                 self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
             self.wfile.write(b"0\r\n\r\n")
         except Exception as e:  # noqa: BLE001
-            try:
-                data = json.dumps({"error": str(e)}).encode()
-                self.send_response(500)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-            except Exception:
-                pass
+            if started:
+                # Headers + chunks already on the wire: a 500 here would
+                # inject a status line mid-body. Drop the connection so the
+                # client sees a truncated (unterminated) chunked stream.
+                self.close_connection = True
+            else:
+                try:
+                    data = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except Exception:
+                    pass
         finally:
             # Client disconnect / handler error mid-stream: release the
             # replica-side generator and the router's outstanding count.

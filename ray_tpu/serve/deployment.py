@@ -534,7 +534,10 @@ class DeploymentHandle:
                 # to replace dead replicas, so back off between attempts
                 # (reference: handles retry system-level replica failures
                 # until the deployment is available again).
-                deadline = time.monotonic() + (timeout or 60.0)
+                # timeout=None means wait indefinitely — same contract as
+                # the normal result() path.
+                deadline = (float("inf") if timeout is None
+                            else time.monotonic() + timeout)
                 last_err = None
                 while time.monotonic() < deadline:
                     self._last_refresh = 0.0
@@ -545,9 +548,11 @@ class DeploymentHandle:
                         time.sleep(1.0)
                         continue
                     try:
+                        budget = None if deadline == float("inf") else \
+                            max(1.0, deadline - time.monotonic())
                         return ray_tpu.get(r_replica.handle_request.remote(
                             self._method, list(args), kwargs, self._model_id),
-                            timeout=max(1.0, deadline - time.monotonic()))
+                            timeout=budget)
                     except ray_tpu.exceptions.ActorError as e:
                         last_err = e
                         time.sleep(1.0)

@@ -1,0 +1,104 @@
+"""Encoder / encoder-decoder model family tests (train-step convergence on
+the CPU fake backend, masking semantics, shape contracts)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jaxlib():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def test_encoder_shapes_and_mask(jaxlib):
+    jax, jnp = jaxlib
+    from ray_tpu.models import TINY_ENCODER, Encoder
+
+    model = Encoder(TINY_ENCODER)
+    tokens = jnp.ones((2, 16), jnp.int32)
+    mask_np = np.zeros((2, 16), bool)
+    mask_np[:, :10] = True
+    mask = jnp.asarray(mask_np)
+    params = model.init(jax.random.PRNGKey(0), tokens, mask)
+    feats, logits = model.apply(params, tokens, mask)
+    assert feats.shape == (2, 16, 64)
+    assert logits.shape == (2, 16, TINY_ENCODER.vocab_size)
+    pooled = Encoder.pooled(feats, mask)
+    assert pooled.shape == (2, 64)
+    # Masked-out tokens must not affect valid-token features.
+    toks2 = tokens.at[:, 12].set(99)
+    feats2, _ = model.apply(params, toks2, mask)
+    np.testing.assert_allclose(np.asarray(feats[:, :10]),
+                               np.asarray(feats2[:, :10]), atol=1e-5)
+
+
+def test_encoder_mlm_trains(jaxlib):
+    jax, jnp = jaxlib
+    import optax
+
+    from ray_tpu.models import TINY_ENCODER, Encoder, mlm_loss
+
+    model = Encoder(TINY_ENCODER)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(3, 256, (4, 24)), jnp.int32)
+    mlm_mask = jnp.asarray(rng.random((4, 24)) < 0.3)
+    inputs = jnp.where(mlm_mask, 1, tokens)  # 1 = [MASK]
+    params = model.init(jax.random.PRNGKey(0), inputs)
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            _, logits = model.apply(p, inputs)
+            return mlm_loss(logits, tokens, mlm_mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, first = step(params, opt_state)
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+    assert float(loss) < float(first) * 0.7
+
+
+def test_encdec_trains_copy_task(jaxlib):
+    jax, jnp = jaxlib
+    import optax
+
+    from ray_tpu.models import TINY_ENCDEC, EncoderDecoder, seq2seq_loss
+
+    model = EncoderDecoder(TINY_ENCDEC)
+    rng = np.random.default_rng(1)
+    src = jnp.asarray(rng.integers(3, 256, (4, 12)), jnp.int32)
+    # Teacher forcing on the copy task: decoder sees <bos>+src[:-1],
+    # predicts src.
+    tgt_in = jnp.concatenate([jnp.full((4, 1), 2, jnp.int32), src[:, :-1]], 1)
+    params = model.init(jax.random.PRNGKey(0), src, tgt_in)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply(p, src, tgt_in)
+            return seq2seq_loss(logits, src)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, first = step(params, opt_state)
+    for _ in range(60):
+        params, opt_state, loss = step(params, opt_state)
+    assert float(loss) < float(first) * 0.5
+    # Greedy accuracy on the training batch should be high for a copy task.
+    logits = model.apply(params, src, tgt_in)
+    acc = (jnp.argmax(logits, -1) == src).mean()
+    assert float(acc) > 0.8

@@ -1,0 +1,77 @@
+"""SSM (Mamba-family) tests: causality, recurrence correctness vs a
+sequential reference, and LM convergence on the CPU fake backend."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jaxlib():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def test_selective_scan_matches_sequential(jaxlib):
+    jax, jnp = jaxlib
+    from ray_tpu.models.ssm import _selective_scan
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.uniform(0.1, 0.99, (2, 9, 3, 4)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((2, 9, 3, 4)).astype(np.float32))
+    h = np.asarray(_selective_scan(a, b))
+    ref = np.zeros_like(h)
+    acc = np.zeros((2, 3, 4), np.float32)
+    for t in range(9):
+        acc = np.asarray(a)[:, t] * acc + np.asarray(b)[:, t]
+        ref[:, t] = acc
+    np.testing.assert_allclose(h, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_ssm_model_is_causal(jaxlib):
+    jax, jnp = jaxlib
+    from ray_tpu.models import TINY_SSM, SSMModel
+
+    model = SSMModel(TINY_SSM)
+    tokens = jnp.ones((1, 12), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    base = np.asarray(model.apply(params, tokens))
+    # Changing token t=8 must not change logits at positions < 8.
+    perturbed = np.asarray(model.apply(params, tokens.at[0, 8].set(7)))
+    np.testing.assert_allclose(base[:, :8], perturbed[:, :8],
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(base[:, 8:], perturbed[:, 8:])
+
+
+def test_ssm_lm_trains(jaxlib):
+    jax, jnp = jaxlib
+    import optax
+
+    from ray_tpu.models import TINY_SSM, SSMModel, cross_entropy_loss
+
+    model = SSMModel(TINY_SSM)
+    rng = np.random.default_rng(0)
+    # Predictable sequence: t+1 = (t*3 + 1) % 200 — learnable by an LM.
+    seq = [5]
+    for _ in range(32):
+        seq.append((seq[-1] * 3 + 1) % 200)
+    data = jnp.asarray([seq], jnp.int32)
+    inp, tgt = data[:, :-1], data[:, 1:]
+    params = model.init(jax.random.PRNGKey(0), inp)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: cross_entropy_loss(model.apply(p, inp), tgt))(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, first = step(params, opt_state)
+    for _ in range(80):
+        params, opt_state, loss = step(params, opt_state)
+    assert float(loss) < float(first) * 0.3
