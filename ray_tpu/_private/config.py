@@ -39,6 +39,10 @@ class Config:
     scheduler_spread_threshold: float = 0.5
     # Worker pool (reference: raylet/worker_pool.cc prestart logic).
     num_workers_soft_limit: int = -1  # -1: default to node CPU count
+    # Workers spawned at raylet boot so first leases find a warm pool
+    # (interpreter + framework imports cost seconds per worker on hosts
+    # whose site hooks pull in jax). 0 disables; -1 = node CPU count.
+    prestart_workers: int = 0
     worker_startup_timeout_s: float = 60.0
     worker_lease_timeout_s: float = 30.0
     # Leased-worker reuse window, amortizes scheduling like the reference's

@@ -114,6 +114,7 @@ class WorkerHandle:
         self.lease_id: str | None = None
         self.lease_resources: dict = {}
         self.lease_pg: tuple[str, int] | None = None
+        self.blocked = False  # in ray.get: CPU returned to the pool
         self.actor_id: str | None = None
         self.idle_since = time.monotonic()
         self.leased_at = 0.0
@@ -198,6 +199,8 @@ class Raylet:
             "MakeRoom": self.handle_make_room,
             "GetNodeInfo": self.handle_get_node_info,
             "ReportWorkerDeath": self.handle_report_worker_death,
+            "WorkerBlocked": self.handle_worker_blocked,
+            "WorkerUnblocked": self.handle_worker_unblocked,
             # peer-raylet-facing
             "FetchChunk": self.handle_fetch_chunk,
             "ObjectInfo": self.handle_object_info,
@@ -253,6 +256,19 @@ class Raylet:
         self._tasks.append(asyncio.create_task(self._reap_loop()))
         if self.config.memory_usage_threshold > 0:
             self._tasks.append(asyncio.create_task(self._memory_monitor_loop()))
+        # Prestart (reference: worker_pool.cc PrestartWorkers): warm the
+        # pool concurrently with the rest of cluster bring-up — each
+        # registration lands the worker in idle_workers and pumps leases.
+        n_pre = self.config.prestart_workers
+        if n_pre < 0:
+            n_pre = int(self.total_resources.get("CPU", 0))
+        soft = self.config.num_workers_soft_limit
+        if soft < 0:
+            soft = max(2, int(self.total_resources.get("CPU", 2)))
+        # The reap loop trims idle workers above the soft limit — spawning
+        # past it would pay the interpreter cost and be killed on arrival.
+        for _ in range(min(n_pre, soft)):
+            self._spawn_worker()
         logger.info("raylet %s on %s:%s resources=%s", self.node_id[:8], self.host,
                     self.port, self.total_resources)
         return self.host, self.port
@@ -636,7 +652,11 @@ class Raylet:
         return True
 
     def _release_lease_resources(self, w: WorkerHandle):
-        if w.lease_pg is not None:
+        if w.blocked:
+            # Resources were already returned when the worker blocked in
+            # ray.get — adding again would double-count.
+            w.blocked = False
+        elif w.lease_pg is not None:
             pool = self.pg_bundles.get(w.lease_pg)
             if pool is not None:
                 add_resources(pool["available"], w.lease_resources)
@@ -646,6 +666,41 @@ class Raylet:
         w.lease_id = None
         w.lease_resources = {}
         w.lease_pg = None
+
+    # ---- blocked-worker CPU release (reference: raylet marks workers
+    # blocked in ray.get and frees their resources so nested tasks can
+    # run — the fix for fan-out/nested-get worker starvation) ----
+
+    def handle_worker_blocked(self, conn, payload):
+        w = self.workers.get(payload["worker_id"])
+        if w is None or not w.leased or w.blocked:
+            return {}
+        w.blocked = True
+        if w.lease_pg is not None:
+            pool = self.pg_bundles.get(w.lease_pg)
+            if pool is not None:
+                add_resources(pool["available"], w.lease_resources)
+        else:
+            add_resources(self.available, w.lease_resources)
+        self._pump_pending_leases()
+        return {}
+
+    def handle_worker_unblocked(self, conn, payload):
+        w = self.workers.get(payload["worker_id"])
+        if w is None or not w.blocked:
+            return {}
+        w.blocked = False
+        # Re-acquire immediately; available may go briefly negative
+        # (dispatch only proceeds when fit, so this self-corrects as
+        # other leases finish — same oversubscription the reference
+        # tolerates on unblock).
+        if w.lease_pg is not None:
+            pool = self.pg_bundles.get(w.lease_pg)
+            if pool is not None:
+                subtract_resources(pool["available"], w.lease_resources)
+        else:
+            subtract_resources(self.available, w.lease_resources)
+        return {}
 
     def _sync_native_view(self):
         """Mirror the GCS cluster view into the native scheduler core."""

@@ -342,9 +342,45 @@ class CoreWorker:
         round-trip total; remote pulls overlap — reference: Get batches
         plasma + remote fetches, core_worker.cc:1353)."""
         async def fetch_all():
-            return await asyncio.gather(
-                *(self._fetch_object(oid, owner, timeout)
-                  for oid, owner in refs), return_exceptions=True)
+            # A worker blocked here still holds its lease's CPU — release
+            # it for the duration so nested/fan-out tasks can run on this
+            # node (reference: raylet blocked-worker accounting; without
+            # this, width > num_cpus nested gets deadlock the pool).
+            def all_ready_here():
+                for oid, _owner in refs:
+                    o = self.objects.get(oid.hex())
+                    if o is not None and o.state == OBJ_READY:
+                        continue
+                    try:
+                        # Borrowed refs whose data is already sealed in the
+                        # local shm store also resolve without blocking.
+                        if self.store.contains(oid):
+                            continue
+                    except Exception:
+                        pass
+                    return False
+                return True
+
+            notify_blocked = (not self.is_driver and self.raylet is not None
+                              and self._current_task_id is not None
+                              and not all_ready_here())
+            if notify_blocked:
+                try:
+                    await self.raylet.notify("WorkerBlocked",
+                                             {"worker_id": self.worker_id})
+                except Exception:
+                    notify_blocked = False
+            try:
+                return await asyncio.gather(
+                    *(self._fetch_object(oid, owner, timeout)
+                      for oid, owner in refs), return_exceptions=True)
+            finally:
+                if notify_blocked:
+                    try:
+                        await self.raylet.notify(
+                            "WorkerUnblocked", {"worker_id": self.worker_id})
+                    except Exception:
+                        pass
 
         fetched = self._run(fetch_all(),
                             None if timeout is None else timeout + 5)

@@ -89,13 +89,27 @@ class StandardAutoscaler:
         launched: dict[str, int] = {}
         if len(current) < self.max_workers:
             to_launch = self.get_nodes_to_launch(demand, pgs, available)
+            count_by_type: dict[str, int] = {}
+            for nid in current:
+                tn = self.provider.node_type(nid)
+                count_by_type[tn] = count_by_type.get(tn, 0) + 1
+            total = len(current)
             for type_name, count in to_launch.items():
                 t = self.node_types[type_name]
-                count = min(count, self.max_workers - len(current))
+                # Rate limit (reference: upscaling_speed — grow by at most
+                # speed × current-of-type per tick, min 1) and per-type +
+                # global max_workers caps; `total` tracks THIS tick's
+                # launches so multiple types cannot jointly exceed the cap.
+                have = count_by_type.get(type_name, 0)
+                rate_cap = max(1, int(self.upscaling_speed * max(1, have)))
+                count = min(count, rate_cap, t.max_workers - have,
+                            self.max_workers - total)
                 if count > 0:
                     logger.info("autoscaler launching %d x %s", count, type_name)
                     self.provider.create_node(t, count)
                     launched[type_name] = count
+                    total += count
+                    count_by_type[type_name] = have + count
 
         # Idle termination: fully-available worker nodes past the timeout.
         terminated = []

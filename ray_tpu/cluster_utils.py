@@ -31,6 +31,11 @@ class Cluster:
             if connect:
                 self.connect()
 
+    @property
+    def address(self) -> str | None:
+        """GCS address (reference parity: cluster_utils.Cluster.address)."""
+        return self.gcs_address
+
     def add_node(self, resources: dict | None = None, num_cpus: float | None = None,
                  labels: dict | None = None, _head: bool = False) -> NodeHandle:
         if self.gcs_address is None:

@@ -71,6 +71,11 @@ class _RunState:
     workflow_id: str
     storage: str
     counters: Counter = field(default_factory=Counter)
+    # Continuation scope: sub-steps spawned by a step's returned Step get
+    # ids under the parent's id ("f_0.g_0"), so a resume that
+    # short-circuits the parent checkpoint (never re-entering the
+    # continuation) cannot shift SIBLING step ids.
+    prefix: list = field(default_factory=list)
 
     def step_dir(self) -> str:
         d = os.path.join(self.storage, self.workflow_id, "steps")
@@ -78,16 +83,17 @@ class _RunState:
         return d
 
     def next_step_id(self, name: str) -> str:
-        idx = self.counters[name]
-        self.counters[name] += 1
-        return f"{name}_{idx}"
+        scoped = ".".join(self.prefix + [name])
+        idx = self.counters[scoped]
+        self.counters[scoped] += 1
+        return f"{scoped}_{idx}"
 
 
 def _result_path(state: _RunState, step_id: str) -> str:
     return os.path.join(state.step_dir(), f"{step_id}.pkl")
 
 
-def _execute(node: Any, state: _RunState):
+def _execute(node: Any, state: _RunState, resolve_continuation: bool = True):
     if isinstance(node, Step):
         step_id = state.next_step_id(node.name)
         path = _result_path(state, step_id)
@@ -111,6 +117,23 @@ def _execute(node: Any, state: _RunState):
                 break
             except Exception as e:  # noqa: BLE001
                 last_err = e
+        # Dynamic workflow: a step returned another step (reference:
+        # workflow.continuation). The OUTERMOST step of a chain resolves
+        # it iteratively (long tail-chains must not hit the Python
+        # recursion limit) under its own id scope, so a resume that
+        # short-circuits this checkpoint cannot shift sibling step ids.
+        # Continuation failures flow into the same last_err/catch
+        # handling as the step's own failure.
+        if resolve_continuation:
+            while last_err is None and isinstance(result, Step):
+                state.prefix.append(step_id)
+                try:
+                    result = _execute(result, state,
+                                      resolve_continuation=False)
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+                finally:
+                    state.prefix.pop()
         if last_err is not None:
             if not catch:
                 raise last_err
@@ -121,6 +144,12 @@ def _execute(node: Any, state: _RunState):
             result = (None, cause if cause is not None else last_err)
         elif catch:
             result = (result, None)
+        if isinstance(result, Step):
+            # Shallow (mid-chain) execution: the value is the NEXT
+            # continuation, owned by the outermost step's loop — a Step is
+            # not a durable value (its fn may not even pickle), so this
+            # link re-executes on resume and only settled values persist.
+            return result
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(result, f)
@@ -197,8 +226,37 @@ def delete(workflow_id: str, *, storage: str | None = None) -> None:
                   ignore_errors=True)
 
 
+class EventListener:
+    """Subclass with poll_for_event(*args) blocking until the event fires
+    and returning its payload (reference: workflow/event_listener.py)."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+def wait_for_event(listener_cls: type, *args, **kwargs) -> Step:
+    """A step that resolves when the external event arrives (reference:
+    workflow.wait_for_event). The listener runs as a task; the payload
+    checkpoints like any step result, so an already-received event is not
+    re-awaited on resume."""
+
+    def _wait(*a, **k):
+        return listener_cls().poll_for_event(*a, **k)
+
+    return Step(_wait, args, kwargs,
+                name=f"event-{listener_cls.__name__}", options={})
+
+
+def continuation(s: Step) -> Step:
+    """Mark a step returned from inside a step as the workflow's
+    continuation (reference: workflow.continuation). Returning the Step
+    directly has the same effect; this exists for API parity."""
+    return s
+
+
 __all__ = ["step", "run", "run_async", "get_output", "get_status",
-           "list_workflows", "delete", "Step", "StepFunction"]
+           "list_workflows", "delete", "Step", "StepFunction",
+           "EventListener", "wait_for_event", "continuation"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu('workflow')

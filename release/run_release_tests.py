@@ -1,0 +1,297 @@
+"""Release-test driver (reference model: release/ray_release/ — runs the
+manifest's suites, records metrics, asserts thresholds).
+
+Each entry spins a FRESH local cluster, runs one workload, and compares
+its metric to the manifest floor. Results land in release_results.json
+(one record per test — the analog of the reference's result DB rows).
+
+Usage:
+    python release/run_release_tests.py               # quick mode, all
+    python release/run_release_tests.py --full
+    python release/run_release_tests.py --suite scalability
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# Workloads: each returns {metric_name: value, ...}
+# ---------------------------------------------------------------------------
+
+
+def many_tasks(num_tasks: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    # Warm the worker pool first — the metric is steady-state scheduling
+    # throughput, not interpreter spawn time (reference microbenchmarks
+    # likewise measure warm pools; cold-start is covered by prestart).
+    ray_tpu.get([noop.remote(i) for i in range(16)], timeout=300)
+    t0 = time.perf_counter()
+    out = ray_tpu.get([noop.remote(i) for i in range(num_tasks)], timeout=600)
+    dt = time.perf_counter() - t0
+    assert out == list(range(num_tasks))
+    return {"tasks_per_s": round(num_tasks / dt, 1), "wall_s": round(dt, 2)}
+
+
+def many_actors(num_actors: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(num_actors)]
+    assert sum(ray_tpu.get([a.ping.remote() for a in actors],
+                           timeout=600)) == num_actors
+    dt = time.perf_counter() - t0
+    for a in actors:
+        ray_tpu.kill(a)
+    return {"actors": num_actors, "wall_s": round(dt, 2),
+            "actors_per_s": round(num_actors / dt, 1)}
+
+
+def many_placement_groups(num_pgs: int) -> dict:
+    import ray_tpu
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.01}]) for _ in range(num_pgs)]
+    ray_tpu.get([pg.ready() for pg in pgs], timeout=600)
+    dt = time.perf_counter() - t0
+    for pg in pgs:
+        remove_placement_group(pg)
+    return {"placement_groups": num_pgs, "wall_s": round(dt, 2)}
+
+
+def object_store_throughput(mb: int, rounds: int) -> dict:
+    import numpy as np
+
+    import ray_tpu
+
+    arr = np.random.default_rng(0).standard_normal(mb * 131072)  # mb MiB f64
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(rounds):
+        ref = ray_tpu.put(arr)
+        out = ray_tpu.get(ref)
+        total += out.nbytes * 2  # write + read
+    dt = time.perf_counter() - t0
+    return {"gib_per_s": round(total / dt / (1 << 30), 3)}
+
+
+def task_fanout_args(num_args: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def consume(*args):
+        return len(args)
+
+    refs = [ray_tpu.put(i) for i in range(num_args)]
+    assert ray_tpu.get(consume.remote(*refs), timeout=600) == num_args
+    return {"num_args": num_args}
+
+
+def nested_tasks(width: int, depth: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def spawn(d):
+        if d == 0:
+            return 1
+        import ray_tpu as rt
+
+        return sum(rt.get([spawn.remote(d - 1) for _ in range(width)],
+                          timeout=600))
+
+    total = ray_tpu.get(spawn.remote(depth), timeout=600)
+    assert total == width ** depth
+    return {"total_tasks": sum(width ** d for d in range(1, depth + 1)) + 1}
+
+
+def kill_node_mid_run(num_tasks: int) -> dict:
+    """Chaos: add a worker node, start tasks, kill the node — retried tasks
+    must all complete (reference: NodeKillerActor chaos suites)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=cluster.address)
+    victim = cluster.add_node(num_cpus=4)
+
+    @ray_tpu.remote(max_retries=3)
+    def slow(i):
+        time.sleep(0.1)
+        return i
+
+    try:
+        refs = [slow.remote(i) for i in range(num_tasks)]
+        time.sleep(0.5)
+        cluster.remove_node(victim)
+        out = ray_tpu.get(refs, timeout=600)
+        assert out == list(range(num_tasks))
+        return {"recovered_tasks": num_tasks}
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def trainer_2worker_throughput(num_workers: int, steps: int) -> dict:
+    import ray_tpu
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(cfg):
+        from ray_tpu.train import session
+
+        for s in range(cfg["steps"]):
+            session.report({"step": s})
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={"steps": steps},
+        scaling_config=ScalingConfig(num_workers=num_workers, use_tpu=False))
+    result = trainer.fit()
+    return {"reports": result.metrics["step"] + 1}
+
+
+ENTRIES = {
+    "many_tasks": many_tasks,
+    "many_actors": many_actors,
+    "many_placement_groups": many_placement_groups,
+    "object_store_throughput": object_store_throughput,
+    "task_fanout_args": task_fanout_args,
+    "nested_tasks": nested_tasks,
+    "kill_node_mid_run": kill_node_mid_run,
+    "trainer_2worker_throughput": trainer_2worker_throughput,
+}
+
+# Workloads that manage their own cluster lifecycle.
+_SELF_MANAGED = {"kill_node_mid_run"}
+
+
+def _load_manifest() -> dict:
+    import re
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "release_tests.yaml")
+    try:
+        import yaml
+
+        with open(path) as f:
+            return yaml.safe_load(f)
+    except ImportError:
+        # Dependency-free fallback parser for this manifest's fixed shape
+        # (2-space indents, "- name:" entries, inline {...} dicts).
+        suites: dict = {}
+        current_suite = None
+        entry = None
+        with open(path) as f:
+            for raw in f:
+                line = raw.rstrip()
+                if not line or line.lstrip().startswith("#"):
+                    continue
+                if re.match(r"^  \w+:$", line):
+                    current_suite = line.strip()[:-1]
+                    suites[current_suite] = []
+                elif line.lstrip().startswith("- name:"):
+                    entry = {"name": line.split(":", 1)[1].strip()}
+                    suites[current_suite].append(entry)
+                elif ":" in line and entry is not None:
+                    key, val = line.strip().split(":", 1)
+                    val = val.strip()
+                    if val.startswith("{"):
+                        val = {k.strip(): _coerce(v)
+                               for k, v in (kv.split(":") for kv in
+                                            val.strip("{}").split(","))}
+                    else:
+                        val = _coerce(val)
+                    entry[key] = val
+        return {"suites": suites}
+
+
+def _coerce(v: str):
+    v = v.strip()
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+def run_test(test: dict, quick: bool) -> dict:
+    import ray_tpu
+
+    kwargs = test["quick"] if quick else test["full"]
+    fn = ENTRIES[test["entry"]]
+    record = {"name": test["name"], "mode": "quick" if quick else "full",
+              "kwargs": kwargs}
+    t0 = time.perf_counter()
+    try:
+        if test["entry"] in _SELF_MANAGED:
+            metrics = fn(**kwargs)
+        else:
+            from ray_tpu._private.config import Config
+
+            ray_tpu.init(num_cpus=8, config=Config(prestart_workers=4))
+            try:
+                metrics = fn(**kwargs)
+            finally:
+                ray_tpu.shutdown()
+        record["metrics"] = metrics
+        value = metrics[test["metric"]]
+        record["value"] = value
+        record["passed"] = bool(value >= test["threshold"])
+    except Exception as e:  # noqa: BLE001
+        record["passed"] = False
+        record["error"] = f"{type(e).__name__}: {e}"
+    record["total_s"] = round(time.perf_counter() - t0, 2)
+    return record
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", default=None)
+    ap.add_argument("--full", action="store_true")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "release_results.json"))
+    args = ap.parse_args()
+
+    manifest = _load_manifest()
+    results = []
+    for suite, tests in manifest["suites"].items():
+        if args.suite and suite != args.suite:
+            continue
+        for test in tests:
+            print(f"[{suite}/{test['name']}] running...", flush=True)
+            rec = run_test(test, quick=not args.full)
+            rec["suite"] = suite
+            status = "PASS" if rec["passed"] else "FAIL"
+            print(f"[{suite}/{test['name']}] {status} "
+                  f"{rec.get('value')} (threshold {test['threshold']}) "
+                  f"in {rec['total_s']}s", flush=True)
+            results.append(rec)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    failed = [r for r in results if not r["passed"]]
+    print(f"\n{len(results) - len(failed)}/{len(results)} passed; "
+          f"results -> {args.out}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,3 +163,25 @@ def test_runtime_context_in_task(ray_start_regular):
     node_id, worker_id = ray_tpu.get(who.remote())
     assert len(node_id) == 40
     assert len(worker_id) == 40
+
+
+def test_nested_fanout_wider_than_cpus(ray_start_regular):
+    """Nested gets release the blocked worker's CPU (reference: raylet
+    blocked-worker accounting) — a fan-out wider than the CPU count must
+    not deadlock the worker pool."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    def leaf(i):
+        return i
+
+    @ray_tpu.remote(num_cpus=1)
+    def fan(width):
+        import ray_tpu as rt
+
+        return sum(rt.get([leaf.remote(i) for i in range(width)], timeout=60))
+
+    # ray_start_regular gives 4 CPUs; two concurrent fan() calls each
+    # spawning 6 leaves need blocked-release to make progress.
+    out = ray_tpu.get([fan.remote(6), fan.remote(6)], timeout=120)
+    assert out == [15, 15]

@@ -71,3 +71,104 @@ def test_joblib_backend(ray_start_regular):
     with joblib.parallel_backend("ray", n_jobs=2):
         got = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(6))
     assert got == [x * x for x in range(6)]
+
+
+def test_workflow_dynamic_continuation(ray_start_regular, tmp_path):
+    """A step returning another step recurses (reference:
+    workflow.continuation) — here a durable recursive factorial."""
+    from ray_tpu import workflow
+
+    @workflow.step
+    def fact(n, acc=1):
+        if n <= 1:
+            return acc
+        return fact.step(n - 1, acc * n)
+
+    out = workflow.run(fact.step(6), workflow_id="wf-dyn",
+                       storage=str(tmp_path))
+    assert out == 720
+    assert workflow.get_output("wf-dyn", storage=str(tmp_path)) == 720
+
+
+def test_workflow_wait_for_event(ray_start_regular, tmp_path):
+    """Events are durable steps: the workflow blocks until the listener
+    fires, and a resumed run reuses the checkpointed payload."""
+    import threading
+    import time
+
+    from ray_tpu import workflow
+
+    flag = tmp_path / "fired"
+
+    class FileEvent(workflow.EventListener):
+        def poll_for_event(self, path):
+            import os
+            import time as t
+
+            for _ in range(200):
+                if os.path.exists(path):
+                    with open(path) as f:
+                        return f.read()
+                t.sleep(0.05)
+            raise TimeoutError("event never fired")
+
+    @workflow.step
+    def combine(payload):
+        return f"got:{payload}"
+
+    def fire():
+        time.sleep(0.5)
+        flag.write_text("payload-1")
+
+    threading.Thread(target=fire, daemon=True).start()
+    dag = combine.step(workflow.wait_for_event(FileEvent, str(flag)))
+    out = workflow.run(dag, workflow_id="wf-evt", storage=str(tmp_path))
+    assert out == "got:payload-1"
+    # Resume: event checkpoint short-circuits (file removed → would hang
+    # if re-awaited).
+    flag.unlink()
+    out2 = workflow.run(dag, workflow_id="wf-evt", storage=str(tmp_path))
+    assert out2 == "got:payload-1"
+
+
+def test_workflow_continuation_sibling_ids(ray_start_regular, tmp_path):
+    """Continuation sub-steps are id-scoped under their parent, so a
+    sibling step with the same name keeps its own checkpoint on re-run."""
+    from ray_tpu import workflow
+
+    @workflow.step
+    def inner(x):
+        return x * 10
+
+    @workflow.step
+    def outer():
+        return inner.step(1)  # continuation uses the same step name
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    dag = add.step(outer.step(), inner.step(5))
+    assert workflow.run(dag, workflow_id="wf-sib",
+                        storage=str(tmp_path)) == 60
+    # Re-run (fully checkpointed): ids must map exactly as before.
+    assert workflow.run(dag, workflow_id="wf-sib",
+                        storage=str(tmp_path)) == 60
+
+
+def test_workflow_continuation_catch_exceptions(ray_start_regular, tmp_path):
+    """catch_exceptions covers failures inside a returned continuation."""
+    from ray_tpu import workflow
+
+    @workflow.step
+    def boom():
+        raise ValueError("continuation bang")
+
+    @workflow.step(catch_exceptions=True)
+    def outer():
+        return boom.step()
+
+    value, err = workflow.run(outer.step(), workflow_id="wf-catch",
+                              storage=str(tmp_path))
+    assert value is None
+    assert "continuation bang" in str(err)
