@@ -12,9 +12,31 @@ script always produces a number.
 from __future__ import annotations
 
 import json
+import subprocess
+import sys
 import time
 
-import jax
+
+def _device_backend_alive(timeout_s: float = 90.0) -> bool:
+    """Probe the accelerator in a SUBPROCESS: a wedged device tunnel hangs
+    on first device use, which would otherwise hang this whole script.
+    Only the child blocks; on timeout the parent falls back to CPU."""
+    probe = "import jax; jax.devices(); print(jax.default_backend())"
+    try:
+        r = subprocess.run([sys.executable, "-c", probe], timeout=timeout_s,
+                           capture_output=True, text=True)
+        return r.returncode == 0 and "tpu" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+if not _device_backend_alive():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
 import jax.numpy as jnp
 import numpy as np
 

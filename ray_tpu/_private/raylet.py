@@ -262,12 +262,9 @@ class Raylet:
         n_pre = self.config.prestart_workers
         if n_pre < 0:
             n_pre = int(self.total_resources.get("CPU", 0))
-        soft = self.config.num_workers_soft_limit
-        if soft < 0:
-            soft = max(2, int(self.total_resources.get("CPU", 2)))
         # The reap loop trims idle workers above the soft limit — spawning
         # past it would pay the interpreter cost and be killed on arrival.
-        for _ in range(min(n_pre, soft)):
+        for _ in range(min(n_pre, self._idle_soft_limit())):
             self._spawn_worker()
         logger.info("raylet %s on %s:%s resources=%s", self.node_id[:8], self.host,
                     self.port, self.total_resources)
@@ -426,9 +423,7 @@ class Raylet:
                     await self._on_worker_death(w, f"worker process exited "
                                                    f"with code {w.proc.returncode}")
             # Trim idle workers beyond the soft limit / idle timeout.
-            soft = self.config.num_workers_soft_limit
-            if soft < 0:
-                soft = max(2, int(self.total_resources.get("CPU", 2)))
+            soft = self._idle_soft_limit()
             while len(self.idle_workers) > soft:
                 w = self.idle_workers.popleft()
                 self._kill_worker(w)
@@ -550,6 +545,14 @@ class Raylet:
             raise
         except Exception:
             pass
+
+    def _idle_soft_limit(self) -> int:
+        """Idle-pool cap shared by the reap loop and prestart (keeping the
+        two in lockstep so prestarted workers aren't reaped on arrival)."""
+        soft = self.config.num_workers_soft_limit
+        if soft < 0:
+            soft = max(2, int(self.total_resources.get("CPU", 2)))
+        return soft
 
     def _spawn_worker(self) -> WorkerHandle:
         from ray_tpu._private.ids import WorkerID

@@ -142,7 +142,11 @@ def _execute(node: Any, state: _RunState, resolve_continuation: bool = True):
             # Unwrap the task-error envelope to the application exception.
             cause = getattr(last_err, "cause", None)
             result = (None, cause if cause is not None else last_err)
-        elif catch:
+        elif catch and not isinstance(result, Step):
+            # A Step result is the NEXT continuation link, not a settled
+            # value — wrapping it would halt the chain. A mid-chain step's
+            # catch_exceptions covers its OWN execution; failures later in
+            # the chain surface to the outermost step's handling.
             result = (result, None)
         if isinstance(result, Step):
             # Shallow (mid-chain) execution: the value is the NEXT

@@ -236,12 +236,15 @@ def _coerce(v: str):
 def run_test(test: dict, quick: bool) -> dict:
     import ray_tpu
 
-    kwargs = test["quick"] if quick else test["full"]
-    fn = ENTRIES[test["entry"]]
-    record = {"name": test["name"], "mode": "quick" if quick else "full",
-              "kwargs": kwargs}
+    record = {"name": test.get("name", "?"),
+              "mode": "quick" if quick else "full"}
     t0 = time.perf_counter()
     try:
+        # Manifest-shape errors (missing mode dict, unknown entry) fail
+        # THIS record, not the whole run.
+        kwargs = test["quick"] if quick else test["full"]
+        fn = ENTRIES[test["entry"]]
+        record["kwargs"] = kwargs
         if test["entry"] in _SELF_MANAGED:
             metrics = fn(**kwargs)
         else:
@@ -273,6 +276,12 @@ def main():
 
     manifest = _load_manifest()
     results = []
+
+    def flush_results():
+        # Incremental: a crash mid-run must not lose completed records.
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2, default=str)
+
     for suite, tests in manifest["suites"].items():
         if args.suite and suite != args.suite:
             continue
@@ -282,11 +291,11 @@ def main():
             rec["suite"] = suite
             status = "PASS" if rec["passed"] else "FAIL"
             print(f"[{suite}/{test['name']}] {status} "
-                  f"{rec.get('value')} (threshold {test['threshold']}) "
+                  f"{rec.get('value')} (threshold {test.get('threshold')}) "
                   f"in {rec['total_s']}s", flush=True)
             results.append(rec)
-    with open(args.out, "w") as f:
-        json.dump(results, f, indent=2)
+            flush_results()
+    flush_results()
     failed = [r for r in results if not r["passed"]]
     print(f"\n{len(results) - len(failed)}/{len(results)} passed; "
           f"results -> {args.out}")
