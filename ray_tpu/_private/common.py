@@ -138,6 +138,9 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     store_path: str = ""
     is_head: bool = False
+    # Port of the node's native C++ object-transfer server (0 = none;
+    # peers then fall back to the RPC chunk path).
+    transfer_port: int = 0
 
     def to_wire(self):
         return {
@@ -150,4 +153,5 @@ class NodeInfo:
             "alive": self.alive,
             "store_path": self.store_path,
             "is_head": self.is_head,
+            "transfer_port": self.transfer_port,
         }

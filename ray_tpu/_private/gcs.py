@@ -219,7 +219,8 @@ class GcsServer:
                 total_resources=w["total_resources"],
                 available_resources=w["available_resources"],
                 labels=w.get("labels") or {}, store_path=w.get("store_path", ""),
-                is_head=w.get("is_head", False))
+                is_head=w.get("is_head", False),
+                transfer_port=w.get("transfer_port", 0))
             # Nodes come back when their raylet re-registers; stale-alive
             # entries would mislead placement.
             info.alive = False
@@ -314,6 +315,7 @@ class GcsServer:
             labels=payload.get("labels") or {},
             store_path=payload.get("store_path", ""),
             is_head=payload.get("is_head", False),
+            transfer_port=payload.get("transfer_port", 0),
         )
         self.nodes[info.node_id] = info
         self.node_conns[info.node_id] = conn
@@ -351,6 +353,7 @@ class GcsServer:
                 "available_resources": n.available_resources,
                 "total_resources": n.total_resources,
                 "labels": n.labels,
+                "transfer_port": n.transfer_port,
             }
             for nid, n in self.nodes.items()
             if n.alive

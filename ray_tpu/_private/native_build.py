@@ -14,18 +14,21 @@ SRC_DIR = os.path.normpath(
 _build_lock = threading.Lock()
 
 
-def ensure_built(src_name: str, lib_name: str,
+def ensure_built(src_name: "str | tuple[str, ...]", lib_name: str,
                  extra_flags: tuple[str, ...] = ()) -> str:
-    """Compile src/<src_name> to _lib/<lib_name> if stale; returns the lib
-    path. Compiles to a private temp file then os.replace()s: concurrent
-    processes (GCS + raylet on a fresh checkout) must never dlopen a
-    half-written .so."""
-    src = os.path.join(SRC_DIR, src_name)
+    """Compile src/<src_name(s)> to _lib/<lib_name> if stale; returns the
+    lib path. Compiles to a private temp file then os.replace()s:
+    concurrent processes (GCS + raylet on a fresh checkout) must never
+    dlopen a half-written .so."""
+    names = (src_name,) if isinstance(src_name, str) else tuple(src_name)
+    srcs = [os.path.join(SRC_DIR, n) for n in names]
     lib_path = os.path.join(LIB_DIR, lib_name)
     with _build_lock:
+        existing = [s for s in srcs if os.path.exists(s)]
         if os.path.exists(lib_path) and (
-            not os.path.exists(src)
-            or os.path.getmtime(lib_path) >= os.path.getmtime(src)
+            not existing
+            or os.path.getmtime(lib_path) >= max(os.path.getmtime(s)
+                                                 for s in existing)
         ):
             return lib_path
         os.makedirs(LIB_DIR, exist_ok=True)
@@ -33,7 +36,7 @@ def ensure_built(src_name: str, lib_name: str,
         subprocess.run(
             [os.environ.get("CXX", "g++"),
              "-O2", "-Wall", "-fPIC", "-std=c++17", "-shared",
-             "-o", tmp, src, *extra_flags],
+             "-o", tmp, *srcs, *extra_flags],
             check=True, capture_output=True)
         os.replace(tmp, lib_path)
     return lib_path

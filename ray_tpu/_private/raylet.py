@@ -240,6 +240,11 @@ class Raylet:
             handlers={**self._handlers(), "Publish": self._on_publish},
             name=f"raylet-{self.node_id[:8]}->gcs",
             timeout=self.config.rpc_connect_timeout_s)
+        # Native data plane: serve this store's objects to peers from C++
+        # (payload bytes never cross the Python daemons).
+        from ray_tpu._private.native_transfer import TransferServer
+
+        self.transfer_server = TransferServer(self.store_path)
         resp = await self.gcs_conn.call("RegisterNode", {
             "node_id": self.node_id,
             "host": self.host,
@@ -248,6 +253,7 @@ class Raylet:
             "labels": self.labels,
             "store_path": self.store_path,
             "is_head": self.is_head,
+            "transfer_port": self.transfer_server.port,
         })
         if resp.get("config"):
             self.config = Config.from_json(resp["config"])
@@ -275,6 +281,8 @@ class Raylet:
             t.cancel()
         for w in list(self.workers.values()):
             self._kill_worker(w)
+        if getattr(self, "transfer_server", None) is not None:
+            await asyncio.to_thread(self.transfer_server.stop)
         await self.server.stop()
         if self.gcs_conn:
             await self.gcs_conn.close()
@@ -379,6 +387,8 @@ class Raylet:
                     "labels": self.labels,
                     "store_path": self.store_path,
                     "is_head": self.is_head,
+                    "transfer_port": getattr(self, "transfer_server", None)
+                    and self.transfer_server.port or 0,
                 }, timeout=self.config.rpc_call_timeout_s)
                 if resp.get("ok"):
                     old, self.gcs_conn = self.gcs_conn, conn
@@ -1185,6 +1195,9 @@ class Raylet:
                 if info is None:
                     continue
                 try:
+                    if await self._native_pull(info, oid):
+                        self._pull_locks.pop(oid_hex, None)
+                        return {"ok": True}
                     peer = await self._peer_conn(info["host"], info["raylet_port"])
                     ok = await self._pull_from(peer, oid)
                     if ok:
@@ -1195,6 +1208,34 @@ class Raylet:
                     last_err = str(e)
             self._pull_locks.pop(oid_hex, None)
             return {"ok": False, "reason": last_err}
+
+    async def _native_pull(self, info: dict, oid: ObjectID) -> bool:
+        """Pull via the peer's C++ transfer server (bulk bytes stream
+        shm-to-shm without touching Python). False = use the RPC path."""
+        tport = info.get("transfer_port") or 0
+        if not tport:
+            return False
+        from ray_tpu._private import native_transfer
+
+        loop = asyncio.get_running_loop()
+        try:
+            rc = await loop.run_in_executor(
+                None, native_transfer.fetch, self.store_path, info["host"],
+                tport, oid.binary())
+        except Exception:
+            return False
+        if rc == -3:
+            # Local arena full: make room like the RPC path would, then
+            # retry once.
+            try:
+                if not await self._ensure_room(64 << 20):
+                    return False
+            except Exception:
+                return False
+            rc = await loop.run_in_executor(
+                None, native_transfer.fetch, self.store_path, info["host"],
+                tport, oid.binary())
+        return rc == 0
 
     async def _pull_from(self, peer: rpc.Connection, oid: ObjectID) -> bool:
         chunk_size = self.config.object_transfer_chunk_size

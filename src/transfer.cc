@@ -1,0 +1,347 @@
+// Native object-transfer plane: serves and fetches bulk objects directly
+// between shared-memory stores over TCP, bypassing the Python daemons for
+// data bytes (reference: src/ray/object_manager/ push/pull streaming over
+// the ObjectManager gRPC service — here the framing is a fixed header and
+// the payload is written straight from/into the shm arena).
+//
+// Wire protocol (one connection serves many sequential requests):
+//   request : 20-byte object id
+//   response: u64 total_size | u64 meta_size | total_size payload bytes
+//             total_size == UINT64_MAX => object not found
+//
+// C ABI (ctypes from ray_tpu/_private/raylet.py):
+//   void* transfer_server_start(const char* store_path, int* out_port)
+//   void  transfer_server_stop(void* h)
+//   int   transfer_fetch(const char* store_path, const char* host, int port,
+//                        const uint8_t* id)   // 0 ok, <0 error
+//
+// Builds into libtputransfer.so together with object_store.cc (the store
+// ABI below), each process attaching its own mapping of the arena.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+// Store ABI (object_store.cc, linked into this .so).
+extern "C" {
+void* store_attach(const char* path);
+void store_detach(void* handle);
+void* store_base(void* handle);
+int store_create(void* handle, const uint8_t* id, uint64_t data_size,
+                 uint64_t meta_size, uint64_t* out_offset);
+int store_seal(void* handle, const uint8_t* id);
+int store_get(void* handle, const uint8_t* id, uint64_t* out_offset,
+              uint64_t* out_size, uint64_t* out_meta_size);
+int store_release(void* handle, const uint8_t* id);
+int store_contains(void* handle, const uint8_t* id);
+int store_abort(void* handle, const uint8_t* id);
+}
+
+namespace {
+
+constexpr int kIdSize = 20;
+constexpr uint64_t kNotFound = UINT64_MAX;
+
+bool send_all(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  void* store = nullptr;
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  // Per-connection threads run DETACHED; shutdown shuts their sockets
+  // down and waits for the live count to reach zero (joining blocked
+  // threads would hang forever on silently-dead peers, and keeping
+  // joinable thread objects around would leak a stack per connection).
+  std::mutex conns_mu;
+  std::condition_variable conns_cv;
+  std::set<int> conn_fds;
+  int live_conns = 0;
+
+  ~Server() {
+    stop.store(true);
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    {
+      std::unique_lock<std::mutex> g(conns_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+      conns_cv.wait_for(g, std::chrono::seconds(5),
+                        [this] { return live_conns == 0; });
+    }
+    if (store) store_detach(store);
+  }
+};
+
+void tune_socket(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Large buffers: bulk streams on busy/single-core hosts otherwise spend
+  // their time context-switching between the two copy loops.
+  int buf = 4 << 20;
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+  // A silently-dead peer (partition, power loss — no RST) must not pin a
+  // thread forever: recv/send give up after this long between bytes.
+  struct timeval tv {60, 0};
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+void serve_conn(Server* srv, int fd) {
+  tune_socket(fd);
+  uint8_t id[kIdSize];
+  while (!srv->stop.load() && recv_all(fd, id, kIdSize)) {
+    uint64_t off = 0, size = 0, meta = 0;
+    int rc = store_get(srv->store, id, &off, &size, &meta);
+    if (rc != 0) {
+      uint64_t hdr[2] = {kNotFound, 0};
+      if (!send_all(fd, hdr, sizeof(hdr))) break;
+      continue;
+    }
+    uint64_t hdr[2] = {size, meta};
+    bool ok = send_all(fd, hdr, sizeof(hdr)) &&
+              send_all(fd, static_cast<uint8_t*>(store_base(srv->store)) + off,
+                       size);
+    store_release(srv->store, id);
+    if (!ok) break;
+  }
+  {
+    std::lock_guard<std::mutex> g(srv->conns_mu);
+    srv->conn_fds.erase(fd);
+    srv->live_conns--;
+  }
+  srv->conns_cv.notify_all();
+  ::close(fd);
+}
+
+// Fetch-side attach cache: one mapping per store path per process.
+std::mutex g_attach_mu;
+std::map<std::string, void*>& attach_cache() {
+  static std::map<std::string, void*> m;
+  return m;
+}
+
+void* attached_store(const char* path) {
+  std::lock_guard<std::mutex> g(g_attach_mu);
+  auto& cache = attach_cache();
+  auto it = cache.find(path);
+  if (it != cache.end()) return it->second;
+  void* h = store_attach(path);
+  if (h) cache[path] = h;
+  return h;
+}
+
+int connect_to(const char* host, int port, int timeout_ms = 10000) {
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family,
+                  ai->ai_socktype | SOCK_NONBLOCK, ai->ai_protocol);
+    if (fd < 0) continue;
+    int rc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd {fd, POLLOUT, 0};
+      int err = 0;
+      socklen_t elen = sizeof(err);
+      if (::poll(&pfd, 1, timeout_ms) == 1 &&
+          ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) == 0 &&
+          err == 0) {
+        rc = 0;
+      }
+    }
+    if (rc == 0) {
+      // Back to blocking; per-op limits come from SO_RCV/SNDTIMEO.
+      int flags = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+      break;
+    }
+    ::close(fd);
+    fd = -1;
+  }
+  ::freeaddrinfo(res);
+  if (fd >= 0) tune_socket(fd);
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* transfer_server_start(const char* store_path, int* out_port) {
+  Server* srv = new Server();
+  srv->store = store_attach(store_path);
+  if (!srv->store) {
+    delete srv;
+    return nullptr;
+  }
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = 0;  // ephemeral
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(srv->listen_fd, 64) != 0) {
+    delete srv;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  *out_port = ntohs(addr.sin_port);
+
+  srv->accept_thread = std::thread([srv] {
+    while (!srv->stop.load()) {
+      int fd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (srv->stop.load()) return;
+        // Persistent errors (EMFILE under fd pressure) must not busy-spin.
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        continue;
+      }
+      {
+        std::lock_guard<std::mutex> g(srv->conns_mu);
+        srv->conn_fds.insert(fd);
+        srv->live_conns++;
+      }
+      std::thread(serve_conn, srv, fd).detach();
+    }
+  });
+  return srv;
+}
+
+void transfer_server_stop(void* h) {
+  delete reinterpret_cast<Server*>(h);
+}
+
+// Fetch-side connection cache: one persistent connection per peer (the
+// wire protocol serves many sequential requests; reconnecting per object
+// would pay connect latency on every pull). Guarded by one mutex — pulls
+// to the same peer serialize, which matches the single-stream protocol.
+struct PeerConn {
+  std::mutex mu;
+  int fd = -1;
+};
+std::mutex g_peers_mu;
+std::map<std::string, PeerConn*>& peer_conns() {
+  static std::map<std::string, PeerConn*> m;
+  return m;
+}
+
+int fetch_once(void* store, int fd, const uint8_t* id) {
+  // Returns 0 ok, -2 not found on peer, -3 store full, -4 io/protocol
+  // error (caller reconnects once on -4).
+  if (!send_all(fd, id, kIdSize)) return -4;
+  uint64_t hdr[2];
+  if (!recv_all(fd, hdr, sizeof(hdr))) return -4;
+  if (hdr[0] == kNotFound) return -2;
+  uint64_t total = hdr[0], meta = hdr[1];
+  uint64_t off = 0;
+  int crc = store_create(store, id, total, meta, &off);
+  if (crc == -2 /*kErrExists*/) {
+    // Concurrent create in flight: drain the payload to keep the
+    // connection aligned, then report found only if that create SEALED
+    // (it may still abort — same contains() guard as the RPC path).
+    std::vector<char> sink(1 << 20);
+    uint64_t left = total;
+    while (left > 0) {
+      size_t n = left < sink.size() ? left : sink.size();
+      if (!recv_all(fd, sink.data(), n)) return -4;
+      left -= n;
+    }
+    return store_contains(store, id) ? 0 : -2;
+  }
+  if (crc != 0) return -3;
+  uint8_t* dst = static_cast<uint8_t*>(store_base(store)) + off;
+  if (!recv_all(fd, dst, total)) {
+    store_abort(store, id);
+    return -4;
+  }
+  store_seal(store, id);
+  return 0;
+}
+
+// Pull one object from a peer's transfer server straight into the local
+// store. Returns 0 on success (or already present), -1 connect error,
+// -2 not found on peer, -3 local store full, -4 protocol error.
+int transfer_fetch(const char* store_path, const char* host, int port,
+                   const uint8_t* id) {
+  void* store = attached_store(store_path);
+  if (!store) return -4;
+  if (store_contains(store, id)) return 0;
+  std::string key = std::string(host) + ":" + std::to_string(port);
+  PeerConn* peer;
+  {
+    std::lock_guard<std::mutex> g(g_peers_mu);
+    auto& m = peer_conns();
+    auto it = m.find(key);
+    if (it == m.end()) it = m.emplace(key, new PeerConn()).first;
+    peer = it->second;
+  }
+  std::lock_guard<std::mutex> g(peer->mu);
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (peer->fd < 0) {
+      peer->fd = connect_to(host, port);
+      if (peer->fd < 0) return -1;
+    }
+    int rc = fetch_once(store, peer->fd, id);
+    if (rc != -4) return rc;
+    // IO error — possibly a server-side idle-expired cached connection:
+    // drop it and retry once on a fresh one.
+    ::close(peer->fd);
+    peer->fd = -1;
+  }
+  return -4;
+}
+
+}  // extern "C"
