@@ -33,6 +33,9 @@ const SECTIONS = [
   ["Cluster", "/api/cluster_status"], ["Nodes", "/api/nodes"],
   ["Actors", "/api/actors"], ["Jobs", "/api/jobs"],
   ["Submission jobs", "/api/submission_jobs"],
+  ["Placement groups", "/api/placement_groups"],
+  ["Serve deployments", "/api/serve"],
+  ["Workflows", "/api/workflows"],
   ["Task summary", "/api/summary"]];
 function table(rows) {
   if (!Array.isArray(rows)) rows = [rows];
@@ -107,6 +110,28 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.job_submission import JobSubmissionClient
 
                 data = [j.__dict__ for j in JobSubmissionClient().list_jobs()]
+            elif path == "/api/placement_groups":
+                data = state.list_placement_groups()
+            elif path == "/api/objects":
+                data = state.list_objects()
+            elif path == "/api/serve":
+                # Serve module (reference: dashboard/modules/serve): the
+                # controller's deployment table, empty when serve is down.
+                try:
+                    import ray_tpu
+                    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                                   namespace="serve")
+                    data = ray_tpu.get(
+                        controller.list_deployments.remote(), timeout=10)
+                except Exception:
+                    data = {}
+            elif path == "/api/workflows":
+                from ray_tpu import workflow
+
+                data = [{"workflow_id": w, "status": workflow.get_status(w)}
+                        for w in workflow.list_workflows()]
             else:
                 return self._send(404, b'{"error": "not found"}',
                                   "application/json")
