@@ -80,10 +80,24 @@ class Connection:
 
     async def _send(self, frame: list) -> None:
         data = pack(frame)
-        async with self._send_lock:
-            self.writer.write(len(data).to_bytes(4, "big"))
+        # Small frames: one buffer, one write — separate header/body writes
+        # double the syscalls on the hot path (every task push/response is
+        # a frame). Large frames (object-transfer chunks) keep two writes:
+        # concatenation would memcpy the whole body. write() is synchronous
+        # and ordered on the loop, so no lock is needed; drain() (a
+        # scheduler hop per frame) only when the transport is actually
+        # backed up past the high-water mark.
+        header = len(data).to_bytes(4, "big")
+        if len(data) < (64 << 10):
+            self.writer.write(header + data)
+        else:
+            self.writer.write(header)
             self.writer.write(data)
-            await self.writer.drain()
+        transport = self.writer.transport
+        if transport is not None and \
+                transport.get_write_buffer_size() > (1 << 20):
+            async with self._send_lock:
+                await self.writer.drain()
 
     async def call(self, method: str, payload=None, timeout: float | None = None):
         if self._closed:

@@ -116,17 +116,20 @@ class _Handler(BaseHTTPRequestHandler):
                 data = state.list_objects()
             elif path == "/api/serve":
                 # Serve module (reference: dashboard/modules/serve): the
-                # controller's deployment table, empty when serve is down.
-                try:
-                    import ray_tpu
-                    from ray_tpu.serve.controller import CONTROLLER_NAME
+                # controller's deployment table. Only "no controller"
+                # means serve-is-down; a wedged controller must surface
+                # as an error, not render as an empty table.
+                import ray_tpu
+                from ray_tpu.serve.controller import CONTROLLER_NAME
 
+                try:
                     controller = ray_tpu.get_actor(CONTROLLER_NAME,
                                                    namespace="serve")
-                    data = ray_tpu.get(
-                        controller.list_deployments.remote(), timeout=10)
-                except Exception:
+                except ValueError:  # named actor not found
                     data = {}
+                else:
+                    data = ray_tpu.get(
+                        controller.list_deployments.remote(), timeout=5)
             elif path == "/api/workflows":
                 from ray_tpu import workflow
 
