@@ -14,13 +14,13 @@ from typing import Any, Iterable
 import numpy as np
 
 import ray_tpu
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import DataIterator, Dataset, GroupedData
-
-DEFAULT_BLOCK_COUNT = 8
 
 
 def _to_blocks(rows: list, num_blocks: int | None) -> list:
-    n = num_blocks or min(DEFAULT_BLOCK_COUNT, max(1, len(rows)))
+    n = num_blocks or min(DataContext.get_current().default_block_count,
+                          max(1, len(rows)))
     per = math.ceil(len(rows) / n) if rows else 0
     blocks = [rows[i * per:(i + 1) * per] for i in _builtins.range(n)]
     return [b for b in blocks if b] or [[]]
@@ -171,6 +171,7 @@ def _expand(paths: str | list) -> list:
 
 
 __all__ = [
+    "DataContext",
     "Dataset", "DataIterator", "GroupedData", "from_items", "range",
     "range_tensor", "from_numpy", "from_pandas", "from_arrow", "read_text",
     "read_json", "read_csv", "read_numpy", "read_parquet",

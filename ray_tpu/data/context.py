@@ -1,0 +1,34 @@
+"""DataContext: process-wide execution knobs for ray_tpu.data
+(reference: python/ray/data/context.py — DataContext.get_current()).
+
+    ctx = ray_tpu.data.DataContext.get_current()
+    ctx.max_in_flight_blocks = 16   # streaming backpressure window
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import ClassVar
+
+
+@dataclass
+class DataContext:
+    # Streaming executor backpressure: how many block-transform tasks may
+    # be outstanding per pipeline segment (reference: ExecutionResources
+    # limits in streaming_executor.py:280).
+    max_in_flight_blocks: int = 8
+    # Default block count for from_items/range when unspecified.
+    default_block_count: int = 8
+    # Per-block remote task timeout (seconds) in the streaming loop.
+    block_task_timeout_s: float = 300.0
+
+    _lock: ClassVar[threading.Lock] = threading.Lock()
+    _current: ClassVar["DataContext | None"] = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = cls()
+            return cls._current

@@ -31,7 +31,6 @@ from ray_tpu.data.block import (
     slice_block,
 )
 
-DEFAULT_MAX_IN_FLIGHT = 8
 
 
 @dataclass
@@ -370,7 +369,7 @@ class Dataset:
 
     # ------------- execution -------------
 
-    def _iter_output_blocks(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+    def _iter_output_blocks(self, max_in_flight: int | None = None
                             ) -> Iterator[Any]:
         """The streaming loop: push blocks through stages with bounded
         in-flight remote tasks (reference: streaming_executor.py:217
@@ -379,6 +378,10 @@ class Dataset:
         for Dataset.stats()."""
         import time as _time
 
+        if max_in_flight is None:
+            from ray_tpu.data.context import DataContext
+
+            max_in_flight = DataContext.get_current().max_in_flight_blocks
         t0 = _time.perf_counter()
         n_blocks = n_rows = 0
         try:
@@ -410,9 +413,12 @@ class Dataset:
                 f"Output: {s['output_blocks']} blocks, {s['output_rows']} rows\n"
                 f"Wall time: {s['wall_s']}s")
 
-    def _iter_output_blocks_inner(self, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT
+    def _iter_output_blocks_inner(self, max_in_flight: int
                                   ) -> Iterator[Any]:
         from ray_tpu._private import serialization
+        from ray_tpu.data.context import DataContext
+
+        task_timeout = DataContext.get_current().block_task_timeout_s
 
         def resolve_sources() -> Iterator:
             """Launch deferred reads as remote tasks; their ObjectRefs feed
@@ -454,9 +460,9 @@ class Dataset:
                     window.append(actors[i % len(actors)].apply.remote(blk))
                     i += 1
                     if len(window) >= max(max_in_flight, len(actors)):
-                        yield ray_tpu.get(window.pop(0), timeout=300)
+                        yield ray_tpu.get(window.pop(0), timeout=task_timeout)
                 while window:
-                    yield ray_tpu.get(window.pop(0), timeout=300)
+                    yield ray_tpu.get(window.pop(0), timeout=task_timeout)
             finally:
                 for a in actors:
                     try:
@@ -485,9 +491,9 @@ class Dataset:
             for idx, blk in enumerate(in_blocks):
                 window.append(launch(blk, idx))
                 if len(window) >= max_in_flight:
-                    yield ray_tpu.get(window.pop(0), timeout=300)
+                    yield ray_tpu.get(window.pop(0), timeout=task_timeout)
             while window:
-                yield ray_tpu.get(window.pop(0), timeout=300)
+                yield ray_tpu.get(window.pop(0), timeout=task_timeout)
 
         def run_shuffle(in_blocks: Iterable, st: _Stage) -> Iterator:
             """Push-based shuffle: map tasks partition (num_returns=n_out
@@ -503,7 +509,7 @@ class Dataset:
                 sblob = serialization.dumps_func(st.shuffle_sample_fn)
                 sampled = ray_tpu.get(
                     [_shuffle_sample.remote(sblob, r) for r in in_refs],
-                    timeout=600)
+                    timeout=task_timeout)
                 aux = st.shuffle_plan_fn(sampled)
             mblob = serialization.dumps_func(st.shuffle_map_fn)
             rblob = serialization.dumps_func(st.shuffle_reduce_fn)
@@ -534,14 +540,14 @@ class Dataset:
         for b in blocks:
             if not isinstance(b, ray_tpu.ObjectRef):
                 while window:
-                    yield ray_tpu.get(window.pop(0), timeout=300)
+                    yield ray_tpu.get(window.pop(0), timeout=task_timeout)
                 yield b
                 continue
             window.append(b)
             if len(window) >= max_in_flight:
-                yield ray_tpu.get(window.pop(0), timeout=300)
+                yield ray_tpu.get(window.pop(0), timeout=task_timeout)
         while window:
-            yield ray_tpu.get(window.pop(0), timeout=300)
+            yield ray_tpu.get(window.pop(0), timeout=task_timeout)
 
     def materialize(self) -> "Dataset":
         out = list(self._iter_output_blocks())

@@ -23,6 +23,12 @@ class _Session:
     world_size: int
     report_queue: "queue.Queue"
     collective_group: str | None = None
+    # Set when this run restores: a retried trainer attempt (elastic
+    # restart) or a Tune trial resuming/exploiting a checkpoint.
+    restore_checkpoint_path: str | None = None
+    # Durable root for dict checkpoints (RunConfig.storage_path); None =
+    # node-local tempdir (single-host semantics).
+    storage_path: str | None = None
 
 
 def _set_session(s: _Session | None) -> None:
@@ -38,6 +44,18 @@ def _get_session() -> _Session:
     return s
 
 
+def get_checkpoint():
+    """The checkpoint this run should resume from, or None on a fresh
+    start (reference: ray.train.get_checkpoint() — set on elastic
+    restarts and Tune restore/exploit)."""
+    s = _get_session()
+    if s.restore_checkpoint_path is None:
+        return None
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    return Checkpoint(s.restore_checkpoint_path)
+
+
 def report(metrics: dict, checkpoint=None) -> None:
     """Stream metrics (and optionally a Checkpoint) to the trainer.
     A plain dict is wrapped via Checkpoint.from_dict (reference: air
@@ -46,9 +64,16 @@ def report(metrics: dict, checkpoint=None) -> None:
     payload = {"metrics": dict(metrics), "rank": s.rank}
     if checkpoint is not None:
         if isinstance(checkpoint, dict):
+            import os
+            import uuid
+
             from ray_tpu.train.checkpoint import Checkpoint
 
-            checkpoint = Checkpoint.from_dict(checkpoint)
+            path = None
+            if s.storage_path:
+                path = os.path.join(s.storage_path, "checkpoints",
+                                    f"ckpt-{uuid.uuid4().hex[:12]}")
+            checkpoint = Checkpoint.from_dict(checkpoint, path)
         payload["checkpoint_path"] = checkpoint.path
     s.report_queue.put(payload)
 

@@ -60,16 +60,24 @@ class JaxTrainer:
     def fit(self) -> Result:
         max_failures = self.run_config.failure_config.max_failures
         attempt = 0
+        restore_from: Checkpoint | None = None
         while True:
             try:
-                return self._fit_once()
+                return self._fit_once(restore_from)
             except exc.RayTpuError as e:
                 attempt += 1
                 if attempt > max_failures:
                     raise
+                # Elastic restart (reference: FailureConfig retries restore
+                # from the latest reported checkpoint — XLA programs are
+                # fixed-shape over a fixed mesh, so elasticity IS
+                # checkpoint-restart): the fresh worker gang resumes via
+                # session.get_checkpoint().
+                restore_from = getattr(e, "_last_checkpoint", None) \
+                    or restore_from
                 time.sleep(1.0)
 
-    def _fit_once(self) -> Result:
+    def _fit_once(self, restore_from: "Checkpoint | None" = None) -> Result:
         run_id = uuid.uuid4().hex[:8]
         group = WorkerGroup(self.scaling_config)
         try:
@@ -81,6 +89,14 @@ class JaxTrainer:
                 cfg["_collective_group"] = group_name
             else:
                 cfg = dict(self._config)
+            if restore_from is not None:
+                cfg["_checkpoint_path"] = restore_from.path
+            if self.run_config.storage_path:
+                # Dict checkpoints land under durable storage instead of a
+                # node-local tempdir — on real node loss the retry gang (on
+                # other hosts) must still reach them (shared-fs semantics,
+                # same as the reference's storage_path contract).
+                cfg["_storage_path"] = self.run_config.storage_path
             blob = serialization.dumps_func(self._train_loop)
             group.run_on_all("run", blob, cfg)
             return self._drive(group)
@@ -96,8 +112,14 @@ class JaxTrainer:
         error: str | None = None
         final_metrics: dict = {}
         while not all(done):
-            polls = ray_tpu.get(
-                [w.poll.remote() for w in group.workers], timeout=300)
+            try:
+                polls = ray_tpu.get(
+                    [w.poll.remote() for w in group.workers], timeout=300)
+            except exc.RayTpuError as e:
+                # Worker actor died (node loss, OOM kill): the retry loop
+                # needs the newest checkpoint seen before the crash.
+                e._last_checkpoint = last_ckpt
+                raise
             for i, p in enumerate(polls):
                 for rep in p["reports"]:
                     if rep["rank"] == 0:
@@ -110,7 +132,10 @@ class JaxTrainer:
                     if p["error"] and error is None:
                         error = f"worker {i}: {p['error']}"
             if error:
-                raise exc.RayTpuError(f"training failed: {error}")
+                err = exc.RayTpuError(f"training failed: {error}")
+                # Carried to fit()'s retry loop for checkpoint restore.
+                err._last_checkpoint = last_ckpt
+                raise err
             if not all(done):
                 time.sleep(0.05)
         return Result(metrics=final_metrics, checkpoint=last_ckpt,

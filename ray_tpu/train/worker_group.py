@@ -59,7 +59,9 @@ class TrainWorker:
         def target():
             session._set_session(session._Session(
                 rank=self.rank, world_size=self.world_size,
-                report_queue=self._reports))
+                report_queue=self._reports,
+                restore_checkpoint_path=config.get("_checkpoint_path"),
+                storage_path=config.get("_storage_path")))
             try:
                 self._result = fn(config) if _wants_arg(fn) else fn()
             except BaseException as e:  # noqa: BLE001

@@ -101,3 +101,22 @@ def test_iter_jax_batches(ray_start_regular):
     assert len(batches) == 2
     assert isinstance(batches[0]["x"], jax.Array)
     assert float(batches[0]["x"].sum()) == sum(range(8))
+
+
+def test_data_context_controls_execution(ray_start_regular):
+    """DataContext knobs flow into plan execution (reference:
+    data/context.py DataContext.get_current())."""
+    from ray_tpu import data
+
+    ctx = data.DataContext.get_current()
+    assert ctx is data.DataContext.get_current()  # process singleton
+    old_blocks, old_inflight = ctx.default_block_count, ctx.max_in_flight_blocks
+    try:
+        ctx.default_block_count = 3
+        ds = data.from_items(list(range(30)))
+        assert ds.num_blocks() == 3
+        ctx.max_in_flight_blocks = 2
+        assert ds.map(lambda x: x + 1).sum() == sum(range(1, 31))
+    finally:
+        ctx.default_block_count = old_blocks
+        ctx.max_in_flight_blocks = old_inflight
