@@ -212,6 +212,7 @@ class Raylet:
             "ReturnPGBundle": self.handle_return_pg_bundle,
             "Drain": self.handle_drain,
             "GetState": self.handle_get_state,
+            "NodeStacks": self.handle_node_stacks,
         }
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
@@ -683,6 +684,32 @@ class Raylet:
     # ---- blocked-worker CPU release (reference: raylet marks workers
     # blocked in ray.get and frees their resources so nested tasks can
     # run — the fix for fan-out/nested-get worker starvation) ----
+
+    async def handle_node_stacks(self, conn, payload):
+        """Stack dumps from every live worker on this node (reference:
+        `ray stack` — scripts.py:2453 py-spies all workers)."""
+        skipped = []
+        live = []
+        for w in list(self.workers.values()):
+            if w.dead or w.conn is None or w.conn.closed:
+                # Usually a worker still cold-starting (interpreter spawn
+                # takes seconds when site hooks import jax).
+                skipped.append({"worker_id": w.worker_id, "dead": w.dead,
+                                "registered": w.conn is not None})
+                continue
+            live.append(w)
+
+        async def dump_one(w):
+            try:
+                return await w.conn.call("DumpStack", {}, timeout=10)
+            except Exception as e:
+                return {"worker_id": w.worker_id,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        # Concurrent: N wedged workers must cost ~one timeout, not N.
+        dumps = list(await asyncio.gather(*(dump_one(w) for w in live)))
+        return {"node_id": self.node_id, "workers": dumps,
+                "skipped": skipped}
 
     def handle_worker_blocked(self, conn, payload):
         w = self.workers.get(payload["worker_id"])

@@ -176,6 +176,7 @@ class CoreWorker:
             "CancelTask": self._handle_cancel_task,
             "Exit": self._handle_exit,
             "Ping": lambda conn, p: {"ok": True},
+            "DumpStack": self._handle_dump_stack,
         }, name=f"worker-{self.worker_id[:8]}")
         host, port = await self.server.start("127.0.0.1", 0)
         self.address = Address(host, port, self.worker_id, self.node_id)
@@ -1035,6 +1036,24 @@ class CoreWorker:
     async def _handle_exit(self, conn, payload):
         self.loop.call_soon(lambda: os._exit(0))
         return {"ok": True}
+
+    async def _handle_dump_stack(self, conn, payload):
+        """All-thread stack dump (reference: `ray stack` py-spies every
+        worker, scripts.py:2453 — here the worker reports its own frames,
+        no external profiler needed)."""
+        import sys
+
+        frames = sys._current_frames()
+        threads = {t.ident: t for t in threading.enumerate()}
+        out = []
+        for ident, frame in frames.items():
+            t = threads.get(ident)
+            name = t.name if t else f"thread-{ident}"
+            stack = "".join(traceback.format_stack(frame))
+            out.append({"thread": name, "daemon": bool(t and t.daemon),
+                        "stack": stack})
+        return {"pid": os.getpid(), "worker_id": self.worker_id,
+                "actor_id": self._actor_id, "threads": out}
 
     def execution_loop(self):
         """Main thread of a pool worker: executes tasks sequentially

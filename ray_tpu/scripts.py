@@ -104,6 +104,30 @@ def cmd_status(args):
     return 0
 
 
+def cmd_stack(args):
+    """Dump every worker's thread stacks (reference: `ray stack`)."""
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util import state
+
+    for node in state.dump_stacks():
+        print(f"=== node {node.get('node_id', '?')[:12]} ===")
+        if "error" in node:
+            print(f"  unreachable: {node['error']}")
+            continue
+        for w in node.get("workers", []):
+            hdr = (f"-- worker {w.get('worker_id', '?')[:12]} "
+                   f"pid={w.get('pid')} actor={w.get('actor_id')}")
+            print(hdr)
+            for t in w.get("threads", []):
+                print(f"  [{t['thread']}{' daemon' if t['daemon'] else ''}]")
+                for line in t["stack"].rstrip().splitlines():
+                    print(f"    {line}")
+            if "error" in w:
+                print(f"  error: {w['error']}")
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_list(args):
     ray_tpu = _connect_from_state(args)
     from ray_tpu.util import state
@@ -195,6 +219,9 @@ def main():
 
     p = sub.add_parser("stop", help="stop local daemons")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("stack", help="dump all workers' thread stacks")
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("status", help="cluster status")
     p.set_defaults(fn=cmd_status)

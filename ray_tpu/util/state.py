@@ -42,6 +42,38 @@ def list_tasks(limit: int = 1000) -> list[dict]:
     return list(latest.values())[-limit:]
 
 
+def dump_stacks() -> list[dict]:
+    """All-thread stacks of every worker on every node (reference:
+    `ray stack`, scripts.py:2453)."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+
+    import asyncio
+
+    cw = get_core_worker()
+    nodes = cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"]
+
+    async def one(n):
+        try:
+            conn = await rpc.connect(n["host"], n["raylet_port"],
+                                     name="stack-dump")
+            try:
+                return await conn.call("NodeStacks", {}, timeout=30)
+            finally:
+                await conn.close()
+        except Exception as e:
+            return {"node_id": n["node_id"],
+                    "error": f"{type(e).__name__}: {e}"}
+
+    async def collect():
+        # Concurrent per node: degraded nodes cost one timeout, not one each.
+        return list(await asyncio.gather(
+            *(one(n) for n in nodes if n.get("alive"))))
+
+    return cw._run(collect())
+
+
 def list_objects() -> list[dict]:
     """Objects owned by the calling process (cluster-wide listing requires
     per-raylet scans; see `summarize_objects`)."""

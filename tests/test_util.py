@@ -200,3 +200,33 @@ def test_worker_logs_stream_to_driver(ray_start_regular, capfd):
     line = next(l for l in out.splitlines()
                 if "log-streaming-sentinel-xyz" in l)
     assert line.startswith("(pid=")
+
+
+def test_dump_stacks_across_workers(ray_start_regular):
+    """`ray stack` analog: every live worker reports its thread frames."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self, s):
+            time.sleep(s)
+            return True
+
+    a = Sleeper.remote()
+    # Wait for the actor worker to be fully up (cold interpreter spawn can
+    # take seconds) BEFORE starting the long call we want to observe.
+    assert ray_tpu.get(a.nap.remote(0), timeout=120) is True
+    ref = a.nap.remote(3)
+    time.sleep(0.5)  # make sure the nap is on-CPU when we sample
+    nodes = state.dump_stacks()
+    assert len(nodes) >= 1
+    workers = [w for n in nodes for w in n.get("workers", [])]
+    assert workers, nodes
+    blob = "\n".join(t["stack"] for w in workers
+                     for t in w.get("threads", []))
+    assert "nap" in blob  # the sleeping actor method is visible
+    assert ray_tpu.get(ref, timeout=30) is True
+    ray_tpu.kill(a)
