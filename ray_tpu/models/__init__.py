@@ -50,6 +50,9 @@ from ray_tpu.models.ssm import (
     TINY_SSM,
     SSMConfig,
     SSMModel,
+    init_ssm_state,
+    ssm_decode_step,
+    ssm_prefill,
 )
 from ray_tpu.models.vit import (
     VIT_B16,
@@ -72,4 +75,5 @@ __all__ = [
     "mlm_loss", "EncoderDecoder", "EncDecConfig", "T5_BASE", "T5_LARGE",
     "TINY_ENCDEC", "seq2seq_loss",
     "SSMModel", "SSMConfig", "MAMBA_130M", "MAMBA_790M", "TINY_SSM",
+    "init_ssm_state", "ssm_decode_step", "ssm_prefill",
 ]

@@ -4,8 +4,10 @@ Rounds out the model zoo with the SSM architecture class. The TPU-native
 angle: the recurrence h_t = a_t * h_{t-1} + b_t is evaluated with
 `jax.lax.associative_scan` — O(log S) depth parallel prefix instead of a
 sequential loop, which is the difference between MXU/VPU-friendly and
-latency-bound on TPU. (Training/full-sequence forward only; an
-incremental cached-state decode API is future work.)
+latency-bound on TPU. Decode is O(1) per token: `init_ssm_state` /
+`ssm_decode_step` carry the per-layer SSM state (E,N) and the depthwise
+conv window (d_conv-1, E) — the SSM advantage over attention's O(S)
+KV cache.
 
 Structure follows the Mamba block shape (Gu & Dao 2023, public
 architecture): in-proj to a gated pair, short depthwise causal conv,
@@ -61,7 +63,14 @@ class SSMBlock(nn.Module):
     cfg: SSMConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, state=None, return_state: bool = False):
+        """state=None: full-sequence parallel forward -> y, or
+        (y, final_state) with return_state=True (the O(log S) prefill —
+        sequential per-token priming would be exactly the latency-bound
+        pattern the scan exists to avoid).
+        state=(conv_window, h): O(1) single-token step (S must be 1)
+        -> (y, new_state). conv_window: (B, d_conv-1, E) last pre-conv
+        activations; h: (B, E, N) f32 SSM state."""
         c = self.cfg
         B, S, _ = x.shape
         E, N = c.d_inner, c.d_state
@@ -69,14 +78,26 @@ class SSMBlock(nn.Module):
             n, use_bias=bias, dtype=c.dtype, param_dtype=c.dtype, name=name)
 
         xz = dense(2 * E, "in_proj")(x)
-        u, z = jnp.split(xz, 2, axis=-1)          # (B,S,E) each
+        u_in, z = jnp.split(xz, 2, axis=-1)       # (B,S,E) each
 
         # Short depthwise causal conv (local mixing before the SSM).
         conv_w = self.param("conv_w", nn.initializers.normal(0.02),
                             (c.d_conv, E), c.dtype)
-        u_pad = jnp.pad(u, ((0, 0), (c.d_conv - 1, 0), (0, 0)))
-        u = sum(u_pad[:, i: i + S] * conv_w[i][None, None]
-                for i in range(c.d_conv))
+        if state is None:
+            u_pad = jnp.pad(u_in, ((0, 0), (c.d_conv - 1, 0), (0, 0)))
+            # Next decode step needs the last d_conv-1 pre-conv activations.
+            window = u_pad[:, S:]
+            u = sum(u_pad[:, i: i + S] * conv_w[i][None, None]
+                    for i in range(c.d_conv))
+        else:
+            if S != 1:
+                raise ValueError(
+                    f"stateful SSM step requires S==1, got S={S}; prime a "
+                    "prompt with the parallel forward (return_state=True)")
+            conv_state, h_prev = state
+            window = jnp.concatenate([conv_state, u_in], axis=1)  # (B,d_conv,E)
+            u = sum(window[:, i: i + 1] * conv_w[i][None, None]
+                    for i in range(c.d_conv))                      # (B,1,E)
         u = jax.nn.silu(u)
 
         # Input-selective SSM parameters.
@@ -92,13 +113,22 @@ class SSMBlock(nn.Module):
         decay = jnp.exp(d32[..., None] * A[None, None])              # (B,S,E,N)
         drive = (d32 * u.astype(jnp.float32))[..., None] * \
             Bsel.astype(jnp.float32)[:, :, None, :]                  # (B,S,E,N)
-        h = _selective_scan(decay, drive)                            # (B,S,E,N)
+        if state is None:
+            h = _selective_scan(decay, drive)                        # (B,S,E,N)
+        else:
+            h_new = decay[:, 0] * h_prev + drive[:, 0]               # (B,E,N)
+            h = h_new[:, None]
         y = jnp.einsum("bsen,bsn->bse", h, Csel.astype(jnp.float32))
         D = self.param("d_skip", nn.initializers.ones, (E,), jnp.float32)
         y = (y + D[None, None] * u.astype(jnp.float32)).astype(c.dtype)
 
         y = y * jax.nn.silu(z)
-        return dense(c.d_model, "out_proj")(y)
+        out = dense(c.d_model, "out_proj")(y)
+        if state is not None:
+            return out, (window[:, 1:], h_new)
+        if return_state:
+            return out, (window, h[:, -1])
+        return out
 
 
 class SSMModel(nn.Module):
@@ -107,14 +137,55 @@ class SSMModel(nn.Module):
     cfg: SSMConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, states=None, return_states: bool = False):
+        """states=None: (B,S) -> (B,S,V) logits; with return_states=True
+        -> (logits, states) — the parallel PREFILL priming decode.
+        states=[per-layer (conv_window, h)]: (B,1) single-token decode ->
+        (logits (B,1,V), new_states). Build fresh states with
+        init_ssm_state or prime them with the prefill form."""
         c = self.cfg
         embed = nn.Embed(c.vocab_size, c.d_model, dtype=c.dtype,
                          param_dtype=c.dtype, name="tok_embed")
         x = embed(tokens)
+        new_states = []
         for i in range(c.n_layers):
             h = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32,
                            name=f"norm_{i}")(x).astype(c.dtype)
-            x = x + SSMBlock(c, name=f"block_{i}")(h)
+            block = SSMBlock(c, name=f"block_{i}")
+            if states is not None:
+                y, st = block(h, states[i])
+            elif return_states:
+                y, st = block(h, return_state=True)
+            else:
+                y, st = block(h), None
+            x = x + y
+            if st is not None:
+                new_states.append(st)
         x = nn.RMSNorm(epsilon=1e-5, dtype=jnp.float32, name="norm_f")(x)
-        return embed.attend(x.astype(c.dtype))
+        logits = embed.attend(x.astype(c.dtype))
+        if states is None and not return_states:
+            return logits
+        return logits, new_states
+
+
+def init_ssm_state(cfg: SSMConfig, batch: int):
+    """Fresh per-layer decode state: conv window + SSM state, all zeros
+    (the attention-KV-cache analog, but O(1) in sequence length)."""
+    E, N = cfg.d_inner, cfg.d_state
+    return [(jnp.zeros((batch, cfg.d_conv - 1, E), cfg.dtype),
+             jnp.zeros((batch, E, N), jnp.float32))
+            for _ in range(cfg.n_layers)]
+
+
+def ssm_prefill(model: SSMModel, params, tokens):
+    """Prime decode state from a prompt in ONE parallel forward (O(log S)
+    scan depth): tokens (B,S) -> (last_logits (B,V), states)."""
+    logits, states = model.apply(params, tokens, return_states=True)
+    return logits[:, -1], states
+
+
+def ssm_decode_step(model: SSMModel, params, token, states):
+    """One O(1) decode step: token (B,) -> (logits (B,V), new_states).
+    jit this; the state pytree has static shapes independent of position."""
+    logits, new_states = model.apply(params, token[:, None], states)
+    return logits[:, 0], new_states
