@@ -104,6 +104,25 @@ def cmd_status(args):
     return 0
 
 
+def cmd_serve(args):
+    """Declarative serve management (reference: `serve deploy/status`)."""
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu import serve
+
+    if args.serve_cmd == "deploy":
+        from ray_tpu.serve.config_deploy import deploy_config
+
+        handles = deploy_config(args.config)
+        print(json.dumps({"deployed": sorted(handles)}))
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_stack(args):
     """Dump every worker's thread stacks (reference: `ray stack`)."""
     ray_tpu = _connect_from_state(args)
@@ -237,6 +256,14 @@ def main():
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--port", type=int, default=8265)
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("serve", help="declarative serve deploy/status")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    ps = ssub.add_parser("deploy")
+    ps.add_argument("config", help="JSON config file (ServeDeploy schema)")
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("job", help="submit and manage jobs")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

@@ -81,7 +81,13 @@ def get_deployment_handle(name: str, *_a, **_k) -> DeploymentHandle:
 
 
 def status() -> dict:
-    return ray_tpu.get(_get_controller().list_deployments.remote())
+    # Read-only: must not spawn a detached controller as a side effect on
+    # clusters where serve was never started.
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace="serve")
+    except ValueError:
+        return {}
+    return ray_tpu.get(controller.list_deployments.remote())
 
 
 def delete(name: str) -> None:
@@ -229,9 +235,17 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> int:
     return _proxy_server.server_address[1]
 
 
+def deploy_config(config):
+    """Declarative multi-application deploy (reference: serve REST config /
+    `serve deploy`); see serve/config_deploy.py for the schema."""
+    from ray_tpu.serve.config_deploy import deploy_config as _impl
+
+    return _impl(config)
+
+
 __all__ = [
     "deployment", "run", "get_deployment_handle", "status", "delete",
-    "shutdown", "batch", "start_http_proxy", "Deployment",
+    "shutdown", "batch", "start_http_proxy", "deploy_config", "Deployment",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]

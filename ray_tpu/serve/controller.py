@@ -104,6 +104,17 @@ class ServeController:
             if autoscaling else num_replicas
         d["version"] += 1
         self._reconcile(name)
+        # Redeploy with a changed user_config must reach the replicas that
+        # already exist — reconcile only fixes the count (reference:
+        # deployment_state reconfigures live replicas on config-only
+        # updates instead of restarting them).
+        if user_config_blob is not None:
+            user_config = serialization.loads_func(user_config_blob)
+            for r in list(d["replicas"]):
+                try:
+                    r.reconfigure.remote(user_config)
+                except Exception:
+                    pass
         return True
 
     def _make_replica(self, d):

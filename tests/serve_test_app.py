@@ -1,0 +1,23 @@
+"""Bound deployment graph imported by declarative-deploy tests
+(tests/test_serve_config.py) via import_path."""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x):
+        return x * 2
+
+
+@serve.deployment
+class Pipeline:
+    def __init__(self, doubler):
+        self.doubler = doubler
+
+    def __call__(self, payload):
+        v = payload["v"] if isinstance(payload, dict) else payload
+        return self.doubler.remote(v).result(timeout=30) + 1
+
+
+app = Pipeline.bind(Doubler.bind())

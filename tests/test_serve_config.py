@@ -1,0 +1,72 @@
+"""Declarative serve config deploy (reference: serve/schema.py +
+`serve deploy` REST/CLI path)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture()
+def serve_cleanup(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def test_deploy_config_with_overrides(serve_cleanup):
+    handles = serve.deploy_config({
+        "applications": [{
+            "name": "calc",
+            "import_path": "tests.serve_test_app:app",
+            "route_prefix": "/calc",
+            "deployments": [{"name": "Doubler", "num_replicas": 2}],
+        }],
+    })
+    assert set(handles) == {"calc"}
+    assert handles["calc"].remote({"v": 20}).result(timeout=60) == 41
+    status = serve.status()
+    assert status["Doubler"]["target"] == 2
+    assert "Pipeline" in status
+
+
+def test_deploy_config_bad_import(serve_cleanup):
+    with pytest.raises((ImportError, AttributeError, ModuleNotFoundError)):
+        serve.deploy_config({"applications": [
+            {"import_path": "tests.serve_test_app:nope"}]})
+
+
+def test_deploy_config_validation_and_prune(serve_cleanup):
+    base = {"applications": [{
+        "name": "calc", "import_path": "tests.serve_test_app:app"}]}
+    # Typo'd deployment name errors instead of silently deploying defaults.
+    bad = {"applications": [{
+        "import_path": "tests.serve_test_app:app",
+        "deployments": [{"name": "doubler", "num_replicas": 8}]}]}
+    with pytest.raises(ValueError, match="unknown deployment"):
+        serve.deploy_config(bad)
+    # Unknown key errors too.
+    bad2 = {"applications": [{
+        "import_path": "tests.serve_test_app:app",
+        "deployments": [{"name": "Doubler", "replicas": 8}]}]}
+    with pytest.raises(ValueError, match="unknown config keys"):
+        serve.deploy_config(bad2)
+    # Goal-state semantics: a stray deployment vanishes on re-deploy.
+    serve.deploy_config(base)
+
+    @serve.deployment
+    def stray(p):
+        return p
+
+    serve.run(stray.bind())
+    assert "stray" in serve.status()
+    serve.deploy_config(base)
+    assert "stray" not in serve.status()
+    assert "Doubler" in serve.status()
+
+
+def test_status_does_not_spawn_controller(ray_start_regular):
+    assert serve.status() == {}
+    import ray_tpu
+
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
