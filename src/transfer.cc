@@ -149,16 +149,22 @@ void serve_conn(Server* srv, int fd) {
     std::lock_guard<std::mutex> g(srv->conns_mu);
     srv->conn_fds.erase(fd);
     srv->live_conns--;
+    // Notify UNDER the lock: the destructor may destroy this cv the
+    // moment its predicate holds, and an unlocked broadcast could still
+    // be touching it (TSan-verified ordering).
+    srv->conns_cv.notify_all();
   }
-  srv->conns_cv.notify_all();
   ::close(fd);
 }
 
 // Fetch-side attach cache: one mapping per store path per process.
+// Heap-allocated and never destroyed: a static map's exit-time destructor
+// would free the nodes while orphaning the Store/PeerConn objects they
+// point to (LeakSanitizer flags exactly that).
 std::mutex g_attach_mu;
 std::map<std::string, void*>& attach_cache() {
-  static std::map<std::string, void*> m;
-  return m;
+  static auto* m = new std::map<std::string, void*>();
+  return *m;
 }
 
 void* attached_store(const char* path) {
@@ -274,8 +280,8 @@ struct PeerConn {
 };
 std::mutex g_peers_mu;
 std::map<std::string, PeerConn*>& peer_conns() {
-  static std::map<std::string, PeerConn*> m;
-  return m;
+  static auto* m = new std::map<std::string, PeerConn*>();  // see attach_cache
+  return *m;
 }
 
 int fetch_once(void* store, int fd, const uint8_t* id) {
