@@ -95,19 +95,24 @@ struct Server {
   std::set<int> conn_fds;
   int live_conns = 0;
 
-  ~Server() {
+  // Returns true when every connection thread has exited — only then is
+  // it safe to free this object (a timed-out wait means wedged detached
+  // threads still hold pointers into it; the caller must LEAK it).
+  bool shutdown_and_drain() {
     stop.store(true);
     if (listen_fd >= 0) {
       ::shutdown(listen_fd, SHUT_RDWR);
       ::close(listen_fd);
+      listen_fd = -1;
     }
     if (accept_thread.joinable()) accept_thread.join();
-    {
-      std::unique_lock<std::mutex> g(conns_mu);
-      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
-      conns_cv.wait_for(g, std::chrono::seconds(5),
-                        [this] { return live_conns == 0; });
-    }
+    std::unique_lock<std::mutex> g(conns_mu);
+    for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    return conns_cv.wait_for(g, std::chrono::seconds(5),
+                             [this] { return live_conns == 0; });
+  }
+
+  ~Server() {
     if (store) store_detach(store);
   }
 };
@@ -267,7 +272,12 @@ void* transfer_server_start(const char* store_path, int* out_port) {
 }
 
 void transfer_server_stop(void* h) {
-  delete reinterpret_cast<Server*>(h);
+  Server* srv = reinterpret_cast<Server*>(h);
+  if (srv->shutdown_and_drain()) {
+    delete srv;
+  }
+  // else: a wedged connection thread still references srv — leaking one
+  // Server beats a use-after-free in its lock/cv/store.
 }
 
 // Fetch-side connection cache: one persistent connection per peer (the

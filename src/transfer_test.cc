@@ -67,13 +67,14 @@ struct FetchJob {
   int port;
   int n;
   int rc;
+  const char* host = "127.0.0.1";
 };
 
 static void* fetch_thread(void* arg) {
   FetchJob* j = (FetchJob*)arg;
   uint8_t id[20];
   make_id(id, j->n);
-  j->rc = transfer_fetch(j->dst, "127.0.0.1", j->port, id);
+  j->rc = transfer_fetch(j->dst, j->host, j->port, id);
   return nullptr;
 }
 
@@ -106,12 +107,15 @@ int main() {
   assert(transfer_fetch(kDst, "127.0.0.1", port, id) == 0);
   printf("not-found ok\n");
 
-  // Concurrent fetches of distinct objects (sanitizers watch the server's
-  // detached per-connection threads + the shared peer-connection cache).
+  // Concurrent fetches of distinct objects. The fetch side caches ONE
+  // connection per host:port key, so alternating "127.0.0.1"/"localhost"
+  // forces two genuinely parallel server-side connection threads — the
+  // conn_fds/live_conns bookkeeping the sanitizer builds must watch.
   pthread_t threads[4];
   FetchJob jobs[4];
   for (int i = 0; i < 4; i++) {
     jobs[i] = {kDst, port, 3 + i, -100};
+    jobs[i].host = (i % 2) ? "localhost" : "127.0.0.1";
     pthread_create(&threads[i], nullptr, fetch_thread, &jobs[i]);
   }
   for (int i = 0; i < 4; i++) pthread_join(threads[i], nullptr);
