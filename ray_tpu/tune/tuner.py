@@ -296,12 +296,42 @@ class _TuneController:
 
     def _start_trial(self, trial: Trial, restore_from: Checkpoint | None = None):
         res = trial.resources or self.resources
-        opts = {"num_cpus": res.get("CPU", 1),
-                "resources": {k: v for k, v in res.items() if k != "CPU"}}
+        if isinstance(res, (list, tuple)):
+            # PlacementGroupFactory-style bundles (reference:
+            # tune/execution/placement_groups.py — a PG per trial): the
+            # trial actor takes bundle 0; the rest stay reserved for the
+            # trainable's own sub-workers via config["_trial_pg"].
+            from ray_tpu.util.placement_group import (placement_group,
+                                                      remove_placement_group)
+
+            trial.pg = placement_group([dict(b) for b in res],
+                                       strategy="PACK")
+            try:
+                ray_tpu.get(trial.pg.ready(), timeout=120)
+            except Exception:
+                # Unschedulable (cluster too small / oversubscribed by
+                # concurrent trials): release the reservation — a leaked
+                # PG would starve every later trial.
+                remove_placement_group(trial.pg)
+                trial.pg = None
+                raise
+            b0 = res[0]
+            opts = {"num_cpus": b0.get("CPU", 0),
+                    "resources": {k: v for k, v in b0.items() if k != "CPU"},
+                    "placement_group": trial.pg,
+                    "placement_group_bundle_index": 0}
+        else:
+            trial.pg = None
+            opts = {"num_cpus": res.get("CPU", 1),
+                    "resources": {k: v for k, v in res.items() if k != "CPU"}}
         trial.actor = TrainWorker.options(**opts).remote(0, 1, {})
         cfg = dict(trial.config)
         if restore_from is not None:
             cfg["_checkpoint_path"] = restore_from.path
+        if trial.pg is not None:
+            # The trainable places its own sub-workers into the reserved
+            # bundles (reference: trials run inside their PG by default).
+            cfg["_trial_pg"] = trial.pg
         ray_tpu.get(trial.actor.run.remote(self.trainable_blob, cfg))
         trial.status = "RUNNING"
 
@@ -313,6 +343,14 @@ class _TuneController:
             except Exception:
                 pass
             trial.actor = None
+        if getattr(trial, "pg", None) is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
 
     def run(self):
         # Restored TERMINATED/ERROR trials keep their results; only
@@ -332,8 +370,17 @@ class _TuneController:
                         break
                 else:
                     break
-                # A restored trial resumes from its last checkpoint.
-                self._start_trial(t, restore_from=t.checkpoint)
+                # A restored trial resumes from its last checkpoint. A
+                # start failure (unschedulable PG, worker spawn) fails
+                # THAT trial; it must not abort the experiment and lose
+                # every other trial's results.
+                try:
+                    self._start_trial(t, restore_from=t.checkpoint)
+                except Exception as e:  # noqa: BLE001
+                    t.error = f"trial start failed: {type(e).__name__}: {e}"
+                    self._stop_trial(t, "ERROR")
+                    self._notify_searcher(t)
+                    continue
                 running.append(t)
             if not running and not pending:
                 break

@@ -103,9 +103,10 @@ struct Server {
     if (listen_fd >= 0) {
       ::shutdown(listen_fd, SHUT_RDWR);
       ::close(listen_fd);
-      listen_fd = -1;
     }
     if (accept_thread.joinable()) accept_thread.join();
+    // Only after the join: the accept loop reads listen_fd concurrently.
+    listen_fd = -1;
     std::unique_lock<std::mutex> g(conns_mu);
     for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
     return conns_cv.wait_for(g, std::chrono::seconds(5),
@@ -113,6 +114,7 @@ struct Server {
   }
 
   ~Server() {
+    if (listen_fd >= 0) ::close(listen_fd);  // failed-start path
     if (store) store_detach(store);
   }
 };

@@ -108,14 +108,15 @@ int main() {
   printf("not-found ok\n");
 
   // Concurrent fetches of distinct objects. The fetch side caches ONE
-  // connection per host:port key, so alternating "127.0.0.1"/"localhost"
+  // connection per host:port key, so alternating loopback addresses
+  // (Linux routes all of 127.0.0.0/8 to lo; no /etc/hosts dependency)
   // forces two genuinely parallel server-side connection threads — the
   // conn_fds/live_conns bookkeeping the sanitizer builds must watch.
   pthread_t threads[4];
   FetchJob jobs[4];
   for (int i = 0; i < 4; i++) {
     jobs[i] = {kDst, port, 3 + i, -100};
-    jobs[i].host = (i % 2) ? "localhost" : "127.0.0.1";
+    jobs[i].host = (i % 2) ? "127.0.0.2" : "127.0.0.1";
     pthread_create(&threads[i], nullptr, fetch_thread, &jobs[i]);
   }
   for (int i = 0; i < 4; i++) pthread_join(threads[i], nullptr);

@@ -110,3 +110,40 @@ def test_resource_changing_scheduler(ray_start_regular):
     assert not results.errors
     for r in results:
         assert r.metrics["score"] >= 0.0
+
+
+def test_pg_per_trial_bundles(ray_start_regular):
+    """A list of bundles as resources_per_trial reserves a placement
+    group per trial (reference: tune PlacementGroupFactory); the trial
+    actor runs in bundle 0 and the trainable receives the PG to place
+    sub-workers into the rest."""
+
+    def trainable(config):
+        from ray_tpu.train import session
+
+        pg = config["_trial_pg"]
+        assert len(pg.bundle_specs) == 2
+
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1, placement_group=pg,
+                        placement_group_bundle_index=1)
+        def sub():
+            return 7
+
+        session.report({"sub": ray_tpu.get(sub.remote(), timeout=60)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="sub", mode="max",
+                                    max_concurrent_trials=1),
+        resources_per_trial=[{"CPU": 1}, {"CPU": 1}])
+    results = tuner.fit()
+    assert len(results) == 2 and not results.errors
+    assert all(r.metrics["sub"] == 7 for r in results)
+    # PGs are removed with their trials.
+    from ray_tpu.util.state import list_placement_groups
+
+    assert all(p.get("state") == "REMOVED"
+               for p in list_placement_groups()) or not list_placement_groups()
