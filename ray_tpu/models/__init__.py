@@ -48,6 +48,7 @@ from ray_tpu.models.ssm import (
     MAMBA_130M,
     MAMBA_790M,
     TINY_SSM,
+    SSM_RULES,
     SSMConfig,
     SSMModel,
     init_ssm_state,
@@ -75,5 +76,5 @@ __all__ = [
     "mlm_loss", "EncoderDecoder", "EncDecConfig", "T5_BASE", "T5_LARGE",
     "TINY_ENCDEC", "seq2seq_loss",
     "SSMModel", "SSMConfig", "MAMBA_130M", "MAMBA_790M", "TINY_SSM",
-    "init_ssm_state", "ssm_decode_step", "ssm_prefill",
+    "SSM_RULES", "init_ssm_state", "ssm_decode_step", "ssm_prefill",
 ]

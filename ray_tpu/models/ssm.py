@@ -24,6 +24,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.parallel.sharding import P, ShardingRules
+
 
 @dataclasses.dataclass(frozen=True)
 class SSMConfig:
@@ -166,6 +168,22 @@ class SSMModel(nn.Module):
         if states is None and not return_states:
             return logits
         return logits, new_states
+
+
+# Mesh sharding rules (same idiom as TRANSFORMER_RULES/MOE_RULES): TP
+# shards the inner channel dim E, FSDP the other matrix dim; the tiny
+# d_state axis stays replicated.
+SSM_RULES = ShardingRules([
+    (r"tok_embed/embedding", P("tp", "fsdp")),
+    (r"in_proj/kernel", P("fsdp", "tp")),
+    (r"out_proj/kernel", P("tp", "fsdp")),
+    (r"dt_proj/kernel", P("fsdp", "tp")),
+    (r"(b_proj|c_proj)/kernel", P("fsdp", None)),
+    (r"conv_w", P(None, "tp")),
+    (r"a_log", P("tp", None)),
+    (r"d_skip", P("tp")),
+    (r"(norm|scale|bias)", P()),
+], default=P())
 
 
 def init_ssm_state(cfg: SSMConfig, batch: int):
