@@ -38,6 +38,10 @@ _SENTINEL = object()
 class _Slot:
     request: "RequestHandle | None" = None
     generated: int = 0
+    # Chunked prefill in progress: the full prompt and how much of it has
+    # been written into this slot's KV cache so far. None = decoding.
+    prefill_prompt: "object" = None
+    prefill_pos: int = 0
 
 
 class RequestHandle:
@@ -68,7 +72,7 @@ class LLMEngine:
 
     def __init__(self, cfg: LlamaConfig, params, *, max_batch: int = 4,
                  max_len: int = 1024, decode_chunk: int = 8,
-                 rng_seed: int = 0):
+                 prefill_chunk: int = 0, rng_seed: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -81,6 +85,10 @@ class LLMEngine:
         # dramatically so through a tunneled device). Admission waits at
         # most one chunk; tokens stream with chunk granularity.
         self.decode_chunk = max(1, decode_chunk)
+        # >0: prompts longer than this prefill in chunks INTERLEAVED with
+        # decode ticks, so one long prompt cannot stall every in-flight
+        # stream for its whole prefill. 0: whole-prompt bucketed prefill.
+        self.prefill_chunk = prefill_chunk
         self.model = LlamaModel(cfg)
         self._jax, self._jnp = jax, jnp
         self._rng = jax.random.PRNGKey(rng_seed)
@@ -103,6 +111,31 @@ class LLMEngine:
             logits, new = model.apply(params, tokens, positions,
                                       kv_caches=caches1)
             return logits[0], [(k[0], v[0]) for k, v, _l in new]
+
+        import functools
+
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def prefill_chunk(params, tokens, start, kv_full, slot):
+            # One CHUNK of a long prompt: tokens (1, chunk) at absolute
+            # positions start..start+chunk, KV written at the same offset
+            # of slot `slot`'s cache. Gather/scatter of the slot row stays
+            # INSIDE the jit with the full cache donated, so a chunk costs
+            # one row update, not a full multi-slot cache copy per tick.
+            C = tokens.shape[1]
+            positions = start + jnp.arange(C)[None, :]
+            caches1 = [
+                (jax.lax.dynamic_slice_in_dim(k, slot, 1, axis=0),
+                 jax.lax.dynamic_slice_in_dim(v, slot, 1, axis=0), start)
+                for k, v in kv_full]
+            logits, new = model.apply(params, tokens, positions,
+                                      kv_caches=caches1)
+            out_kv = [
+                (jax.lax.dynamic_update_slice_in_dim(kf, kn, slot, axis=0),
+                 jax.lax.dynamic_update_slice_in_dim(vf, vn, slot, axis=0))
+                for (kf, vf), (kn, vn, _l) in zip(kv_full, new)]
+            return logits[0], out_kv
+
+        self._prefill_chunk = prefill_chunk
 
         def _decode_one(params, token, pos, kv, lens):
             # One sequence: token (), pos (), kv list of ((Hkv,L,D) k, v),
@@ -169,6 +202,7 @@ class LLMEngine:
         self._topks = np.zeros(max_batch, np.int32)
         self._topps = np.ones(max_batch, np.float32)
         self._slots = [_Slot() for _ in range(max_batch)]
+        self._prefill_rr = 0  # round-robin cursor over prefilling slots
         self._pending: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -208,6 +242,7 @@ class LLMEngine:
                 st.request.error = err
                 st.request._q.put(_SENTINEL)
                 st.request = None
+            st.prefill_prompt = None
         while True:
             try:
                 _prompt, handle = self._pending.get_nowait()
@@ -233,6 +268,30 @@ class LLMEngine:
     def _admit(self, prompt: np.ndarray, handle: RequestHandle):
         jnp = self._jnp
         slot = next(i for i, s in enumerate(self._slots) if s.request is None)
+        # Chunked only when the chunk GRID fits the cache: the final
+        # chunk's write window [start, start+C) must not run past max_len,
+        # where dynamic_update_slice clamping would silently relocate it
+        # over already-prefilled KV. Otherwise the bucketed whole-prompt
+        # path (whose write window is exactly the bucket) handles it.
+        C = self.prefill_chunk
+        grid_fits = C and -(-len(prompt) // C) * C <= self.max_len
+        if C and len(prompt) > C and grid_fits:
+            # Chunked path: bookkeeping only; the loop advances one chunk
+            # per tick. Point the slot's decode-write offset at the last
+            # cache index so the shared decode program's garbage writes
+            # for this still-prefilling slot cannot land inside the
+            # region being prefilled (that index is overwritten before
+            # any legitimate attention reaches it).
+            st = self._slots[slot]
+            st.request = handle
+            st.generated = 0
+            st.prefill_prompt = prompt
+            st.prefill_pos = 0
+            self._lens[slot] = self.max_len - 1
+            self._temps[slot] = handle.sampling.temperature
+            self._topks[slot] = handle.sampling.top_k
+            self._topps[slot] = handle.sampling.top_p
+            return
         bucket = self._bucket(len(prompt))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : len(prompt)] = prompt
@@ -257,6 +316,37 @@ class LLMEngine:
         st = self._slots[slot]
         st.request = handle
         st.generated = 0
+        st.prefill_prompt = None
+        self._emit(slot, tok)
+
+    def _advance_prefill(self, slot: int):
+        """Write ONE chunk of a long prompt into the slot's cache; on the
+        final chunk, sample the first token and switch to decoding."""
+        jnp = self._jnp
+        st = self._slots[slot]
+        prompt = st.prefill_prompt
+        C = self.prefill_chunk
+        start = st.prefill_pos
+        chunk = np.zeros((1, C), np.int32)
+        n = min(C, len(prompt) - start)
+        chunk[0, :n] = prompt[start: start + n]
+        logits, kv_out = self._prefill_chunk(
+            self.params, jnp.asarray(chunk), jnp.int32(start), self._kv,
+            jnp.int32(slot))
+        self._kv = [(k, v) for k, v in kv_out]
+        st.prefill_pos = start + n
+        if st.prefill_pos < len(prompt):
+            return
+        # Prompt complete: first token from the last REAL position's logits.
+        self._rng, srng = self._jax.random.split(self._rng)
+        sp = st.request.sampling
+        tok = int(np.asarray(self._sample(
+            logits[n - 1][None], np.float32([sp.temperature]),
+            np.int32([sp.top_k]), np.float32([sp.top_p]), srng))[0])
+        self._lens[slot] = len(prompt)
+        self._pos[slot] = len(prompt)
+        self._token[slot] = tok
+        st.prefill_prompt = None
         self._emit(slot, tok)
 
     def _emit(self, slot: int, tok: int):
@@ -287,6 +377,27 @@ class LLMEngine:
                     handle._q.put(_SENTINEL)
             if self.num_active() == 0:
                 continue
+            # Advance ONE chunk of ONE prefilling slot per tick — long
+            # prompts interleave with decoding instead of stalling it.
+            prefilling = [i for i, s in enumerate(self._slots)
+                          if s.request is not None
+                          and s.prefill_prompt is not None]
+            if prefilling:
+                idx = prefilling[self._prefill_rr % len(prefilling)]
+                self._prefill_rr += 1
+                try:
+                    self._advance_prefill(idx)
+                except Exception as e:
+                    st = self._slots[idx]
+                    if st.request is not None:
+                        st.request.error = e
+                        st.request._q.put(_SENTINEL)
+                        st.request = None
+                        st.prefill_prompt = None
+            decoding = any(s.request is not None and s.prefill_prompt is None
+                           for s in self._slots)
+            if not decoding:
+                continue
             # One decode CHUNK for every slot (inactive slots compute
             # garbage on their stale state — discarded host-side; slots
             # finishing mid-chunk have their overshoot discarded too).
@@ -308,7 +419,7 @@ class LLMEngine:
                 continue
             self._kv = [(k, v) for k, v in kv_out]
             for i, st in enumerate(self._slots):
-                if st.request is None:
+                if st.request is None or st.prefill_prompt is not None:
                     continue
                 for kstep in range(toks.shape[0]):
                     tok = int(toks[kstep, i])
@@ -342,9 +453,11 @@ class LLMServer:
     """
 
     def __init__(self, cfg: LlamaConfig, params, *, max_batch: int = 4,
-                 max_len: int = 1024):
+                 max_len: int = 1024, decode_chunk: int = 8,
+                 prefill_chunk: int = 0):
         self.engine = LLMEngine(cfg, params, max_batch=max_batch,
-                                max_len=max_len)
+                                max_len=max_len, decode_chunk=decode_chunk,
+                                prefill_chunk=prefill_chunk)
 
     def __call__(self, payload: dict):
         sp = SamplingParams(

@@ -112,3 +112,38 @@ def test_llm_server_streams_through_serve(tiny_model, ray_start_regular):
         assert toks == expected
     finally:
         serve.shutdown()
+
+
+def test_chunked_prefill_matches_generator(tiny_model):
+    """Long prompts prefilled in chunks interleaved with decoding still
+    produce exactly the reference greedy output, and a short in-flight
+    request keeps decoding while the long prompt prefills."""
+    cfg, params = tiny_model
+    eng = LLMEngine(cfg, params, max_batch=2, max_len=96, decode_chunk=4,
+                    prefill_chunk=8)
+    try:
+        long_prompt = [(i * 7 + 3) % 120 for i in range(27)]  # 4 chunks
+        short_prompt = [5, 6]
+        h_short = eng.submit(short_prompt, SamplingParams(max_new_tokens=20))
+        h_long = eng.submit(long_prompt, SamplingParams(max_new_tokens=10))
+        out_short = h_short.tokens()
+        out_long = h_long.tokens()
+        assert out_long == _reference_greedy(cfg, params, long_prompt, 10)
+        assert out_short == _reference_greedy(cfg, params, short_prompt, 20)
+    finally:
+        eng.shutdown()
+
+
+def test_chunked_prefill_grid_overrun_falls_back(tiny_model):
+    """A chunk grid that would overrun max_len (clamped writes would
+    corrupt prefilled KV) falls back to whole-prompt prefill — output
+    still matches the reference exactly."""
+    cfg, params = tiny_model
+    eng = LLMEngine(cfg, params, max_batch=1, max_len=96, decode_chunk=4,
+                    prefill_chunk=50)  # ceil(60/50)*50 = 100 > 96
+    try:
+        prompt = [(i * 11 + 2) % 120 for i in range(60)]
+        got = eng.generate(prompt, SamplingParams(max_new_tokens=8))
+        assert got == _reference_greedy(cfg, params, prompt, 8)
+    finally:
+        eng.shutdown()
