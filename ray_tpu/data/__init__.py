@@ -160,6 +160,37 @@ def read_binary_files(paths: str | list, *, include_paths: bool = False,
     return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
+def read_images(paths: str | list, *, include_paths: bool = False,
+                mode: str | None = None, size: tuple | None = None,
+                override_num_blocks: int | None = None) -> Dataset:
+    """One row per image file with an ndarray "image" column (reference:
+    data/read_api.py read_images, incl. its (height, width) `size`
+    convention). mode: PIL convert target (e.g. "RGB"); a fixed size makes
+    the column batch into one dense array, the shape TPU input pipelines
+    want."""
+    try:
+        import PIL  # noqa: F401
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("read_images requires pillow") from e
+
+    def read_one(p, include_paths=include_paths, mode=mode, size=size):
+        import numpy as _np
+        from PIL import Image
+
+        with Image.open(p) as f:
+            img = f.convert(mode) if mode else f
+            if size:
+                # size is (height, width); PIL resize takes (width, height).
+                img = img.resize((size[1], size[0]))
+            arr = _np.asarray(img)
+        row = {"image": arr}
+        if include_paths:
+            row["path"] = p
+        return [row]
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
+
+
 def _expand(paths: str | list) -> list:
     if isinstance(paths, str):
         paths = [paths]
@@ -175,7 +206,7 @@ __all__ = [
     "Dataset", "DataIterator", "GroupedData", "from_items", "range",
     "range_tensor", "from_numpy", "from_pandas", "from_arrow", "read_text",
     "read_json", "read_csv", "read_numpy", "read_parquet",
-    "read_binary_files",
+    "read_binary_files", "read_images",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

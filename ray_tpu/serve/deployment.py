@@ -293,6 +293,26 @@ class DeploymentResponse:
         self._error = err
         self._event.set()
 
+    def __await__(self):
+        """Async callers `await handle.remote(...)` directly (reference:
+        DeploymentResponse is awaitable in async contexts, with no
+        implicit deadline). The wait is poll-based — no executor thread is
+        parked per pending response, so wide async fan-outs aren't capped
+        by the thread pool."""
+        import asyncio
+
+        async def waiter():
+            while True:
+                if self._event.is_set():
+                    if self._ref is None:
+                        return self.result(timeout=None)
+                    ready, _ = ray_tpu.wait([self._ref], timeout=0)
+                    if ready:
+                        return self.result(timeout=None)
+                await asyncio.sleep(0.005)
+
+        return waiter().__await__()
+
     def result(self, timeout: float | None = 60.0):
         start = time.monotonic()
 

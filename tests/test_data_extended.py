@@ -200,3 +200,21 @@ def test_iter_torch_batches(ray_start_regular):
                                                 "label": torch.long})))
     assert b["x"].dtype == torch.float16
     assert b["label"].dtype == torch.long
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    import numpy as np
+    from PIL import Image
+
+    from ray_tpu import data
+
+    for i, shape in enumerate([(8, 6), (10, 10)]):
+        arr = np.full((*shape, 3), i * 40, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    # size is (height, width), matching the reference convention.
+    ds = data.read_images(str(tmp_path / "*.png"), mode="RGB", size=(4, 6),
+                          include_paths=True)
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert len(rows) == 2
+    assert all(r["image"].shape == (4, 6, 3) for r in rows)
+    assert rows[1]["image"].max() == 40

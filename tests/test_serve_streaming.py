@@ -95,3 +95,21 @@ def test_data_stats_and_new_readers(ray_start_regular, tmp_path):
         return
     t = pa.table({"x": [1, 2, 3]})
     assert data.from_arrow(t).count() == 3
+
+
+def test_deployment_response_awaitable(serve_cluster):
+    """`await handle.remote(...)` works in async handlers (the reference's
+    async DeploymentHandle surface)."""
+    import asyncio
+
+    @serve.deployment
+    def triple(p):
+        return p * 3
+
+    serve.run(triple.bind())
+    handle = serve.get_deployment_handle("triple")
+
+    async def drive():
+        return await handle.remote(14)
+
+    assert asyncio.run(drive()) == 42
