@@ -99,6 +99,12 @@ def shutdown() -> None:
     if _proxy_server is not None:
         _proxy_server.shutdown()
         _proxy_server = None
+    for actor, _host, _port in _node_proxies.values():
+        try:
+            ray_tpu.kill(actor)
+        except Exception:
+            pass
+    _node_proxies.clear()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME, namespace="serve")
         ray_tpu.get(controller.shutdown.remote())
@@ -224,6 +230,72 @@ class _ProxyHandler(BaseHTTPRequestHandler):
     do_GET = do_POST
 
 
+@ray_tpu.remote(num_cpus=0)
+class _ProxyActor:
+    """Runs the HTTP ingress inside a worker on a specific node
+    (reference: serve runs a proxy on every node; handles inside the
+    actor route to replicas cluster-wide)."""
+
+    def __init__(self, port: int):
+        from ray_tpu import serve as _serve
+
+        self.port = _serve.start_http_proxy(host="0.0.0.0", port=port)
+
+    def address(self) -> int:
+        return self.port
+
+    def healthy(self) -> bool:
+        return True
+
+
+_node_proxies: dict = {}  # node_id -> (actor, host, port)
+
+
+def start_proxies(port: int = 0) -> dict:
+    """One HTTP proxy per alive node (reference: proxies on every node,
+    serve/_private/proxy.py + proxy_state). Idempotent reconcile: calling
+    again keeps healthy proxies, replaces dead ones, and covers nodes
+    added since. Returns {node_id: (host, port)}. port=0 picks an
+    ephemeral port per node — required when several raylets share a host
+    (fake multi-node)."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    out = {}
+    pending = {}
+    for n in ray_tpu.nodes():
+        if not n.get("alive"):
+            continue
+        nid = n["node_id"]
+        existing = _node_proxies.get(nid)
+        if existing is not None:
+            actor, host, known_port = existing
+            try:
+                if ray_tpu.get(actor.healthy.remote(), timeout=15):
+                    out[nid] = (host, known_port)
+                    continue
+            except Exception:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+                _node_proxies.pop(nid, None)
+        actor = _ProxyActor.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=nid)).remote(port)
+        # Tracked BEFORE any blocking wait: even if the address fetch
+        # below fails, shutdown() can still kill this actor.
+        _node_proxies[nid] = (actor, n["host"], None)
+        pending[nid] = (actor, n["host"])
+    # Addresses collected after ALL spawns: N nodes cost one worker
+    # startup of wall clock, not N.
+    for nid, (actor, host) in pending.items():
+        p = ray_tpu.get(actor.address.remote(), timeout=120)
+        _node_proxies[nid] = (actor, host, p)
+        out[nid] = (host, p)
+    return out
+
+
 def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> int:
     """HTTP ingress (parity: serve/_private/proxy.py uvicorn proxies;
     stdlib threading server this round). POST /<deployment> with a JSON
@@ -245,7 +317,8 @@ def deploy_config(config):
 
 __all__ = [
     "deployment", "run", "get_deployment_handle", "status", "delete",
-    "shutdown", "batch", "start_http_proxy", "deploy_config", "Deployment",
+    "shutdown", "batch", "start_http_proxy", "start_proxies",
+    "deploy_config", "Deployment",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]

@@ -70,3 +70,49 @@ def test_status_does_not_spawn_controller(ray_start_regular):
 
     with pytest.raises(ValueError):
         ray_tpu.get_actor("SERVE_CONTROLLER", namespace="serve")
+
+
+def test_proxies_on_every_node(ray_start_cluster):
+    """serve.start_proxies runs an HTTP ingress on each node (reference:
+    proxies on every node); requests through either reach replicas."""
+    import json
+    import urllib.request
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        @serve.deployment
+        def echo(p):
+            return {"v": p["v"] * 2}
+
+        serve.run(echo.bind())
+        proxies = serve.start_proxies(port=0)
+        assert len(proxies) == 2
+        for node_id, (host, port) in proxies.items():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/echo",
+                data=json.dumps({"v": 21}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = json.loads(r.read())
+            assert body["result"]["v"] == 42, (node_id, body)
+    finally:
+        serve.shutdown()
+
+
+def test_start_proxies_idempotent(ray_start_regular):
+    """Re-invoking start_proxies keeps the existing healthy proxy rather
+    than stacking a duplicate."""
+    try:
+        @serve.deployment
+        def noop(p):
+            return p
+
+        serve.run(noop.bind())
+        first = serve.start_proxies(port=0)
+        second = serve.start_proxies(port=0)
+        assert first == second  # same actor, same port
+    finally:
+        serve.shutdown()
