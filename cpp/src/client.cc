@@ -10,10 +10,12 @@
 #include <arpa/inet.h>
 #include <netdb.h>
 #include <netinet/tcp.h>
+#include <fcntl.h>
 #include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstring>
 #include <mutex>
 #include <sstream>
@@ -336,10 +338,44 @@ struct Client::Impl {
     if (rc != 0)
       throw RayError("resolve " + host + ": " + gai_strerror(rc));
     RayError last("connect failed");
+    // One deadline for the WHOLE call (not per addrinfo entry), and
+    // EINTR retries the poll with the remaining budget.
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(
+                        timeout_s > 0 ? static_cast<long>(timeout_s * 1000)
+                                      : 3600 * 1000L);
     for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
-      fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      // Non-blocking connect + poll so timeout_s is honored even for a
+      // black-holed host (a blocking ::connect would hang for the OS
+      // default of minutes).
+      fd = ::socket(ai->ai_family, ai->ai_socktype | SOCK_NONBLOCK,
+                    ai->ai_protocol);
       if (fd < 0) continue;
-      if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      int rc2 = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+      if (rc2 != 0 && errno == EINPROGRESS) {
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        int pr = -1;
+        for (;;) {
+          auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                          deadline - std::chrono::steady_clock::now())
+                          .count();
+          if (left <= 0) { pr = 0; break; }  // deadline passed: timeout
+          struct pollfd pfd{fd, POLLOUT, 0};
+          pr = ::poll(&pfd, 1, static_cast<int>(left));
+          if (pr >= 0 || errno != EINTR) break;
+        }
+        if (pr == 1 &&
+            ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) == 0 &&
+            err == 0) {
+          rc2 = 0;
+        } else {
+          errno = err != 0 ? err : ETIMEDOUT;
+        }
+      }
+      if (rc2 == 0) {
+        int flags = ::fcntl(fd, F_GETFL, 0);
+        ::fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
         ::freeaddrinfo(res);
         int one = 1;
         ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -350,7 +386,6 @@ struct Client::Impl {
       fd = -1;
     }
     ::freeaddrinfo(res);
-    (void)timeout_s;
     throw last;
   }
 

@@ -221,6 +221,15 @@ class CoreWorker:
         if self._shutdown:
             return
         self._shutdown = True
+        # Stop the usage-stats daemon thread (attached by ray_tpu.init)
+        # so init/shutdown cycles don't leak pollers against a
+        # torn-down runtime.
+        reporter = getattr(self, "_usage_reporter", None)
+        if reporter is not None:
+            try:
+                reporter.stop()
+            except Exception:
+                pass
         try:
             self._run(self._async_shutdown(), timeout=5)
         except Exception:

@@ -118,13 +118,39 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
+def _vma_supported() -> bool:
+    """Feature-detect ShapeDtypeStruct(vma=...) + jax.typeof: both arrived
+    together; on older JAX we skip vma (matching the lax.pvary fallback
+    path used by ring attention below)."""
+    global _VMA_OK
+    if _VMA_OK is None:
+        try:
+            jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+            _VMA_OK = hasattr(jax, "typeof")
+        except TypeError:
+            _VMA_OK = False
+    return _VMA_OK
+
+
+_VMA_OK = None
+
+
 def _operand_vma(*arrays) -> frozenset:
     """Union of mesh axes the operands vary over (empty outside shard_map)."""
     vma: frozenset = frozenset()
+    if not _vma_supported():
+        return vma
     for a in arrays:
         t = jax.typeof(a)
         vma = vma | getattr(t, "vma", frozenset())
     return vma
+
+
+def _out_struct(shape, dtype, vma):
+    """ShapeDtypeStruct with vma when this JAX supports it."""
+    if _vma_supported():
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _flash_forward(q, k, v, sm_scale: float, causal: bool,
@@ -178,9 +204,8 @@ def _flash_forward(q, k, v, sm_scale: float, causal: bool,
             # vma: under shard_map (ring/Ulysses wrappers) outputs vary
             # over the same mesh axes as the operands; required when the
             # kernel is called with check_vma=True (the default).
-            jax.ShapeDtypeStruct((B, H, Sq, D), q.dtype, vma=_operand_vma(q, k, v)),
-            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32,
-                                 vma=_operand_vma(q, k, v)),
+            _out_struct((B, H, Sq, D), q.dtype, _operand_vma(q, k, v)),
+            _out_struct((B, H, Sq, 1), jnp.float32, _operand_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32) if pltpu else None,

@@ -272,6 +272,12 @@ def start_proxies(port: int = 0) -> dict:
             actor, host, known_port = existing
             try:
                 if ray_tpu.get(actor.healthy.remote(), timeout=15):
+                    if known_port is None:
+                        # A previous address fetch failed; re-fetch
+                        # rather than cache a useless None port forever.
+                        known_port = ray_tpu.get(actor.address.remote(),
+                                                 timeout=30)
+                        _node_proxies[nid] = (actor, host, known_port)
                     out[nid] = (host, known_port)
                     continue
             except Exception:
@@ -289,10 +295,27 @@ def start_proxies(port: int = 0) -> dict:
         pending[nid] = (actor, n["host"])
     # Addresses collected after ALL spawns: N nodes cost one worker
     # startup of wall clock, not N.
+    failed = []
     for nid, (actor, host) in pending.items():
-        p = ray_tpu.get(actor.address.remote(), timeout=120)
+        try:
+            p = ray_tpu.get(actor.address.remote(), timeout=120)
+        except Exception as e:
+            # Don't leave a (actor, host, None) entry that a later call
+            # would trust as healthy: kill and forget so the next
+            # reconcile replaces the proxy.
+            failed.append((nid, e))
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+            _node_proxies.pop(nid, None)
+            continue
         _node_proxies[nid] = (actor, host, p)
         out[nid] = (host, p)
+    if failed:
+        raise RuntimeError(
+            f"proxy address fetch failed on nodes {failed}; "
+            f"{len(out)} proxies started")
     return out
 
 

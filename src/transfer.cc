@@ -19,6 +19,7 @@
 // ABI below), each process attaching its own mapping of the arena.
 
 #include <arpa/inet.h>
+#include <endian.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netdb.h>
@@ -141,11 +142,13 @@ void serve_conn(Server* srv, int fd) {
     uint64_t off = 0, size = 0, meta = 0;
     int rc = store_get(srv->store, id, &off, &size, &meta);
     if (rc != 0) {
-      uint64_t hdr[2] = {kNotFound, 0};
+      // Header u64s go big-endian on the wire (like the RPC frame
+      // length) so mixed-endian peers can't misread sizes.
+      uint64_t hdr[2] = {htobe64(kNotFound), 0};
       if (!send_all(fd, hdr, sizeof(hdr))) break;
       continue;
     }
-    uint64_t hdr[2] = {size, meta};
+    uint64_t hdr[2] = {htobe64(size), htobe64(meta)};
     bool ok = send_all(fd, hdr, sizeof(hdr)) &&
               send_all(fd, static_cast<uint8_t*>(store_base(srv->store)) + off,
                        size);
@@ -302,8 +305,8 @@ int fetch_once(void* store, int fd, const uint8_t* id) {
   if (!send_all(fd, id, kIdSize)) return -4;
   uint64_t hdr[2];
   if (!recv_all(fd, hdr, sizeof(hdr))) return -4;
-  if (hdr[0] == kNotFound) return -2;
-  uint64_t total = hdr[0], meta = hdr[1];
+  if (be64toh(hdr[0]) == kNotFound) return -2;
+  uint64_t total = be64toh(hdr[0]), meta = be64toh(hdr[1]);
   uint64_t off = 0;
   int crc = store_create(store, id, total, meta, &off);
   if (crc == -2 /*kErrExists*/) {
