@@ -7,6 +7,7 @@ ActorMethod), _private/ray_option_utils.py (options validation).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 from typing import Any
@@ -43,17 +44,53 @@ def core_worker_or_none():
     return _core_worker
 
 
+_nested_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def collect_nested_refs():
+    """Serialize-side collector: while active, ObjectRef.__reduce__ appends
+    (oid_hex, owner_wire) here instead of job-lifetime pinning — the caller
+    then applies borrower-protocol accounting to the collected refs
+    (reference: reference_count.cc tracks refs found while serializing
+    arguments/returns)."""
+    prev = getattr(_nested_ctx, "ser_sink", None)
+    sink: list = []
+    _nested_ctx.ser_sink = sink
+    try:
+        yield sink
+    finally:
+        _nested_ctx.ser_sink = prev
+
+
+@contextlib.contextmanager
+def deser_context(preregistered: set | None = None):
+    """Deserialize-side collector: rebuilt borrowed refs are recorded here;
+    `preregistered` oids are ones the payload's sender already registered
+    with their owners on our behalf (no BorrowRef needed from us)."""
+    prev = (getattr(_nested_ctx, "deser_sink", None),
+            getattr(_nested_ctx, "deser_prereg", None))
+    sink: list = []
+    _nested_ctx.deser_sink = sink
+    _nested_ctx.deser_prereg = preregistered or set()
+    try:
+        yield sink
+    finally:
+        _nested_ctx.deser_sink, _nested_ctx.deser_prereg = prev
+
+
 class ObjectRef:
     """A reference to an object owned by some worker (reference:
     python/ray ObjectRef; owner address travels with the ref as in
     src/ray/protobuf/common.proto ObjectReference)."""
 
-    __slots__ = ("id", "owner", "_registered")
+    __slots__ = ("id", "owner", "_registered", "_borrowed")
 
     def __init__(self, oid: ObjectID, owner: Address | None, _register: bool = True):
         self.id = oid
         self.owner = owner
         self._registered = False
+        self._borrowed = False
         cw = _core_worker
         if _register and cw is not None:
             cw.add_local_ref(oid.hex())
@@ -72,25 +109,34 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __del__(self):
-        if self._registered and _core_worker is not None:
-            try:
-                _core_worker.remove_local_ref(self.id.hex())
-            except Exception:
-                pass
+        cw = _core_worker
+        if cw is None:
+            return
+        try:
+            if self._registered:
+                cw.remove_local_ref(self.id.hex())
+            elif self._borrowed:
+                cw.borrow_decr(self.id.hex())
+        except Exception:
+            pass
 
     def __reduce__(self):
-        # Nested-ref serialization (ref inside a return value / argument
-        # payload): pin the object owner-side for the job lifetime so the
-        # far side can always resolve it — the round-1 stand-in for the
-        # reference's full borrower protocol (reference_count.cc). Without
-        # this, returning a put() ref from a task frees the object the
-        # moment the task's local variable dies.
-        cw = _core_worker
-        if cw is not None and self.owner is not None \
-                and self.owner.worker_id == cw.worker_id:
-            cw.pin_nested_ref(self.id.hex())
-        return (_rebuild_object_ref,
-                (self.id.binary(), self.owner.to_wire() if self.owner else None))
+        # Nested-ref serialization (ref inside a value arg / return / put
+        # payload). Inside a runtime serialization context the ref is
+        # COLLECTED and handled by the borrower protocol
+        # (reference: reference_count.cc). A bare out-of-band pickle (user
+        # calling pickle.dumps directly) falls back to the job-lifetime
+        # owner pin, the only safe default without a recipient to track.
+        sink = getattr(_nested_ctx, "ser_sink", None)
+        owner_wire = self.owner.to_wire() if self.owner else None
+        if sink is not None:
+            sink.append((self.id.hex(), owner_wire))
+        else:
+            cw = _core_worker
+            if cw is not None and self.owner is not None \
+                    and self.owner.worker_id == cw.worker_id:
+                cw.pin_nested_ref(self.id.hex())
+        return (_rebuild_object_ref, (self.id.binary(), owner_wire))
 
     # Allow `await ref` patterns later; for now block via global get.
     def future(self):
@@ -98,9 +144,28 @@ class ObjectRef:
 
 
 def _rebuild_object_ref(id_bytes, owner_wire):
-    return ObjectRef(ObjectID(id_bytes),
-                     Address.from_wire(owner_wire) if owner_wire else None,
-                     _register=False)
+    owner = Address.from_wire(owner_wire) if owner_wire else None
+    ref = ObjectRef(ObjectID(id_bytes), owner, _register=False)
+    cw = _core_worker
+    if cw is None or owner is None:
+        return ref
+    oid_hex = ref.id.hex()
+    if owner.worker_id == cw.worker_id:
+        # Deserializing our own ref: count it like any locally created
+        # handle so user-held copies keep the object alive.
+        cw.add_local_ref(oid_hex)
+        ref._registered = True
+        return ref
+    # Borrowed ref: one local count per live handle (reference:
+    # reference_count.cc borrower accounting).
+    prereg = getattr(_nested_ctx, "deser_prereg", None)
+    cw.borrow_incr(oid_hex, owner,
+                   registered=bool(prereg and oid_hex in prereg))
+    ref._borrowed = True
+    sink = getattr(_nested_ctx, "deser_sink", None)
+    if sink is not None:
+        sink.append((oid_hex, owner))
+    return ref
 
 
 _OPTION_DEFAULTS = {
@@ -202,6 +267,7 @@ class RemoteFunction:
         # outlive clusters (tests start many), so one cached key would point
         # at a GCS that no longer exists.
         self._func_keys: dict[str, str] = {}
+        self._wire_cache = None  # (strategy triple, resources) per-opts
         functools.update_wrapper(self, fn)
 
     def options(self, **opts) -> "RemoteFunction":
@@ -225,8 +291,15 @@ class RemoteFunction:
         func_key = self._func_keys.get(cw.job_id)
         if func_key is None:
             func_key = self._func_keys[cw.job_id] = cw.register_function(self._fn)
-        wire_args, kwargs_keys, _deps = cw.serialize_args(args, kwargs)
-        strategy, pg_id, bundle_index = _wire_strategy(self._opts)
+        wire_args, kwargs_keys, _deps, nested = cw.serialize_args(args, kwargs)
+        # Options are immutable per RemoteFunction (options() returns a new
+        # instance): derive strategy/resources once, not per .remote().
+        cached = self._wire_cache
+        if cached is None:
+            cached = self._wire_cache = (
+                _wire_strategy(self._opts),
+                _build_resources(self._opts, default_cpus=1.0))
+        (strategy, pg_id, bundle_index), resources = cached
         task_id = cw.next_task_id()
         spec = TaskSpec(
             task_id=task_id.hex(),
@@ -236,7 +309,7 @@ class RemoteFunction:
             args=wire_args,
             kwargs_keys=kwargs_keys,
             num_returns=self._opts["num_returns"],
-            resources=_build_resources(self._opts, default_cpus=1.0),
+            resources=dict(resources),  # spec owns a private copy
             max_retries=self._opts["max_retries"],
             retry_exceptions=bool(self._opts["retry_exceptions"]),
             owner=cw.address.to_wire(),
@@ -249,7 +322,7 @@ class RemoteFunction:
 
         with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
             spec.trace_ctx = trace_ctx
-            returns = cw.submit_task(spec)
+            returns = cw.submit_task(spec, nested_args=nested)
         refs = [ObjectRef(oid, cw.address) for oid in returns]
         if self._opts["num_returns"] == 1:
             return refs[0]
@@ -300,7 +373,7 @@ class ActorHandle:
 
     def _submit_method(self, method_name: str, args, kwargs, num_returns: int):
         cw = get_core_worker()
-        wire_args, kwargs_keys, _ = cw.serialize_args(args, kwargs)
+        wire_args, kwargs_keys, _, nested = cw.serialize_args(args, kwargs)
         task_id = cw.next_task_id()
         spec = TaskSpec(
             task_id=task_id.hex(),
@@ -320,7 +393,8 @@ class ActorHandle:
         with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
             spec.trace_ctx = trace_ctx
             returns = cw.submit_actor_task(self._actor_id.hex(), spec,
-                                           self._max_task_retries)
+                                           self._max_task_retries,
+                                           nested_args=nested)
         refs = [ObjectRef(oid, cw.address) for oid in returns]
         return refs[0] if num_returns == 1 else refs
 
@@ -361,7 +435,9 @@ class ActorClass:
         if class_key is None:
             class_key = self._class_keys[cw.job_id] = cw.register_function(self._cls)
         actor_id = ActorID.from_random()
-        wire_args, kwargs_keys, _ = cw.serialize_args(args, kwargs)
+        # Constructor args are held for the actor's lifetime (the actor
+        # may stash nested refs in self; released with the job).
+        wire_args, kwargs_keys, _, _nested = cw.serialize_args(args, kwargs)
         strategy, pg_id, bundle_index = _wire_strategy(self._opts)
         task_id = cw.next_task_id()
         spec = TaskSpec(

@@ -24,14 +24,22 @@ the *owner* of result objects. The owner stores small results inline in its
 in-process memory store, tracks shm locations of large results, serves
 `GetObjectStatus` long-polls to other processes, and reconstructs lost
 task-produced objects by resubmitting their creating task (lineage).
-Differences from the reference this round: borrowed-reference accounting for
-*nested* (serialized-inside-arguments) refs pins the object for the job
-lifetime instead of running the full borrower protocol.
+
+Borrower protocol (reference: reference_count.cc borrowing): refs serialized
+inside payloads are COLLECTED, not pinned. Holds are per-cause — submission
+holds released at task completion, container holds released when the
+enclosing object frees, per-handle borrow counts released by ObjectRef
+GC — and every handoff registers the recipient with the owner BEFORE the
+sender's own hold can release (reply-reported arg borrows; eager forward
+for returns and status fetches), so the owner frees an object only when
+local refs, submitted refs, and the borrowers set are all empty. The only
+job-lifetime pin left is for refs pickled outside any runtime context.
 """
 
 from __future__ import annotations
 
 import asyncio
+import functools
 import hashlib
 import itertools
 import logging
@@ -58,7 +66,8 @@ OBJ_FAILED = "failed"
 
 class _OwnedObject:
     __slots__ = ("state", "inline", "locations", "lineage_task", "error",
-                 "ready_event", "local_refs", "submitted_refs", "size")
+                 "ready_event", "local_refs", "submitted_refs", "size",
+                 "borrowers")
 
     def __init__(self):
         self.state = OBJ_PENDING
@@ -70,23 +79,57 @@ class _OwnedObject:
         self.local_refs = 0
         self.submitted_refs = 0     # pending tasks that take this as an arg
         self.size = 0
+        # Borrower protocol (reference: reference_count.cc): worker_ids of
+        # remote processes known to hold a reference. A non-empty set
+        # blocks freeing; the owner's WaitForRefRemoved watches remove
+        # entries when borrowers release or die.
+        self.borrowers: set[str] = set()
+
+
+class _BorrowedRef:
+    """This process's accounting for ONE object owned elsewhere
+    (reference: reference_count.cc borrower-side state). count aggregates
+    every local holder: live ObjectRef instances, containers (return
+    values / puts) embedding the ref, and in-flight submissions that
+    forwarded it. `registered` means the owner knows about us; release is
+    OWNER-INITIATED — the owner long-polls WaitForRefRemoved and we answer
+    when count reaches zero (removed_event), which makes release ordering
+    race-free by construction (reference: WaitForRefRemoved pub/sub in
+    reference_count.cc)."""
+    __slots__ = ("owner", "count", "registered", "removed_event")
+
+    def __init__(self, owner):
+        self.owner = owner
+        self.count = 0
+        self.registered = False
+        self.removed_event: asyncio.Event | None = None
 
 
 class _PendingTask:
-    __slots__ = ("spec", "retries_left", "constructor_like", "futures", "pushed_to")
+    __slots__ = ("spec", "retries_left", "constructor_like", "futures",
+                 "pushed_to", "nested_args")
 
-    def __init__(self, spec: TaskSpec, retries_left: int):
+    def __init__(self, spec: TaskSpec, retries_left: int,
+                 nested_args: list | None = None):
         self.spec = spec
         self.retries_left = retries_left
         self.futures: list[asyncio.Future] = []
         self.pushed_to: str | None = None
+        # Refs serialized INSIDE value args (not top-level): list of
+        # (oid_hex, owner_wire|None); refcounted like top-level args and
+        # released at completion per the borrower protocol.
+        self.nested_args = nested_args or []
 
 
 class _LeaseSlot:
+    """One leased worker. `outstanding` tracks tasks pushed but not yet
+    completed (streamed TaskDone notifies drain it; a closed connection
+    fails/retries everything left in it)."""
     __slots__ = ("conn", "lease_id", "worker_id", "node_id", "raylet", "busy",
-                 "idle_since")
+                 "idle_since", "outstanding", "worker_addr")
 
-    def __init__(self, conn, lease_id, worker_id, node_id, raylet):
+    def __init__(self, conn, lease_id, worker_id, node_id, raylet,
+                 worker_addr=None):
         self.conn = conn
         self.lease_id = lease_id
         self.worker_id = worker_id
@@ -94,6 +137,8 @@ class _LeaseSlot:
         self.raylet = raylet
         self.busy = False
         self.idle_since = time.monotonic()
+        self.outstanding: dict = {}  # task_id -> _PendingTask
+        self.worker_addr = worker_addr  # Address wire of the worker
 
 
 def _shape_key(resources: dict) -> str:
@@ -149,6 +194,33 @@ class CoreWorker:
         self._leases: dict[str, list[_LeaseSlot]] = defaultdict(list)
         self._lease_requests_in_flight: dict[str, int] = defaultdict(int)
         self._queues: dict[str, list] = defaultdict(list)  # shape -> [task_id]
+        # Submission batching: caller threads append here; ONE loop wakeup
+        # drains the whole burst (reference analog: the Cython submit path
+        # amortizes into the C++ submitter; here we amortize loop wakeups).
+        self._submit_buf: list = []
+        self._submit_lock = threading.Lock()
+        self._submit_scheduled = False
+        # Ref-count op batching: same trick for add/remove_local_ref and
+        # bump_submitted_ref — a burst of ObjectRef creations costs one
+        # loop wakeup, not one self-pipe write per ref.
+        self._post_buf: list = []
+        self._post_lock = threading.Lock()
+        self._post_scheduled = False
+        # Worker-side completion streaming (see _queue_task_done).
+        self._done_buf: dict = {}
+        self._done_lock = threading.Lock()
+        self._done_scheduled: set = set()
+        # Borrower protocol state (reference: reference_count.cc).
+        self.borrowed: dict[str, _BorrowedRef] = {}   # oid -> borrow state
+        self._borrow_lock = threading.Lock()
+        # container oid -> [(nested_oid, owner_wire|None), ...]: refs
+        # embedded in a stored payload; released when the container frees.
+        self._container_nested: dict[str, list] = {}
+        self._actor_task_nested: dict[str, list] = {}  # task_id -> nested
+        # container oid -> {nested oids} pre-registered for us by the
+        # container's owner (consumed by get()'s deserialize).
+        self._fetched_prereg: dict[str, set] = {}
+        self._borrow_watches: dict = {}  # (oid, borrower) -> generation
         self._task_events: list = []
         self._run(self._async_init())
 
@@ -169,10 +241,13 @@ class CoreWorker:
     async def _async_init(self):
         self.server = rpc.RpcServer({
             "PushTask": self._handle_push_task,
+            "PushTaskBatch": self._handle_push_task_batch,
             "ActorCall": self._handle_actor_call,
             "ActorSeqSkip": self._handle_actor_seq_skip,
             "AssignActor": self._handle_assign_actor,
             "GetObjectStatus": self._handle_get_object_status,
+            "BorrowRef": self._handle_borrow_ref,
+            "WaitForRefRemoved": self._handle_wait_for_ref_removed,
             "CancelTask": self._handle_cancel_task,
             "Exit": self._handle_exit,
             "Ping": lambda conn, p: {"ok": True},
@@ -295,9 +370,15 @@ class CoreWorker:
         self._exec_tls.task_id = value
 
     def put(self, value) -> "tuple[ObjectID, Address]":
+        from ray_tpu._private.api_internal import collect_nested_refs
+
         oid = ObjectID.for_put(self._current_task_id,
                                next(self._put_counter))
-        sobj = serialization.serialize(value)
+        with collect_nested_refs() as sink:
+            sobj = serialization.serialize(value)
+        if sink:
+            # Embedded refs live as long as the put container does.
+            self._post(self._track_container, oid.hex(), list(sink))
         self._run(self._store_owned(oid, sobj))
         return oid, self.address
 
@@ -407,11 +488,20 @@ class CoreWorker:
         if first_err is not None:
             release_unconsumed(0)
             raise first_err
+        from ray_tpu._private.api_internal import deser_context
+
         out = []
         for i, ((oid, _owner), (meta, data, pin)) in enumerate(
                 zip(refs, fetched)):
             try:
-                kind, value = serialization.deserialize(meta, data)
+                # Pre-registered nested oids: from our own container map
+                # (we own the object) or the owner's status reply.
+                oid_hex = oid.hex()
+                prereg = ({n[0] for n in self._container_nested.get(oid_hex, [])}
+                          | self._fetched_prereg.pop(oid_hex, set()))
+                with deser_context(prereg) as dsink:
+                    kind, value = serialization.deserialize(meta, data)
+                self._register_new_borrows(dsink)
                 if pin is not None and _has_buffers(meta):
                     self._pinned_reads.add(oid.hex())
                 elif pin is not None:
@@ -474,9 +564,12 @@ class CoreWorker:
                     if o.ready_event is None:
                         o.ready_event = asyncio.Event()
                     try:
-                        wait_t = 0.5 if deadline is None else \
-                            min(0.5, max(0.001, deadline - time.monotonic()))
+                        wait_t = None if deadline is None else \
+                            min(30.0, max(0.001, deadline - time.monotonic()))
                         await asyncio.wait_for(o.ready_event.wait(), wait_t)
+                        # Event fired: re-check state immediately, no
+                        # backoff sleep (hot path for burst completions).
+                        continue
                     except asyncio.TimeoutError:
                         pass
             if deadline is not None and time.monotonic() > deadline:
@@ -490,11 +583,17 @@ class CoreWorker:
         try:
             conn = await self._owner_conn(owner)
             resp = await conn.call("GetObjectStatus",
-                                   {"object_id": oid.hex(), "wait_s": 2.0},
+                                   {"object_id": oid.hex(), "wait_s": 2.0,
+                                    "requester": self.worker_id,
+                                    "requester_addr": self.address.to_wire()},
                                    timeout=self.config.rpc_call_timeout_s)
         except (rpc.RpcError, OSError) as e:
             raise exc.OwnerDiedError(
                 oid.hex(), f"owner of {oid.hex()} unreachable: {e}")
+        if resp.get("nested"):
+            # The owner pre-registered us as borrower of these embedded
+            # refs; remember that for the deserialize in get().
+            self._fetched_prereg[oid.hex()] = {n[0] for n in resp["nested"]}
         status = resp["status"]
         if status == "inline":
             return bytes(resp["meta"]), bytes(resp["data"])
@@ -639,14 +738,36 @@ class CoreWorker:
 
     # ---------- ref counting ----------
 
+    def _post(self, fn, *args):
+        """Run fn(*args) on the IO loop, batched: FIFO order is preserved
+        (single buffer, single drain) while a burst of posts costs one
+        call_soon_threadsafe wakeup."""
+        with self._post_lock:
+            self._post_buf.append((fn, args))
+            wake = not self._post_scheduled
+            if wake:
+                self._post_scheduled = True
+        if wake:
+            try:
+                self.loop.call_soon_threadsafe(self._drain_post_buf)
+            except RuntimeError:
+                pass
+
+    def _drain_post_buf(self):
+        with self._post_lock:
+            buf, self._post_buf = self._post_buf, []
+            self._post_scheduled = False
+        for fn, args in buf:
+            try:
+                fn(*args)
+            except Exception:
+                logger.exception("posted op failed")
+
     def add_local_ref(self, oid_hex: str):
         """Thread-safe: counts mutate on the IO loop only. Post order is
         creation order per ref, so a later remove can never overtake its
         add in the loop's FIFO."""
-        try:
-            self.loop.call_soon_threadsafe(self._add_local_ref_impl, oid_hex)
-        except RuntimeError:
-            pass
+        self._post(self._add_local_ref_impl, oid_hex)
 
     def _add_local_ref_impl(self, oid_hex: str):
         o = self.objects.get(oid_hex)
@@ -654,36 +775,224 @@ class CoreWorker:
             o.local_refs += 1
 
     def pin_nested_ref(self, oid_hex: str):
-        """Job-lifetime pin for a ref serialized into a payload (may be
-        called from exec threads; the count mutates on the IO loop)."""
+        """Job-lifetime pin — LEGACY escape hatch, used only when a ref is
+        pickled outside any runtime serialization context (user calls
+        pickle.dumps themselves); in-runtime payloads go through the
+        borrower protocol instead (collect_nested_refs)."""
         self.add_local_ref(oid_hex)
+
+    # ---------- borrower protocol (reference: reference_count.cc) ----------
+
+    def borrow_incr(self, oid_hex: str, owner, *, registered: bool = False):
+        """Count one more local holder of a borrowed (non-owned) ref.
+        Thread-safe (exec threads deserialize). registered=True when the
+        owner already knows about this process (pre-registered by the
+        sender), so no BorrowRef needs to be sent; release happens via
+        the owner's WaitForRefRemoved long-poll."""
+        with self._borrow_lock:
+            b = self.borrowed.get(oid_hex)
+            if b is None:
+                b = self.borrowed[oid_hex] = _BorrowedRef(owner)
+            b.count += 1
+            if registered:
+                b.registered = True
+
+    def borrow_decr(self, oid_hex: str):
+        """Drop one local holder; at zero, wake the owner's
+        WaitForRefRemoved long-poll (if one is parked)."""
+        with self._borrow_lock:
+            b = self.borrowed.get(oid_hex)
+            if b is None:
+                return
+            b.count -= 1
+            if b.count > 0:
+                return
+            del self.borrowed[oid_hex]
+            ev = b.removed_event
+        if ev is not None and not self._shutdown:
+            try:
+                self.loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass
+
+    def borrow_mark_registered(self, oid_hex: str) -> bool:
+        """Mark a live borrow as owner-known; False if already released."""
+        with self._borrow_lock:
+            b = self.borrowed.get(oid_hex)
+            if b is None:
+                return False
+            b.registered = True
+            return True
+
+    async def _handle_wait_for_ref_removed(self, conn, payload):
+        """Borrower-side: park until our count for this object reaches
+        zero (the owner holds this call open; our reply IS the release)."""
+        oid_hex = payload["object_id"]
+        with self._borrow_lock:
+            b = self.borrowed.get(oid_hex)
+            if b is None or b.count <= 0:
+                return {}
+            if b.removed_event is None:
+                b.removed_event = asyncio.Event()
+            ev = b.removed_event
+        await ev.wait()
+        return {}
+
+    def _add_borrower(self, oid_hex: str, borrower_id: str, borrower_addr):
+        """Owner-side: record a borrower and start (once per live
+        registration) the WaitForRefRemoved watch that will eventually
+        remove it. Re-registration while a watch exists bumps the watch
+        generation so a stale watch cannot discard the fresh borrow."""
+        o = self.objects.get(oid_hex)
+        if o is None or borrower_id == self.worker_id:
+            return
+        o.borrowers.add(borrower_id)
+        key = (oid_hex, borrower_id)
+        if key in self._borrow_watches:
+            self._borrow_watches[key] += 1
+        else:
+            self._borrow_watches[key] = 1
+            self._spawn(self._watch_borrower(oid_hex, borrower_id,
+                                             borrower_addr))
+
+    async def _watch_borrower(self, oid_hex: str, borrower_id: str,
+                              borrower_addr):
+        """Long-poll the borrower; when it answers (count hit zero) or its
+        process dies (connection error), drop it from the borrowers set.
+        The initial grace period lets an eagerly pre-registered borrower
+        actually record its borrow before we ask. A generation bump
+        (re-registration racing our completed wait) restarts the wait
+        instead of discarding the live borrow."""
+        key = (oid_hex, borrower_id)
+        seen_gen = self._borrow_watches.get(key, 1)
+        try:
+            while not self._shutdown:
+                await asyncio.sleep(5.0)
+                try:
+                    conn = await self._owner_conn(
+                        Address.from_wire(borrower_addr))
+                    await conn.call("WaitForRefRemoved",
+                                    {"object_id": oid_hex}, timeout=None)
+                except (rpc.RpcError, OSError, asyncio.TimeoutError):
+                    break  # borrower unreachable == borrower gone
+                gen = self._borrow_watches.get(key, seen_gen)
+                if gen == seen_gen:
+                    break  # clean release, no re-registration raced us
+                seen_gen = gen  # re-registered: wait for the new borrow
+        finally:
+            self._borrow_watches.pop(key, None)
+            o = self.objects.get(oid_hex)
+            if o is not None:
+                o.borrowers.discard(borrower_id)
+                if o.local_refs <= 0 and o.submitted_refs <= 0 \
+                        and not o.borrowers:
+                    self._free_object(oid_hex)
+
+    def _register_new_borrows(self, dsink: list):
+        """Immediately register any rebuilt borrow the owner doesn't know
+        about yet (payloads fetched from the shm store have no
+        pre-registration channel). Tiny race vs a concurrent final
+        release — crash-free: a late BorrowRef on a freed object is a
+        no-op and the borrower then observes ObjectLostError, the
+        reference's behavior for out-of-band ref leaks."""
+        for oid_hex, owner in dsink:
+            with self._borrow_lock:
+                b = self.borrowed.get(oid_hex)
+                if b is None or b.registered:
+                    continue
+                b.registered = True
+            if owner is not None:
+                self._spawn(self._send_borrow_ref(oid_hex, owner))
+
+    async def _send_borrow_ref(self, oid_hex: str, owner):
+        try:
+            conn = await self._owner_conn(owner)
+            await conn.notify("BorrowRef",
+                              {"object_id": oid_hex,
+                               "borrower": self.worker_id,
+                               "borrower_addr": self.address.to_wire()})
+        except Exception:
+            pass
+
+    async def _forward_borrow(self, oid_hex: str, owner_wire,
+                              borrower_id: str, borrower_addr):
+        """Register a borrower (id + address) with the object's owner on
+        our ordered owner connection — sent BEFORE we release our own hold
+        on the same connection, which is what makes the handoff
+        race-free. The owner starts a WaitForRefRemoved watch to the
+        borrower's address."""
+        if owner_wire is None or borrower_addr is None:
+            return
+        owner = Address.from_wire(owner_wire)
+        if owner.worker_id == self.worker_id:
+            self._add_borrower(oid_hex, borrower_id, borrower_addr)
+            return
+        try:
+            conn = await self._owner_conn(owner)
+            # A CALL, not a notify: the ack guarantees the owner recorded
+            # the new borrower before our own hold (whose release answers
+            # a WaitForRefRemoved on a DIFFERENT connection) can drop —
+            # cross-connection ordering that a notify cannot provide.
+            await conn.call("BorrowRef", {"object_id": oid_hex,
+                                          "borrower": borrower_id,
+                                          "borrower_addr": borrower_addr},
+                            timeout=10)
+        except Exception:
+            pass  # owner unreachable: object is lost anyway
+
+    async def _handle_borrow_ref(self, conn, payload):
+        self._add_borrower(payload["object_id"], payload["borrower"],
+                           payload.get("borrower_addr"))
+
+    def _track_container(self, container_hex: str, nested: list):
+        """A stored payload (put value / task return) embeds `nested`
+        refs: hold each until the container object is freed. Owned refs
+        take a local count; borrowed refs take a borrow count and are
+        registered with their owner if not already (duplicate BorrowRefs
+        are idempotent — borrowers is a set)."""
+        if not nested:
+            return
+        self._container_nested.setdefault(container_hex, []).extend(nested)
+        new_borrows = []
+        for oid_hex, owner_wire in nested:
+            o = self.objects.get(oid_hex)
+            if o is not None:
+                o.local_refs += 1
+            else:
+                owner = Address.from_wire(owner_wire) if owner_wire else None
+                self.borrow_incr(oid_hex, owner)
+                new_borrows.append((oid_hex, owner))
+        self._register_new_borrows(new_borrows)
+
+    def _release_container(self, container_hex: str):
+        for oid_hex, _owner in self._container_nested.pop(container_hex, []):
+            o = self.objects.get(oid_hex)
+            if o is not None:
+                self._remove_local_ref_impl(oid_hex)
+            else:
+                self.borrow_decr(oid_hex)
 
     def bump_submitted_ref(self, oid_hex: str):
         """Thread-safe submitted_refs increment (submissions may originate
         on concurrent actor exec threads)."""
-        def bump():
-            o = self.objects.get(oid_hex)
-            if o is not None:
-                o.submitted_refs += 1
-        try:
-            self.loop.call_soon_threadsafe(bump)
-        except RuntimeError:
-            pass
+        self._post(self._bump_submitted_ref_impl, oid_hex)
+
+    def _bump_submitted_ref_impl(self, oid_hex: str):
+        o = self.objects.get(oid_hex)
+        if o is not None:
+            o.submitted_refs += 1
 
     def remove_local_ref(self, oid_hex: str):
         if self._shutdown:
             return
-        try:
-            self.loop.call_soon_threadsafe(self._remove_local_ref_impl, oid_hex)
-        except RuntimeError:
-            pass
+        self._post(self._remove_local_ref_impl, oid_hex)
 
     def _remove_local_ref_impl(self, oid_hex: str):
         o = self.objects.get(oid_hex)
         if o is None:
             return
         o.local_refs -= 1
-        if o.local_refs <= 0 and o.submitted_refs <= 0:
+        if o.local_refs <= 0 and o.submitted_refs <= 0 and not o.borrowers:
             self._free_object(oid_hex)
 
     def _free_object(self, oid_hex: str):
@@ -696,6 +1005,8 @@ class CoreWorker:
             spec = self.lineage.pop(o.lineage_task, None)
             if spec is not None:
                 self._lineage_bytes -= len(str(spec.args))
+        # Refs embedded in this container's payload lose their hold.
+        self._release_container(oid_hex)
 
     # ---------- function table ----------
 
@@ -731,47 +1042,121 @@ class CoreWorker:
         return TaskID(h.digest()[:TaskID.SIZE])
 
     def serialize_args(self, args: tuple, kwargs: dict):
-        """Build wire args; returns (wire_args, kwargs_keys, dep_ids)."""
-        from ray_tpu._private.api_internal import ObjectRef  # cycle-free import
+        """Build wire args; returns (wire_args, kwargs_keys, dep_ids,
+        nested_refs). nested_refs are refs pickled INSIDE value args —
+        refcounted like top-level args via the borrower protocol
+        (reference: reference_count.cc collects refs during arg
+        serialization)."""
+        from ray_tpu._private.api_internal import (  # cycle-free import
+            ObjectRef, collect_nested_refs)
 
         wire = []
         deps = []
+        nested: list = []
         items = list(args) + list(kwargs.values())
         for a in items:
             if isinstance(a, ObjectRef):
                 wire.append(["r", a.id.hex(), a.owner.to_wire() if a.owner else None])
                 deps.append(a.id.hex())
-                self.bump_submitted_ref(a.id.hex())
+                self._hold_for_submission(
+                    a.id.hex(), a.owner.to_wire() if a.owner else None)
             else:
-                sobj = serialization.serialize(a)
+                with collect_nested_refs() as sink:
+                    sobj = serialization.serialize(a)
                 if sobj.total_size > self.config.max_inline_object_size:
                     # Large arg: promote to a put object passed by reference
-                    # (reference: same promotion in submit path).
+                    # (reference: same promotion in submit path). The put
+                    # container now holds the nested refs (tracked by
+                    # put()'s own collector), so drop this sink.
                     oid, owner = self.put(a)
                     wire.append(["r", oid.hex(), owner.to_wire()])
                     deps.append(oid.hex())
-                    self.bump_submitted_ref(oid.hex())
+                    self._hold_for_submission(oid.hex(), owner.to_wire())
                 else:
                     wire.append(["v", sobj.meta, sobj.to_bytes()])
-        return wire, list(kwargs.keys()), deps
+                    for oid_hex, owner_wire in sink:
+                        nested.append((oid_hex, owner_wire))
+                        self._hold_for_submission(oid_hex, owner_wire)
+        return wire, list(kwargs.keys()), deps, nested
 
-    def submit_task(self, spec: TaskSpec) -> list[ObjectID]:
+    def _hold_for_submission(self, oid_hex: str, owner_wire):
+        """Keep a ref alive until its task completes: owned refs bump
+        submitted_refs; borrowed refs bump the local borrow count (both
+        released in _complete_task / _release_submitted_refs)."""
+        if oid_hex in self.objects:
+            self.bump_submitted_ref(oid_hex)
+        else:
+            owner = Address.from_wire(owner_wire) if owner_wire else None
+            self.borrow_incr(oid_hex, owner)
+
+    def submit_task(self, spec: TaskSpec,
+                    nested_args: list | None = None) -> list[ObjectID]:
         """Submit; returns the return-object IDs (owner = this worker)."""
         returns = [ObjectID.for_task_return(TaskID.from_hex(spec.task_id), i + 1)
                    for i in range(spec.num_returns)]
-        pt = _PendingTask(spec, retries_left=spec.max_retries)
+        pt = _PendingTask(spec, retries_left=spec.max_retries,
+                          nested_args=nested_args)
         for oid in returns:
             o = self.objects.setdefault(oid.hex(), _OwnedObject())
             o.lineage_task = spec.task_id
         self.pending_tasks[spec.task_id] = pt
         self._record_task_event(spec.task_id, spec.name, "PENDING")
-        self.loop.call_soon_threadsafe(self._enqueue_task, pt)
+        with self._submit_lock:
+            self._submit_buf.append(pt)
+            wake = not self._submit_scheduled
+            if wake:
+                self._submit_scheduled = True
+        if wake:
+            self.loop.call_soon_threadsafe(self._drain_submit_buf)
         return returns
+
+    def _drain_submit_buf(self):
+        """Loop-side: queue every buffered submission, one pump per shape.
+        A burst of N submissions costs one loop wakeup + one pump, not N."""
+        with self._submit_lock:
+            buf, self._submit_buf = self._submit_buf, []
+            self._submit_scheduled = False
+        shapes: dict[str, TaskSpec] = {}
+        for pt in buf:
+            shape = (_shape_key(pt.spec.resources) + repr(pt.spec.strategy)
+                     + pt.spec.placement_group)
+            self._queues[shape].append(pt.spec.task_id)
+            shapes.setdefault(shape, pt.spec)
+        for shape, spec in shapes.items():
+            self._spawn(self._pump_queue(shape, spec))
 
     def _enqueue_task(self, pt: _PendingTask):
         shape = _shape_key(pt.spec.resources) + repr(pt.spec.strategy) + pt.spec.placement_group
         self._queues[shape].append(pt.spec.task_id)
         self._spawn(self._pump_queue(shape, pt.spec))
+
+    _PUSH_BATCH_MAX = 64
+
+    def _pop_batch(self, shape: str) -> list:
+        """Pop a fair share of the queue for one worker slot.
+
+        Batch size balances RPC amortization (big batches: a burst of
+        trivial tasks costs ~2 frames per _PUSH_BATCH_MAX tasks, the key
+        to the reference's 10k+ tasks/s floor, ray_perf.py:93) against
+        parallelism (cap at the queue's fair share per expected worker so
+        one slot can't swallow a burst that n leased workers could run
+        in parallel).
+        """
+        q = self._queues[shape]
+        if not q:
+            return []
+        # Optimism about in-flight leases is capped: counting all of them
+        # (a burst spawns up to 32) would shrink batches to ~1 task and
+        # forfeit the RPC amortization that IS the throughput win.
+        n_workers = max(1, len(self._leases[shape])
+                        + min(self._lease_requests_in_flight[shape], 4))
+        take = min(self._PUSH_BATCH_MAX, max(1, -(-len(q) // n_workers)))
+        pts = []
+        while q and len(pts) < take:
+            pt = self.pending_tasks.get(q.pop(0))
+            if pt is not None:
+                pts.append(pt)
+        return pts
 
     async def _pump_queue(self, shape: str, template_spec: TaskSpec):
         """Ensure enough leased workers for the queue; dispatch tasks.
@@ -784,14 +1169,15 @@ class CoreWorker:
             if not q:
                 return
             if not s.busy and not s.conn.closed:
-                task_id = q.pop(0)
-                pt = self.pending_tasks.get(task_id)
-                if pt is not None:
+                pts = self._pop_batch(shape)
+                if pts:
                     s.busy = True
-                    asyncio.ensure_future(self._push_task(s, pt, shape))
-        want = len(q)
+                    asyncio.ensure_future(self._push_tasks(s, pts, shape))
+        # Outstanding lease requests are capped in TOTAL (not per pump
+        # call): extra requests just queue at the raylet and churn its
+        # pending-lease timers without adding parallelism.
         in_flight = self._lease_requests_in_flight[shape]
-        max_new = min(want - in_flight, 32)
+        max_new = min(len(q), 32) - in_flight
         for _ in range(max(0, max_new)):
             self._lease_requests_in_flight[shape] += 1
             asyncio.ensure_future(self._request_lease(shape, template_spec))
@@ -836,8 +1222,16 @@ class CoreWorker:
                         raylet_conn = self.raylet
                         _hop = 0
                         continue
-                    slot = _LeaseSlot(conn, resp["lease_id"], resp["worker_id"],
-                                      resp["node_id"], raylet_conn)
+                    slot = _LeaseSlot(
+                        conn, resp["lease_id"], resp["worker_id"],
+                        resp["node_id"], raylet_conn,
+                        worker_addr=[resp["worker_host"],
+                                     resp["worker_port"],
+                                     resp["worker_id"], resp["node_id"]])
+                    conn.handlers["TaskDone"] = functools.partial(
+                        self._handle_task_done, slot, shape)
+                    conn.on_close(functools.partial(
+                        self._on_slot_conn_closed, slot, shape))
                     self._leases[shape].append(slot)
                     await self._on_slot_idle(slot, shape)
                     return
@@ -888,19 +1282,24 @@ class CoreWorker:
         return conn
 
     async def _on_slot_idle(self, slot: _LeaseSlot, shape: str):
+        if slot.outstanding or slot.conn.closed:
+            # A concurrent TaskDone handler already refilled this slot
+            # (or the conn died and close-handling owns the cleanup):
+            # this idle notification is stale.
+            return
         q = self._queues[shape]
         if q:
-            task_id = q.pop(0)
-            pt = self.pending_tasks.get(task_id)
-            if pt is not None:
+            pts = self._pop_batch(shape)
+            if pts:
                 slot.busy = True
-                await self._push_task(slot, pt, shape)
+                await self._push_tasks(slot, pts, shape)
                 return
         # No work: return lease after a grace period (lease reuse window).
         slot.busy = False
         slot.idle_since = time.monotonic()
         await asyncio.sleep(self.config.idle_worker_keep_s)
-        if not slot.busy and slot in self._leases[shape] and not q:
+        if not slot.busy and not slot.outstanding \
+                and slot in self._leases[shape] and not q:
             self._leases[shape].remove(slot)
             try:
                 await slot.raylet.call("ReturnWorker", {"lease_id": slot.lease_id})
@@ -908,22 +1307,63 @@ class CoreWorker:
                 pass
             await slot.conn.close()
 
-    async def _push_task(self, slot: _LeaseSlot, pt: _PendingTask, shape: str):
-        spec = pt.spec
-        pt.pushed_to = slot.node_id
-        self._record_task_event(spec.task_id, spec.name, "RUNNING",
-                                target_node=slot.node_id)
+    async def _push_tasks(self, slot: _LeaseSlot, pts: list, shape: str):
+        """Push a batch of tasks to a leased worker in ONE notify frame.
+
+        Completions STREAM back as TaskDone notifies (opportunistically
+        coalesced worker-side) — required for correctness, not just
+        latency: tasks later in a batch may depend on results of earlier
+        ones (chain pattern), so a single end-of-batch reply would
+        deadlock the worker against its own unsent results.
+
+        No per-push deadline: user tasks may legitimately run for hours;
+        worker death surfaces as a closed connection (the raylet SIGKILLs
+        and we see EOF), the reference's model too (push_normal_task has
+        no execution deadline).
+        """
+        for pt in pts:
+            pt.pushed_to = slot.node_id
+            slot.outstanding[pt.spec.task_id] = pt
+            self._record_task_event(pt.spec.task_id, pt.spec.name, "RUNNING",
+                                    target_node=slot.node_id)
         try:
-            resp = await slot.conn.call("PushTask", {"spec": spec.to_wire()},
-                                        timeout=self.config.rpc_call_timeout_s)
+            await slot.conn.notify(
+                "PushTaskBatch",
+                {"specs": [pt.spec.to_wire() for pt in pts]})
         except (rpc.RpcError, asyncio.TimeoutError, OSError) as e:
-            # Worker died or connection lost → retry or fail.
+            for pt in pts:
+                slot.outstanding.pop(pt.spec.task_id, None)
             if slot in self._leases[shape]:
                 self._leases[shape].remove(slot)
-            await self._handle_worker_failure(pt, shape, str(e))
+            for pt in pts:
+                await self._handle_worker_failure(pt, shape, str(e))
+
+    async def _handle_task_done(self, slot: _LeaseSlot, shape: str,
+                                conn, payload):
+        for task_id, result in payload["results"]:
+            pt = slot.outstanding.pop(task_id, None)
+            if pt is not None:
+                await self._complete_task(pt, result, slot.node_id,
+                                          borrower_id=slot.worker_id,
+                                          borrower_addr=slot.worker_addr)
+        if not slot.outstanding:
+            asyncio.ensure_future(self._on_slot_idle(slot, shape))
+
+    def _on_slot_conn_closed(self, slot: _LeaseSlot, shape: str):
+        """Worker connection died: drop the slot (idle or not) and
+        fail/retry everything still pushed."""
+        if slot in self._leases[shape]:
+            self._leases[shape].remove(slot)
+        if self._shutdown or not slot.outstanding:
             return
-        await self._complete_task(pt, resp, slot.node_id)
-        asyncio.ensure_future(self._on_slot_idle(slot, shape))
+        pts = list(slot.outstanding.values())
+        slot.outstanding.clear()
+
+        async def fail_all():
+            for pt in pts:
+                await self._handle_worker_failure(
+                    pt, shape, "worker connection lost")
+        asyncio.ensure_future(fail_all())
 
     async def _handle_worker_failure(self, pt: _PendingTask, shape: str, reason: str):
         if pt.retries_left != 0:
@@ -948,9 +1388,10 @@ class CoreWorker:
             o.error = (err.meta, err.to_bytes())
             if o.ready_event:
                 o.ready_event.set()
-        self._release_submitted_refs(pt.spec)
+        self._release_submitted_refs(pt)
 
-    async def _complete_task(self, pt: _PendingTask, resp: dict, node_id: str):
+    async def _complete_task(self, pt: _PendingTask, resp: dict, node_id: str,
+                             borrower_id: str = "", borrower_addr=None):
         spec = pt.spec
         if resp.get("status") == "error" and resp.get("retryable") \
                 and pt.retries_left != 0 and (
@@ -986,23 +1427,48 @@ class CoreWorker:
                 if result[0] == "v":
                     o.inline = (bytes(result[1]), bytes(result[2]))
                     o.size = len(o.inline[1])
-                else:  # ["s", node_id, size]
+                else:  # ["s", node_id, size, (nested)]
                     o.locations.add(result[1])
                     o.size = result[2]
                 o.state = OBJ_READY
                 o.lineage_task = spec.task_id
+                # Refs embedded in the returned payload: the executing
+                # worker pre-registered us with their owners; hold them
+                # for as long as this return object lives.
+                if len(result) > 3 and result[3]:
+                    self._track_container(
+                        oid.hex(), [tuple(n) for n in result[3]])
                 if o.ready_event:
                     o.ready_event.set()
-        self._release_submitted_refs(spec)
+        # Borrower handoff BEFORE releasing our own holds: args the worker
+        # still references are registered with their owners first, on the
+        # same ordered owner connections our releases use.
+        for oid_hex, owner_wire in resp.get("borrows") or []:
+            if borrower_id:
+                await self._forward_borrow(oid_hex, owner_wire, borrower_id,
+                                           borrower_addr)
+        self._release_submitted_refs(pt)
 
-    def _release_submitted_refs(self, spec: TaskSpec):
+    def _release_submitted_refs(self, pt):
+        """Release per-submission holds (top-level arg refs + nested refs
+        inside value args). Accepts a _PendingTask or bare TaskSpec."""
+        spec = pt.spec if isinstance(pt, _PendingTask) else pt
+        nested = pt.nested_args if isinstance(pt, _PendingTask) else []
         for a in spec.args:
             if a[0] == "r":
-                o = self.objects.get(a[1])
-                if o is not None:
-                    o.submitted_refs -= 1
-                    if o.submitted_refs <= 0 and o.local_refs <= 0:
-                        self._free_object(a[1])
+                self._release_one_hold(a[1])
+        for oid_hex, _owner in nested:
+            self._release_one_hold(oid_hex)
+
+    def _release_one_hold(self, oid_hex: str):
+        o = self.objects.get(oid_hex)
+        if o is not None:
+            o.submitted_refs -= 1
+            if o.submitted_refs <= 0 and o.local_refs <= 0 \
+                    and not o.borrowers:
+                self._free_object(oid_hex)
+        else:
+            self.borrow_decr(oid_hex)
 
     # ---------- owner-side status service ----------
 
@@ -1027,9 +1493,22 @@ class CoreWorker:
             return {"status": "failed", "meta": o.error[0], "data": o.error[1]}
         if o.state == OBJ_PENDING:
             return {"status": "pending"}
+        # Refs embedded in this payload: pre-register the requester as
+        # borrower with their owners (ordered before any release of this
+        # container's own holds on the same owner connections).
+        nested = self._container_nested.get(oid_hex) or []
+        requester = payload.get("requester", "")
+        requester_addr = payload.get("requester_addr")
+        if nested and requester:
+            for n_oid, n_owner in nested:
+                await self._forward_borrow(n_oid, n_owner, requester,
+                                           requester_addr)
+        nested_wire = [[n, w] for n, w in nested]
         if o.inline is not None:
-            return {"status": "inline", "meta": o.inline[0], "data": o.inline[1]}
-        return {"status": "stored", "locations": sorted(o.locations)}
+            return {"status": "inline", "meta": o.inline[0],
+                    "data": o.inline[1], "nested": nested_wire}
+        return {"status": "stored", "locations": sorted(o.locations),
+                "nested": nested_wire}
 
     # ---------- execution (worker side) ----------
 
@@ -1038,6 +1517,39 @@ class CoreWorker:
         fut = asyncio.get_running_loop().create_future()
         self._exec_queue.put((spec, fut))
         return await fut
+
+    async def _handle_push_task_batch(self, conn, payload):
+        """Notify sink: execute a batch of task specs sequentially,
+        STREAMING each completion back as a TaskDone notify (coalesced by
+        _queue_task_done). The whole batch is ONE exec-queue item so a
+        burst of trivial tasks costs one thread handoff, not N."""
+        specs = [TaskSpec.from_wire(w) for w in payload["specs"]]
+        self._exec_queue.put((specs, conn))
+
+    def _queue_task_done(self, conn, task_id: str, result: dict):
+        """Exec-thread side: buffer a completion for `conn` and schedule
+        ONE loop-side flush. Results produced while the loop is busy
+        coalesce into a single TaskDone frame (natural batching — no
+        timers), while a lone completion flushes immediately (dependency
+        chains need results visible before the batch finishes)."""
+        with self._done_lock:
+            self._done_buf.setdefault(conn, []).append([task_id, result])
+            wake = conn not in self._done_scheduled
+            if wake:
+                self._done_scheduled.add(conn)
+        if wake:
+            try:
+                self.loop.call_soon_threadsafe(self._flush_task_done, conn)
+            except RuntimeError:
+                pass
+
+    def _flush_task_done(self, conn):
+        with self._done_lock:
+            results = self._done_buf.pop(conn, [])
+            self._done_scheduled.discard(conn)
+        if results and not conn.closed:
+            asyncio.ensure_future(
+                conn.notify("TaskDone", {"results": results}))
 
     async def _handle_cancel_task(self, conn, payload):
         return {"ok": False, "reason": "running-task cancel not supported yet"}
@@ -1074,10 +1586,16 @@ class CoreWorker:
                 continue
             if item is None:
                 break
-            spec, fut = item
-            result = self._execute_task(spec)
-            self.loop.call_soon_threadsafe(
-                lambda f=fut, r=result: (not f.done()) and f.set_result(r))
+            spec, sink = item
+            if isinstance(spec, list):  # batch item: sink is the owner conn
+                for s in spec:
+                    self._queue_task_done(sink, s.task_id,
+                                          self._execute_task(s))
+            else:  # single item: sink is a future
+                result = self._execute_task(spec)
+                self.loop.call_soon_threadsafe(
+                    lambda f=sink, r=result: (not f.done()) and
+                    f.set_result(r))
 
     def _start_actor_concurrency(self, max_concurrency: int) -> None:
         """Spawn extra execution threads so up to max_concurrency actor
@@ -1111,12 +1629,21 @@ class CoreWorker:
             return loop
 
     def _resolve_args(self, spec: TaskSpec):
-        from ray_tpu._private.api_internal import ObjectRef
+        """Materialize arg values. Borrowed refs rebuilt from value args
+        are collected: those still held when the task finishes are
+        reported in the reply so the submitter can register this worker
+        with their owners (reference: reference_count.cc borrows returned
+        in the PushTask reply)."""
+        from ray_tpu._private.api_internal import deser_context
 
         values = []
+        collected: list = []
         for a in spec.args:
             if a[0] == "v":
-                _, value = serialization.deserialize(bytes(a[1]), bytes(a[2]))
+                with deser_context() as dsink:
+                    _, value = serialization.deserialize(
+                        bytes(a[1]), bytes(a[2]))
+                collected.extend(dsink)
                 values.append(value)
             else:
                 oid = ObjectID.from_hex(a[1])
@@ -1128,7 +1655,21 @@ class CoreWorker:
             kwargs = dict(zip(spec.kwargs_keys, kw_vals))
         else:
             pos, kwargs = values, {}
+        self._exec_tls.arg_borrows = collected
         return pos, kwargs
+
+    def _surviving_borrows(self) -> list:
+        """Borrowed arg refs the user code still holds at completion
+        (count > 0): reported in the reply; the submitter forwards them to
+        the owners before releasing its own submission holds."""
+        collected = getattr(self._exec_tls, "arg_borrows", None) or []
+        self._exec_tls.arg_borrows = None
+        out = []
+        for oid_hex, owner in collected:
+            if self.borrow_mark_registered(oid_hex):
+                out.append([oid_hex,
+                            owner.to_wire() if owner is not None else None])
+        return out
 
     def _execute_task(self, spec: TaskSpec) -> dict:
         from ray_tpu.runtime_env import runtime_env_context
@@ -1186,19 +1727,25 @@ class CoreWorker:
                         result = asyncio.run_coroutine_threadsafe(
                             result, self._actor_async_loop()).result()
             else:
-                fn = self._run(self._fetch_function(spec.func_key))
+                # Plain-dict cache hit avoids a cross-thread loop
+                # round-trip per task (hot path: every task execution).
+                fn = self._fn_cache.get(spec.func_key)
+                if fn is None:
+                    fn = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
                 with runtime_env_context(spec.runtime_env):
                     with tracing.execute_span(spec.name, spec.task_id,
                                               spec.trace_ctx):
                         result = fn(*args, **kwargs)
             return {"status": "ok",
-                    "results": self._package_results(spec, result)}
+                    "results": self._package_results(spec, result),
+                    "borrows": self._surviving_borrows()}
         except Exception as e:
             tb = traceback.format_exc()
             err = serialization.serialize_exception(e)
             return {"status": "error", "error": [err.meta, err.to_bytes()],
-                    "retryable": not isinstance(e, exc.RayTpuError)}
+                    "retryable": not isinstance(e, exc.RayTpuError),
+                    "borrows": self._surviving_borrows()}
         finally:
             self._current_task_id = prev_task_id
 
@@ -1215,14 +1762,27 @@ class CoreWorker:
                     f"but returned {len(results)} values")
         out = []
         task_id = TaskID.from_hex(spec.task_id)
+        from ray_tpu._private.api_internal import collect_nested_refs
+
+        caller = Address.from_wire(spec.owner).worker_id if spec.owner else ""
         for i, value in enumerate(results):
-            sobj = serialization.serialize(value)
+            with collect_nested_refs() as sink:
+                sobj = serialization.serialize(value)
+            if sink and caller:
+                # Refs embedded in the return payload: register the CALLER
+                # as borrower with each owner NOW (on our ordered owner
+                # connections), before our own holds can be released —
+                # this is what makes the return handoff race-free.
+                for oid_hex, owner_wire in sink:
+                    self._run(self._forward_borrow(oid_hex, owner_wire,
+                                                   caller, spec.owner))
+            nested = [[oid_hex, owner_wire] for oid_hex, owner_wire in sink]
             if sobj.total_size <= self.config.max_inline_object_size:
-                out.append(["v", sobj.meta, sobj.to_bytes()])
+                out.append(["v", sobj.meta, sobj.to_bytes(), nested])
             else:
                 oid = ObjectID.for_task_return(task_id, i + 1)
                 self._run(self._write_to_store_safe(oid, sobj))
-                out.append(["s", self.node_id, sobj.total_size])
+                out.append(["s", self.node_id, sobj.total_size, nested])
         return out
 
     async def _write_to_store_safe(self, oid, sobj):
@@ -1362,8 +1922,11 @@ class CoreWorker:
                 spec.actor_incarnation = restarts
 
     def submit_actor_task(self, actor_id: str, spec: TaskSpec,
-                          max_task_retries: int = 0) -> list[ObjectID]:
+                          max_task_retries: int = 0,
+                          nested_args: list | None = None) -> list[ObjectID]:
         st = self._actor_state(actor_id)
+        if nested_args:
+            self._actor_task_nested[spec.task_id] = nested_args
         spec.actor_seq = st["seq"]
         spec.actor_incarnation = st["incarnation"]
         st["seq"] += 1
@@ -1418,8 +1981,15 @@ class CoreWorker:
                     resp = await conn.call("ActorCall", {
                         "spec": spec.to_wire(), "caller_id": self.worker_id},
                         timeout=None)
-                    pt = _PendingTask(spec, 0)
-                    await self._complete_task(pt, resp, "")
+                    pt = _PendingTask(
+                        spec, 0,
+                        nested_args=self._actor_task_nested.pop(
+                            spec.task_id, None))
+                    actor_wid = (Address.from_wire(st["address"]).worker_id
+                                 if st.get("address") else "")
+                    await self._complete_task(pt, resp, "",
+                                              borrower_id=actor_wid,
+                                              borrower_addr=st.get("address"))
                     return
                 except exc.ActorDiedError as e:
                     last_reason = str(e)
@@ -1432,7 +2002,9 @@ class CoreWorker:
                     continue
             err = serialization.serialize_exception(
                 exc.ActorDiedError(f"actor task {spec.name} failed: {last_reason}"))
-            pt = _PendingTask(spec, 0)
+            pt = _PendingTask(
+                spec, 0,
+                nested_args=self._actor_task_nested.pop(spec.task_id, None))
             self._complete_task_error(pt, err)
             # This task holds a seq-no under the current incarnation that
             # will never be sent; tell the actor to skip it, or every later

@@ -20,12 +20,15 @@ def _rate(n, dt):
     return round(n / dt, 1)
 
 
-def bench_tasks(n: int = 200) -> dict:
+def bench_tasks(n: int = 4000) -> dict:
     @ray_tpu.remote
     def noop():
         return None
 
-    ray_tpu.get(noop.remote())  # warm the worker pool
+    # Warm the worker pool AND the lease ramp: steady-state throughput is
+    # what the reference's ray_perf.py:93 measures (it runs multi-second
+    # timed windows), so the ramp must not dominate the timed burst.
+    ray_tpu.get([noop.remote() for _ in range(200)])
     t0 = time.perf_counter()
     ray_tpu.get([noop.remote() for _ in range(n)])
     dt = time.perf_counter() - t0

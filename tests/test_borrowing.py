@@ -1,0 +1,161 @@
+"""Distributed borrower-protocol tests (reference semantics:
+src/ray/core_worker/reference_count.cc — nested refs serialized into
+payloads keep objects alive exactly as long as some holder exists, and no
+longer; python/ray/tests/test_reference_counting*.py is the spec model).
+"""
+
+import gc
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private.api_internal import ObjectRef, core_worker_or_none
+from ray_tpu._private.ids import ObjectID
+
+
+def _driver_owns(oid_hex: str) -> bool:
+    cw = core_worker_or_none()
+    return oid_hex in cw.objects
+
+
+def _wait(pred, timeout=10.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    raise AssertionError(f"condition not reached: {msg}")
+
+
+def test_return_nested_put_ref(ray_start_regular):
+    """A ref created (put) inside a task survives the task: the caller
+    becomes a borrower of the worker-owned object."""
+    @ray_tpu.remote
+    def make():
+        inner = ray_tpu.put({"payload": 123})
+        return [inner]
+
+    (inner_ref,) = ray_tpu.get(make.remote())
+    # Far past task completion, the worker-owned object is still alive
+    # because this process is registered as a borrower.
+    time.sleep(1.0)
+    assert ray_tpu.get(inner_ref) == {"payload": 123}
+    assert ray_tpu.get(inner_ref) == {"payload": 123}
+
+
+def test_arg_nested_ref_released_after_task(ray_start_regular):
+    """A driver-owned ref passed INSIDE a list arg is held only until the
+    task completes; after the driver drops its handle the object frees
+    (round 1 pinned it for the job lifetime)."""
+    @ray_tpu.remote
+    def use(box):
+        return ray_tpu.get(box[0])
+
+    ref = ray_tpu.put("nested-payload")
+    oid_hex = ref.hex()
+    assert ray_tpu.get(use.remote([ref])) == "nested-payload"
+    assert _driver_owns(oid_hex)
+    del ref
+    gc.collect()
+    _wait(lambda: not _driver_owns(oid_hex), msg="nested arg ref freed")
+
+
+def test_borrower_outlives_owner_task(ray_start_regular):
+    """An actor that stashes a borrowed ref keeps the object alive after
+    the driver drops its own handle; releasing the stash frees it."""
+    @ray_tpu.remote
+    class Keeper:
+        def keep(self, box):
+            self.box = box
+            return True
+
+        def read(self):
+            return ray_tpu.get(self.box[0])
+
+        def drop(self):
+            self.box = None
+            gc.collect()
+            return True
+
+    k = Keeper.remote()
+    ref = ray_tpu.put({"kept": 1})
+    oid_hex = ref.hex()
+    assert ray_tpu.get(k.keep.remote([ref]))
+    del ref
+    gc.collect()
+    time.sleep(1.0)
+    # Driver dropped its handle, but the actor's borrow keeps it alive.
+    assert _driver_owns(oid_hex)
+    assert ray_tpu.get(k.read.remote()) == {"kept": 1}
+    assert ray_tpu.get(k.drop.remote())
+    _wait(lambda: not _driver_owns(oid_hex),
+          msg="object freed after borrower released")
+
+
+def test_owner_death_fails_borrower_get(ray_start_regular):
+    """Owner (an actor process) dies: the borrower's get on the orphaned
+    ref raises (reference: OwnerDiedError semantics)."""
+    @ray_tpu.remote
+    class Owner:
+        def make(self):
+            return [ray_tpu.put("actor-owned")]
+
+    o = Owner.remote()
+    (inner,) = ray_tpu.get(o.make.remote())
+    assert ray_tpu.get(inner) == "actor-owned"
+    ray_tpu.kill(o)
+    time.sleep(0.5)
+    with pytest.raises((exc.OwnerDiedError, exc.ObjectLostError,
+                        exc.RayTpuError)):
+        ray_tpu.get(inner, timeout=10)
+
+
+def test_forwarded_borrow_chain(ray_start_regular):
+    """Driver ref forwarded task1 -> task2: the chain of holds keeps the
+    object alive end to end, then releases."""
+    @ray_tpu.remote
+    def inner_task(box):
+        return ray_tpu.get(box[0]) * 2
+
+    @ray_tpu.remote
+    def outer_task(box):
+        return ray_tpu.get(inner_task.remote(box))
+
+    ref = ray_tpu.put(21)
+    oid_hex = ref.hex()
+    assert ray_tpu.get(outer_task.remote([ref])) == 42
+    del ref
+    gc.collect()
+    _wait(lambda: not _driver_owns(oid_hex), msg="forwarded ref freed")
+
+
+def test_nested_ref_in_shm_stored_return(ray_start_regular):
+    """Nested ref inside a LARGE (shm-stored, not inline) return value
+    still resolves (container nested list travels on the wire)."""
+    import numpy as np
+
+    @ray_tpu.remote
+    def make():
+        inner = ray_tpu.put("big-container-inner")
+        return {"blob": np.zeros(300_000), "ref": inner}
+
+    out = ray_tpu.get(make.remote())
+    assert ray_tpu.get(out["ref"]) == "big-container-inner"
+
+
+def test_bare_pickle_falls_back_to_pin(ray_start_regular):
+    """User-level pickle outside the runtime keeps the legacy job-lifetime
+    pin (no recipient to track)."""
+    import pickle
+
+    ref = ray_tpu.put("pinned")
+    blob = pickle.dumps(ref)
+    oid_hex = ref.hex()
+    del ref
+    gc.collect()
+    time.sleep(0.5)
+    assert _driver_owns(oid_hex)  # pinned despite no live handle
+    ref2 = pickle.loads(blob)
+    assert ray_tpu.get(ref2) == "pinned"
