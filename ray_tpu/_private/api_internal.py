@@ -224,10 +224,13 @@ def _build_resources(opts: dict, default_cpus: float) -> dict:
 
 def _effective_runtime_env(task_env: dict | None) -> dict | None:
     """Task env merged over the job-level default (reference semantics:
-    job runtime_env inherited unless the task overrides per-field)."""
-    from ray_tpu.runtime_env import RuntimeEnv, get_job_runtime_env
+    job runtime_env inherited unless the task overrides per-field), with
+    local working_dir/py_modules dirs packed + uploaded to the GCS KV as
+    content-addressed packages (reference: working_dir upload)."""
+    from ray_tpu.runtime_env import (RuntimeEnv, get_job_runtime_env,
+                                     prepare_for_wire)
 
-    return RuntimeEnv.merge(get_job_runtime_env(), task_env)
+    return prepare_for_wire(RuntimeEnv.merge(get_job_runtime_env(), task_env))
 
 
 def _wire_strategy(opts: dict):

@@ -715,6 +715,11 @@ class GcsServer:
         if job:
             job["status"] = payload.get("status", "SUCCEEDED")
             job["end_time"] = time.time()
+        # Raylets release the job's runtime-env references on this event
+        # (reference: runtime-env URI GC when the last referencing job
+        # exits, runtime_env ARCHITECTURE.md).
+        await self.publish("JOB", {"event": "finished",
+                                   "job_id": payload["job_id"]})
         return {"ok": True}
 
     async def handle_list_jobs(self, conn, payload):

@@ -197,6 +197,7 @@ class Raylet:
             "PullObject": self.handle_pull_object,
             "FreeObjects": self.handle_free_objects,
             "MakeRoom": self.handle_make_room,
+            "EnsureRuntimeEnv": self.handle_ensure_runtime_env,
             "GetNodeInfo": self.handle_get_node_info,
             "ReportWorkerDeath": self.handle_report_worker_death,
             "WorkerBlocked": self.handle_worker_blocked,
@@ -258,7 +259,20 @@ class Raylet:
         })
         if resp.get("config"):
             self.config = Config.from_json(resp["config"])
-        await self.gcs_conn.call("Subscribe", {"channels": ["NODE"]})
+        await self.gcs_conn.call("Subscribe", {"channels": ["NODE", "JOB"]})
+        # Node-side runtime-env provisioning (reference: per-node
+        # RuntimeEnvAgent, agent_manager.cc): pip envs + package URIs,
+        # cached per node, ref-counted per job, GC'd on job finish.
+        from ray_tpu._private.runtime_env_manager import RuntimeEnvManager
+
+        async def _kv_get(ns, key):
+            r = await self.gcs_conn.call(
+                "KVGet", {"ns": ns, "key": key.encode()})
+            return r.get("value")
+
+        self.runtime_env_manager = RuntimeEnvManager(
+            os.path.join(self.session_dir, f"node-{self.node_id[:8]}"),
+            kv_get=_kv_get)
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._reap_loop()))
         if self.config.memory_usage_threshold > 0:
@@ -395,7 +409,7 @@ class Raylet:
                     old, self.gcs_conn = self.gcs_conn, conn
                     if old is not None and not old.closed:
                         await old.close()
-                    await conn.call("Subscribe", {"channels": ["NODE"]})
+                    await conn.call("Subscribe", {"channels": ["NODE", "JOB"]})
                     while self._pending_death_reports:
                         report = self._pending_death_reports.pop(0)
                         try:
@@ -413,7 +427,16 @@ class Raylet:
             await asyncio.sleep(0.5)
         return False
 
+    async def handle_ensure_runtime_env(self, conn, payload):
+        ctx = await self.runtime_env_manager.ensure(
+            payload["env"], payload.get("job_id", ""))
+        return ctx
+
     async def _on_publish(self, conn, payload):
+        if payload.get("channel") == "JOB" \
+                and payload["message"].get("event") == "finished":
+            self.runtime_env_manager.release_job(payload["message"]["job_id"])
+            return
         if payload.get("channel") == "NODE" and payload["message"].get("event") == "dead":
             # Drop cached peer connection to the dead node.
             msg = payload["message"]

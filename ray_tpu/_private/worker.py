@@ -1008,6 +1008,30 @@ class CoreWorker:
         # Refs embedded in this container's payload lose their hold.
         self._release_container(oid_hex)
 
+    # ---------- runtime env provisioning ----------
+
+    _renv_cache: dict | None = None
+
+    def ensure_runtime_env(self, env: dict, job_id: str = "") -> dict:
+        """Materialize provisioned env parts via this node's raylet.
+        Cached per (job, env): a pooled worker reused by a NEW job must
+        re-register that job's reference with the raylet, or job-finish GC
+        could delete an env dir the new job still uses."""
+        if self._renv_cache is None:
+            self._renv_cache = {}
+        job_id = job_id or self.job_id
+        fields = {k: env[k] for k in ("pip", "working_dir", "py_modules")
+                  if k in env}
+        key = (job_id, repr(sorted(fields.items(), key=lambda kv: kv[0])))
+        ctx = self._renv_cache.get(key)
+        if ctx is None:
+            # Generous timeout: first pip-env creation may download/build.
+            ctx = self._run(self.raylet.call(
+                "EnsureRuntimeEnv", {"env": fields, "job_id": job_id},
+                timeout=650))
+            self._renv_cache[key] = ctx
+        return ctx
+
     # ---------- function table ----------
 
     def register_function(self, fn) -> str:
@@ -1707,7 +1731,8 @@ class CoreWorker:
                 args, kwargs = self._resolve_args(spec)
                 # Actor envs persist: the process is dedicated to the actor
                 # (reference: runtime-env-keyed workers, worker_pool.cc).
-                with runtime_env_context(spec.runtime_env, persistent=True):
+                with runtime_env_context(spec.runtime_env, persistent=True,
+                                         job_id=spec.job_id):
                     with tracing.execute_span(spec.name, spec.task_id,
                                               spec.trace_ctx):
                         self._actor_instance = cls(*args, **kwargs)
@@ -1733,7 +1758,8 @@ class CoreWorker:
                 if fn is None:
                     fn = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
-                with runtime_env_context(spec.runtime_env):
+                with runtime_env_context(spec.runtime_env,
+                                         job_id=spec.job_id):
                     with tracing.execute_span(spec.name, spec.task_id,
                                               spec.trace_ctx):
                         result = fn(*args, **kwargs)
