@@ -66,6 +66,11 @@ class Config:
     # available (tmpfs — mmap writes at memory speed, like plasma), else
     # the session dir (disk-backed, ~10x slower puts).
     object_store_dir: str = ""
+    # External spill target ("" = node-local spill dir). URI with a
+    # registered external-storage scheme, e.g. "file:///mnt/shared/spill"
+    # (reference: object_spilling_config / external_storage.py:72).
+    object_spilling_uri: str = ""
+
 
     # --- memory monitor (reference: memory_monitor.h:52,
     # worker_killing_policy.h:34) ---

@@ -35,6 +35,9 @@ def _load():
     lib.transfer_fetch.restype = ctypes.c_int
     lib.transfer_fetch.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                    ctypes.c_int, ctypes.c_char_p]
+    lib.transfer_fetch_multi.restype = ctypes.c_int
+    lib.transfer_fetch_multi.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.c_char_p]
     _lib = lib
     return lib
 
@@ -73,3 +76,13 @@ def fetch(store_path: str, host: str, port: int, oid_bytes: bytes) -> int:
     lib = _load()
     return lib.transfer_fetch(store_path.encode(), host.encode(), port,
                               oid_bytes)
+
+
+def fetch_multi(store_path: str, peers: list, oid_bytes: bytes) -> int:
+    """Blocking native pull striping chunks across several peers
+    ([(host, port), ...]); large objects fan out over parallel
+    connections (transfer.cc stripe workers + pull admission)."""
+    lib = _load()
+    csv = ",".join(f"{h}:{p}" for h, p in peers)
+    return lib.transfer_fetch_multi(store_path.encode(), csv.encode(),
+                                    oid_bytes)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import ctypes
 import mmap
 import os
+import threading
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.native_build import ensure_built
@@ -86,6 +87,10 @@ class ObjectStoreClient:
         lib = _get_lib()
         self._lib = lib
         self._path = path
+        # RLock: release() can re-enter on the SAME thread when a ctypes
+        # call triggers cyclic GC that collects a _ShmPin (whose __del__
+        # calls release again) — a plain Lock would self-deadlock.
+        self._release_lock = threading.RLock()
         if create:
             self._handle = lib.store_create_arena(path.encode(), size, table_capacity)
         else:
@@ -152,7 +157,13 @@ class ObjectStoreClient:
         return meta, data
 
     def release(self, object_id: ObjectID) -> None:
-        self._lib.store_release(self._handle, object_id.binary())
+        # May be called from GC (_ShmPin.__del__) on any thread, possibly
+        # after close() at shutdown: the lock + None check keep a late
+        # release from reaching C with a detached handle (segfault).
+        with self._release_lock:
+            if self._handle is None:
+                return
+            self._lib.store_release(self._handle, object_id.binary())
 
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.store_contains(self._handle, object_id.binary()))
@@ -197,7 +208,9 @@ class ObjectStoreClient:
         }
 
     def close(self) -> None:
-        if self._handle:
+        with self._release_lock:
+            handle, self._handle = self._handle, None
+        if handle:
             try:
                 self._view.release()
                 self._mm.close()
@@ -205,5 +218,4 @@ class ObjectStoreClient:
                 # Zero-copy views handed to callers are still alive; leave
                 # the mapping open (the OS reclaims it at process exit).
                 pass
-            self._lib.store_detach(self._handle)
-            self._handle = None
+            self._lib.store_detach(handle)

@@ -219,6 +219,22 @@ class Raylet:
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self.host, self.port = await self.server.start(host, port)
         os.makedirs(self.session_dir, exist_ok=True)
+        # Fetch the cluster config BEFORE sizing the arena: store size and
+        # spill backend are config-driven, and the later RegisterNode
+        # response arrives only after the store must already exist
+        # (reference: raylets load the system config from the GCS at boot,
+        # node_manager.cc HandleGetSystemConfig).
+        try:
+            boot = await rpc.connect_retry(
+                self.gcs_host, self.gcs_port, name="raylet-boot->gcs",
+                timeout=self.config.rpc_connect_timeout_s)
+            resp = await boot.call("GetConfig", {}, timeout=10)
+            if resp.get("config"):
+                self.config = Config.from_json(resp["config"])
+            await boot.close()
+        except Exception:
+            logger.warning("config fetch from GCS failed; using defaults",
+                           exc_info=True)
         self.store = ObjectStoreClient(
             self.store_path, create=True,
             size=int(self.total_resources.get(
@@ -230,6 +246,14 @@ class Raylet:
         self.store.set_auto_evict(False)
         self.spill_dir = os.path.join(self.session_dir,
                                       f"spilled-{self.node_id[:12]}")
+        # External spill backend (reference: external_storage.py:72):
+        # object_spilling_uri routes spills to a URI store instead of the
+        # node-local dir; entries in self.spilled then hold full URIs.
+        self._ext_storage = None
+        if self.config.object_spilling_uri:
+            from ray_tpu._private.external_storage import storage_for
+
+            self._ext_storage = storage_for(self.config.object_spilling_uri)
         self.spilled: dict[str, tuple[str, int, int]] = {}  # oid -> (path, meta_size, size)
         self._spill_lock = asyncio.Lock()
         self._spilled_bytes = 0
@@ -1092,17 +1116,32 @@ class Raylet:
                 if got is None:
                     continue
                 meta, data = got
-                path = os.path.join(self.spill_dir, oid_hex)
+                if self._ext_storage is not None:
+                    # External URI backend (reference:
+                    # external_storage.py:72 spill to URI store).
+                    def write_ext(oid_hex=oid_hex, meta=meta, data=data):
+                        return self._ext_storage.put(
+                            oid_hex, bytes(meta) + bytes(data))
 
-                def write_file(path=path, meta=meta, data=data):
-                    with open(path, "wb") as f:
-                        f.write(meta)
-                        f.write(data)
+                    try:
+                        path = await asyncio.to_thread(write_ext)
+                    except Exception:
+                        logger.exception("external spill failed")
+                        continue
+                    finally:
+                        self.store.release(oid)
+                else:
+                    path = os.path.join(self.spill_dir, oid_hex)
 
-                try:
-                    await asyncio.to_thread(write_file)
-                finally:
-                    self.store.release(oid)
+                    def write_file(path=path, meta=meta, data=data):
+                        with open(path, "wb") as f:
+                            f.write(meta)
+                            f.write(data)
+
+                    try:
+                        await asyncio.to_thread(write_file)
+                    finally:
+                        self.store.release(oid)
                 # Non-forced delete: if a reader grabbed it between
                 # candidate selection and now, keep it in shm and drop the
                 # file.
@@ -1113,10 +1152,18 @@ class Raylet:
                     self._num_spilled += 1
                     freed += size
                 else:
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
+                    # Delete-refused race: a reader re-pinned the object
+                    # between candidate selection and delete; the object
+                    # stays in shm, so drop the just-written blob from
+                    # whichever backend holds it.
+                    if self._ext_storage is not None and "://" in path:
+                        await asyncio.to_thread(self._ext_storage.delete,
+                                                path)
+                    else:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
             if freed:
                 logger.info("spilled %d objects (%.1f MB) to %s",
                             self._num_spilled, freed / 1e6, self.spill_dir)
@@ -1146,12 +1193,14 @@ class Raylet:
         path, meta_size, size = entry
 
         def read_file():
+            if self._ext_storage is not None and "://" in path:
+                return self._ext_storage.get(path)
             with open(path, "rb") as f:
                 return f.read()
 
         try:
             blob = await asyncio.to_thread(read_file)
-        except OSError:
+        except OSError:  # includes FileNotFoundError from URI backends
             return False
         try:
             buf = await self._create_with_room(oid, len(blob), meta_size)
@@ -1167,10 +1216,13 @@ class Raylet:
         self.spilled.pop(oid.hex(), None)
         self._spilled_bytes -= size
         self._num_restored += 1
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        if self._ext_storage is not None and "://" in path:
+            await asyncio.to_thread(self._ext_storage.delete, path)
+        else:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         return True
 
     async def handle_make_room(self, conn, payload):
@@ -1240,14 +1292,23 @@ class Raylet:
                 return {"ok": True}
             locations = payload.get("locations") or []
             last_err = "no locations"
+            # Native plane first: ONE multi-peer call stripes chunks
+            # across every location that has a transfer server
+            # (reference: pull_manager requests chunks from all copies).
+            native_peers = [
+                info for nid in locations
+                if (info := self.cluster_view.get(nid)) is not None
+                and info.get("transfer_port")]
+            if native_peers:
+                if await self._native_pull(native_peers, oid):
+                    self._pull_locks.pop(oid_hex, None)
+                    return {"ok": True}
+                last_err = "native pull failed from all peers"
             for nid in locations:
                 info = self.cluster_view.get(nid)
                 if info is None:
                     continue
                 try:
-                    if await self._native_pull(info, oid):
-                        self._pull_locks.pop(oid_hex, None)
-                        return {"ok": True}
                     peer = await self._peer_conn(info["host"], info["raylet_port"])
                     ok = await self._pull_from(peer, oid)
                     if ok:
@@ -1259,19 +1320,20 @@ class Raylet:
             self._pull_locks.pop(oid_hex, None)
             return {"ok": False, "reason": last_err}
 
-    async def _native_pull(self, info: dict, oid: ObjectID) -> bool:
-        """Pull via the peer's C++ transfer server (bulk bytes stream
-        shm-to-shm without touching Python). False = use the RPC path."""
-        tport = info.get("transfer_port") or 0
-        if not tport:
+    async def _native_pull(self, infos: list, oid: ObjectID) -> bool:
+        """Pull via peers' C++ transfer servers (bulk bytes stream
+        shm-to-shm without touching Python; chunks stripe across peers).
+        False = use the RPC path."""
+        peers = [(info["host"], info["transfer_port"]) for info in infos]
+        if not peers:
             return False
         from ray_tpu._private import native_transfer
 
         loop = asyncio.get_running_loop()
         try:
             rc = await loop.run_in_executor(
-                None, native_transfer.fetch, self.store_path, info["host"],
-                tport, oid.binary())
+                None, native_transfer.fetch_multi, self.store_path, peers,
+                oid.binary())
         except Exception:
             return False
         if rc == -3:
@@ -1283,8 +1345,8 @@ class Raylet:
             except Exception:
                 return False
             rc = await loop.run_in_executor(
-                None, native_transfer.fetch, self.store_path, info["host"],
-                tport, oid.binary())
+                None, native_transfer.fetch_multi, self.store_path, peers,
+                oid.binary())
         return rc == 0
 
     async def _pull_from(self, peer: rpc.Connection, oid: ObjectID) -> bool:
@@ -1323,10 +1385,14 @@ class Raylet:
             entry = self.spilled.pop(oid_hex, None)
             if entry is not None:
                 self._spilled_bytes -= entry[2]
-                try:
-                    os.unlink(entry[0])
-                except OSError:
-                    pass
+                if self._ext_storage is not None and "://" in entry[0]:
+                    await asyncio.to_thread(self._ext_storage.delete,
+                                            entry[0])
+                else:
+                    try:
+                        os.unlink(entry[0])
+                    except OSError:
+                        pass
         return {"ok": True}
 
     async def handle_get_node_info(self, conn, payload):

@@ -64,6 +64,32 @@ OBJ_READY = "ready"
 OBJ_FAILED = "failed"
 
 
+class _ShmPin:
+    """Holds one store read-reference for a zero-copy payload.
+
+    Deserialized numpy arrays are views into shm; building them from
+    `memoryview(_ShmPin)` (PEP 688 __buffer__) makes every view keep this
+    object alive, and the LAST view's death releases the store ref —
+    the pure-Python equivalent of plasma's PlasmaBuffer destructor
+    (reference: plasma client buffer lifetime)."""
+
+    __slots__ = ("_mv", "_store", "_oid")
+
+    def __init__(self, mv, store, oid):
+        self._mv = mv
+        self._store = store
+        self._oid = oid
+
+    def __buffer__(self, flags):
+        return memoryview(self._mv)
+
+    def __del__(self):
+        try:
+            self._store.release(self._oid)
+        except Exception:
+            pass  # store already torn down at interpreter exit
+
+
 class _OwnedObject:
     __slots__ = ("state", "inline", "locations", "lineage_task", "error",
                  "ready_event", "local_refs", "submitted_refs", "size",
@@ -170,9 +196,6 @@ class CoreWorker:
         self._task_counter = itertools.count(1)
         self._default_task_id = TaskID.from_random()
         self._exec_tls = threading.local()  # per-thread current task id
-        # Pinned shm reads: objects whose zero-copy buffers escaped to user
-        # code; we hold the shm ref for process lifetime (see module docs).
-        self._pinned_reads: set[str] = set()
         # executor
         self._exec_queue: _queue.Queue = _queue.Queue()
         self._actor_instance = None
@@ -397,7 +420,12 @@ class CoreWorker:
             o.ready_event.set()
 
     async def _write_to_store(self, oid: ObjectID, sobj):
-        for attempt in (0, 1):
+        # Several MakeRoom rounds: concurrent writers race for freshly
+        # spilled space, so one retry is not enough under load
+        # (reference: plasma's create_request_queue keeps create requests
+        # queued until the spill pipeline frees room).
+        attempts = 5
+        for attempt in range(attempts):
             try:
                 if not self.store.contains(oid):
                     meta = sobj.meta
@@ -407,7 +435,7 @@ class CoreWorker:
                     self.store.seal(oid)
                 return
             except ObjectStoreFullError:
-                if attempt:
+                if attempt == attempts - 1:
                     raise
                 # Ask the raylet to spill idle objects to disk, then retry
                 # (reference: plasma create-retry via local_object_manager
@@ -421,6 +449,8 @@ class CoreWorker:
                     raise ObjectStoreFullError(
                         f"store full and spill request failed "
                         f"({sobj.total_size} bytes)") from None
+                if attempt:
+                    await asyncio.sleep(0.05 * attempt)
             except Exception as e:
                 if "already exists" not in str(e):
                     raise
@@ -499,14 +529,25 @@ class CoreWorker:
                 oid_hex = oid.hex()
                 prereg = ({n[0] for n in self._container_nested.get(oid_hex, [])}
                           | self._fetched_prereg.pop(oid_hex, set()))
-                with deser_context(prereg) as dsink:
-                    kind, value = serialization.deserialize(meta, data)
-                self._register_new_borrows(dsink)
                 if pin is not None and _has_buffers(meta):
-                    self._pinned_reads.add(oid.hex())
-                elif pin is not None:
-                    self.store.release(oid)
+                    # Zero-copy payload: DONATE the store read-ref to a
+                    # _ShmPin that every deserialized view keeps alive
+                    # (plasma-buffer semantics — the pin dies with the
+                    # last numpy view, so spilling/eviction can reclaim
+                    # the slot; round 1 pinned for process lifetime,
+                    # which deadlocks restores in a small arena).
+                    shm_owner = _ShmPin(data, self.store, oid)
                     pin = None
+                    with deser_context(prereg) as dsink:
+                        kind, value = serialization.deserialize(
+                            meta, memoryview(shm_owner))
+                else:
+                    with deser_context(prereg) as dsink:
+                        kind, value = serialization.deserialize(meta, data)
+                    if pin is not None:
+                        self.store.release(oid)
+                        pin = None
+                self._register_new_borrows(dsink)
                 if kind == serialization.KIND_EXCEPTION:
                     cause, tb = value
                     if isinstance(cause, exc.RayTpuError):
@@ -517,7 +558,6 @@ class CoreWorker:
                     raise exc.TaskError(cause, tb)
             except BaseException:
                 if pin is not None:
-                    self._pinned_reads.discard(oid.hex())
                     self.store.release(oid)
                 release_unconsumed(i + 1)
                 raise

@@ -178,8 +178,54 @@ ENTRIES = {
     "trainer_2worker_throughput": trainer_2worker_throughput,
 }
 
+def object_broadcast(mb: int, num_nodes: int) -> dict:
+    """Broadcast one large object from its creating node to every other
+    node over the chunked native transfer plane (reference: 1 GiB object
+    broadcast scalability-envelope row, release/benchmarks/README.md:18)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.config import Config
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    cfg = Config()
+    cfg.object_store_memory = int(mb * 3 * 1024 * 1024)
+    cluster = Cluster(initialize_head=True, config=cfg,
+                      head_node_args={"num_cpus": 1})
+    try:
+        ray_tpu.init(address=cluster.address)
+        others = [cluster.add_node(num_cpus=1) for _ in range(num_nodes - 1)]
+        cluster.wait_for_nodes(num_nodes)
+        blob = np.arange(mb * 1024 * 1024 // 8, dtype=np.float64)
+        ref = ray_tpu.put(blob)
+
+        @ray_tpu.remote(num_cpus=1)
+        def consume(x):
+            return float(x[-1]), int(x.nbytes)
+
+        t0 = time.perf_counter()
+        outs = ray_tpu.get(
+            [consume.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=n.node_id)).remote(ref) for n in others],
+            timeout=1200)
+        dt = time.perf_counter() - t0
+        for last, nbytes in outs:
+            assert nbytes == mb * 1024 * 1024
+            assert last == float(mb * 1024 * 1024 // 8 - 1)
+        return {"mb_broadcast": mb,
+                "agg_gib_per_s": round(mb * (num_nodes - 1) / 1024 / dt, 2)}
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+ENTRIES["object_broadcast"] = object_broadcast
+
 # Workloads that manage their own cluster lifecycle.
-_SELF_MANAGED = {"kill_node_mid_run"}
+_SELF_MANAGED = {"kill_node_mid_run", "object_broadcast"}
 
 
 def _load_manifest() -> dict:
@@ -250,7 +296,11 @@ def run_test(test: dict, quick: bool) -> dict:
         else:
             from ray_tpu._private.config import Config
 
-            ray_tpu.init(num_cpus=8, config=Config(prestart_workers=4))
+            # Generous worker-startup budget: quick mode runs on small
+            # single-core hosts where 30+ interpreter spawns serialize.
+            cfg = Config(prestart_workers=4)
+            cfg.worker_startup_timeout_s = 300.0
+            ray_tpu.init(num_cpus=8, config=cfg)
             try:
                 metrics = fn(**kwargs)
             finally:

@@ -4,16 +4,26 @@
 // the ObjectManager gRPC service — here the framing is a fixed header and
 // the payload is written straight from/into the shm arena).
 //
-// Wire protocol (one connection serves many sequential requests):
-//   request : 20-byte object id
-//   response: u64 total_size | u64 meta_size | total_size payload bytes
+// Wire protocol v2 — CHUNKED (one connection serves many sequential
+// chunk requests; header u64s big-endian):
+//   request : 20-byte object id | u64 offset | u64 max_len
+//   response: u64 total_size | u64 meta_size | u64 chunk_len | chunk bytes
 //             total_size == UINT64_MAX => object not found
+// Chunking (8 MiB, reference: object_manager_default_chunk_size
+// ray_config_def.h:355) enables (a) PARALLEL stripes: big objects pull
+// over several connections — and several PEERS — at once
+// (reference: pull_manager.h:52 / push_manager.h:30 chunk pipelining),
+// and (b) pull ADMISSION CONTROL: a global in-flight byte budget bounds
+// memory pressure from concurrent pulls (reference: pull admission).
 //
 // C ABI (ctypes from ray_tpu/_private/raylet.py):
 //   void* transfer_server_start(const char* store_path, int* out_port)
 //   void  transfer_server_stop(void* h)
 //   int   transfer_fetch(const char* store_path, const char* host, int port,
 //                        const uint8_t* id)   // 0 ok, <0 error
+//   int   transfer_fetch_multi(const char* store_path,
+//                              const char* peers_csv,  // "host:port,..."
+//                              const uint8_t* id)
 //
 // Builds into libtputransfer.so together with object_store.cc (the store
 // ABI below), each process attaching its own mapping of the arena.
@@ -59,6 +69,36 @@ namespace {
 
 constexpr int kIdSize = 20;
 constexpr uint64_t kNotFound = UINT64_MAX;
+constexpr uint64_t kChunkSize = 8ull << 20;  // 8 MiB stripes
+// Objects above this fan out over parallel connections.
+constexpr uint64_t kParallelThreshold = 32ull << 20;
+constexpr int kMaxStripes = 4;
+
+// ---- pull admission control (reference: pull_manager.h:52) ----
+// Bounds total bytes being pulled into this process's store at once; a
+// single object larger than the budget is admitted alone.
+constexpr uint64_t kAdmissionBudget = 256ull << 20;
+std::mutex g_adm_mu;
+std::condition_variable g_adm_cv;
+uint64_t g_adm_inflight = 0;
+
+struct Admission {
+  uint64_t n;
+  explicit Admission(uint64_t bytes) : n(bytes) {
+    std::unique_lock<std::mutex> g(g_adm_mu);
+    g_adm_cv.wait(g, [this] {
+      return g_adm_inflight == 0 || g_adm_inflight + n <= kAdmissionBudget;
+    });
+    g_adm_inflight += n;
+  }
+  ~Admission() {
+    {
+      std::lock_guard<std::mutex> g(g_adm_mu);
+      g_adm_inflight -= n;
+    }
+    g_adm_cv.notify_all();
+  }
+};
 
 bool send_all(int fd, const void* data, size_t n) {
   const char* p = static_cast<const char*>(data);
@@ -137,22 +177,34 @@ void tune_socket(int fd) {
 
 void serve_conn(Server* srv, int fd) {
   tune_socket(fd);
-  uint8_t id[kIdSize];
-  while (!srv->stop.load() && recv_all(fd, id, kIdSize)) {
+  uint8_t req[kIdSize + 16];
+  while (!srv->stop.load() && recv_all(fd, req, sizeof(req))) {
+    uint64_t want_off, want_len;
+    std::memcpy(&want_off, req + kIdSize, 8);
+    std::memcpy(&want_len, req + kIdSize + 8, 8);
+    want_off = be64toh(want_off);
+    want_len = be64toh(want_len);
     uint64_t off = 0, size = 0, meta = 0;
-    int rc = store_get(srv->store, id, &off, &size, &meta);
+    int rc = store_get(srv->store, req, &off, &size, &meta);
     if (rc != 0) {
       // Header u64s go big-endian on the wire (like the RPC frame
       // length) so mixed-endian peers can't misread sizes.
-      uint64_t hdr[2] = {htobe64(kNotFound), 0};
+      uint64_t hdr[3] = {htobe64(kNotFound), 0, 0};
       if (!send_all(fd, hdr, sizeof(hdr))) break;
       continue;
     }
-    uint64_t hdr[2] = {htobe64(size), htobe64(meta)};
+    uint64_t clen = 0;
+    if (want_off < size) {
+      clen = size - want_off;
+      if (clen > want_len) clen = want_len;
+    }
+    uint64_t hdr[3] = {htobe64(size), htobe64(meta), htobe64(clen)};
     bool ok = send_all(fd, hdr, sizeof(hdr)) &&
-              send_all(fd, static_cast<uint8_t*>(store_base(srv->store)) + off,
-                       size);
-    store_release(srv->store, id);
+              (clen == 0 ||
+               send_all(fd, static_cast<uint8_t*>(store_base(srv->store)) +
+                                off + want_off,
+                        clen));
+    store_release(srv->store, req);
     if (!ok) break;
   }
   {
@@ -299,32 +351,161 @@ std::map<std::string, PeerConn*>& peer_conns() {
   return *m;
 }
 
-int fetch_once(void* store, int fd, const uint8_t* id) {
-  // Returns 0 ok, -2 not found on peer, -3 store full, -4 io/protocol
-  // error (caller reconnects once on -4).
-  if (!send_all(fd, id, kIdSize)) return -4;
-  uint64_t hdr[2];
+struct ChunkHdr {
+  uint64_t total = 0, meta = 0, clen = 0;
+};
+
+// One chunk request/response on an open connection. dst == nullptr drains
+// the chunk into a scratch buffer (keeps the stream aligned when the
+// local create lost a race). Returns 0 ok, -2 not found, -4 io error.
+int request_chunk(int fd, const uint8_t* id, uint64_t off, uint64_t len,
+                  ChunkHdr* h, uint8_t* dst) {
+  uint8_t req[kIdSize + 16];
+  std::memcpy(req, id, kIdSize);
+  uint64_t obe = htobe64(off), lbe = htobe64(len);
+  std::memcpy(req + kIdSize, &obe, 8);
+  std::memcpy(req + kIdSize + 8, &lbe, 8);
+  if (!send_all(fd, req, sizeof(req))) return -4;
+  uint64_t hdr[3];
   if (!recv_all(fd, hdr, sizeof(hdr))) return -4;
-  if (be64toh(hdr[0]) == kNotFound) return -2;
-  uint64_t total = be64toh(hdr[0]), meta = be64toh(hdr[1]);
+  h->total = be64toh(hdr[0]);
+  if (h->total == kNotFound) return -2;
+  h->meta = be64toh(hdr[1]);
+  h->clen = be64toh(hdr[2]);
+  if (h->clen > len) return -4;  // protocol violation
+  if (h->clen == 0) return 0;
+  if (dst != nullptr) {
+    if (!recv_all(fd, dst, h->clen)) return -4;
+    return 0;
+  }
+  std::vector<char> sink(h->clen < (1u << 20) ? h->clen : (1u << 20));
+  uint64_t left = h->clen;
+  while (left > 0) {
+    size_t n = left < sink.size() ? left : sink.size();
+    if (!recv_all(fd, sink.data(), n)) return -4;
+    left -= n;
+  }
+  return 0;
+}
+
+struct Peer {
+  std::string host;
+  int port;
+};
+
+// Stripe worker: claims 8 MiB chunks off a shared cursor and pulls them
+// over its own connection. A dead/object-less peer is not fatal — the
+// worker fails over to the next peer in its rotation and only poisons
+// the fetch when NO peer can serve a claimed chunk (the surviving
+// copies absorb the dead peer's share).
+void stripe_worker(const std::vector<Peer>& peers, size_t start,
+                   const uint8_t* id, uint8_t* dst, uint64_t total,
+                   std::atomic<uint64_t>* cursor,
+                   std::atomic<bool>* failed) {
+  int fd = -1;
+  size_t pi = start % peers.size();
+  while (!failed->load()) {
+    uint64_t off = cursor->fetch_add(kChunkSize);
+    if (off >= total) break;
+    uint64_t len = total - off < kChunkSize ? total - off : kChunkSize;
+    bool got = false;
+    for (size_t tries = 0; tries < peers.size() && !got; ++tries) {
+      if (fd < 0) {
+        fd = connect_to(peers[pi].host.c_str(), peers[pi].port);
+        if (fd < 0) {
+          pi = (pi + 1) % peers.size();
+          continue;
+        }
+      }
+      ChunkHdr h;
+      if (request_chunk(fd, id, off, len, &h, dst + off) == 0 &&
+          h.clen == len) {
+        got = true;
+      } else {
+        ::close(fd);
+        fd = -1;
+        pi = (pi + 1) % peers.size();
+      }
+    }
+    if (!got) {
+      failed->store(true);
+      break;
+    }
+  }
+  if (fd >= 0) ::close(fd);
+}
+
+// Small probe chunk: received into scratch then copied (object sizes are
+// unknown before the first response); everything past it streams straight
+// into shm, so the copy tax is capped at 256 KiB per fetch.
+constexpr uint64_t kProbeLen = 256 << 10;
+
+int fetch_chunked(void* store, int fd, const uint8_t* id,
+                  const std::vector<Peer>& peers) {
+  // Returns 0 ok, -2 not found on peer, -3 store full, -4 io/protocol
+  // error (caller reconnects once on -4). A small first chunk doubles as
+  // the size probe; the remainder stripes across parallel connections
+  // for large objects.
+  ChunkHdr h0;
+  std::vector<uint8_t> first(kProbeLen);
+  int rc = request_chunk(fd, id, 0, kProbeLen, &h0, first.data());
+  if (rc != 0) return rc;
+  uint64_t total = h0.total, meta = h0.meta;
+  Admission adm(total);
   uint64_t off = 0;
   int crc = store_create(store, id, total, meta, &off);
   if (crc == -2 /*kErrExists*/) {
-    // Concurrent create in flight: drain the payload to keep the
-    // connection aligned, then report found only if that create SEALED
-    // (it may still abort — same contains() guard as the RPC path).
-    std::vector<char> sink(1 << 20);
-    uint64_t left = total;
-    while (left > 0) {
-      size_t n = left < sink.size() ? left : sink.size();
-      if (!recv_all(fd, sink.data(), n)) return -4;
-      left -= n;
-    }
+    // Concurrent create in flight; chunked requests are self-contained,
+    // so no drain needed beyond the already-received first chunk.
     return store_contains(store, id) ? 0 : -2;
   }
   if (crc != 0) return -3;
   uint8_t* dst = static_cast<uint8_t*>(store_base(store)) + off;
-  if (!recv_all(fd, dst, total)) {
+  std::memcpy(dst, first.data(), h0.clen);
+  uint64_t got = h0.clen;
+  bool ok = true;
+  if (got < total) {
+    uint64_t remaining = total - got;
+    int nworkers = 1;
+    if (remaining >= kParallelThreshold) {
+      nworkers = static_cast<int>(remaining / kParallelThreshold) + 1;
+      int cap = kMaxStripes > static_cast<int>(peers.size()) * 2
+                    ? static_cast<int>(peers.size()) * 2
+                    : kMaxStripes;
+      if (nworkers > cap) nworkers = cap;
+    }
+    if (nworkers == 1) {
+      // Mid-size object: sequential chunks on the already-open probe
+      // connection (no extra connect); an IO error returns -4 and the
+      // caller's per-peer retry takes over.
+      while (got < total) {
+        uint64_t len = total - got < kChunkSize ? total - got : kChunkSize;
+        ChunkHdr h;
+        if (request_chunk(fd, id, got, len, &h, dst + got) != 0 ||
+            h.clen != len) {
+          ok = false;
+          break;
+        }
+        got += len;
+      }
+    } else {
+      std::atomic<uint64_t> cursor{got};
+      std::atomic<bool> failed{false};
+      std::vector<std::thread> extra;
+      for (int w = 1; w < nworkers; ++w) {
+        extra.emplace_back(stripe_worker, std::cref(peers),
+                           static_cast<size_t>(w), id, dst, total, &cursor,
+                           &failed);
+      }
+      // This thread stripes too, with the same peer-failover rotation
+      // (worker index 0); the probe connection stays cached for the
+      // next fetch on this peer.
+      stripe_worker(peers, 0, id, dst, total, &cursor, &failed);
+      for (auto& t : extra) t.join();
+      ok = !failed.load();
+    }
+  }
+  if (!ok) {
     store_abort(store, id);
     return -4;
   }
@@ -332,37 +513,85 @@ int fetch_once(void* store, int fd, const uint8_t* id) {
   return 0;
 }
 
-// Pull one object from a peer's transfer server straight into the local
-// store. Returns 0 on success (or already present), -1 connect error,
-// -2 not found on peer, -3 local store full, -4 protocol error.
+// Pull one object from peers' transfer servers straight into the local
+// store, striping large objects across parallel connections and peers.
+// Returns 0 on success (or already present), -1 connect error,
+// -2 not found on any peer, -3 local store full, -4 protocol error.
+static int fetch_from_peers(const char* store_path,
+                            const std::vector<Peer>& peers,
+                            const uint8_t* id) {
+  void* store = attached_store(store_path);
+  if (!store || peers.empty()) return -4;
+  if (store_contains(store, id)) return 0;
+  int last = -1;
+  for (size_t i = 0; i < peers.size(); ++i) {
+    const Peer& p = peers[i];
+    std::string key = p.host + ":" + std::to_string(p.port);
+    PeerConn* pc;
+    {
+      std::lock_guard<std::mutex> g(g_peers_mu);
+      auto& m = peer_conns();
+      auto it = m.find(key);
+      if (it == m.end()) it = m.emplace(key, new PeerConn()).first;
+      pc = it->second;
+    }
+    std::lock_guard<std::mutex> g(pc->mu);
+    // Peers that answer stripe to ALL peers; rotation only changes who
+    // serves the size probe.
+    std::vector<Peer> rotated(peers.begin() + i, peers.end());
+    rotated.insert(rotated.end(), peers.begin(), peers.begin() + i);
+    for (int attempt = 0; attempt < 2; ++attempt) {
+      if (pc->fd < 0) {
+        pc->fd = connect_to(p.host.c_str(), p.port);
+        if (pc->fd < 0) {
+          last = -1;
+          break;  // next peer
+        }
+      }
+      int rc = fetch_chunked(store, pc->fd, id, rotated);
+      if (rc == 0) return 0;
+      if (rc != -4) {
+        last = rc;
+        break;  // not-found / store-full: try next peer (or give up)
+      }
+      last = -4;
+      // IO error — possibly a server-side idle-expired cached
+      // connection: drop it and retry once on a fresh one.
+      ::close(pc->fd);
+      pc->fd = -1;
+    }
+    if (last == -3) return -3;  // local store full: no peer will help
+  }
+  return last;
+}
+
 int transfer_fetch(const char* store_path, const char* host, int port,
                    const uint8_t* id) {
-  void* store = attached_store(store_path);
-  if (!store) return -4;
-  if (store_contains(store, id)) return 0;
-  std::string key = std::string(host) + ":" + std::to_string(port);
-  PeerConn* peer;
-  {
-    std::lock_guard<std::mutex> g(g_peers_mu);
-    auto& m = peer_conns();
-    auto it = m.find(key);
-    if (it == m.end()) it = m.emplace(key, new PeerConn()).first;
-    peer = it->second;
-  }
-  std::lock_guard<std::mutex> g(peer->mu);
-  for (int attempt = 0; attempt < 2; ++attempt) {
-    if (peer->fd < 0) {
-      peer->fd = connect_to(host, port);
-      if (peer->fd < 0) return -1;
+  return fetch_from_peers(store_path, {{host, port}}, id);
+}
+
+// peers_csv: "host:port,host:port,...". Stripes chunks of one object
+// across every listed peer in parallel (reference: pull_manager requests
+// chunks from multiple object copies).
+int transfer_fetch_multi(const char* store_path, const char* peers_csv,
+                         const uint8_t* id) {
+  std::vector<Peer> peers;
+  std::string s(peers_csv ? peers_csv : "");
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t comma = s.find(',', pos);
+    std::string item = s.substr(pos, comma == std::string::npos
+                                         ? std::string::npos
+                                         : comma - pos);
+    size_t colon = item.rfind(':');
+    if (colon != std::string::npos) {
+      peers.push_back({item.substr(0, colon),
+                       std::atoi(item.c_str() + colon + 1)});
     }
-    int rc = fetch_once(store, peer->fd, id);
-    if (rc != -4) return rc;
-    // IO error — possibly a server-side idle-expired cached connection:
-    // drop it and retry once on a fresh one.
-    ::close(peer->fd);
-    peer->fd = -1;
+    if (comma == std::string::npos) break;
+    pos = comma + 1;
   }
-  return -4;
+  return fetch_from_peers(store_path, peers, id);
 }
 
 }  // extern "C"

@@ -27,6 +27,8 @@ void* transfer_server_start(const char* store_path, int* out_port);
 void transfer_server_stop(void* h);
 int transfer_fetch(const char* store_path, const char* host, int port,
                    const uint8_t* id);
+int transfer_fetch_multi(const char* store_path, const char* peers_csv,
+                         const uint8_t* id);
 }
 
 static void make_id(uint8_t* id, int n) {
@@ -81,8 +83,8 @@ static void* fetch_thread(void* arg) {
 int main() {
   unlink(kSrc);
   unlink(kDst);
-  void* src = store_create_arena(kSrc, 32 << 20, 256);
-  void* dst_handle = store_create_arena(kDst, 32 << 20, 256);
+  void* src = store_create_arena(kSrc, 160 << 20, 256);
+  void* dst_handle = store_create_arena(kDst, 160 << 20, 256);
   assert(src && dst_handle);
 
   for (int n = 1; n <= 6; n++) put_object(src, n, 1 << 20);
@@ -124,7 +126,36 @@ int main() {
   for (int n = 3; n <= 6; n++) check_object(kDst, dst_handle, n, 1 << 20);
   printf("concurrent fetch ok\n");
 
+  // Large object: multi-chunk, parallel-striped path (> 32 MiB
+  // threshold) round-trips byte-exact through several connections.
+  put_object(src, 40, 72u << 20);
+  make_id(id, 40);
+  assert(transfer_fetch(kDst, "127.0.0.1", port, id) == 0);
+  check_object(kDst, dst_handle, 40, 72u << 20);
+  printf("large striped fetch ok\n");
+
+  // Multi-peer fetch: two servers over the SAME source store; stripes
+  // split across both peers.
+  int port2 = 0;
+  void* server2 = transfer_server_start(kSrc, &port2);
+  assert(server2 && port2 > 0);
+  put_object(src, 41, 48u << 20);
+  make_id(id, 41);
+  char peers[128];
+  snprintf(peers, sizeof(peers), "127.0.0.1:%d,127.0.0.1:%d", port, port2);
+  assert(transfer_fetch_multi(kDst, peers, id) == 0);
+  check_object(kDst, dst_handle, 41, 48u << 20);
+  // First peer listed dead: falls through to the live one.
+  put_object(src, 42, 1 << 20);
+  make_id(id, 42);
+  snprintf(peers, sizeof(peers), "127.0.0.1:1,127.0.0.1:%d", port2);
+  assert(transfer_fetch_multi(kDst, peers, id) == 0);
+  check_object(kDst, dst_handle, 42, 1 << 20);
+  transfer_server_stop(server2);
+  printf("multi-peer fetch ok\n");
+
   transfer_server_stop(server);
+
   // Server gone: fetch of a NEW object fails with a connection error.
   make_id(id, 77);
   int rc = transfer_fetch(kDst, "127.0.0.1", port, id);

@@ -139,3 +139,42 @@ def test_connect_by_address_only(ray_start_cluster):
         assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 1
     finally:
         ray_tpu.shutdown()
+
+
+def test_large_object_broadcast(ray_start_cluster):
+    """A multi-chunk (64MB > parallel-stripe threshold) object broadcasts
+    from its creating node to every other node via the chunked native
+    transfer plane (reference: the 1 GiB broadcast scalability-envelope
+    row, release/benchmarks; full-size run lives in release_tests.yaml
+    object_broadcast)."""
+    from ray_tpu.cluster_utils import Cluster  # noqa: F401
+    from ray_tpu._private.config import Config
+
+    cluster = ray_start_cluster
+    cluster._node.config.object_store_memory = 192 * 1024 * 1024
+    cluster.add_node(num_cpus=1)
+    cluster.connect()
+    n2 = cluster.add_node(num_cpus=1)
+    n3 = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(3)
+
+    blob = np.arange(8 * 1024 * 1024, dtype=np.float64)  # 64MB
+    ref = ray_tpu.put(blob)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(x):
+        # Chunked native pull into THIS node's store, then zero-copy read.
+        return float(x[0]), float(x[-1]), int(x.nbytes)
+
+    # Two consumers pinned to the two non-owner nodes via spread.
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    outs = ray_tpu.get(
+        [consume.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=n.node_id)).remote(ref) for n in (n2, n3)],
+        timeout=300)
+    for first, last, nbytes in outs:
+        assert first == 0.0
+        assert last == float(8 * 1024 * 1024 - 1)
+        assert nbytes == 64 * 1024 * 1024
