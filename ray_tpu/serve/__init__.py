@@ -96,6 +96,10 @@ def delete(name: str) -> None:
 
 def shutdown() -> None:
     global _proxy_server
+    _ProxyHandler._route_poll_stop.set()
+    _ProxyHandler._route_poll_started = False
+    _ProxyHandler._routes = {}
+    _ProxyHandler._routes_ts = 0.0
     if _proxy_server is not None:
         _proxy_server.shutdown()
         _proxy_server = None
@@ -133,19 +137,58 @@ class _ProxyHandler(BaseHTTPRequestHandler):
     # as body bytes.
     protocol_version = "HTTP/1.1"
     handles: dict[str, DeploymentHandle] = {}
-    # Cached route table {prefix: deployment}; refreshed on a TTL, not per
-    # request (reference: proxies get route updates pushed via long-poll).
+    # Route table {prefix: deployment}: pushed by the controller over a
+    # held long-poll connection (reference: proxies subscribe to route
+    # updates via LongPollClient, long_poll.py:172); a slow TTL pull
+    # remains as the bootstrap/fallback path.
     _routes: dict[str, str] = {}
     _routes_ts: float = 0.0
-    _ROUTE_TTL = 2.0
+    _ROUTE_TTL = 10.0
+    _route_poll_started = False
+    _route_poll_stop = threading.Event()
+    _route_poll_version = 0
 
     def log_message(self, *args):  # silence
         pass
 
     @classmethod
+    def _start_route_poll(cls):
+        if cls._route_poll_started:
+            return
+        cls._route_poll_started = True
+        cls._route_poll_stop.clear()
+        stop = cls._route_poll_stop
+
+        def loop():
+            import time as _time
+
+            while not stop.is_set():
+                try:
+                    # Look up the EXISTING controller only — get_if_exists
+                    # creation here would resurrect a detached controller
+                    # after serve.shutdown().
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                                   namespace="serve")
+                    upd = ray_tpu.get(
+                        controller.long_poll.remote(
+                            {"routes": cls._route_poll_version}, 10.0),
+                        timeout=30)
+                except Exception:
+                    if stop.wait(1.0):
+                        return
+                    continue
+                if "routes" in upd:
+                    cls._route_poll_version, cls._routes = upd["routes"]
+                    cls._routes_ts = _time.monotonic()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="proxy-route-poll").start()
+
+    @classmethod
     def _route_table(cls) -> dict[str, str]:
         import time as _time
 
+        cls._start_route_poll()
         now = _time.monotonic()
         if now - cls._routes_ts > cls._ROUTE_TTL:
             try:

@@ -391,6 +391,44 @@ class _RouterState:
         self.model_replicas: dict[str, set[int]] = {}
         self.lock = threading.Lock()
         self.last_refresh = 0.0
+        # Long-poll push state (reference: long_poll.py LongPollClient):
+        # a daemon thread parks on the controller and applies replica-set
+        # updates the moment they are published.
+        self.poll_version = 0
+        self.poll_thread: threading.Thread | None = None
+        self.poll_stop = threading.Event()
+
+    def start_long_poll(self, name: str, controller) -> None:
+        key = f"replicas:{name}"
+
+        def loop():
+            while not self.poll_stop.is_set():
+                try:
+                    upd = ray_tpu.get(
+                        controller.long_poll.remote(
+                            {key: self.poll_version}, 10.0),
+                        timeout=30)
+                except Exception:
+                    if self.poll_stop.wait(1.0):
+                        return
+                    continue
+                if key in upd:
+                    ver, reps = upd[key]
+                    with self.lock:
+                        self.poll_version = ver
+                        if len(reps) != len(self.replicas):
+                            self.model_replicas.clear()
+                        self.replicas = reps
+                        self.last_refresh = time.monotonic()
+                        for i in range(len(reps)):
+                            self.outstanding.setdefault(i, 0)
+
+        with self.lock:  # check-and-start must be atomic across threads
+            if self.poll_thread is not None and self.poll_thread.is_alive():
+                return
+            self.poll_thread = threading.Thread(
+                target=loop, daemon=True, name="serve-longpoll")
+            self.poll_thread.start()
 
 
 class DeploymentHandle:
@@ -469,11 +507,16 @@ class DeploymentHandle:
     def _last_refresh(self, value):
         self._router.last_refresh = value
 
-    # -- replica set maintenance (long-poll analog: periodic refresh) --
+    # -- replica set maintenance: long-poll push with a slow TTL-refresh
+    # fallback (reference: router updates via LongPollClient) --
 
     def _get_replicas(self):
+        self._router.start_long_poll(self.deployment_name, self._controller)
         now = time.monotonic()
-        if now - self._last_refresh > 0.5 or not self._replicas:
+        # The push thread keeps last_refresh current; the pull below only
+        # fires when the push path is unavailable (controller restart) or
+        # before the first push lands.
+        if now - self._last_refresh > 5.0 or not self._replicas:
             reps = ray_tpu.get(self._controller.get_replicas.remote(
                 self.deployment_name))
             with self._lock:
