@@ -103,6 +103,41 @@ def read_json(paths: str | list, *, lines: bool = True,
     return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
+def from_arrow(tables, *, override_num_blocks: int | None = None) -> Dataset:
+    """Dataset over pyarrow Tables — one block per table (reference:
+    ray.data.from_arrow; tables are the reference's native block format)."""
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    return Dataset(list(tables))
+
+
+def read_parquet(paths: str | list, *, columns: list | None = None,
+                 override_num_blocks: int | None = None) -> Dataset:
+    """Arrow-native parquet read: each read task yields a pyarrow.Table
+    block (reference: ray.data.read_parquet over Arrow datasets; tables
+    pickle with protocol-5 buffers so they move through the shm store
+    zero-copy)."""
+    from ray_tpu.data.dataset import ReadTask
+
+    files = _expand(paths)
+    groups = [[p] for p in files]
+    if override_num_blocks is not None and 0 < override_num_blocks < len(files):
+        n = override_num_blocks
+        per = math.ceil(len(files) / n)
+        groups = [files[i * per:(i + 1) * per] for i in _builtins.range(n)]
+        groups = [g for g in groups if g]
+
+    def read_group(group, columns=columns):
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+
+        tables = [pq.read_table(p, columns=columns) for p in group]
+        return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+
+    return Dataset([ReadTask(fn=(lambda g=g: read_group(g)))
+                    for g in groups])
+
+
 def read_csv(paths: str | list, *, override_num_blocks: int | None = None
              ) -> Dataset:
     def read_one(p):
@@ -122,26 +157,6 @@ def read_numpy(paths: str | list, *, override_num_blocks: int | None = None
         return [{"data": a} for a in _np.load(p)]
 
     return _lazy_read(_expand(paths), read_one, override_num_blocks)
-
-
-def read_parquet(paths: str | list, *, override_num_blocks: int | None = None
-                 ) -> Dataset:
-    try:
-        import pyarrow.parquet  # noqa: F401
-    except ImportError as e:  # pragma: no cover
-        raise ImportError("read_parquet requires pyarrow") from e
-
-    def read_one(p):
-        import pyarrow.parquet as pq
-
-        return pq.read_table(p).to_pylist()
-
-    return _lazy_read(_expand(paths), read_one, override_num_blocks)
-
-
-def from_arrow(table, *, override_num_blocks: int | None = None) -> Dataset:
-    """From a pyarrow Table (reference: data/read_api.py from_arrow)."""
-    return from_items(table.to_pylist(), override_num_blocks=override_num_blocks)
 
 
 def read_binary_files(paths: str | list, *, include_paths: bool = False,

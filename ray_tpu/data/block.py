@@ -1,11 +1,15 @@
 """Blocks: the unit of data in ray_tpu.data.
 
 Parity: reference python/ray/data/block.py — blocks are Arrow/pandas/numpy
-tables living in plasma. Here a block is either a list of rows (simple
-format) or a dict of numpy column arrays (batch format); blocks travel as
-object-store refs so the streaming executor moves references, not data.
-The numpy-dict format is the TPU feed format: columns are contiguous
-arrays that `jax.device_put` ships to HBM without conversion.
+tables living in plasma. Here a block is one of:
+  - a pyarrow.Table (columnar; the reference's primary format — pickles
+    with protocol-5 out-of-band buffers, so tables round-trip through the
+    shm store zero-copy and parquet IO is native),
+  - a dict of numpy column arrays (the TPU feed format: contiguous
+    columns that `jax.device_put` ships to HBM without conversion),
+  - a list of rows (simple format).
+Blocks travel as object-store refs so the streaming executor moves
+references, not data.
 """
 
 from __future__ import annotations
@@ -14,8 +18,19 @@ from typing import Any, Iterable
 
 import numpy as np
 
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover - pyarrow ships in the image
+    pa = None
+
+
+def is_arrow(block) -> bool:
+    return pa is not None and isinstance(block, pa.Table)
+
 
 def block_len(block) -> int:
+    if is_arrow(block):
+        return block.num_rows
     if isinstance(block, dict):
         if not block:
             return 0
@@ -24,6 +39,8 @@ def block_len(block) -> int:
 
 
 def block_to_rows(block) -> list:
+    if is_arrow(block):
+        return block.to_pylist()
     if isinstance(block, dict):
         keys = list(block.keys())
         n = block_len(block)
@@ -42,18 +59,46 @@ def rows_to_batch(rows: list) -> dict:
 
 
 def block_to_batch(block) -> dict:
+    if is_arrow(block):
+        # Columnar → numpy dict; fixed-width columns come out zero-copy
+        # when the table is a single chunk.
+        out = {}
+        for name in block.column_names:
+            col = block.column(name)
+            try:
+                out[name] = col.to_numpy(zero_copy_only=False)
+            except Exception:
+                out[name] = np.asarray(col.to_pylist())
+        return out
     if isinstance(block, dict):
         return block
     return rows_to_batch(block)
 
 
+def block_to_arrow(block):
+    if pa is None:
+        raise ImportError("pyarrow is required for arrow blocks")
+    if is_arrow(block):
+        return block
+    if isinstance(block, dict):
+        return pa.table({k: np.asarray(v) for k, v in block.items()})
+    rows = block_to_rows(block)
+    if rows and not isinstance(rows[0], dict):
+        rows = [{"item": r} for r in rows]
+    return pa.Table.from_pylist(rows)
+
+
 def batch_to_block(batch, batch_format: str):
+    if batch_format in ("pyarrow", "arrow"):
+        return batch if is_arrow(batch) else block_to_arrow(batch)
     if batch_format in ("numpy", "batch", "dict"):
         return batch
     return block_to_rows(batch)
 
 
 def slice_block(block, start: int, end: int):
+    if is_arrow(block):
+        return block.slice(start, end - start)
     if isinstance(block, dict):
         return {k: v[start:end] for k, v in block.items()}
     return block[start:end]
@@ -63,6 +108,8 @@ def concat_blocks(blocks: list):
     blocks = [b for b in blocks if block_len(b)]
     if not blocks:
         return []
+    if is_arrow(blocks[0]):
+        return pa.concat_tables(block_to_arrow(b) for b in blocks)
     if isinstance(blocks[0], dict):
         keys = blocks[0].keys()
         return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
@@ -70,3 +117,12 @@ def concat_blocks(blocks: list):
     for b in blocks:
         out.extend(block_to_rows(b))
     return out
+
+
+def block_nbytes(block) -> int:
+    """Approximate in-memory size (backpressure accounting)."""
+    if is_arrow(block):
+        return block.nbytes
+    if isinstance(block, dict):
+        return sum(getattr(v, "nbytes", len(v) * 8) for v in block.values())
+    return len(block) * 64  # rough row estimate

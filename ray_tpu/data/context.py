@@ -18,6 +18,10 @@ class DataContext:
     # be outstanding per pipeline segment (reference: ExecutionResources
     # limits in streaming_executor.py:280).
     max_in_flight_blocks: int = 8
+    # Byte-based backpressure: estimated in-flight block bytes are kept
+    # under this budget (0 disables). Sizes are learned from completed
+    # blocks, so >RAM datasets stream with a bounded footprint.
+    max_in_flight_bytes: int = 512 * 1024 * 1024
     # Default block count for from_items/range when unspecified.
     default_block_count: int = 8
     # Per-block remote task timeout (seconds) in the streaming loop.

@@ -65,6 +65,20 @@ _current_block_index = 0
 
 
 @ray_tpu.remote
+def _apply_fused(fn_blobs, block, index=0):
+    """Run a FUSED chain of per-block stage fns in one task (logical->
+    physical optimization: consecutive row/batch transforms collapse into
+    a single operator, reference: data/_internal/logical optimizer's
+    fuse rules — N stages cost one task and zero intermediate objects)."""
+    import ray_tpu.data.dataset as _ds
+    from ray_tpu._private import serialization
+
+    _ds._current_block_index = index
+    for blob in fn_blobs:
+        block = serialization.loads_func(blob)(block)
+    return block
+
+
 def _apply_stage(fn_blob, block, index=0):
     import ray_tpu.data.dataset as _ds
     from ray_tpu._private import serialization
@@ -167,17 +181,22 @@ class Dataset:
                     stage_fn._cached_udf = udf
             else:
                 udf = fn
+            def to_batch(piece):
+                if batch_format in ("pyarrow", "arrow"):
+                    from ray_tpu.data.block import block_to_arrow
+
+                    return block_to_arrow(piece)
+                if batch_format == "numpy":
+                    return block_to_batch(piece)
+                return block_to_rows(piece)
+
             if batch_size is None:
-                batch = block_to_batch(block) if batch_format == "numpy" \
-                    else block_to_rows(block)
-                return batch_to_block(udf(batch), batch_format)
+                return batch_to_block(udf(to_batch(block)), batch_format)
             outs = []
             n = block_len(block)
             for s in range(0, n, batch_size):
                 piece = slice_block(block, s, min(s + batch_size, n))
-                batch = block_to_batch(piece) if batch_format == "numpy" \
-                    else block_to_rows(piece)
-                outs.append(batch_to_block(udf(batch), batch_format))
+                outs.append(batch_to_block(udf(to_batch(piece)), batch_format))
             return concat_blocks(outs)
 
         return self._with(_Stage("map_batches", stage_fn,
@@ -480,20 +499,40 @@ class Dataset:
             fn_blobs = [serialization.dumps_func(s.fn) for s in seg]
 
             def launch(blk, idx):
-                ref = blk
-                for blob in fn_blobs:
-                    ref = _apply_stage.remote(blob, ref, idx)
-                return ref
+                # Operator FUSION: the whole per-block stage chain runs as
+                # one task — no intermediate objects, no per-stage RPCs.
+                return _apply_fused.remote(fn_blobs, blk, idx)
 
             # FIFO window: yield in submission order (dataset semantics are
             # ordered, matching the reference's OutputSplitter default).
+            # The window is bounded by COUNT and by estimated BYTES
+            # (reference: ExecutionResources memory limits,
+            # streaming_executor.py:280) — block sizes are learned from
+            # completed blocks, so a >RAM dataset streams with bounded
+            # in-flight footprint.
+            from ray_tpu.data.block import block_nbytes
+            from ray_tpu.data.context import DataContext
+
+            byte_budget = DataContext.get_current().max_in_flight_bytes
+            avg_size = 0.0
+            done = 0
             window: list = []
             for idx, blk in enumerate(in_blocks):
                 window.append(launch(blk, idx))
-                if len(window) >= max_in_flight:
-                    yield ray_tpu.get(window.pop(0), timeout=task_timeout)
+                limit = max_in_flight
+                if avg_size > 0 and byte_budget > 0:
+                    limit = min(limit,
+                                max(2, int(byte_budget / avg_size)))
+                while len(window) >= limit:
+                    out = ray_tpu.get(window.pop(0), timeout=task_timeout)
+                    done += 1
+                    avg_size += (block_nbytes(out) - avg_size) / done
+                    yield out
             while window:
-                yield ray_tpu.get(window.pop(0), timeout=task_timeout)
+                out = ray_tpu.get(window.pop(0), timeout=task_timeout)
+                done += 1
+                avg_size += (block_nbytes(out) - avg_size) / done
+                yield out
 
         def run_shuffle(in_blocks: Iterable, st: _Stage) -> Iterator:
             """Push-based shuffle: map tasks partition (num_returns=n_out
@@ -722,16 +761,15 @@ class Dataset:
     def write_parquet(self, path: str) -> None:
         import os
 
-        import pyarrow as pa
         import pyarrow.parquet as pq
+
+        from ray_tpu.data.block import block_to_arrow
 
         os.makedirs(path, exist_ok=True)
         for i, block in enumerate(self._iter_output_blocks()):
-            rows = [r if isinstance(r, dict) else {"value": r}
-                    for r in block_to_rows(block)]
-            if not rows:
+            if not block_len(block):
                 continue
-            table = pa.Table.from_pylist([_jsonable(r) for r in rows])
+            table = block_to_arrow(block)  # no-op for arrow blocks
             pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
 
     def write_numpy(self, path: str, *, column: str = "data") -> None:
