@@ -20,6 +20,7 @@ class ModelSpec:
     init_params: Callable  # (obs_size, num_actions, hidden, seed) -> params
     numpy_forward: Callable  # (params, obs) -> (logits, value)
     jax_forward: Callable    # same contract under jit/grad
+    default_hidden: int = 64  # the spec owns its width default
 
 
 def register_model(spec: ModelSpec) -> None:
@@ -97,3 +98,101 @@ def _resmlp_jax(params, obs):
 
 
 register_model(ModelSpec("resmlp", _resmlp_init, _resmlp_numpy, _resmlp_jax))
+
+
+# -- Atari-style conv net (parity: reference rllib Nature-CNN default for
+# image observations, rllib/models/catalog.py conv defaults). Used for
+# pixel envs: obs (H, W, C) uint8/float; learner runs it under jit on
+# the accelerator, rollout workers run the SAME jax forward jitted on
+# their CPU backend (a numpy conv would dominate sampling time). --------
+
+def _cnn_init(obs_shape, num_actions: int, hidden: int = 256,
+              seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    h, w, c = obs_shape
+
+    def conv(kh, kw, cin, cout):
+        fan_in = kh * kw * cin
+        return {"w": (rng.standard_normal((kh, kw, cin, cout))
+                      / np.sqrt(fan_in)).astype(np.float32),
+                "b": np.zeros(cout, np.float32)}
+
+    def dense(i, o):
+        return {"w": (rng.standard_normal((i, o)) / np.sqrt(i)
+                      ).astype(np.float32),
+                "b": np.zeros(o, np.float32)}
+
+    # Strided convs shrink H,W by 2 each: 42 -> 21 -> 11 -> 6.
+    def out_hw(x):
+        for _ in range(3):
+            x = (x + 1) // 2
+        return x
+
+    flat = out_hw(h) * out_hw(w) * 64
+    return {
+        "c1": conv(5, 5, c, 16),
+        "c2": conv(3, 3, 16, 32),
+        "c3": conv(3, 3, 32, 64),
+        "fc": dense(flat, hidden),
+        "pi": dense(hidden, num_actions),
+        "vf": dense(hidden, 1),
+    }
+
+
+def _cnn_jax(params: dict, obs):
+    """obs: (B, H, W, C); [0,255] inputs are normalized. Symmetric k//2
+    padding with stride 2 (matches the numpy fallback exactly)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = obs.astype(jnp.float32)
+    x = x / jnp.maximum(1.0, jnp.where(jnp.max(x) > 1.5, 255.0, 1.0))
+    for key in ("c1", "c2", "c3"):
+        w = params[key]["w"]
+        kh, kw = w.shape[0], w.shape[1]
+        dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                            ("NHWC", "HWIO", "NHWC"))
+        x = jax.lax.conv_general_dilated(
+            x, w, window_strides=(2, 2),
+            padding=[(kh // 2, kh // 2), (kw // 2, kw // 2)],
+            dimension_numbers=dn)
+        x = jax.nn.relu(x + params[key]["b"])
+    x = x.reshape(x.shape[0], -1)
+    h = jnp.tanh(x @ params["fc"]["w"] + params["fc"]["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def _cnn_numpy(params: dict, obs: np.ndarray):
+    """Fallback numpy path (tests / environments without jax): naive but
+    correct strided conv."""
+    x = obs.astype(np.float32)
+    if x.max() > 1.5:
+        x = x / 255.0
+
+    def conv2d(x, w, b):
+        bsz, hh, ww, cin = x.shape
+        kh, kw, _, cout = w.shape
+        ph, pw = kh // 2, kw // 2
+        xp = np.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        oh, ow = (hh + 1) // 2, (ww + 1) // 2
+        out = np.zeros((bsz, oh, ow, cout), np.float32)
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[:, i * 2:i * 2 + kh, j * 2:j * 2 + kw, :]
+                out[:, i, j, :] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                               [0, 1, 2]))
+        return np.maximum(out + b, 0.0)
+
+    for key in ("c1", "c2", "c3"):
+        x = conv2d(x, params[key]["w"], params[key]["b"])
+    x = x.reshape(x.shape[0], -1)
+    h = np.tanh(x @ params["fc"]["w"] + params["fc"]["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+register_model(ModelSpec("atari_cnn", _cnn_init, _cnn_numpy, _cnn_jax,
+                         default_hidden=256))

@@ -125,6 +125,13 @@ ENV_REGISTRY = {"CartPole-v1": CartPole, "CartPole": CartPole,
                 "Pendulum-v1": Pendulum, "Pendulum": Pendulum}
 
 
+def _register_late():  # populated after the classes below are defined
+    ENV_REGISTRY.update({
+        "VisualCatch-v0": VisualCatch, "VisualCatch": VisualCatch,
+        "DualCartPole-v0": DualCartPole, "DualCartPole": DualCartPole,
+    })
+
+
 def make_env(env: str | type) -> Env:
     if isinstance(env, str):
         if env not in ENV_REGISTRY:
@@ -132,3 +139,93 @@ def make_env(env: str | type) -> Env:
                              "ray_tpu.rllib.env.ENV_REGISTRY")
         return ENV_REGISTRY[env]()
     return env()
+
+
+class VisualCatch(Env):
+    """Atari-style PIXEL control task: a ball falls down a 42x42 frame,
+    the agent slides a paddle to catch it (the classic minimal visual-RL
+    benchmark). Observations are (42, 42, 1) uint8 frames — exercises the
+    full image pipeline (CNN policy under jit, frame normalization)
+    without shipping game ROMs. Actions: 0=left 1=stay 2=right."""
+
+    SIZE = 42
+    observation_shape = (42, 42, 1)
+    observation_size = 42 * 42  # flattened (MLP fallback)
+    num_actions = 3
+
+    def __init__(self):
+        self.rng = np.random.default_rng(0)
+        self.reset()
+
+    def _frame(self) -> np.ndarray:
+        f = np.zeros((self.SIZE, self.SIZE, 1), np.uint8)
+        f[self.ball_y, self.ball_x, 0] = 255
+        x0 = max(0, self.paddle_x - 2)
+        x1 = min(self.SIZE, self.paddle_x + 3)
+        f[self.SIZE - 1, x0:x1, 0] = 255
+        return f
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.ball_x = int(self.rng.integers(0, self.SIZE))
+        self.ball_y = 0
+        self.paddle_x = self.SIZE // 2
+        return self._frame()
+
+    def step(self, action: int):
+        self.paddle_x = int(np.clip(self.paddle_x + (int(action) - 1), 2,
+                                    self.SIZE - 3))
+        self.ball_y += 1
+        done = self.ball_y >= self.SIZE - 1
+        reward = 0.0
+        if done:
+            reward = 1.0 if abs(self.ball_x - self.paddle_x) <= 2 else -1.0
+        return self._frame(), reward, done, {}
+
+
+class MultiAgentEnv:
+    """Multi-agent env interface (parity: reference rllib MultiAgentEnv):
+    reset() -> {agent_id: obs}; step({agent_id: action}) ->
+    (obs_dict, reward_dict, done_dict incl. '__all__', info_dict)."""
+
+    agent_ids: tuple = ()
+
+    def reset(self, seed: int | None = None) -> dict:
+        raise NotImplementedError
+
+    def step(self, actions: dict):
+        raise NotImplementedError
+
+
+class DualCartPole(MultiAgentEnv):
+    """Two independent CartPole agents in one env — the minimal
+    multi-agent scaffold (reference: rllib examples' multi-agent
+    cartpole). Episode ends when BOTH poles have fallen."""
+
+    agent_ids = ("agent_0", "agent_1")
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self):
+        self.envs = {a: CartPole() for a in self.agent_ids}
+        self.done = {a: False for a in self.agent_ids}
+
+    def reset(self, seed: int | None = None) -> dict:
+        self.done = {a: False for a in self.agent_ids}
+        return {a: e.reset(None if seed is None else seed + i)
+                for i, (a, e) in enumerate(self.envs.items())}
+
+    def step(self, actions: dict):
+        obs, rew, done = {}, {}, {}
+        for a, e in self.envs.items():
+            if self.done[a]:
+                continue
+            o, r, d, _ = e.step(actions[a])
+            obs[a], rew[a], done[a] = o, r, d
+            self.done[a] = d
+        done["__all__"] = all(self.done.values())
+        return obs, rew, done, {}
+
+
+_register_late()

@@ -51,17 +51,126 @@ def numpy_forward(params: dict, obs: np.ndarray):
     return logits, value
 
 
+def _gae(rews, vals, dones, gamma, lam):
+    """Generalized advantage estimation over one fragment; vals has the
+    bootstrap value appended."""
+    n = len(rews)
+    adv = np.zeros(n, np.float32)
+    last = 0.0
+    for t in range(n - 1, -1, -1):
+        nonterminal = 0.0 if dones[t] else 1.0
+        delta = rews[t] + gamma * vals[t + 1] * nonterminal - vals[t]
+        last = delta + gamma * lam * nonterminal * last
+        adv[t] = last
+    return adv, adv + vals[:-1]
+
+
 @ray_tpu.remote
 class RolloutWorker:
-    """CPU sampling actor (parity: rllib/evaluation/rollout_worker.py)."""
+    """CPU sampling actor (parity: rllib/evaluation/rollout_worker.py).
 
-    def __init__(self, env_spec, worker_index: int, gamma: float, lam: float):
+    model="mlp" uses the catalog's numpy forward; image models (CNN) run
+    the SAME jax forward jitted on the worker's CPU backend — a python
+    conv per env step would dominate sampling."""
+
+    def __init__(self, env_spec, worker_index: int, gamma: float, lam: float,
+                 model: str = "mlp"):
+        from ray_tpu.rllib.catalog import get_model
+
         self.env = make_env(env_spec)
         self.index = worker_index
         self.gamma = gamma
         self.lam = lam
         self.rng = np.random.default_rng(1000 + worker_index)
         self.obs = self.env.reset(seed=worker_index)
+        self._spec = get_model(model)
+        self._fwd = None
+        if model != "mlp":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            self._fwd = jax.jit(self._spec.jax_forward)
+
+    def _forward(self, params, obs):
+        if self._fwd is not None:
+            logits, value = self._fwd(params, obs)
+            return np.asarray(logits), np.asarray(value)
+        return self._spec.numpy_forward(params, obs)
+
+    def sample_multi_agent(self, policy_params: dict, num_steps: int,
+                           mapping: dict) -> dict:
+        """Multi-agent fragment (parity: reference MultiAgentEnv sampling):
+        steps every live agent with its mapped policy; returns one batch
+        PER POLICY plus episode stats."""
+        env = self.env
+        if not isinstance(self.obs, dict):
+            self.obs = env.reset(seed=self.index)
+        bufs = {a: {k: [] for k in
+                    ("obs", "actions", "logp", "rew", "val", "done")}
+                for a in env.agent_ids}
+        episode_returns = []
+        ep_ret = 0.0
+        for _ in range(num_steps):
+            actions = {}
+            for a, ob in self.obs.items():
+                params = policy_params[mapping[a]]
+                logits, value = self._forward(params, np.asarray(ob)[None])
+                logits = logits[0]
+                pr = np.exp(logits - logits.max())
+                pr /= pr.sum()
+                act = int(self.rng.choice(len(pr), p=pr))
+                actions[a] = act
+                b = bufs[a]
+                b["obs"].append(np.asarray(ob, np.float32))
+                b["actions"].append(act)
+                b["logp"].append(float(np.log(pr[act] + 1e-8)))
+                b["val"].append(float(value[0]))
+            next_obs, rews, dones, _ = env.step(actions)
+            for a in actions:
+                bufs[a]["rew"].append(float(rews.get(a, 0.0)))
+                bufs[a]["done"].append(bool(dones.get(a, False)))
+                ep_ret += float(rews.get(a, 0.0))
+            if dones.get("__all__"):
+                episode_returns.append(ep_ret)
+                ep_ret = 0.0
+                self.obs = env.reset()
+            else:
+                # Agents that just finished deliver their terminal obs with
+                # done=True and then leave the episode: keep only live
+                # agents, or the next loop would record a phantom
+                # transition from a terminal state.
+                self.obs = {a: o for a, o in next_obs.items()
+                            if not dones.get(a, False)}
+        out = {}
+        for a, b in bufs.items():
+            if not b["obs"]:
+                continue
+            # Bootstrap with the policy's value of the agent's last obs
+            # (0 when the agent is already done).
+            if a in self.obs and not (b["done"] and b["done"][-1]):
+                _, lv = self._forward(policy_params[mapping[a]],
+                                      np.asarray(self.obs[a])[None])
+                last_val = float(lv[0])
+            else:
+                last_val = 0.0
+            vals = np.asarray(b["val"] + [last_val], np.float32)
+            adv, rets = _gae(np.asarray(b["rew"], np.float32), vals,
+                             np.asarray(b["done"], bool), self.gamma,
+                             self.lam)
+            pid = mapping[a]
+            batch = {
+                "obs": np.asarray(b["obs"], np.float32),
+                "actions": np.asarray(b["actions"], np.int32),
+                "logp": np.asarray(b["logp"], np.float32),
+                "advantages": adv,
+                "returns": rets,
+            }
+            if pid in out:
+                out[pid] = {k: np.concatenate([out[pid][k], batch[k]])
+                            for k in batch}
+            else:
+                out[pid] = batch
+        return {"policy_batches": out, "episode_returns": episode_returns}
 
     def sample(self, params: dict, num_steps: int) -> dict:
         obs_buf, act_buf, logp_buf, rew_buf, val_buf, done_buf = \
@@ -69,7 +178,7 @@ class RolloutWorker:
         episode_returns = []
         ep_ret = 0.0
         for _ in range(num_steps):
-            logits, value = numpy_forward(params, self.obs[None, :])
+            logits, value = self._forward(params, np.asarray(self.obs)[None])
             logits = logits[0]
             p = np.exp(logits - logits.max())
             p /= p.sum()
@@ -90,7 +199,7 @@ class RolloutWorker:
             else:
                 self.obs = next_obs
         # Bootstrap value for the final partial episode.
-        _, last_val = numpy_forward(params, self.obs[None, :])
+        _, last_val = self._forward(params, np.asarray(self.obs)[None])
         vals = np.array(val_buf + [float(last_val[0])], np.float32)
         rews = np.array(rew_buf, np.float32)
         dones = np.array(done_buf, bool)
@@ -128,8 +237,16 @@ class PPOConfig:
     vf_coeff: float = 0.5
     entropy_coeff: float = 0.01
     lr: float = 3e-4
-    hidden_size: int = 64
+    # None -> the catalog model's own default width.
+    hidden_size: int | None = None
     seed: int = 0
+    # Catalog model name ("mlp", "resmlp", "atari_cnn" for pixel envs).
+    model: str = "mlp"
+    # Multi-agent (parity: reference .multi_agent(policies=...,
+    # policy_mapping_fn=...)): policy_id -> None; mapping agent_id ->
+    # policy_id. None = single-agent.
+    policies: Any = None
+    policy_mapping_fn: Any = None
 
     def environment(self, env):
         self.env = env
@@ -147,6 +264,11 @@ class PPOConfig:
             setattr(self, k, v)
         return self
 
+    def multi_agent(self, *, policies: dict, policy_mapping_fn):
+        self.policies = dict(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
     def build(self) -> "PPO":
         return PPO(self)
 
@@ -156,15 +278,41 @@ class PPO:
     algorithm.py:815 / training_step:1402)."""
 
     def __init__(self, config: PPOConfig):
+        from ray_tpu.rllib.catalog import get_model
+
         self.config = config
         probe_env = make_env(config.env)
-        self.obs_size = probe_env.observation_size
         self.num_actions = probe_env.num_actions
-        self.params = init_policy_params(
-            self.obs_size, self.num_actions, config.hidden_size, config.seed)
+        self._spec = get_model(config.model)
+        if config.model == "atari_cnn":
+            obs_in = getattr(probe_env, "observation_shape")
+        else:
+            obs_in = probe_env.observation_size
+        self.obs_size = obs_in
+
+        hidden = config.hidden_size or self._spec.default_hidden
+
+        def fresh_params(seed):
+            return self._spec.init_params(obs_in, self.num_actions, hidden,
+                                          seed)
+
+        if config.policies:
+            self.policy_params = {
+                pid: fresh_params(config.seed + i)
+                for i, pid in enumerate(sorted(config.policies))}
+            self.params = None
+        else:
+            self.params = fresh_params(config.seed)
+            self.policy_params = None
         self.workers = [
-            RolloutWorker.remote(config.env, i, config.gamma, config.lam)
+            RolloutWorker.remote(config.env, i, config.gamma, config.lam,
+                                 config.model)
             for i in range(config.num_rollout_workers)]
+        self._agent_mapping = None
+        if config.policies:
+            self._agent_mapping = {
+                a: config.policy_mapping_fn(a)
+                for a in probe_env.agent_ids}
         self._update = None
         self.iteration = 0
 
@@ -178,13 +326,16 @@ class PPO:
         cfg = self.config
         opt = optax.adam(cfg.lr)
         self._opt = opt
-        self._opt_state = opt.init(self.params)
+        if self.policy_params is not None:
+            self._opt_state = {pid: opt.init(p)
+                               for pid, p in self.policy_params.items()}
+        else:
+            self._opt_state = opt.init(self.params)
+
+        forward = self._spec.jax_forward
 
         def loss_fn(params, batch):
-            h = jnp.tanh(batch["obs"] @ params["h1"]["w"] + params["h1"]["b"])
-            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
-            logits = h @ params["pi"]["w"] + params["pi"]["b"]
-            value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+            logits, value = forward(params, batch["obs"])
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
@@ -220,6 +371,8 @@ class PPO:
         t0 = time.time()
         per_worker = max(cfg.rollout_fragment_length,
                          cfg.train_batch_size // max(1, len(self.workers)))
+        if self.policy_params is not None:
+            return self._train_multi_agent(per_worker, t0)
         host_params = jax.tree_util.tree_map(np.asarray, self.params)
         batches = ray_tpu.get(
             [w.sample.remote(host_params, per_worker) for w in self.workers],
@@ -254,6 +407,56 @@ class PPO:
             **{k: float(v) for k, v in last_aux.items()},
         }
 
+    def _train_multi_agent(self, per_worker: int, t0: float) -> dict:
+        """Multi-agent iteration: per-policy batches from every worker,
+        one PPO update stream per policy (parity: reference multi-agent
+        training_step updating each policy from its own batch)."""
+        import jax
+        import numpy as np
+
+        cfg = self.config
+        mapping = self._agent_mapping
+        host = {pid: jax.tree_util.tree_map(np.asarray, p)
+                for pid, p in self.policy_params.items()}
+        results = ray_tpu.get(
+            [w.sample_multi_agent.remote(host, per_worker, mapping)
+             for w in self.workers], timeout=600)
+        episode_returns = sum((r["episode_returns"] for r in results), [])
+        sample_time = time.time() - t0
+        t1 = time.time()
+        total_steps = 0
+        last_aux = {}
+        for pid in self.policy_params:
+            parts = [r["policy_batches"][pid] for r in results
+                     if pid in r["policy_batches"]]
+            if not parts:
+                continue
+            batch = {k: np.concatenate([p[k] for p in parts])
+                     for k in parts[0]}
+            n = len(batch["obs"])
+            total_steps += n
+            rng = np.random.default_rng(cfg.seed + self.iteration)
+            for _ in range(cfg.num_sgd_iter):
+                perm = rng.permutation(n)
+                for st in range(0, n, cfg.sgd_minibatch_size):
+                    idx = perm[st: st + cfg.sgd_minibatch_size]
+                    mb = {k: v[idx] for k, v in batch.items()}
+                    (self.policy_params[pid], self._opt_state[pid],
+                     _loss, aux) = self._update(
+                        self.policy_params[pid], self._opt_state[pid], mb)
+                    last_aux = aux
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(episode_returns))
+            if episode_returns else 0.0,
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_this_iter": total_steps,
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(time.time() - t1, 3),
+            **{k: float(v) for k, v in last_aux.items()},
+        }
+
     def stop(self):
         for w in self.workers:
             try:
@@ -261,12 +464,20 @@ class PPO:
             except Exception:
                 pass
 
-    def get_policy_params(self):
+    def get_policy_params(self, policy_id: str | None = None):
         import jax
         import numpy as np
 
+        if self.policy_params is not None:
+            if policy_id is None:
+                raise ValueError(
+                    "multi-agent PPO: pass policy_id to "
+                    f"get_policy_params (policies: {sorted(self.policy_params)})")
+            return jax.tree_util.tree_map(np.asarray,
+                                          self.policy_params[policy_id])
         return jax.tree_util.tree_map(np.asarray, self.params)
 
-    def compute_single_action(self, obs) -> int:
-        logits, _ = numpy_forward(self.get_policy_params(), obs[None, :])
+    def compute_single_action(self, obs, policy_id: str | None = None) -> int:
+        logits, _ = self._spec.numpy_forward(
+            self.get_policy_params(policy_id), np.asarray(obs)[None])
         return int(np.argmax(logits[0]))

@@ -222,7 +222,31 @@ def object_broadcast(mb: int, num_nodes: int) -> dict:
         cluster.shutdown()
 
 
+def ppo_throughput(iters: int, num_workers: int, model: str = "mlp",
+                   env: str = "CartPole-v1") -> dict:
+    """PPO sampled env-steps/sec (reference gate: BASELINE.json "PPO
+    steps/sec"; rollout actors on CPU, jitted learner)."""
+    from ray_tpu.rllib.ppo import PPOConfig
+
+    algo = (PPOConfig().environment(env)
+            .rollouts(num_rollout_workers=num_workers)
+            .training(model=model, rollout_fragment_length=512,
+                      train_batch_size=512 * num_workers,
+                      num_sgd_iter=4, sgd_minibatch_size=256)
+            .build())
+    try:
+        algo.train()  # warm (compile + worker spin-up)
+        t0 = time.perf_counter()
+        steps = sum(algo.train()["timesteps_this_iter"]
+                    for _ in range(iters))
+        dt = time.perf_counter() - t0
+        return {"env_steps_per_s": round(steps / dt, 1)}
+    finally:
+        algo.stop()
+
+
 ENTRIES["object_broadcast"] = object_broadcast
+ENTRIES["ppo_throughput"] = ppo_throughput
 
 # Workloads that manage their own cluster lifecycle.
 _SELF_MANAGED = {"kill_node_mid_run", "object_broadcast"}
@@ -316,6 +340,30 @@ def run_test(test: dict, quick: bool) -> dict:
     return record
 
 
+def _pin_cpu_if_accelerator_dead(timeout_s: float = 60.0) -> None:
+    """Workloads jit in THIS process (PPO learner, trainers). With a live
+    accelerator they should use it; with a wedged axon tunnel the first
+    device init would hang forever (the sitecustomize hook force-inits
+    the tunnel backend), so probe in a SUBPROCESS and pin the CPU
+    platform before any jax import when the tunnel is dead (same guard
+    as bench.py)."""
+    import subprocess
+
+    probe = "import jax; print(jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", probe], timeout=timeout_s,
+                           capture_output=True, text=True)
+        alive = r.returncode == 0 and r.stdout.strip() not in ("", "cpu")
+    except subprocess.TimeoutExpired:
+        alive = False
+    if not alive:
+        print("release: accelerator unavailable; pinning jax to CPU",
+              file=sys.stderr)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default=None)
@@ -323,6 +371,7 @@ def main():
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "release_results.json"))
     args = ap.parse_args()
+    _pin_cpu_if_accelerator_dead()
 
     manifest = _load_manifest()
     results = []
