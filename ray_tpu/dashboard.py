@@ -86,9 +86,11 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/":
                 return self._send(200, _PAGE.encode(), "text/html")
             if path == "/metrics":
-                from ray_tpu.util.metrics import prometheus_text
+                from ray_tpu.util.metrics import (core_prometheus_text,
+                                                  prometheus_text)
 
-                return self._send(200, prometheus_text().encode(),
+                body = prometheus_text() + core_prometheus_text()
+                return self._send(200, body.encode(),
                                   "text/plain; version=0.0.4")
             if path == "/api/version":
                 import ray_tpu

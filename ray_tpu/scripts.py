@@ -160,6 +160,19 @@ def cmd_list(args):
     return 0
 
 
+def cmd_summary(args):
+    """`ray_tpu summary tasks|actors|objects` (parity: reference
+    `ray summary` — experimental/state/state_cli.py summary commands)."""
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util import state
+
+    fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors,
+          "objects": state.summarize_objects}[args.entity]
+    print(json.dumps(fn(), indent=2, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_microbenchmark(args):
     from ray_tpu import microbenchmark
 
@@ -249,6 +262,11 @@ def main():
     p.add_argument("entity", choices=["nodes", "actors", "jobs", "tasks",
                                       "placement-groups", "objects"])
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="aggregate counts per entity "
+                                       "(parity: `ray summary`)")
+    p.add_argument("entity", choices=["tasks", "actors", "objects"])
+    p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("microbenchmark", help="core-runtime throughput suite")
     p.set_defaults(fn=cmd_microbenchmark)

@@ -18,6 +18,12 @@ behind Serve deployments); this engine is native and TPU-shaped:
 - **Streaming.** `submit()` returns a handle whose iterator yields tokens
   as they are produced; `LLMDeployment` plugs that into Serve's
   generator-streaming path (`handle.options(stream=True)` / `?stream=1`).
+
+KV memory: slots currently hold max_len-sized caches. The paged
+replacement (vLLM block tables — pool pages + per-slot page tables +
+the scalar-prefetch pallas kernel in ops/paged_attention.py, with its
+PageAllocator) is built and unit-tested; engine integration is the next
+step so HBM scales with resident tokens instead of max_len x slots.
 """
 
 from __future__ import annotations

@@ -200,3 +200,59 @@ def prometheus_text() -> str:
                         f"{name}{_prom_labels(tag_json, {'worker': worker})}"
                         f" {v}")
     return "\n".join(lines) + "\n"
+
+
+def core_prometheus_text() -> str:
+    """Core-runtime metrics in Prometheus exposition format (parity:
+    reference src/ray/stats/metric_defs.cc per-component instrumentation
+    exported through the metrics agent): per-node scheduler/worker-pool/
+    object-store gauges plus cluster-level actor/task state counts."""
+    from ray_tpu.util import state as _state
+
+    lines = []
+
+    def gauge(name, help_, samples):
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        for labels, value in samples:
+            lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            lines.append(f"{name}{{{lab}}} {value}")
+
+    try:
+        stats = _state.node_stats()
+    except Exception:
+        stats = []
+    ok = [st for st in stats if "error" not in st]
+    nid = lambda st: {"node_id": str(st.get("node_id", "?"))[:12]}
+    gauge("ray_tpu_node_workers", "worker processes per node",
+          [(nid(st), st.get("num_workers", 0)) for st in ok])
+    gauge("ray_tpu_node_idle_workers", "idle pool workers per node",
+          [(nid(st), st.get("idle_workers", 0)) for st in ok])
+    gauge("ray_tpu_node_pending_leases", "queued lease requests per node",
+          [(nid(st), st.get("pending_leases", 0)) for st in ok])
+    gauge("ray_tpu_node_leases_granted_total", "leases granted since boot",
+          [(nid(st), st.get("leases_granted", 0)) for st in ok])
+    gauge("ray_tpu_store_bytes_in_use", "shm object store bytes in use",
+          [(nid(st), st.get("store", {}).get("bytes_in_use", 0))
+           for st in ok])
+    gauge("ray_tpu_store_num_objects", "objects resident in the shm store",
+          [(nid(st), st.get("store", {}).get("num_objects", 0))
+           for st in ok])
+    gauge("ray_tpu_spilled_bytes", "bytes currently spilled",
+          [(nid(st), st.get("spilled_bytes", 0)) for st in ok])
+    for key, avail in (("CPU", "cpu"), ("TPU", "tpu")):
+        gauge(f"ray_tpu_node_{avail}_available", f"available {key} per node",
+              [(nid(st), st.get("available", {}).get(key, 0)) for st in ok])
+    try:
+        actors = _state.summarize_actors()["by_state"]
+        gauge("ray_tpu_actors", "actors by state",
+              [({"state": k}, v) for k, v in actors.items()])
+    except Exception:
+        pass
+    try:
+        tasks = _state.summarize_tasks()["by_state"]
+        gauge("ray_tpu_tasks", "task events by state",
+              [({"state": k}, v) for k, v in tasks.items()])
+    except Exception:
+        pass
+    return "\n".join(lines) + "\n"

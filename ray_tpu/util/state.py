@@ -74,6 +74,37 @@ def dump_stacks() -> list[dict]:
     return cw._run(collect())
 
 
+def node_stats() -> list[dict]:
+    """Per-raylet core stats (workers, leases, store, spilling) pulled
+    concurrently from every alive node — the data source for the
+    dashboard's core metrics (parity: reference per-node stats via the
+    dashboard reporter agent)."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+
+    cw = get_core_worker()
+    nodes = cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"]
+
+    async def one(n):
+        try:
+            conn = await rpc.connect(n["host"], n["raylet_port"],
+                                     name="node-stats")
+            try:
+                return await conn.call("GetState", {}, timeout=10)
+            finally:
+                await conn.close()
+        except Exception as e:
+            return {"node_id": n["node_id"],
+                    "error": f"{type(e).__name__}: {e}"}
+
+    async def collect():
+        return list(await asyncio.gather(
+            *(one(n) for n in nodes if n.get("alive"))))
+
+    return cw._run(collect())
+
+
 def list_objects() -> list[dict]:
     """Objects owned by the calling process (cluster-wide listing requires
     per-raylet scans; see `summarize_objects`)."""
@@ -104,6 +135,19 @@ def summarize_tasks() -> dict:
 def summarize_actors() -> dict:
     by_state = Counter(a["state"] for a in list_actors())
     return {"by_state": dict(by_state)}
+
+
+def summarize_objects() -> dict:
+    """Owner-reported object counts and bytes by state (parity:
+    `ray summary objects`)."""
+    from collections import Counter
+
+    by_state = Counter()
+    total_bytes = 0
+    for o in list_objects():
+        by_state[o.get("state", "?")] += 1
+        total_bytes += int(o.get("size") or 0)
+    return {"by_state": dict(by_state), "total_bytes": total_bytes}
 
 
 def cluster_status() -> dict:
