@@ -142,6 +142,10 @@ class GcsServer:
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         if self.persistence_path:
             self._load_state()
+            from ray_tpu.util import events
+
+            events.configure(os.path.dirname(self.persistence_path), "gcs")
+            events.record("INFO", "gcs", "control plane started")
         addr = await self._server.start(host, port)
         self._health_task = asyncio.create_task(self._health_check_loop())
         if self.persistence_path:
@@ -393,6 +397,10 @@ class GcsServer:
         self.pending_demand.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id[:8], reason)
         self.mark_dirty()
+        from ray_tpu.util import events
+
+        events.record("ERROR", "gcs", f"node dead: {reason}",
+                      node_id=node_id)
         await self.publish("NODE", {"event": "dead", "node_id": node_id, "reason": reason})
         # Actor fault tolerance: restart or kill actors that lived there
         # (reference: gcs_actor_manager.cc OnNodeDead).
@@ -634,6 +642,10 @@ class GcsServer:
             a["address"] = None
             a["death_cause"] = reason
             self.named_actors.pop((a["namespace"], a["name"]), None)
+            from ray_tpu.util import events
+
+            events.record("WARNING", "gcs", "actor dead",
+                          actor_id=actor_id)
             await self.publish("ACTOR", {"actor_id": actor_id, "state": ACTOR_DEAD,
                                          "reason": reason})
 

@@ -219,6 +219,11 @@ class Raylet:
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self.host, self.port = await self.server.start(host, port)
         os.makedirs(self.session_dir, exist_ok=True)
+        from ray_tpu.util import events
+
+        events.configure(self.session_dir, f"raylet-{self.node_id[:8]}")
+        events.record("INFO", "raylet", "node started",
+                      node_id=self.node_id, resources=self.total_resources)
         # Fetch the cluster config BEFORE sizing the arena: store size and
         # spill backend are config-driven, and the later RegisterNode
         # response arrives only after the store must already exist
@@ -529,6 +534,11 @@ class Raylet:
             self._kill_worker(victim)
 
     async def _on_worker_death(self, w: WorkerHandle, reason: str):
+        from ray_tpu.util import events
+
+        events.record("WARNING" if w.leased or w.actor_id else "INFO",
+                      "raylet", f"worker died: {reason}",
+                      worker_id=w.worker_id, actor_id=w.actor_id)
         w.dead = True
         self.workers.pop(w.worker_id, None)
         if w in self.idle_workers:
@@ -1165,6 +1175,11 @@ class Raylet:
                         except OSError:
                             pass
             if freed:
+                from ray_tpu.util import events
+
+                events.record("INFO", "raylet", "objects spilled",
+                              freed_bytes=freed,
+                              total_spilled=self._num_spilled)
                 logger.info("spilled %d objects (%.1f MB) to %s",
                             self._num_spilled, freed / 1e6, self.spill_dir)
             return freed

@@ -160,6 +160,28 @@ def cmd_list(args):
     return 0
 
 
+def cmd_events(args):
+    """`ray_tpu events` — merged structured cluster events (parity:
+    reference src/ray/util/event.h + dashboard event module)."""
+    import glob
+    import os
+
+    from ray_tpu.util.events import list_events
+
+    base = "/tmp/ray_tpu_sessions"
+    sessions = sorted(glob.glob(os.path.join(base, "session-*")),
+                      key=os.path.getmtime)
+    if not sessions:
+        print("no sessions found")
+        return 1
+    for e in list_events(sessions[-1], min_severity=args.severity):
+        fields = e.get("fields") or {}
+        extra = " ".join(f"{k}={v}" for k, v in fields.items())
+        print(f'{e["ts"]:.3f} {e["severity"]:7} {e["source"]:8} '
+              f'{e["message"]} {extra}'.rstrip())
+    return 0
+
+
 def cmd_summary(args):
     """`ray_tpu summary tasks|actors|objects` (parity: reference
     `ray summary` — experimental/state/state_cli.py summary commands)."""
@@ -262,6 +284,12 @@ def main():
     p.add_argument("entity", choices=["nodes", "actors", "jobs", "tasks",
                                       "placement-groups", "objects"])
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("events", help="structured cluster events "
+                                      "(node/actor deaths, OOM, spills)")
+    p.add_argument("--severity", default="INFO",
+                   choices=["DEBUG", "INFO", "WARNING", "ERROR", "FATAL"])
+    p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("summary", help="aggregate counts per entity "
                                        "(parity: `ray summary`)")
