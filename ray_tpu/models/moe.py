@@ -226,7 +226,7 @@ def moe_aux_loss(intermediates) -> jnp.ndarray:
 # Sharding rules: transformer rules + expert weights sharded over ep (and
 # tp/fsdp inside each expert). The router stays replicated.
 MOE_RULES = ShardingRules([
-    (r"embed/embedding", P("tp", "fsdp")),
+    (r"embed/embedding", P("fsdp", "tp")),
     (r"(q_proj|k_proj|v_proj)/kernel", P("fsdp", "tp")),
     (r"o_proj/kernel", P("tp", "fsdp")),
     (r"router/kernel", P()),

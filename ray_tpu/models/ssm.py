@@ -174,7 +174,7 @@ class SSMModel(nn.Module):
 # shards the inner channel dim E, FSDP the other matrix dim; the tiny
 # d_state axis stays replicated.
 SSM_RULES = ShardingRules([
-    (r"tok_embed/embedding", P("tp", "fsdp")),
+    (r"tok_embed/embedding", P("fsdp", "tp")),
     (r"in_proj/kernel", P("fsdp", "tp")),
     (r"out_proj/kernel", P("tp", "fsdp")),
     (r"dt_proj/kernel", P("fsdp", "tp")),

@@ -75,9 +75,16 @@ def _clip_spec(spec: PartitionSpec, leaf) -> PartitionSpec:
 
 # Default rule set for transformer decoders (llama-style naming in
 # ray_tpu.models): TP shards attention heads + MLP hidden, FSDP shards the
-# other dimension of each matrix (ZeRO), embeddings shard vocab over tp.
+# other dimension of each matrix (ZeRO). The (vocab, d_model) embedding
+# TABLE shards vocab over fsdp and d_model over tp — with vocab on tp,
+# the embedding backward needs grad-activations resharded batch→d_model
+# ACROSS fsdp, which XLA can only express as a full rematerialization
+# ("Involuntary full rematerialization" per step); with d_model on tp the
+# reshard is a local slice. The (d_model, vocab) lm_head kernel keeps
+# vocab on tp (Megatron column-parallel output; its backward has no such
+# pathology — the dryrun compiles warning-free).
 TRANSFORMER_RULES = ShardingRules([
-    (r"embed/embedding", P("tp", "fsdp")),
+    (r"embed/embedding", P("fsdp", "tp")),
     (r"(q_proj|k_proj|v_proj)/kernel", P("fsdp", "tp")),
     (r"o_proj/kernel", P("tp", "fsdp")),
     (r"(gate_proj|up_proj)/kernel", P("fsdp", "tp")),
