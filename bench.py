@@ -31,7 +31,14 @@ def _probe_accelerator() -> str | None:
     """
     probe = ("import jax; d = jax.devices()[0]; "
              "print(d.platform, '|', d.device_kind)")
-    timeouts = (90.0, 120.0, 150.0)
+    # ~14 min total with backoff: a wedged tunnel often recovers within
+    # minutes, and giving up early is how two rounds of BENCH artifacts
+    # ended up as CPU fallbacks.  Overridable for tests.
+    timeouts = (90.0, 150.0, 240.0, 300.0)
+    if os.environ.get("RAY_TPU_BENCH_PROBE_TIMEOUTS"):
+        timeouts = tuple(
+            float(t) for t in
+            os.environ["RAY_TPU_BENCH_PROBE_TIMEOUTS"].split(","))
     for attempt, timeout_s in enumerate(timeouts):
         try:
             r = subprocess.run([sys.executable, "-c", probe],
@@ -51,7 +58,7 @@ def _probe_accelerator() -> str | None:
             print(f"bench: device probe attempt {attempt + 1} failed rc="
                   f"{r.returncode}: {r.stderr[-500:]}", file=sys.stderr)
         if attempt + 1 < len(timeouts):
-            time.sleep(10)
+            time.sleep(15 * (attempt + 1))
     return None
 
 

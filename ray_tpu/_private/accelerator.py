@@ -138,12 +138,25 @@ def install_worker_jax_isolation() -> None:
     import importlib.machinery
     import sys
 
-    if "jax" in sys.modules:  # already imported: pin now
-        _pin_jax_platform(sys.modules["jax"])
+    if "jax" in sys.modules:
+        # Pre-imported jax (site hooks, or a zygote-forked worker): no
+        # backend is initialized yet, so the pin can — and must — wait
+        # until the first task, when the TPU lease is actually known.
+        # Pinning "cpu" here would freeze every such worker off the TPU.
         return
     if any(isinstance(f, _JaxIsolationFinder) for f in sys.meta_path):
         return
     sys.meta_path.insert(0, _JaxIsolationFinder())
+
+
+def ensure_jax_pinned() -> None:
+    """Task-time pin for workers whose jax was pre-imported (the import
+    hook never fired). Safe to call repeatedly; first call wins, matching
+    the freeze-on-first-import semantics of the hook path."""
+    import sys
+
+    if _pinned_platform is None and "jax" in sys.modules:
+        _pin_jax_platform(sys.modules["jax"])
 
 
 class _JaxIsolationFinder:

@@ -144,7 +144,11 @@ class Connection:
         except Exception:
             logger.exception("%s: recv loop error", self.name)
         finally:
-            await self._shutdown()
+            # Synchronous on purpose: this finally also runs when the
+            # coroutine is closed by GC after its loop is gone (process
+            # teardown) — an `await` here would raise "Event loop is
+            # closed" as an unraisable exception.
+            self._shutdown()
 
     async def _dispatch(self, seq, method: str, payload) -> None:
         handler = self.handlers.get(method)
@@ -168,13 +172,17 @@ class Connection:
             else:
                 logger.exception("%s: error in notify handler %s", self.name, method)
 
-    async def _shutdown(self) -> None:
+    def _shutdown(self) -> None:
         if self._closed:
             return
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():
-                fut.set_exception(ConnectionLost(f"{self.name}: connection lost"))
+                try:
+                    fut.set_exception(
+                        ConnectionLost(f"{self.name}: connection lost"))
+                except RuntimeError:
+                    pass  # future's event loop already closed (teardown)
         self._pending.clear()
         try:
             self.writer.close()
@@ -193,7 +201,7 @@ class Connection:
                 await self._recv_task
             except (asyncio.CancelledError, Exception):
                 pass
-        await self._shutdown()
+        self._shutdown()
 
 
 class RpcServer:

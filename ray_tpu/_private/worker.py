@@ -131,9 +131,12 @@ class _BorrowedRef:
         self.removed_event: asyncio.Event | None = None
 
 
+_task_seq = itertools.count(1)
+
+
 class _PendingTask:
     __slots__ = ("spec", "retries_left", "constructor_like", "futures",
-                 "pushed_to", "nested_args")
+                 "pushed_to", "nested_args", "seq")
 
     def __init__(self, spec: TaskSpec, retries_left: int,
                  nested_args: list | None = None):
@@ -145,6 +148,13 @@ class _PendingTask:
         # (oid_hex, owner_wire|None); refcounted like top-level args and
         # released at completion per the borrower protocol.
         self.nested_args = nested_args or []
+        # Submission order, kept across retries: queues stay sorted by
+        # seq so a retried producer re-enters AHEAD of a later-submitted
+        # consumer (a tail re-enqueue could order the consumer first in
+        # the same push batch, which executes sequentially on one worker
+        # thread — the consumer would block forever on the producer's
+        # return object while the producer sits behind it).
+        self.seq = next(_task_seq)
 
 
 class _LeaseSlot:
@@ -356,11 +366,18 @@ class CoreWorker:
         for c in (self.gcs, self.raylet):
             if c:
                 await c.close()
-        # Cancel stragglers (event flusher, recv loops of cached conns) so
-        # loop teardown is silent.
-        for t in asyncio.all_tasks():
-            if t is not asyncio.current_task():
-                t.cancel()
+        # Cancel stragglers (event flusher, recv loops of cached conns) AND
+        # give them a cycle to unwind — cancelling without awaiting leaves
+        # "Task was destroyed but it is pending!" noise at loop teardown.
+        pending = [t for t in asyncio.all_tasks()
+                   if t is not asyncio.current_task()]
+        for t in pending:
+            t.cancel()
+        if pending:
+            try:
+                await asyncio.wait(pending, timeout=1.0)
+            except Exception:
+                pass
 
     # ---------- events ----------
 
@@ -905,6 +922,7 @@ class CoreWorker:
         instead of discarding the live borrow."""
         key = (oid_hex, borrower_id)
         seen_gen = self._borrow_watches.get(key, 1)
+        transient_failures = 0
         try:
             while not self._shutdown:
                 await asyncio.sleep(5.0)
@@ -913,8 +931,18 @@ class CoreWorker:
                         Address.from_wire(borrower_addr))
                     await conn.call("WaitForRefRemoved",
                                     {"object_id": oid_hex}, timeout=None)
+                except (rpc.ConnectionLost, ConnectionRefusedError,
+                        ConnectionResetError):
+                    break  # borrower process confirmed gone
                 except (rpc.RpcError, OSError, asyncio.TimeoutError):
-                    break  # borrower unreachable == borrower gone
+                    # Transient (handler error, busy peer): a live
+                    # borrower must NOT be discarded — its object would
+                    # be freed under it. Retry a few times first.
+                    transient_failures += 1
+                    if transient_failures >= 5:
+                        break
+                    continue
+                transient_failures = 0
                 gen = self._borrow_watches.get(key, seen_gen)
                 if gen == seen_gen:
                     break  # clean release, no re-registration raced us
@@ -967,18 +995,36 @@ class CoreWorker:
         if owner.worker_id == self.worker_id:
             self._add_borrower(oid_hex, borrower_id, borrower_addr)
             return
-        try:
-            conn = await self._owner_conn(owner)
-            # A CALL, not a notify: the ack guarantees the owner recorded
-            # the new borrower before our own hold (whose release answers
-            # a WaitForRefRemoved on a DIFFERENT connection) can drop —
-            # cross-connection ordering that a notify cannot provide.
-            await conn.call("BorrowRef", {"object_id": oid_hex,
-                                          "borrower": borrower_id,
-                                          "borrower_addr": borrower_addr},
-                            timeout=10)
-        except Exception:
-            pass  # owner unreachable: object is lost anyway
+        # Retry transient failures with backoff: the executing worker has
+        # already marked this borrow registered (it will never send its
+        # own BorrowRef), so dropping the forward on a 10s timeout
+        # against a live-but-busy owner would let the owner free an
+        # object a live process still references. Only confirmed owner
+        # death (connection lost/refused) aborts — then the object is
+        # lost regardless of the borrow.
+        for delay in (0.5, 1.0, 2.0, 4.0, None):
+            try:
+                conn = await self._owner_conn(owner)
+                # A CALL, not a notify: the ack guarantees the owner
+                # recorded the new borrower before our own hold (whose
+                # release answers a WaitForRefRemoved on a DIFFERENT
+                # connection) can drop — cross-connection ordering that a
+                # notify cannot provide.
+                await conn.call("BorrowRef", {"object_id": oid_hex,
+                                              "borrower": borrower_id,
+                                              "borrower_addr": borrower_addr},
+                                timeout=10)
+                return
+            except (rpc.ConnectionLost, ConnectionRefusedError):
+                return  # owner process gone: object is lost anyway
+            except Exception:
+                if delay is None or self._shutdown:
+                    logger.warning(
+                        "forwarding borrow of %s to its owner kept "
+                        "failing; the borrower at %s may observe "
+                        "ObjectLostError", oid_hex[:8], borrower_addr)
+                    return
+                await asyncio.sleep(delay)
 
     async def _handle_borrow_ref(self, conn, payload):
         self._add_borrower(payload["object_id"], payload["borrower"],
@@ -1191,7 +1237,18 @@ class CoreWorker:
 
     def _enqueue_task(self, pt: _PendingTask):
         shape = _shape_key(pt.spec.resources) + repr(pt.spec.strategy) + pt.spec.placement_group
-        self._queues[shape].append(pt.spec.task_id)
+        q = self._queues[shape]
+        # Keep the queue sorted by submission seq. Fresh submissions have
+        # the highest seq so the scan exits immediately (append); only a
+        # retry walks back past younger entries, restoring
+        # producer-before-consumer order within a future push batch.
+        i = len(q)
+        while i > 0:
+            prev = self.pending_tasks.get(q[i - 1])
+            if prev is None or prev.seq <= pt.seq:
+                break
+            i -= 1
+        q.insert(i, pt.spec.task_id)
         self._spawn(self._pump_queue(shape, pt.spec))
 
     _PUSH_BATCH_MAX = 64
@@ -1465,6 +1522,17 @@ class CoreWorker:
             # system condition retried like worker death, independent of
             # the user's retry_exceptions setting.
             pt.retries_left -= 1
+            # The failed attempt may still hold borrows (refs it stashed
+            # out-of-band before raising): the worker marked them
+            # registered and waits for owner-initiated release, so they
+            # must reach the owners even though the result is discarded.
+            # Spawned: a slow forward must not delay the retry or the
+            # rest of the reply batch (the retry keeps its arg holds, so
+            # there is no release to order against).
+            for oid_hex, owner_wire in resp.get("borrows") or []:
+                if borrower_id:
+                    self._spawn(self._forward_borrow(
+                        oid_hex, owner_wire, borrower_id, borrower_addr))
             self._enqueue_task(pt)
             return
         self.pending_tasks.pop(spec.task_id, None)
@@ -1506,11 +1574,23 @@ class CoreWorker:
                     o.ready_event.set()
         # Borrower handoff BEFORE releasing our own holds: args the worker
         # still references are registered with their owners first, on the
-        # same ordered owner connections our releases use.
-        for oid_hex, owner_wire in resp.get("borrows") or []:
-            if borrower_id:
-                await self._forward_borrow(oid_hex, owner_wire, borrower_id,
-                                           borrower_addr)
+        # same ordered owner connections our releases use. Forwards can
+        # block for seconds (retry-with-backoff against a busy owner), so
+        # they run in a spawned per-task continuation — ordering only
+        # matters WITHIN a task (forwards, then release), and awaiting
+        # here would stall every other result in the same TaskDone batch.
+        borrows = [b for b in (resp.get("borrows") or []) if borrower_id]
+        if borrows:
+            self._spawn(self._forward_borrows_then_release(
+                pt, borrows, borrower_id, borrower_addr))
+        else:
+            self._release_submitted_refs(pt)
+
+    async def _forward_borrows_then_release(self, pt, borrows, borrower_id,
+                                            borrower_addr):
+        for oid_hex, owner_wire in borrows:
+            await self._forward_borrow(oid_hex, owner_wire, borrower_id,
+                                       borrower_addr)
         self._release_submitted_refs(pt)
 
     def _release_submitted_refs(self, pt):
@@ -1750,6 +1830,9 @@ class CoreWorker:
 
             accelerator.set_current_task_tpu(
                 (spec.resources or {}).get(accelerator.TPU_RESOURCE, 0) > 0)
+            # Workers whose jax was pre-imported (zygote fork / site
+            # hooks) pin at first task, now that the lease is known.
+            accelerator.ensure_jax_pinned()
             if accelerator.current_task_needs_fresh_worker():
                 # jax is already pinned to CPU in this process and cannot
                 # switch; running a TPU-lease task here would silently

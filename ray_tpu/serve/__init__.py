@@ -156,13 +156,18 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         if cls._route_poll_started:
             return
         cls._route_poll_started = True
-        cls._route_poll_stop.clear()
-        stop = cls._route_poll_stop
+        # Fresh Event per poll thread: clearing the shared one would
+        # resurrect a previous thread still parked in its (up to 30s)
+        # blocking get from before shutdown(), leaving two route-poll
+        # threads racing against the new serve session.
+        stop = threading.Event()
+        cls._route_poll_stop = stop
 
         def loop():
             import time as _time
 
             while not stop.is_set():
+                t0 = _time.monotonic()
                 try:
                     # Look up the EXISTING controller only — get_if_exists
                     # creation here would resurrect a detached controller
@@ -177,6 +182,11 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                     if stop.wait(1.0):
                         return
                     continue
+                if "routes" not in upd and _time.monotonic() - t0 < 1.0:
+                    # Instant empty reply: controller's parked-poll slots
+                    # exhausted — back off instead of spinning.
+                    if stop.wait(0.5):
+                        return
                 if "routes" in upd:
                     cls._route_poll_version, cls._routes = upd["routes"]
                     cls._routes_ts = _time.monotonic()

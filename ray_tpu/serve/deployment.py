@@ -403,6 +403,7 @@ class _RouterState:
 
         def loop():
             while not self.poll_stop.is_set():
+                t0 = time.monotonic()
                 try:
                     upd = ray_tpu.get(
                         controller.long_poll.remote(
@@ -412,6 +413,13 @@ class _RouterState:
                     if self.poll_stop.wait(1.0):
                         return
                     continue
+                if key not in upd and time.monotonic() - t0 < 1.0:
+                    # Instant empty reply = the controller's parked-poll
+                    # slots are exhausted (it answers {} immediately, not
+                    # after the 10s park). Re-calling in a tight loop
+                    # would hammer its concurrency lanes; back off.
+                    if self.poll_stop.wait(0.5):
+                        return
                 if key in upd:
                     ver, reps = upd[key]
                     with self.lock:

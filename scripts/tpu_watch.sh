@@ -1,0 +1,35 @@
+#!/bin/bash
+# Persistent TPU watcher: probe the axon tunnel until it answers, then run
+# the real-TPU bench (bench.py) and record the result in BENCH_TPU_LIVE.json.
+#
+# VERDICT.md (round 2) weak #1: both prior BENCH artifacts were CPU
+# fallbacks because the probe ladder gave up in <7 minutes.  This watcher
+# outlasts a wedged tunnel: it retries for up to 10 hours with a 10-minute
+# per-probe timeout and runs the full bench on first success.
+cd "$(dirname "$0")/.." || exit 1
+LOG=.tpu_watch.log
+deadline=$(( $(date +%s) + 10*3600 ))
+attempt=0
+echo "[$(date +%T)] tpu_watch starting (pid $$)" >> "$LOG"
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  attempt=$((attempt+1))
+  echo "[$(date +%T)] probe attempt $attempt" >> "$LOG"
+  if timeout 600 python -c "import jax; d=jax.devices()[0]; print(d.platform,'|',d.device_kind,'|',len(jax.devices()))" >> "$LOG" 2>&1; then
+    echo "[$(date +%T)] probe OK; running bench.py" >> "$LOG"
+    if timeout 3600 python bench.py > .bench_tpu_out.json 2>> "$LOG"; then
+      if grep -q '"backend": "cpu"' .bench_tpu_out.json; then
+        echo "[$(date +%T)] bench fell back to cpu; will retry" >> "$LOG"
+      else
+        echo "[$(date +%T)] TPU BENCH SUCCESS:" >> "$LOG"
+        cat .bench_tpu_out.json >> "$LOG"
+        cp .bench_tpu_out.json BENCH_TPU_LIVE.json
+        exit 0
+      fi
+    else
+      echo "[$(date +%T)] bench failed or timed out" >> "$LOG"
+    fi
+  fi
+  sleep 120
+done
+echo "[$(date +%T)] gave up: deadline reached after $attempt attempts" >> "$LOG"
+exit 1

@@ -1,0 +1,90 @@
+"""Unit tests for owner-side worker internals (no cluster spin-up).
+
+Covers the retry re-enqueue ordering protocol: a retried producer must
+re-enter its queue AHEAD of any later-submitted task (a tail re-enqueue
+can place a dependent consumer first in the same sequential push batch,
+deadlocking the worker exec thread — advisor finding, round 2).
+"""
+
+from collections import defaultdict
+
+from ray_tpu._private.common import TaskSpec
+from ray_tpu._private.worker import _PendingTask
+import ray_tpu._private.worker as worker_mod
+
+
+class _QueueHarness:
+    """Just enough of Worker for _enqueue_task: queues + pending map."""
+
+    def __init__(self):
+        self._queues = defaultdict(list)
+        self.pending_tasks = {}
+        self.pumped = []
+
+    def _spawn(self, coro):
+        coro.close()  # never run the pump; we only inspect queue order
+
+    def _pump_queue(self, shape, spec):
+        async def noop():
+            self.pumped.append(shape)
+        return noop()
+
+    def enqueue(self, pt):
+        self.pending_tasks[pt.spec.task_id] = pt
+        worker_mod.CoreWorker._enqueue_task(self, pt)
+
+    def queue(self):
+        [(shape, q)] = self._queues.items()
+        return q
+
+
+def _pt(task_id: str) -> _PendingTask:
+    return _PendingTask(
+        TaskSpec(task_id=task_id, job_id="j", name=task_id, func_key="f"),
+        retries_left=3)
+
+
+def test_fresh_submissions_append_in_order():
+    h = _QueueHarness()
+    pts = [_pt(f"t{i}") for i in range(4)]
+    for pt in pts:
+        h.enqueue(pt)
+    assert h.queue() == ["t0", "t1", "t2", "t3"]
+
+
+def test_retry_reenqueues_before_later_submissions():
+    h = _QueueHarness()
+    producer, consumer = _pt("producer"), _pt("consumer")
+    h.enqueue(producer)
+    h.enqueue(consumer)
+    # Producer gets popped for a push attempt that fails retryably...
+    h.queue().remove("producer")
+    # ...and must re-enter AHEAD of the later-submitted consumer.
+    worker_mod.CoreWorker._enqueue_task(h, producer)
+    assert h.queue() == ["producer", "consumer"]
+
+
+def test_multiple_retries_preserve_relative_order():
+    h = _QueueHarness()
+    p1, p2, c = _pt("p1"), _pt("p2"), _pt("c")
+    for pt in (p1, p2, c):
+        h.enqueue(pt)
+    h.queue().remove("p1")
+    h.queue().remove("p2")
+    # Retry in batch order p1 then p2 (the order a failed batch is walked):
+    worker_mod.CoreWorker._enqueue_task(h, p1)
+    worker_mod.CoreWorker._enqueue_task(h, p2)
+    assert h.queue() == ["p1", "p2", "c"]
+
+
+def test_stale_queue_ids_do_not_break_ordering():
+    h = _QueueHarness()
+    p, c = _pt("p"), _pt("c")
+    h.enqueue(p)
+    h.enqueue(c)
+    # A completed task whose id still sits in the queue (popped lazily).
+    h.queue().insert(0, "gone")
+    h.queue().remove("p")
+    worker_mod.CoreWorker._enqueue_task(h, p)
+    # p lands after the stale entry but before the younger consumer.
+    assert h.queue() == ["gone", "p", "c"]
