@@ -43,6 +43,11 @@ class Config:
     # (interpreter + framework imports cost seconds per worker on hosts
     # whose site hooks pull in jax). 0 disables; -1 = node CPU count.
     prestart_workers: int = 0
+    # Fork-server worker factory: one warm template process per node pays
+    # the interpreter/site-hook import once; each worker is an os.fork()
+    # of it (~10ms) instead of a cold interpreter (~seconds on TPU hosts
+    # whose site hooks import jax). See _private/worker_zygote.py.
+    use_worker_zygote: bool = True
     worker_startup_timeout_s: float = 60.0
     worker_lease_timeout_s: float = 30.0
     # Leased-worker reuse window, amortizes scheduling like the reference's

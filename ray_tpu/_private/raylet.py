@@ -23,8 +23,11 @@ One asyncio process per node:
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
+import signal
+import socket
 import subprocess
 import sys
 import time
@@ -121,6 +124,138 @@ class WorkerHandle:
         self.dead = False
 
 
+class _PendingProc:
+    """Placeholder while a worker materializes asynchronously (zygote
+    warm-up / fork in flight): reads as alive, remembers a kill."""
+
+    pid = 0
+    returncode = None
+
+    def __init__(self):
+        self.kill_requested = False
+
+    def poll(self):
+        return None
+
+    def kill(self):
+        self.kill_requested = True
+
+
+class _PidProc:
+    """Popen-shaped handle for a zygote-forked worker. The raylet is not
+    its parent (the zygote reaps it), so liveness is signal-0."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode = None
+
+    def poll(self):
+        if self.returncode is None:
+            try:
+                os.kill(self.pid, 0)
+            except ProcessLookupError:
+                self.returncode = -1
+            except PermissionError:
+                pass
+        return self.returncode
+
+    def kill(self):
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+class _ZygoteClient:
+    """Client side of the fork-server worker factory
+    (_private/worker_zygote.py). All methods are synchronous and bounded;
+    the raylet calls them via asyncio.to_thread under a lock."""
+
+    def __init__(self, session_dir: str, node_id: str):
+        self.sock_path = os.path.join(session_dir,
+                                      f"zygote-{node_id[:8]}.sock")
+        env = dict(os.environ)
+        env["RAY_TPU_ZYGOTE_SOCKET"] = self.sock_path
+        env["PYTHONUNBUFFERED"] = "1"
+        log_path = os.path.join(session_dir, "logs",
+                                f"zygote-{node_id[:8]}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        with open(log_path, "ab") as log_file:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_zygote"],
+                env=env, stdout=log_file, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        self._sock: socket.socket | None = None
+        self._file = None
+
+    def connect(self, timeout: float = 0.2) -> bool:
+        """True once the zygote accepted our control connection."""
+        if self._sock is not None:
+            return True
+        if self.proc.poll() is not None:
+            return False
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        try:
+            s.connect(self.sock_path)
+        except OSError:
+            s.close()
+            return False
+        self._sock = s
+        self._file = s.makefile("rwb")
+        return True
+
+    def spawn(self, env: dict, log_path: str,
+              timeout: float = 10.0) -> int | None:
+        """Fork a worker; returns its pid, or None (caller cold-spawns)."""
+        try:
+            if not self.connect(min(timeout, 0.5)):
+                return None
+            self._sock.settimeout(timeout)
+            self._file.write((json.dumps(
+                {"env": env, "log_path": log_path}) + "\n").encode())
+            self._file.flush()
+            line = self._file.readline()
+            if not line:
+                raise OSError("zygote hung up")
+            return json.loads(line)["pid"]
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("zygote spawn failed (%s); cold-spawning", e)
+            self._drop_conn()
+            return None
+
+    def _drop_conn(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._file = None
+
+    def close(self):
+        self._drop_conn()
+        # SIGTERM first: the zygote's handler kills its forked workers
+        # (they setsid'd, so killing the zygote alone leaks them), then a
+        # hard kill as backstop.
+        try:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                pass
+        except Exception:
+            pass
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+
+
 class Raylet:
     def __init__(self, gcs_host: str, gcs_port: int, *,
                  resources: dict | None = None, labels: dict | None = None,
@@ -177,6 +312,10 @@ class Raylet:
         # Actor deaths observed while the GCS was unreachable; replayed
         # after reconnection (the snapshot restores such actors as ALIVE).
         self._pending_death_reports: list[dict] = []
+        # Fork-server worker factory (started in start(); None = disabled).
+        self._zygote: _ZygoteClient | None = None
+        self._zygote_lock = asyncio.Lock()
+        self._zygote_strikes = 0
         # Native C++ scheduling core mirrors the GCS-fed cluster view for
         # spillback decisions (src/scheduler.cc; Python policy is fallback).
         self._native_sched = None
@@ -240,6 +379,16 @@ class Raylet:
         except Exception:
             logger.warning("config fetch from GCS failed; using defaults",
                            exc_info=True)
+        if self.config.use_worker_zygote:
+            # Started only after the GCS config lands (a cluster-level
+            # use_worker_zygote=false must actually disable it); still
+            # eager relative to leases, so the template's heavy imports
+            # overlap the rest of cluster bring-up.
+            try:
+                self._zygote = _ZygoteClient(self.session_dir, self.node_id)
+            except OSError as e:
+                logger.warning("zygote unavailable (%s); workers will "
+                               "cold-spawn", e)
         self.store = ObjectStoreClient(
             self.store_path, create=True,
             size=int(self.total_resources.get(
@@ -325,6 +474,9 @@ class Raylet:
             t.cancel()
         for w in list(self.workers.values()):
             self._kill_worker(w)
+        if self._zygote is not None:
+            zygote, self._zygote = self._zygote, None
+            await asyncio.to_thread(zygote.close)  # proc.wait can block 2s
         if getattr(self, "transfer_server", None) is not None:
             await asyncio.to_thread(self.transfer_server.stop)
         await self.server.stop()
@@ -626,8 +778,7 @@ class Raylet:
         from ray_tpu._private.ids import WorkerID
 
         worker_id = WorkerID.from_random().hex()
-        env = dict(os.environ)
-        env.update({
+        worker_env = {
             "RAY_TPU_WORKER_ID": worker_id,
             "RAY_TPU_NODE_ID": self.node_id,
             "RAY_TPU_RAYLET_HOST": self.host,
@@ -639,20 +790,84 @@ class Raylet:
             # Logs stream to the driver via the tail loop; block-buffered
             # stdout would hold lines back for ~8KB.
             "PYTHONUNBUFFERED": "1",
-        })
+        }
         log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
-        log_file = open(log_path, "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker"],
-            env=env, stdout=log_file, stderr=subprocess.STDOUT,
-            start_new_session=True)
-        log_file.close()
-        w = WorkerHandle(proc, worker_id)
+        w = WorkerHandle(_PendingProc(), worker_id)
         self.workers[worker_id] = w
         self._tasks.append(
             asyncio.ensure_future(self._tail_worker_log(w, log_path)))
+        self._tasks.append(
+            asyncio.ensure_future(
+                self._materialize_worker(w, worker_env, log_path)))
         return w
+
+    async def _materialize_worker(self, w: WorkerHandle, worker_env: dict,
+                                  log_path: str):
+        """Back the handle with a real process: fork from the zygote when
+        it is (or comes) warm, else cold-spawn an interpreter."""
+        proc = None
+        if self._zygote is not None:
+            # Waiting for zygote warm-up beats cold-spawning in parallel
+            # (the cold interpreter pays the exact same import cost the
+            # zygote is finishing, contending for the same cores) — but
+            # the wait must leave most of worker_startup_timeout_s for
+            # the caller's registration window, or an alive-but-wedged
+            # zygote starves every spawn: cap it well below that budget.
+            deadline = time.monotonic() + min(
+                20.0, self.config.worker_startup_timeout_s / 2)
+            async with self._zygote_lock:
+                zygote = self._zygote
+                while (zygote is not None
+                       and not await asyncio.to_thread(zygote.connect)
+                       and time.monotonic() < deadline
+                       and zygote.proc.poll() is None
+                       and not w.dead):
+                    await asyncio.sleep(0.1)
+                pid = None
+                if zygote is not None \
+                        and await asyncio.to_thread(zygote.connect, 0.05):
+                    self._zygote_strikes = 0
+                    pid = await asyncio.to_thread(
+                        zygote.spawn, worker_env, log_path)
+                elif zygote is not None:
+                    # Never-connected template: three strikes and it is
+                    # retired so later spawns stop paying the wait.
+                    self._zygote_strikes += 1
+                    if self._zygote_strikes >= 3:
+                        logger.warning(
+                            "worker zygote never became ready; disabling "
+                            "fork-server (workers will cold-spawn)")
+                        self._zygote = None
+                        await asyncio.to_thread(zygote.close)
+            if pid is not None:
+                proc = _PidProc(pid)
+        if proc is None:
+            from ray_tpu._private.ids import WorkerID
+
+            # Fresh worker id for the fallback: a zygote spawn that forks
+            # but loses its response leaves an orphan carrying the OLD id;
+            # two registrations sharing one id would cross their death
+            # handling (the orphan registers as unpooled and is reaped at
+            # zygote shutdown).
+            new_id = WorkerID.from_random().hex()
+            self.workers.pop(w.worker_id, None)
+            w.worker_id = new_id
+            worker_env["RAY_TPU_WORKER_ID"] = new_id
+            if not w.dead:
+                self.workers[new_id] = w
+            env = dict(os.environ)
+            env.update(worker_env)
+            with open(log_path, "ab") as log_file:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.worker"],
+                    env=env, stdout=log_file, stderr=subprocess.STDOUT,
+                    start_new_session=True)
+        kill_requested = isinstance(w.proc, _PendingProc) \
+            and w.proc.kill_requested
+        w.proc = proc
+        if w.dead or kill_requested:
+            proc.kill()
 
     def _kill_worker(self, w: WorkerHandle):
         w.dead = True
