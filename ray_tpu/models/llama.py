@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any
+from typing import Any, NamedTuple
 
 import flax.linen as nn
 import jax
@@ -29,6 +29,21 @@ from ray_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+
+
+class PagedKVCache(NamedTuple):
+    """Per-layer paged KV state for batched single-token decode.
+
+    The KV cache is a shared pool of fixed-size pages (the vLLM block
+    table idea, TPU-shaped — see ops/paged_attention.py); each sequence
+    owns rows of `table`. HBM scales with resident tokens, not
+    max_len x slots.
+    """
+
+    k_pool: Any    # (P, page_size, Hkv, D)
+    v_pool: Any    # (P, page_size, Hkv, D)
+    table: Any     # (B, NP) int32 pool indices per sequence
+    length: Any    # (B,) int32 tokens already cached (= write offset)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,6 +137,29 @@ class Attention(nn.Module):
         cos, sin = rope_frequencies(Dh, cfg.max_seq_len, cfg.rope_theta)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
+
+        if isinstance(kv_cache, PagedKVCache):
+            # Batched single-token decode over the shared page pool:
+            # scatter this step's K/V into each sequence's current page,
+            # then attend over its page table (GQA handled in-kernel; no
+            # head repetition, no per-slot max_len cache).
+            from ray_tpu.ops.paged_attention import (
+                paged_decode_attention_batch)
+
+            pc = kv_cache
+            ps = pc.k_pool.shape[1]
+            pages = jnp.take_along_axis(
+                pc.table, (pc.length // ps)[:, None], axis=1)[:, 0]
+            offs = pc.length % ps
+            k_pool = pc.k_pool.at[pages, offs].set(k[:, :, 0, :])
+            v_pool = pc.v_pool.at[pages, offs].set(v[:, :, 0, :])
+            out = paged_decode_attention_batch(
+                q[:, :, 0, :], k_pool, v_pool, pc.table, pc.length + 1)
+            out = out[:, :, None, :].astype(cfg.dtype)
+            out = out.transpose(0, 2, 1, 3).reshape(B, S, Hq * Dh)
+            out = dense(cfg.d_model, name="o_proj")(out)
+            return out, PagedKVCache(k_pool, v_pool, pc.table,
+                                     pc.length + 1)
 
         new_cache = None
         if kv_cache is not None:

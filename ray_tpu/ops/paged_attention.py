@@ -127,6 +127,97 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, length,
     return out.reshape(H, D)
 
 
+def _paged_decode_batch_kernel(page_table_ref, length_ref,  # scalar prefetch
+                               q_ref, k_ref, v_ref, o_ref,
+                               m_scratch, l_scratch, acc_scratch,
+                               *, page_size: int, sm_scale: float):
+    b = pl.program_id(0)
+    pi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)            # (Hkv, G, D)
+    k = k_ref[0].astype(jnp.float32)            # (page, Hkv, D)
+    v = v_ref[0].astype(jnp.float32)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    scores = jnp.einsum("hgd,thd->hgt", q, k) * sm_scale
+    token_idx = pi * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 2)
+    scores = jnp.where(token_idx < length_ref[b], scores, _NEG_INF)
+
+    m_prev = m_scratch[...]
+    m_cur = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)
+    l_new = alpha * l_scratch[...] + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("hgt,thd->hgd", p, v)
+    acc_scratch[...] = acc_scratch[...] * alpha + pv
+    m_scratch[...] = m_new
+    l_scratch[...] = l_new
+
+    @pl.when(pi == pl.num_programs(1) - 1)
+    def _finish():
+        l = l_scratch[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention_batch(q, k_pool, v_pool, page_tables, lengths,
+                                 *, sm_scale: float | None = None):
+    """Batched single-token decode attention over paged KV.
+
+    The batch dimension is a leading GRID axis (not vmap — scalar-prefetch
+    pallas calls don't batch), so one compiled program serves every slot
+    of a continuous-batching engine per decode step.
+
+    q:           (B, H, D) one query per sequence
+    k/v_pool:    (P, page_size, Hkv, D) pools SHARED by all sequences
+    page_tables: (B, NP) int32 pool indices per sequence
+    lengths:     (B,) int32 valid token counts (incl. current tokens)
+    Returns (B, H, D).
+    """
+    B, H, D = q.shape
+    P, page_size, Hkv, _ = k_pool.shape
+    groups = H // Hkv
+    npages = page_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+
+    q4 = q.reshape(B, Hkv, groups, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, npages),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, groups, D),
+                         lambda b, i, pt, ln: (b, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, Hkv, D),
+                         lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, Hkv, D),
+                         lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, groups, D),
+                               lambda b, i, pt, ln: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, groups, 1), jnp.float32),
+            pltpu.VMEM((Hkv, groups, 1), jnp.float32),
+            pltpu.VMEM((Hkv, groups, D), jnp.float32),
+        ],
+    ) if pltpu else None
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_batch_kernel, page_size=page_size,
+                          sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, groups, D), q.dtype),
+        interpret=_interpret_mode(),
+    )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, k_pool, v_pool)
+    return out.reshape(B, H, D)
+
+
 class PageAllocator:
     """Host-side free-list allocator for KV pool pages (one per engine).
 

@@ -19,11 +19,14 @@ behind Serve deployments); this engine is native and TPU-shaped:
   as they are produced; `LLMDeployment` plugs that into Serve's
   generator-streaming path (`handle.options(stream=True)` / `?stream=1`).
 
-KV memory: slots currently hold max_len-sized caches. The paged
-replacement (vLLM block tables — pool pages + per-slot page tables +
-the scalar-prefetch pallas kernel in ops/paged_attention.py, with its
-PageAllocator) is built and unit-tested; engine integration is the next
-step so HBM scales with resident tokens instead of max_len x slots.
+- **Paged KV (page_size > 0).** Slots share one pool of fixed-size KV
+  pages per layer (vLLM block tables, TPU-shaped: the scalar-prefetch
+  pallas kernel in ops/paged_attention.py attends over scattered pages;
+  PageAllocator manages the free list host-side). HBM is bounded by
+  `kv_pool_tokens` RESIDENT tokens, not max_len x slots — admission
+  defers requests when the pool is dry and pages return to the free
+  list the moment a stream completes. page_size=0 keeps the dense
+  per-slot max_len caches.
 """
 
 from __future__ import annotations
@@ -48,6 +51,8 @@ class _Slot:
     # been written into this slot's KV cache so far. None = decoding.
     prefill_prompt: "object" = None
     prefill_pos: int = 0
+    # Paged mode: allocator key owning this slot's pages.
+    seq_id: str = ""
 
 
 class RequestHandle:
@@ -78,7 +83,8 @@ class LLMEngine:
 
     def __init__(self, cfg: LlamaConfig, params, *, max_batch: int = 4,
                  max_len: int = 1024, decode_chunk: int = 8,
-                 prefill_chunk: int = 0, rng_seed: int = 0):
+                 prefill_chunk: int = 0, rng_seed: int = 0,
+                 page_size: int = 0, kv_pool_tokens: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -86,6 +92,17 @@ class LLMEngine:
         self.params = params
         self.max_batch = max_batch
         self.max_len = max_len
+        # Paged KV mode (page_size > 0): admission is bounded by POOL
+        # pages (resident tokens), not slot count x max_len.
+        self.page_size = page_size
+        if page_size:
+            if max_len % page_size:
+                raise ValueError(
+                    f"max_len={max_len} must be a multiple of "
+                    f"page_size={page_size}")
+            if prefill_chunk:
+                raise ValueError(
+                    "chunked prefill is not supported in paged mode")
         # Steps per compiled decode call: one host sync per CHUNK, not per
         # token (dispatch/fetch latency dominates single-token decode —
         # dramatically so through a tunneled device). Admission waits at
@@ -197,10 +214,68 @@ class LLMEngine:
         self._sample = jax.jit(_sample)
         self._prefill_one = prefill_one
 
+        # ---- paged-mode programs ----------------------------------------
+
+        if page_size:
+            from ray_tpu.models.llama import PagedKVCache
+            from ray_tpu.ops.paged_attention import PageAllocator
+
+            # Overshoot margin: a chunk of K steps may run up to K-1
+            # tokens past a stream's max_new before the host notices eos.
+            pool_tokens = kv_pool_tokens or max_batch * (max_len + K)
+            self._np_pages = -(-(max_len + K) // page_size)  # table width
+            self._num_pages = -(-pool_tokens // page_size) + 1  # + dummy
+            self._tables = None  # created by _init_paged_state
+            self._init_paged_state()
+
+            def decode_chunk_paged(params, token, pos, pools, tables, lens,
+                                   temps, top_ks, top_ps, base_rng):
+                def body(carry, i):
+                    token, pos, pools, lens = carry
+                    caches = [PagedKVCache(k, v, tables, lens)
+                              for (k, v) in pools]
+                    logits, new = model.apply(params, token[:, None],
+                                              pos[:, None], kv_caches=caches)
+                    pools2 = [(c.k_pool, c.v_pool) for c in new]
+                    tok = _sample(logits[:, 0], temps, top_ks, top_ps,
+                                  jax.random.fold_in(base_rng, i))
+                    return (tok, pos + 1, pools2, lens + 1), tok
+
+                (token, pos, pools, lens), toks = jax.lax.scan(
+                    body, (token, pos, pools, lens), jnp.arange(K))
+                return toks, pools  # toks: (K, B)
+
+            self._decode_chunk_paged = jax.jit(decode_chunk_paged,
+                                               donate_argnums=(3,))
+
+            ps_ = page_size
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def write_prompt_pages(pools, kv_one, page_ids):
+                # Scatter a bucketed prefill's (Hkv, max_len, D) caches
+                # into pool pages. page_ids rows past the prompt point at
+                # the dummy page (garbage there is fine).
+                out = []
+                for (kp, vp), (k1, v1) in zip(pools, kv_one):
+                    Hkv_, L_, D_ = k1.shape
+                    kpg = k1.transpose(1, 0, 2).reshape(
+                        L_ // ps_, ps_, Hkv_, D_)
+                    vpg = v1.transpose(1, 0, 2).reshape(
+                        L_ // ps_, ps_, Hkv_, D_)
+                    out.append((kp.at[page_ids].set(kpg),
+                                vp.at[page_ids].set(vpg)))
+                return out
+
+            self._write_prompt_pages = write_prompt_pages
+            self._deferred: list = []  # pool-dry admissions, FIFO retry
+
         # ---- engine state (host-managed; device caches stacked by slot) --
 
-        proto = init_kv_caches(cfg, max_batch, max_len)
-        self._kv = [(k, v) for k, v, _l in proto]  # [(B,Hkv,L,D) x2] / layer
+        if page_size:
+            self._kv = None  # paged mode: pools above replace slot caches
+        else:
+            proto = init_kv_caches(cfg, max_batch, max_len)
+            self._kv = [(k, v) for k, v, _l in proto]  # [(B,Hkv,L,D)] / layer
         self._lens = np.zeros(max_batch, np.int32)
         self._token = np.zeros(max_batch, np.int32)
         self._pos = np.zeros(max_batch, np.int32)
@@ -225,6 +300,13 @@ class LLMEngine:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new_tokens({sp.max_new_tokens})"
                 f" exceeds engine max_len={self.max_len}")
+        if self.page_size:
+            need = self._alloc.pages_needed(
+                len(prompt) + sp.max_new_tokens + self.decode_chunk)
+            if need > self._alloc.num_pages - 1:  # -1: dummy page
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool holds "
+                    f"{self._alloc.num_pages - 1}; raise kv_pool_tokens")
         handle = RequestHandle(len(prompt), sp)
         self._pending.put((prompt, handle))
         return handle
@@ -242,13 +324,19 @@ class LLMEngine:
         self._fail_all(RuntimeError("engine shut down"))
 
     def _fail_all(self, err: Exception):
-        """Unblock every waiter: active slots and queued requests."""
-        for st in self._slots:
+        """Unblock every waiter: active slots, deferred and queued requests."""
+        for i, st in enumerate(self._slots):
             if st.request is not None:
                 st.request.error = err
                 st.request._q.put(_SENTINEL)
                 st.request = None
             st.prefill_prompt = None
+            self._free_slot_pages(i)
+        for _prompt, handle in getattr(self, "_deferred", []):
+            handle.error = err
+            handle._q.put(_SENTINEL)
+        if self.page_size:
+            self._deferred.clear()
         while True:
             try:
                 _prompt, handle = self._pending.get_nowait()
@@ -274,6 +362,8 @@ class LLMEngine:
     def _admit(self, prompt: np.ndarray, handle: RequestHandle):
         jnp = self._jnp
         slot = next(i for i, s in enumerate(self._slots) if s.request is None)
+        if self.page_size:
+            return self._admit_paged(slot, prompt, handle)
         # Chunked only when the chunk GRID fits the cache: the final
         # chunk's write window [start, start+C) must not run past max_len,
         # where dynamic_update_slice clamping would silently relocate it
@@ -325,6 +415,91 @@ class LLMEngine:
         st.prefill_prompt = None
         self._emit(slot, tok)
 
+    def _admit_paged(self, slot: int, prompt: np.ndarray,
+                     handle: RequestHandle):
+        """Paged admission: reserve pages for the stream's WHOLE lifetime
+        (prompt + max_new + chunk overshoot) up front, so decode can
+        never fail mid-stream on an empty pool; MemoryError here defers
+        the request instead (admission control by resident tokens)."""
+        jnp = self._jnp
+        sp = handle.sampling
+        st = self._slots[slot]
+        seq_id = f"slot{slot}-{id(handle):x}"
+        need = len(prompt) + sp.max_new_tokens + self.decode_chunk
+        self._alloc.allocate(seq_id, need)  # MemoryError -> caller defers
+        st.seq_id = seq_id
+        try:
+            logits = self._prefill_into_pages(slot, seq_id, prompt)
+        except BaseException:
+            # A failed prefill (device OOM, ...) must return the pages —
+            # the next admission overwrites st.seq_id and they would
+            # leak from the pool forever.
+            self._free_slot_pages(slot)
+            raise
+        first_logits = logits[len(prompt) - 1]
+        self._rng, srng = self._jax.random.split(self._rng)
+        tok = int(np.asarray(self._sample(
+            first_logits[None], np.float32([sp.temperature]),
+            np.int32([sp.top_k]), np.float32([sp.top_p]), srng))[0])
+        self._lens[slot] = len(prompt)
+        self._pos[slot] = len(prompt)
+        self._token[slot] = tok
+        self._temps[slot] = sp.temperature
+        self._topks[slot] = sp.top_k
+        self._topps[slot] = sp.top_p
+        st.request = handle
+        st.generated = 0
+        st.prefill_prompt = None
+        self._emit(slot, tok)
+
+    def _init_paged_state(self):
+        """(Re)build the page pool: allocator + dummy page + zeroed
+        per-layer pools + tables. Shared by __init__ and the
+        decode-failure recovery path so the two can never drift."""
+        from ray_tpu.ops.paged_attention import PageAllocator
+
+        jnp = self._jnp
+        self._alloc = PageAllocator(self._num_pages, self.page_size)
+        # Dummy page: inactive slots' garbage writes and table padding
+        # land here, never in a page a live sequence owns.
+        self._dummy_page = self._alloc.allocate("__dummy__", 1)[0]
+        Hkv, Dh = self.cfg.n_kv_heads, self.cfg.head_dim
+        self._pools = [
+            (jnp.zeros((self._num_pages, self.page_size, Hkv, Dh),
+                       self.cfg.dtype),
+             jnp.zeros((self._num_pages, self.page_size, Hkv, Dh),
+                       self.cfg.dtype))
+            for _ in range(self.cfg.n_layers)]
+        self._tables = np.full((self.max_batch, self._np_pages),
+                               self._dummy_page, np.int32)
+
+    def _prefill_into_pages(self, slot: int, seq_id: str,
+                            prompt: np.ndarray):
+        """Bucketed prefill through the dense program, scattering the
+        prompt's KV into this sequence's pages; returns the logits."""
+        jnp = self._jnp
+        bucket = max(self._bucket(len(prompt)), self.page_size)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(prompt)] = prompt
+        logits, kv_one = self._prefill_one(self.params, jnp.asarray(padded))
+        row = np.asarray(self._alloc.table(seq_id, self._np_pages))
+        n_prompt_pages = self._alloc.pages_needed(len(prompt))
+        prompt_pages = jnp.asarray(np.concatenate([
+            row[:n_prompt_pages],
+            np.full(self.max_len // self.page_size - n_prompt_pages,
+                    self._dummy_page, np.int32)]))
+        self._pools = self._write_prompt_pages(
+            self._pools, kv_one, prompt_pages)
+        self._tables[slot] = row
+        return logits
+
+    def _free_slot_pages(self, slot: int):
+        st = self._slots[slot]
+        if self.page_size and st.seq_id:
+            self._alloc.free(st.seq_id)
+            self._tables[slot, :] = self._dummy_page
+            st.seq_id = ""
+
     def _advance_prefill(self, slot: int):
         """Write ONE chunk of a long prompt into the slot's cache; on the
         final chunk, sample the first token and switch to decoding."""
@@ -364,21 +539,41 @@ class LLMEngine:
                 st.generated >= sp.max_new_tokens:
             st.request._q.put(_SENTINEL)
             st.request = None
+            # Paged mode: the stream's pages return to the pool the
+            # moment it completes — this is what lets a deferred request
+            # admit on the next loop pass.
+            self._free_slot_pages(slot)
 
     def _loop(self):
         jax, jnp = self._jax, self._jnp
         while not self._stop.is_set():
             # Admit as many pending requests as there are free slots —
-            # without stalling slots that are mid-decode.
+            # without stalling slots that are mid-decode. Paged mode also
+            # gates on pool pages: a dry pool defers the request (FIFO)
+            # until completions free pages.
             while any(s.request is None for s in self._slots):
-                try:
-                    prompt, handle = self._pending.get(
-                        block=(self.num_active() == 0), timeout=0.05)
-                except queue.Empty:
-                    break
+                from_deferred = bool(self.page_size and self._deferred)
+                if from_deferred:
+                    prompt, handle = self._deferred[0]
+                else:
+                    try:
+                        prompt, handle = self._pending.get(
+                            block=(self.num_active() == 0), timeout=0.05)
+                    except queue.Empty:
+                        break
                 try:
                     self._admit(prompt, handle)
+                    if from_deferred:
+                        self._deferred.pop(0)
+                except MemoryError:
+                    # Pool dry: keep FIFO order and stop admitting until
+                    # a completion frees pages.
+                    if not from_deferred:
+                        self._deferred.append((prompt, handle))
+                    break
                 except Exception as e:  # surfacing beats a dead stream
+                    if from_deferred:
+                        self._deferred.pop(0)
                     handle.error = e
                     handle._q.put(_SENTINEL)
             if self.num_active() == 0:
@@ -409,21 +604,35 @@ class LLMEngine:
             # finishing mid-chunk have their overshoot discarded too).
             try:
                 self._rng, srng = jax.random.split(self._rng)
-                toks, kv_out = self._decode_chunk_fn(
-                    self.params, jnp.asarray(self._token),
-                    jnp.asarray(self._pos), self._kv, jnp.asarray(self._lens),
-                    jnp.asarray(self._temps), self._topks_arr(),
-                    self._topps_arr(), srng)
+                if self.page_size:
+                    toks, pools_out = self._decode_chunk_paged(
+                        self.params, jnp.asarray(self._token),
+                        jnp.asarray(self._pos), self._pools,
+                        jnp.asarray(self._tables), jnp.asarray(self._lens),
+                        jnp.asarray(self._temps), self._topks_arr(),
+                        self._topps_arr(), srng)
+                    self._pools = [(k, v) for k, v in pools_out]
+                else:
+                    toks, kv_out = self._decode_chunk_fn(
+                        self.params, jnp.asarray(self._token),
+                        jnp.asarray(self._pos), self._kv,
+                        jnp.asarray(self._lens),
+                        jnp.asarray(self._temps), self._topks_arr(),
+                        self._topps_arr(), srng)
+                    self._kv = [(k, v) for k, v in kv_out]
                 toks = np.asarray(toks)  # (K, B)
             except Exception as e:
                 # A decode failure (device OOM, donated-buffer misuse, ...)
                 # must not strand waiters on a dead thread: fail loudly and
                 # keep serving subsequent requests on fresh state.
                 self._fail_all(e)
-                proto = init_kv_caches(self.cfg, self.max_batch, self.max_len)
-                self._kv = [(k, v) for k, v, _l in proto]
+                if self.page_size:
+                    self._init_paged_state()
+                else:
+                    proto = init_kv_caches(self.cfg, self.max_batch,
+                                           self.max_len)
+                    self._kv = [(k, v) for k, v, _l in proto]
                 continue
-            self._kv = [(k, v) for k, v in kv_out]
             for i, st in enumerate(self._slots):
                 if st.request is None or st.prefill_prompt is not None:
                     continue
@@ -460,10 +669,13 @@ class LLMServer:
 
     def __init__(self, cfg: LlamaConfig, params, *, max_batch: int = 4,
                  max_len: int = 1024, decode_chunk: int = 8,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0, page_size: int = 0,
+                 kv_pool_tokens: int = 0):
         self.engine = LLMEngine(cfg, params, max_batch=max_batch,
                                 max_len=max_len, decode_chunk=decode_chunk,
-                                prefill_chunk=prefill_chunk)
+                                prefill_chunk=prefill_chunk,
+                                page_size=page_size,
+                                kv_pool_tokens=kv_pool_tokens)
 
     def __call__(self, payload: dict):
         sp = SamplingParams(
