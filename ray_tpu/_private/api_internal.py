@@ -323,8 +323,11 @@ class RemoteFunction:
         )
         from ray_tpu.util import tracing
 
-        with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
-            spec.trace_ctx = trace_ctx
+        if tracing.enabled():
+            with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
+                spec.trace_ctx = trace_ctx
+                returns = cw.submit_task(spec, nested_args=nested)
+        else:  # hot path: skip two contextmanager frames per task
             returns = cw.submit_task(spec, nested_args=nested)
         refs = [ObjectRef(oid, cw.address) for oid in returns]
         if self._opts["num_returns"] == 1:

@@ -24,7 +24,7 @@ PLACEMENT_GROUP_ID_SIZE = 16
 
 class BaseID:
     SIZE = 20
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hex")
 
     def __init__(self, id_bytes: bytes):
         if len(id_bytes) != self.SIZE:
@@ -32,6 +32,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
             )
         self._bytes = bytes(id_bytes)
+        self._hex = None
 
     @classmethod
     def from_random(cls):
@@ -52,7 +53,12 @@ class BaseID:
         return self._bytes
 
     def hex(self) -> str:
-        return binascii.hexlify(self._bytes).decode()
+        # Memoized: submission/completion hot paths hex the same id
+        # several times per task.
+        h = self._hex
+        if h is None:
+            h = self._hex = binascii.hexlify(self._bytes).decode()
+        return h
 
     def __hash__(self):
         return hash(self._bytes)

@@ -92,6 +92,15 @@ def serialize(value, kind: int = KIND_PYTHON) -> SerializedObject:
     return SerializedObject(meta, inband, buffers)
 
 
+def serialize_primitive(value) -> tuple[bytes, bytes]:
+    """Fast path for values that cannot contain ObjectRefs or buffers
+    (exact builtin scalar/str/bytes types): one pickle, one packb — skips
+    the buffer/offset bookkeeping and SerializedObject construction that
+    dominate per-arg cost on the task-submission hot path."""
+    inband = pickle.dumps(value, protocol=5)
+    return msgpack.packb([KIND_PYTHON, len(inband), []]), inband
+
+
 def serialize_exception(exc: BaseException) -> SerializedObject:
     tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
     try:

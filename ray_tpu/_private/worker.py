@@ -133,10 +133,14 @@ class _BorrowedRef:
 
 _task_seq = itertools.count(1)
 
+# Exact types only: a subclass could carry ObjectRef attributes.
+_PRIMITIVE_TYPES = frozenset(
+    (int, float, bool, str, bytes, type(None)))
+
 
 class _PendingTask:
     __slots__ = ("spec", "retries_left", "constructor_like", "futures",
-                 "pushed_to", "nested_args", "seq")
+                 "pushed_to", "nested_args", "seq", "return_hexes")
 
     def __init__(self, spec: TaskSpec, retries_left: int,
                  nested_args: list | None = None):
@@ -144,6 +148,9 @@ class _PendingTask:
         self.retries_left = retries_left
         self.futures: list[asyncio.Future] = []
         self.pushed_to: str | None = None
+        # Return ObjectID hexes, filled by submit_task so completion does
+        # not re-derive them (each is a sha1).
+        self.return_hexes: list[str] | None = None
         # Refs serialized INSIDE value args (not top-level): list of
         # (oid_hex, owner_wire|None); refcounted like top-level args and
         # released at completion per the borrower protocol.
@@ -204,6 +211,7 @@ class CoreWorker:
         self._fn_cache: dict[str, object] = {}
         self._put_counter = itertools.count(1)
         self._task_counter = itertools.count(1)
+        self._task_id_prefix = os.urandom(TaskID.SIZE - 8)
         self._default_task_id = TaskID.from_random()
         self._exec_tls = threading.local()  # per-thread current task id
         # executor
@@ -382,18 +390,28 @@ class CoreWorker:
     # ---------- events ----------
 
     def _record_task_event(self, task_id: str, name: str, state: str, **extra):
-        self._task_events.append({
-            "task_id": task_id, "name": name, "state": state,
-            "node_id": self.node_id, "worker_id": self.worker_id,
-            "job_id": self.job_id, "ts": time.time(), **extra})
+        # Hot path (several per task): append a tuple; the flush loop
+        # formats the wire dicts off the critical path.
+        self._task_events.append(
+            (task_id, name, state, time.time(), extra or None))
 
     async def _flush_task_events_loop(self):
         while True:
             await asyncio.sleep(1.0)
             if self._task_events and self.gcs and not self.gcs.closed:
                 batch, self._task_events = self._task_events, []
+                events = []
+                for task_id, name, state, ts, extra in batch:
+                    ev = {"task_id": task_id, "name": name, "state": state,
+                          "node_id": self.node_id,
+                          "worker_id": self.worker_id,
+                          "job_id": self.job_id, "ts": ts}
+                    if extra:
+                        ev.update(extra)
+                    events.append(ev)
                 try:
-                    await self.gcs.call("AddTaskEvents", {"events": batch}, timeout=5)
+                    await self.gcs.call("AddTaskEvents", {"events": events},
+                                        timeout=5)
                 except Exception:
                     pass
 
@@ -1146,10 +1164,12 @@ class CoreWorker:
     # ---------- task submission (owner side) ----------
 
     def next_task_id(self) -> TaskID:
-        h = hashlib.sha1(
-            self._current_task_id.binary()
-            + next(self._task_counter).to_bytes(8, "big"))
-        return TaskID(h.digest()[:TaskID.SIZE])
+        # Random per-process prefix + counter: unique across all
+        # submitters (incl. nested tasks in other workers) without a
+        # hash per submission. Return ObjectIDs still embed the TaskID
+        # (ids.for_task_return), which is all lineage recovery needs.
+        return TaskID(self._task_id_prefix
+                      + next(self._task_counter).to_bytes(8, "big"))
 
     def serialize_args(self, args: tuple, kwargs: dict):
         """Build wire args; returns (wire_args, kwargs_keys, dep_ids,
@@ -1164,7 +1184,20 @@ class CoreWorker:
         deps = []
         nested: list = []
         items = list(args) + list(kwargs.values())
+        max_inline = self.config.max_inline_object_size
         for a in items:
+            # Exact builtin scalars/strings cannot contain ObjectRefs or
+            # out-of-band buffers: skip the nested-ref collector and the
+            # SerializedObject machinery (the dominant per-arg cost at
+            # trivial-task throughput). Size-gate str/bytes CHEAPLY first
+            # so an over-inline-size value is not pickled twice (here and
+            # again in the promotion path).
+            if type(a) in _PRIMITIVE_TYPES and not (
+                    type(a) in (str, bytes) and len(a) >= max_inline):
+                meta, data = serialization.serialize_primitive(a)
+                if len(data) <= max_inline:
+                    wire.append(["v", meta, data])
+                    continue
             if isinstance(a, ObjectRef):
                 wire.append(["r", a.id.hex(), a.owner.to_wire() if a.owner else None])
                 deps.append(a.id.hex())
@@ -1206,8 +1239,9 @@ class CoreWorker:
                    for i in range(spec.num_returns)]
         pt = _PendingTask(spec, retries_left=spec.max_retries,
                           nested_args=nested_args)
-        for oid in returns:
-            o = self.objects.setdefault(oid.hex(), _OwnedObject())
+        pt.return_hexes = [oid.hex() for oid in returns]
+        for oid_hex in pt.return_hexes:
+            o = self.objects.setdefault(oid_hex, _OwnedObject())
             o.lineage_task = spec.task_id
         self.pending_tasks[spec.task_id] = pt
         self._record_task_event(spec.task_id, spec.name, "PENDING")
@@ -1251,7 +1285,7 @@ class CoreWorker:
         q.insert(i, pt.spec.task_id)
         self._spawn(self._pump_queue(shape, pt.spec))
 
-    _PUSH_BATCH_MAX = 64
+    _PUSH_BATCH_MAX = 256
 
     def _pop_batch(self, shape: str) -> list:
         """Pop a fair share of the queue for one worker slot.
@@ -1498,13 +1532,19 @@ class CoreWorker:
                 exc.WorkerCrashedError(f"worker died running {pt.spec.name}: {reason}"))
             self._complete_task_error(pt, err)
 
+    def _return_hexes(self, pt: _PendingTask) -> list[str]:
+        if pt.return_hexes is None:
+            task_id = TaskID.from_hex(pt.spec.task_id)
+            pt.return_hexes = [
+                ObjectID.for_task_return(task_id, i + 1).hex()
+                for i in range(pt.spec.num_returns)]
+        return pt.return_hexes
+
     def _complete_task_error(self, pt: _PendingTask, err):
         self.pending_tasks.pop(pt.spec.task_id, None)
         self._record_task_event(pt.spec.task_id, pt.spec.name, "FAILED")
-        task_id = TaskID.from_hex(pt.spec.task_id)
-        for i in range(pt.spec.num_returns):
-            oid = ObjectID.for_task_return(task_id, i + 1)
-            o = self.objects.setdefault(oid.hex(), _OwnedObject())
+        for oid_hex in self._return_hexes(pt):
+            o = self.objects.setdefault(oid_hex, _OwnedObject())
             o.state = OBJ_FAILED
             o.error = (err.meta, err.to_bytes())
             if o.ready_event:
@@ -1536,26 +1576,32 @@ class CoreWorker:
             self._enqueue_task(pt)
             return
         self.pending_tasks.pop(spec.task_id, None)
-        task_id = TaskID.from_hex(spec.task_id)
+        hexes = self._return_hexes(pt)
         if resp.get("status") == "error":
             self._record_task_event(spec.task_id, spec.name, "FAILED")
             err_meta, err_data = resp["error"]
-            for i in range(spec.num_returns):
-                oid = ObjectID.for_task_return(task_id, i + 1)
-                o = self.objects.setdefault(oid.hex(), _OwnedObject())
+            for oid_hex in hexes:
+                o = self.objects.setdefault(oid_hex, _OwnedObject())
                 o.state = OBJ_FAILED
                 o.error = (bytes(err_meta), bytes(err_data))
                 if o.ready_event:
                     o.ready_event.set()
         else:
             self._record_task_event(spec.task_id, spec.name, "FINISHED")
-            # Keep lineage for reconstruction (bounded).
+            # Keep lineage for reconstruction (bounded). Size estimate is
+            # structural, not str(args) — str() of wire args costs more
+            # than the rest of completion at trivial-task rates.
             if self._lineage_bytes < self.config.max_lineage_bytes:
                 self.lineage[spec.task_id] = spec
-                self._lineage_bytes += len(str(spec.args))
+                est = 64
+                for a in spec.args:
+                    est += len(a[2]) + 16 if a[0] == "v" else 80
+                self._lineage_bytes += est
             for i, result in enumerate(resp["results"]):
-                oid = ObjectID.for_task_return(task_id, i + 1)
-                o = self.objects.setdefault(oid.hex(), _OwnedObject())
+                oid_hex = hexes[i] if i < len(hexes) else \
+                    ObjectID.for_task_return(
+                        TaskID.from_hex(spec.task_id), i + 1).hex()
+                o = self.objects.setdefault(oid_hex, _OwnedObject())
                 if result[0] == "v":
                     o.inline = (bytes(result[1]), bytes(result[2]))
                     o.size = len(o.inline[1])
@@ -1569,7 +1615,7 @@ class CoreWorker:
                 # for as long as this return object lives.
                 if len(result) > 3 and result[3]:
                     self._track_container(
-                        oid.hex(), [tuple(n) for n in result[3]])
+                        oid_hex, [tuple(n) for n in result[3]])
                 if o.ready_event:
                     o.ready_event.set()
         # Borrower handoff BEFORE releasing our own holds: args the worker
@@ -1881,11 +1927,17 @@ class CoreWorker:
                 if fn is None:
                     fn = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
-                with runtime_env_context(spec.runtime_env,
-                                         job_id=spec.job_id):
-                    with tracing.execute_span(spec.name, spec.task_id,
-                                              spec.trace_ctx):
-                        result = fn(*args, **kwargs)
+                if not spec.runtime_env and not spec.trace_ctx \
+                        and not tracing.enabled():
+                    # Hot path: no env to activate, no span to open —
+                    # skip both contextmanagers.
+                    result = fn(*args, **kwargs)
+                else:
+                    with runtime_env_context(spec.runtime_env,
+                                             job_id=spec.job_id):
+                        with tracing.execute_span(spec.name, spec.task_id,
+                                                  spec.trace_ctx):
+                            result = fn(*args, **kwargs)
             return {"status": "ok",
                     "results": self._package_results(spec, result),
                     "borrows": self._surviving_borrows()}
@@ -1914,7 +1966,17 @@ class CoreWorker:
         from ray_tpu._private.api_internal import collect_nested_refs
 
         caller = Address.from_wire(spec.owner).worker_id if spec.owner else ""
+        max_inline = self.config.max_inline_object_size
         for i, value in enumerate(results):
+            # Mirror of the submit-side primitive fast path: ref-free
+            # builtin returns skip the collector + SerializedObject.
+            if type(value) in _PRIMITIVE_TYPES and not (
+                    type(value) in (str, bytes)
+                    and len(value) >= max_inline):
+                meta, data = serialization.serialize_primitive(value)
+                if len(data) <= max_inline:
+                    out.append(["v", meta, data, []])
+                    continue
             with collect_nested_refs() as sink:
                 sobj = serialization.serialize(value)
             if sink and caller:
