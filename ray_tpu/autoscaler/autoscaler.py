@@ -27,13 +27,18 @@ logger = logging.getLogger(__name__)
 class StandardAutoscaler:
     def __init__(self, provider: NodeProvider, node_types: list[NodeType],
                  *, get_cluster_status, idle_timeout_s: float = 60.0,
-                 upscaling_speed: float = 1.0, max_workers: int = 20):
+                 upscaling_speed: float = 1.0, max_workers: int = 20,
+                 drain_node=None):
         self.provider = provider
         self.node_types = {t.name: t for t in node_types}
         self.get_cluster_status = get_cluster_status
         self.idle_timeout_s = idle_timeout_s
         self.upscaling_speed = upscaling_speed
         self.max_workers = max_workers
+        # Called with each GCS node_id before the provider tears the VM
+        # down (reference: drain precedes termination so running leases
+        # finish — node_manager.cc HandleDrainRaylet analog).
+        self.drain_node = drain_node
         self._idle_since: dict[str, float] = {}
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -42,8 +47,13 @@ class StandardAutoscaler:
 
     def get_nodes_to_launch(self, pending_demand: list[dict],
                             pending_pgs: list[dict],
-                            current_available: list[dict]) -> dict[str, int]:
-        """First-fit-decreasing bin-pack of unmet demand onto node types."""
+                            current_available: list[dict],
+                            upcoming_by_type: dict[str, int] | None = None
+                            ) -> dict[str, int]:
+        """First-fit-decreasing bin-pack of unmet demand onto node types.
+        upcoming_by_type: provider nodes still provisioning, per type —
+        they absorb pending gang demand so a minutes-long TPU slice
+        provision is not re-launched every tick."""
         bins = [dict(a) for a in current_available]
         to_launch: dict[str, int] = {}
         for demand in sorted(pending_demand,
@@ -65,6 +75,7 @@ class StandardAutoscaler:
             else:
                 logger.warning("demand %s fits no node type", demand)
         # STRICT_ICI placement groups: launch whole slices.
+        upcoming = dict(upcoming_by_type or {})
         for pg in pending_pgs:
             if pg.get("strategy") != "STRICT_ICI":
                 continue
@@ -72,7 +83,14 @@ class StandardAutoscaler:
             for t in self.node_types.values():
                 if t.hosts_per_slice > 1 and all(
                         resources_fit(t.resources, b) for b in bundles):
-                    to_launch[t.name] = to_launch.get(t.name, 0) + 1
+                    # A slice of this type still provisioning absorbs
+                    # this gang: launching another every reconcile tick
+                    # of a minutes-long provision would duplicate TPU
+                    # slices. Each provisioning slice absorbs ONE gang.
+                    if upcoming.get(t.name, 0) > 0:
+                        upcoming[t.name] -= 1
+                    else:
+                        to_launch[t.name] = to_launch.get(t.name, 0) + 1
                     break
         return to_launch
 
@@ -86,9 +104,29 @@ class StandardAutoscaler:
         pgs = status.get("pending_placement_groups", [])
 
         current = self.provider.non_terminated_nodes()
+        # Provider nodes with no GCS registration yet (queued/provisioning
+        # cloud capacity) still satisfy demand ONCE UP: count their full
+        # resources as upcoming bins, or every tick of a minutes-long
+        # TPU provision would launch a duplicate slice (reference:
+        # resource_demand_scheduler counts launching nodes as upcoming).
+        registered = {n["node_id"] for n in alive}
+        registered |= {(n.get("labels") or {}).get("tpu-slice")
+                       for n in alive}
+        upcoming = []
+        upcoming_by_type: dict[str, int] = {}
+        for nid in current:
+            if nid in registered:
+                continue
+            t_name = self.provider.node_type(nid)
+            t = self.node_types.get(t_name)
+            if t is not None:
+                upcoming.append(dict(t.resources))
+                upcoming_by_type[t_name] = upcoming_by_type.get(t_name, 0) + 1
         launched: dict[str, int] = {}
         if len(current) < self.max_workers:
-            to_launch = self.get_nodes_to_launch(demand, pgs, available)
+            to_launch = self.get_nodes_to_launch(demand, pgs,
+                                                 available + upcoming,
+                                                 upcoming_by_type)
             count_by_type: dict[str, int] = {}
             for nid in current:
                 tn = self.provider.node_type(nid)
@@ -112,18 +150,28 @@ class StandardAutoscaler:
                     count_by_type[type_name] = have + count
 
         # Idle termination: fully-available worker nodes past the timeout.
+        # A provider node maps to GCS nodes either directly by id (fake
+        # provider) or through the `tpu-slice` label (cloud slices: one
+        # provider node = a whole multi-host slice registering under its
+        # own GCS node ids) — a slice is idle only when EVERY host is.
         terminated = []
         now = time.monotonic()
         by_id = {n["node_id"]: n for n in alive}
+        by_slice: dict[str, list[dict]] = {}
+        for n in alive:
+            label = (n.get("labels") or {}).get("tpu-slice")
+            if label:
+                by_slice.setdefault(label, []).append(n)
         min_by_type: dict[str, int] = {}
         for nid in list(current):
-            info = by_id.get(nid)
-            if info is None:
+            infos = [by_id[nid]] if nid in by_id else by_slice.get(nid, [])
+            if not infos:
                 continue
             t_name = self.provider.node_type(nid)
             t = self.node_types.get(t_name)
-            idle = (info["available_resources"] == info["total_resources"]
-                    and not demand)
+            idle = not demand and all(
+                i["available_resources"] == i["total_resources"]
+                for i in infos)
             if not idle:
                 self._idle_since.pop(nid, None)
                 continue
@@ -132,6 +180,9 @@ class StandardAutoscaler:
             if now - first_idle > self.idle_timeout_s and t is not None \
                     and kept >= t.min_workers:
                 logger.info("autoscaler terminating idle node %s", nid[:8])
+                if self.drain_node is not None:
+                    for i in infos:
+                        self.drain_node(i["node_id"])
                 self.provider.terminate_node(nid)
                 terminated.append(nid)
                 self._idle_since.pop(nid, None)

@@ -16,11 +16,14 @@ die together).
 from __future__ import annotations
 
 import json
+import logging
 import shutil
 import subprocess
 import uuid
 
 from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
+
+logger = logging.getLogger(__name__)
 
 
 class GCPTPUNodeProvider(NodeProvider):
@@ -178,12 +181,22 @@ class GCPTPUNodeProvider(NodeProvider):
         if not head:
             info["bootstrapped"] = True
             return
-        start = f"python -m ray_tpu.scripts start --address={head}"
+        # TPU_NAME ties every host's raylet to this provider node: the
+        # autoscaler matches GCS nodes back to the slice through the
+        # resulting `tpu-slice` label for idle-drain-terminate.
+        start = (f"TPU_NAME={name} "
+                 f"python -m ray_tpu.scripts start --address={head}")
         try:
             self._run(self.ssh_fanout_command(name, start))
             info["bootstrapped"] = True
-        except RuntimeError:
-            pass  # retried next tick
+            info.pop("bootstrap_error", None)
+        except RuntimeError as e:
+            # Surfaced, counted, retried next tick — a slice that never
+            # bootstraps must be visible, not silently half-provisioned.
+            info["bootstrap_failures"] = info.get("bootstrap_failures", 0) + 1
+            info["bootstrap_error"] = str(e)
+            logger.warning("bootstrap of slice %s failed (attempt %d): %s",
+                           name, info["bootstrap_failures"], e)
 
     def node_resources(self, node_id: str) -> dict:
         chips = int(self.config["accelerator_type"].rsplit("-", 1)[-1])

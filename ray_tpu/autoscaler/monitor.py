@@ -1,0 +1,117 @@
+"""Autoscaler monitor: the standalone process that scales a live cluster.
+
+Parity: reference python/ray/autoscaler/_private/monitor.py — a process
+beside the GCS that reads load (pending lease demand + pending placement
+groups) from the control plane, runs `StandardAutoscaler.update()` on an
+interval, and drains nodes through the GCS before terminating them
+(reference: autoscaler.py:171 update reconciliation; drain via
+DrainNode, the analog of node_manager.cc HandleDrainRaylet).
+
+Run::
+
+    python -m ray_tpu.autoscaler.monitor \
+        --address 127.0.0.1:6379 --config cluster.yaml
+
+The monitor owns no cluster state: everything it needs is re-read from
+the GCS each tick, so it can crash and restart freely (same stateless
+design as the reference's monitor).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import threading
+
+from ray_tpu._private import rpc
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.cluster_config import (
+    load_cluster_config, make_provider, node_types_from_config)
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    """GCS-backed status/drain plumbing + the autoscaler loop."""
+
+    def __init__(self, gcs_host: str, gcs_port: int, provider, node_types,
+                 *, idle_timeout_s: float = 300.0,
+                 upscaling_speed: float = 1.0, max_workers: int = 20):
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever,
+                                        daemon=True, name="monitor-rpc")
+        self._thread.start()
+        self._conn = self._call_async(rpc.connect_retry(
+            gcs_host, gcs_port, name="monitor->gcs", timeout=30.0))
+        self.autoscaler = StandardAutoscaler(
+            provider, node_types,
+            get_cluster_status=self.get_cluster_status,
+            drain_node=self.drain_node,
+            idle_timeout_s=idle_timeout_s,
+            upscaling_speed=upscaling_speed, max_workers=max_workers)
+
+    def _call_async(self, coro, timeout: float = 30.0):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
+            timeout)
+
+    def get_cluster_status(self) -> dict:
+        return self._call_async(self._conn.call("GetClusterStatus", {}))
+
+    def drain_node(self, node_id: str) -> None:
+        """Stop new leases on the node and let running work finish
+        before the provider tears the VM down."""
+        try:
+            self._call_async(self._conn.call("DrainNode",
+                                             {"node_id": node_id}))
+        except Exception:
+            logger.warning("drain of node %s failed; terminating anyway",
+                           node_id[:8], exc_info=True)
+
+    def run(self, interval_s: float = 5.0):
+        self.autoscaler.start(interval_s=interval_s)
+
+    def run_blocking(self, interval_s: float = 5.0):
+        import time
+
+        self.run(interval_s)
+        try:
+            while True:
+                time.sleep(60)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def stop(self):
+        self.autoscaler.stop()
+        try:
+            self._call_async(self._conn.close(), timeout=5.0)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5.0)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="[monitor] %(asctime)s %(message)s")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--address", required=True, help="GCS host:port")
+    ap.add_argument("--config", required=True, help="cluster YAML")
+    ap.add_argument("--interval", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    cfg = load_cluster_config(args.config)
+    host, port = args.address.rsplit(":", 1)
+    provider = make_provider(cfg)
+    monitor = Monitor(
+        host, int(port), provider, node_types_from_config(cfg),
+        idle_timeout_s=60.0 * float(cfg.get("idle_timeout_minutes", 5)),
+        upscaling_speed=float(cfg.get("upscaling_speed", 1.0)),
+        max_workers=int(cfg.get("max_workers", 20)))
+    monitor.run_blocking(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,178 @@
+"""Hermetic end-to-end test of the GCP TPU provider reconcile loop:
+provision (queued-resources) -> READY -> bootstrap (ssh fan-out, with a
+failure retried) -> idle -> drain -> terminate — against a FAKE gcloud
+binary so the whole flow runs without GCP (reference model:
+autoscaler fake-provider tests + the queued-resources TPU-VM flow)."""
+
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
+from ray_tpu.autoscaler.node_provider import NodeType
+
+FAKE_GCLOUD = '''#!{python}
+import json, os, sys
+STATE = {state!r}
+LOG = {log!r}
+def load():
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            return json.load(f)
+    return {{"tpus": {{}}, "queued": {{}}, "fail_ssh": 0}}
+def save(s):
+    with open(STATE, "w") as f:
+        json.dump(s, f)
+args = sys.argv[1:]
+with open(LOG, "a") as f:
+    f.write(json.dumps(args) + chr(10))
+s = load()
+op = args[:4]
+if op == ["compute", "tpus", "queued-resources", "create"]:
+    s["queued"][args[4]] = "WAITING_FOR_RESOURCES"
+    save(s); sys.exit(0)
+if op == ["compute", "tpus", "tpu-vm", "list"]:
+    print(json.dumps([{{"name": n, "state": st}}
+                      for n, st in s["tpus"].items()])); sys.exit(0)
+if op == ["compute", "tpus", "queued-resources", "list"]:
+    print(json.dumps([{{"name": n, "state": {{"state": st}}}}
+                      for n, st in s["queued"].items()])); sys.exit(0)
+if op == ["compute", "tpus", "tpu-vm", "ssh"]:
+    if s.get("fail_ssh", 0) > 0:
+        s["fail_ssh"] -= 1; save(s)
+        sys.stderr.write("ssh: connect refused" + chr(10)); sys.exit(1)
+    sys.exit(0)
+if op == ["compute", "tpus", "queued-resources", "delete"]:
+    s["queued"].pop(args[4], None); s["tpus"].pop(args[4], None)
+    save(s); sys.exit(0)
+if op == ["compute", "tpus", "tpu-vm", "delete"]:
+    s["tpus"].pop(args[4], None); save(s); sys.exit(0)
+sys.stderr.write("fake gcloud: unknown op " + repr(args[:4]) + chr(10))
+sys.exit(2)
+'''
+
+
+@pytest.fixture()
+def fake_gcloud(tmp_path, monkeypatch):
+    state = tmp_path / "gcloud_state.json"
+    log = tmp_path / "gcloud_calls.log"
+    exe = tmp_path / "gcloud"
+    exe.write_text(FAKE_GCLOUD.format(python=sys.executable,
+                                      state=str(state), log=str(log)))
+    exe.chmod(exe.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv("PATH", f"{tmp_path}{os.pathsep}"
+                               f"{os.environ.get('PATH', '')}")
+
+    class Ctl:
+        def calls(self):
+            if not log.exists():
+                return []
+            return [json.loads(l) for l in log.read_text().splitlines()]
+
+        def state(self):
+            return json.loads(state.read_text())
+
+        def set_state(self, s):
+            state.write_text(json.dumps(s))
+
+    return Ctl()
+
+
+def _provider():
+    return GCPTPUNodeProvider({
+        "project": "proj", "zone": "us-central2-b",
+        "accelerator_type": "v5e-8", "runtime_version": "tpu-ubuntu2204",
+        "head_address": "10.0.0.1:6379",
+    })
+
+
+def test_provision_bootstrap_drain_terminate_cycle(fake_gcloud):
+    provider = _provider()
+    tpu_type = NodeType("tpu", {"TPU": 8.0}, max_workers=4)
+    drained: list = []
+    status = {"nodes": [], "pending_demand": [{"TPU": 8.0}],
+              "pending_placement_groups": []}
+    scaler = StandardAutoscaler(
+        provider, [tpu_type], get_cluster_status=lambda: status,
+        drain_node=drained.append, idle_timeout_s=0.0)
+
+    # Tick 1: unmet TPU demand -> queued-resource created.
+    scaler.update()
+    st = fake_gcloud.state()
+    assert len(st["queued"]) == 1
+    (name,) = st["queued"]
+    assert name.startswith("ray-tpu-")
+    assert st["queued"][name] == "WAITING_FOR_RESOURCES"
+
+    # Tick 2: still waiting -> queued capacity counts, NO duplicate launch.
+    scaler.update()
+    assert len(fake_gcloud.state()["queued"]) == 1
+
+    # Capacity arrives; first bootstrap SSH fails and must be retried.
+    st = fake_gcloud.state()
+    st["tpus"][name] = "READY"
+    st["fail_ssh"] = 1
+    fake_gcloud.set_state(st)
+    scaler.update()
+    info = provider._nodes[name]
+    assert info.get("bootstrap_failures") == 1
+    assert "bootstrap_error" in info
+    scaler.update()  # retried next tick
+    assert provider._nodes[name].get("bootstrapped") is True
+    ssh_calls = [c for c in fake_gcloud.calls() if c[2:4] == ["tpu-vm", "ssh"]]
+    assert len(ssh_calls) == 2
+    assert any(f"TPU_NAME={name}" in arg
+               for arg in ssh_calls[-1] if "--command=" in arg)
+
+    # The slice registers with the GCS under its own node ids, carrying
+    # the tpu-slice label; demand clears -> idle -> drain -> terminate.
+    status["pending_demand"] = []
+    status["nodes"] = [
+        {"node_id": f"gcsnode{i}", "alive": True,
+         "available_resources": {"TPU": 8.0},
+         "total_resources": {"TPU": 8.0},
+         "labels": {"tpu-slice": name}}
+        for i in range(2)
+    ]
+    scaler.update()  # marks idle
+    scaler.update()  # terminates after the (0s) timeout
+    assert drained == ["gcsnode0", "gcsnode1"]
+    assert fake_gcloud.state()["queued"] == {}
+    assert provider.non_terminated_nodes() == []
+    deletes = [c for c in fake_gcloud.calls()
+               if c[2:4] == ["queued-resources", "delete"]]
+    assert len(deletes) == 1 and deletes[0][4] == name
+
+
+def test_busy_slice_not_terminated(fake_gcloud):
+    provider = _provider()
+    tpu_type = NodeType("tpu", {"TPU": 8.0}, max_workers=4)
+    status = {"nodes": [], "pending_demand": [{"TPU": 8.0}],
+              "pending_placement_groups": []}
+    scaler = StandardAutoscaler(
+        provider, [tpu_type], get_cluster_status=lambda: status,
+        idle_timeout_s=0.0)
+    scaler.update()
+    (name,) = fake_gcloud.state()["queued"]
+    st = fake_gcloud.state()
+    st["tpus"][name] = "READY"
+    fake_gcloud.set_state(st)
+    scaler.update()
+    # One host busy (resources in use): the slice must NOT be terminated
+    # even with zero demand.
+    status["pending_demand"] = []
+    status["nodes"] = [
+        {"node_id": "a", "alive": True,
+         "available_resources": {"TPU": 0.0},
+         "total_resources": {"TPU": 8.0}, "labels": {"tpu-slice": name}},
+        {"node_id": "b", "alive": True,
+         "available_resources": {"TPU": 8.0},
+         "total_resources": {"TPU": 8.0}, "labels": {"tpu-slice": name}},
+    ]
+    scaler.update()
+    scaler.update()
+    assert name in fake_gcloud.state()["tpus"]
