@@ -24,6 +24,7 @@ TPU-native re-design (SURVEY.md §2.5): there are two planes —
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -36,6 +37,87 @@ _REDUCE_OPS = {
     "min": np.minimum,
     "max": np.maximum,
 }
+
+
+class _PeerPlane:
+    """Direct worker-to-worker transport for host collectives.
+
+    The rendezvous actor coordinates MEMBERSHIP only; payloads flow
+    peer-to-peer over each member's existing CoreWorker RPC server (a
+    "CollectiveDeliver" handler feeding a mailbox). This is what lets
+    allreduce scale past a handful of workers: a ring moves 2·(W-1)/W of
+    the tensor per member regardless of W, where the actor funnel
+    serialized W full tensors through one process (the round-2 advisor's
+    scaling complaint; reference gloo rings behave the same way)."""
+
+    def __init__(self):
+        from ray_tpu._private.api_internal import get_core_worker
+
+        self.cw = get_core_worker()
+        self._cond = threading.Condition()
+        self._inbox: dict[tuple, tuple] = {}
+        self._conns: dict[tuple, object] = {}
+        self.cw.server.handlers["CollectiveDeliver"] = self._on_deliver
+        self.addr = [self.cw.address.host, self.cw.address.port]
+
+    async def _on_deliver(self, conn, payload):
+        key = (payload["group"], payload["tag"])
+        with self._cond:
+            self._inbox[key] = (payload["dtype"], payload["shape"],
+                                payload["data"])
+            self._cond.notify_all()
+        return {}
+
+    def _conn_for(self, addr):
+        from ray_tpu._private import rpc
+
+        key = tuple(addr)
+        conn = self._conns.get(key)
+        if conn is None or conn.closed:
+            conn = self.cw._run(rpc.connect(
+                addr[0], int(addr[1]), name="collective-peer"))
+            self._conns[key] = conn
+        return conn
+
+    def send(self, group: str, addr, tag: str, arr: np.ndarray):
+        conn = self._conn_for(addr)
+        self.cw._run(conn.notify("CollectiveDeliver", {
+            "group": group, "tag": tag, "dtype": str(arr.dtype),
+            "shape": list(arr.shape), "data": arr.tobytes()}))
+
+    def recv(self, group: str, tag: str, timeout: float = 300.0
+             ) -> np.ndarray:
+        key = (group, tag)
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while key not in self._inbox:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"collective recv timed out waiting for {tag!r}")
+                self._cond.wait(remaining)
+            dtype, shape, data = self._inbox.pop(key)
+        return np.frombuffer(bytearray(data), dtype=dtype).reshape(shape)
+
+    def close(self):
+        for conn in self._conns.values():
+            try:
+                self.cw._run(conn.close())
+            except Exception:
+                pass
+        self._conns.clear()
+
+
+_peer_plane: _PeerPlane | None = None
+_peer_plane_lock = threading.Lock()
+
+
+def _get_peer_plane() -> _PeerPlane:
+    global _peer_plane
+    with _peer_plane_lock:
+        if _peer_plane is None:
+            _peer_plane = _PeerPlane()
+        return _peer_plane
 
 
 @ray_tpu.remote
@@ -55,6 +137,9 @@ class _RendezvousActor:
 
     def num_members(self) -> int:
         return len(self.members)
+
+    def members_info(self) -> dict:
+        return self.members
 
     def contribute(self, round_key: str, op: str, rank: int, payload):
         """Gather contributions; when all present, compute + publish."""
@@ -104,17 +189,25 @@ class _RendezvousActor:
 
 class _Group:
     def __init__(self, name: str, world_size: int, rank: int, backend: str,
-                 actor):
+                 actor, peer_addrs: dict[int, list] | None = None):
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.backend = backend
         self.actor = actor
+        # rank -> [host, port] of each member's worker RPC server; when
+        # present, collectives run over the peer ring instead of the
+        # rendezvous actor.
+        self.peer_addrs = peer_addrs or {}
         self._seq = 0
 
     def next_key(self, op: str) -> str:
         self._seq += 1
         return f"{op}:{self._seq}"
+
+    @property
+    def ring(self) -> bool:
+        return len(self.peer_addrs) == self.world_size and self.world_size > 1
 
 
 class GroupManager:
@@ -128,7 +221,9 @@ class GroupManager:
         actor = _RendezvousActor.options(
             name=f"collective:{group_name}", get_if_exists=True,
             lifetime="detached").remote(world_size)
-        ray_tpu.get(actor.join.remote(rank, {"backend": backend}))
+        plane = _get_peer_plane()
+        ray_tpu.get(actor.join.remote(
+            rank, {"backend": backend, "addr": plane.addr}))
         # Wait for full membership.
         deadline = time.monotonic() + 60
         while ray_tpu.get(actor.num_members.remote()) < world_size:
@@ -138,7 +233,10 @@ class GroupManager:
                     f"{ray_tpu.get(actor.num_members.remote())}/{world_size} "
                     "members joined within 60s")
             time.sleep(0.02)
-        g = _Group(group_name, world_size, rank, backend, actor)
+        members = ray_tpu.get(actor.members_info.remote())
+        peer_addrs = {int(r): m["addr"] for r, m in members.items()
+                      if m.get("addr")}
+        g = _Group(group_name, world_size, rank, backend, actor, peer_addrs)
         self.groups[group_name] = g
         return g
 
@@ -197,11 +295,66 @@ def _collect(g: _Group, op: str, array):
         time.sleep(0.002)
 
 
+def _ring_reduce_chunks(g: _Group, arr: np.ndarray, op: str):
+    """Ring reduce-scatter over flattened chunks; returns (chunks, seq)
+    with this rank holding the FULLY reduced chunk at index
+    (rank+1) % W after the W-1 steps."""
+    plane = _get_peer_plane()
+    W, r = g.world_size, g.rank
+    right = g.peer_addrs[(r + 1) % W]
+    f = _REDUCE_OPS[op]
+    flat = np.ascontiguousarray(arr).ravel()
+    chunks = [np.array(c) for c in np.array_split(flat, W)]
+    seq = g.next_key("ring")
+    for step in range(W - 1):
+        send_idx = (r - step) % W
+        recv_idx = (r - step - 1) % W
+        plane.send(g.name, right, f"{seq}:rs{step}", chunks[send_idx])
+        got = plane.recv(g.name, f"{seq}:rs{step}")
+        chunks[recv_idx] = f(chunks[recv_idx], got)
+    return chunks, seq
+
+
+def _ring_allreduce(g: _Group, arr: np.ndarray, op: str) -> np.ndarray:
+    """Classic two-phase ring: reduce-scatter then allgather. Each member
+    moves 2·(W-1)/W of the tensor total, independent of W."""
+    plane = _get_peer_plane()
+    W, r = g.world_size, g.rank
+    right = g.peer_addrs[(r + 1) % W]
+    chunks, seq = _ring_reduce_chunks(g, arr, op)
+    for step in range(W - 1):
+        send_idx = (r + 1 - step) % W
+        recv_idx = (r - step) % W
+        plane.send(g.name, right, f"{seq}:ag{step}", chunks[send_idx])
+        chunks[recv_idx] = plane.recv(g.name, f"{seq}:ag{step}")
+    out = np.concatenate(chunks)
+    return out.reshape(np.asarray(arr).shape)
+
+
+def _ring_allgather(g: _Group, arr: np.ndarray) -> list:
+    """Each member's array circulates the ring once (W-1 forwards)."""
+    plane = _get_peer_plane()
+    W, r = g.world_size, g.rank
+    right = g.peer_addrs[(r + 1) % W]
+    seq = g.next_key("ring")
+    out: list = [None] * W
+    out[r] = np.asarray(arr)
+    carry = out[r]
+    for step in range(W - 1):
+        plane.send(g.name, right, f"{seq}:ag{step}", carry)
+        carry = plane.recv(g.name, f"{seq}:ag{step}")
+        out[(r - step - 1) % W] = carry
+    return out
+
+
 def allreduce(tensor, group_name: str = "default", op: str = "sum"):
     """In-place-style allreduce; returns the reduced array."""
     g = _manager.get(group_name)
     arr = np.asarray(tensor)
-    out = _collect(g, op, arr)
+    if g.ring:
+        out = _ring_allreduce(g, arr, op)
+    else:
+        out = _collect(g, op, arr)
     try:
         tensor[...] = out
     except (TypeError, ValueError):
@@ -211,12 +364,22 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum"):
 
 def allgather(tensor, group_name: str = "default") -> list:
     g = _manager.get(group_name)
+    if g.ring:
+        return _ring_allgather(g, np.asarray(tensor))
     return _collect(g, "gather", np.asarray(tensor))
 
 
 def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
     g = _manager.get(group_name)
     arr = np.asarray(tensor)
+    if g.ring:
+        # Contract (same as the actor path): rank's shard is
+        # array_split(reduced, W)[rank] along AXIS 0 of the original
+        # shape. The ring chunks over the ravel, so reconstruct the full
+        # reduced array and slice — still O(size) ring traffic with no
+        # single-process funnel, just not the reduce-scatter minimum.
+        reduced = _ring_allreduce(g, arr, op)
+        return np.array_split(reduced, g.world_size)[g.rank]
     reduced = _collect(g, op, arr)
     shards = np.array_split(reduced, g.world_size)
     return shards[g.rank]
@@ -224,9 +387,20 @@ def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     g = _manager.get(group_name)
-    gathered = _collect(g, "gather", np.asarray(tensor) if g.rank == src_rank
-                        else np.asarray(tensor))
-    out = gathered[src_rank]
+    if g.ring:
+        plane = _get_peer_plane()
+        seq = g.next_key("ring")
+        if g.rank == src_rank:
+            arr = np.asarray(tensor)
+            for dst, addr in g.peer_addrs.items():
+                if dst != src_rank:
+                    plane.send(g.name, addr, f"{seq}:bc", arr)
+            out = arr
+        else:
+            out = plane.recv(g.name, f"{seq}:bc")
+    else:
+        gathered = _collect(g, "gather", np.asarray(tensor))
+        out = gathered[src_rank]
     try:
         tensor[...] = out
     except (TypeError, ValueError):

@@ -230,3 +230,48 @@ def test_dump_stacks_across_workers(ray_start_regular):
     assert "nap" in blob  # the sleeping actor method is visible
     assert ray_tpu.get(ref, timeout=30) is True
     ray_tpu.kill(a)
+
+
+def test_collective_ring_4workers(ray_start_regular):
+    """4 members: collectives run over the peer-to-peer ring (the
+    rendezvous actor only coordinates membership — advisor r2: the
+    single-actor funnel must not serialize payloads)."""
+
+    @ray_tpu.remote
+    def member(rank, world):
+        import numpy as np
+
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world, rank, backend="cpu",
+                                  group_name="ring4")
+        from ray_tpu.util.collective import collective as col_impl
+
+        g = col_impl._manager.get("ring4")
+        assert g.ring, "4-member cpu group must use the peer ring"
+        # allreduce: sum over an 11-element array (uneven chunking).
+        red = col.allreduce(np.arange(11, dtype=np.float64) + rank,
+                            group_name="ring4")
+        # allgather: per-rank distinct shapes are allowed.
+        gathered = col.allgather(np.full(rank + 1, rank, np.int64),
+                                 group_name="ring4")
+        # reducescatter: rank's own shard of the summed array.
+        shard = col.reducescatter(np.ones(8, np.float32) * (rank + 1),
+                                  group_name="ring4")
+        # broadcast from rank 2.
+        b = np.zeros(3, np.float64) if rank != 2 else np.arange(3, 6.0)
+        bout = col.broadcast(b, src_rank=2, group_name="ring4")
+        col.barrier(group_name="ring4")
+        return (red.tolist(), [g.tolist() for g in gathered],
+                shard.tolist(), bout.tolist())
+
+    world = 4
+    results = ray_tpu.get([member.remote(r, world) for r in range(world)],
+                          timeout=180)
+    expect_red = [(4 * i + 6.0) for i in range(11)]  # sum of arange+rank
+    expect_shard = 1.0 + 2 + 3 + 4  # ones * (rank+1) summed
+    for rank, (red, gathered, shard, bout) in enumerate(results):
+        assert red == expect_red
+        assert gathered == [[r] * (r + 1) for r in range(world)]
+        assert all(s == expect_shard for s in shard) and len(shard) == 2
+        assert bout == [3.0, 4.0, 5.0]
