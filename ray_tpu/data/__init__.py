@@ -83,7 +83,7 @@ def _lazy_read(files: list, read_one, override_num_blocks: int | None
 def read_text(paths: str | list, *, override_num_blocks: int | None = None
               ) -> Dataset:
     def read_one(p):
-        with open(p) as f:
+        with _open(p) as f:
             return [{"text": line.rstrip("\n")} for line in f]
 
     return _lazy_read(_expand(paths), read_one, override_num_blocks)
@@ -94,7 +94,7 @@ def read_json(paths: str | list, *, lines: bool = True,
     def read_one(p, lines=lines):
         import json
 
-        with open(p) as f:
+        with _open(p) as f:
             if lines:
                 return [json.loads(ln) for ln in f if ln.strip()]
             data = json.load(f)
@@ -111,15 +111,38 @@ def from_arrow(tables, *, override_num_blocks: int | None = None) -> Dataset:
     return Dataset(list(tables))
 
 
+def _read_parquet_group(group, columns, filters, endpoint_url=None):
+    """One parquet read task (module-level so pushdown can rebuild it with
+    pruned columns/filters). s3:// objects fetch through the stdlib S3
+    client into a seekable buffer."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data import s3 as _s3
+
+    tables = []
+    for p in group:
+        src = _s3.open_uri(p, endpoint_url) if _s3.is_s3_uri(p) else p
+        tables.append(pq.read_table(src, columns=columns, filters=filters))
+    return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+
+
 def read_parquet(paths: str | list, *, columns: list | None = None,
+                 filters: list | None = None,
+                 endpoint_url: str | None = None,
                  override_num_blocks: int | None = None) -> Dataset:
     """Arrow-native parquet read: each read task yields a pyarrow.Table
     block (reference: ray.data.read_parquet over Arrow datasets; tables
     pickle with protocol-5 buffers so they move through the shm store
-    zero-copy)."""
+    zero-copy). The ReadTasks carry structured metadata so a following
+    select_columns()/filter(expr=...) pushes down into the reader
+    (reference: data/_internal/logical optimizer rules). Paths may be
+    s3:// URIs against an S3-compatible endpoint (data/s3.py)."""
+    import functools
+
     from ray_tpu.data.dataset import ReadTask
 
-    files = _expand(paths)
+    files = _expand(paths, endpoint_url=endpoint_url)
     groups = [[p] for p in files]
     if override_num_blocks is not None and 0 < override_num_blocks < len(files):
         n = override_num_blocks
@@ -127,15 +150,15 @@ def read_parquet(paths: str | list, *, columns: list | None = None,
         groups = [files[i * per:(i + 1) * per] for i in _builtins.range(n)]
         groups = [g for g in groups if g]
 
-    def read_group(group, columns=columns):
-        import pyarrow.parquet as pq
-        import pyarrow as pa
-
-        tables = [pq.read_table(p, columns=columns) for p in group]
-        return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
-
-    return Dataset([ReadTask(fn=(lambda g=g: read_group(g)))
-                    for g in groups])
+    tasks = []
+    for g in groups:
+        meta = {"kind": "parquet", "group": list(g), "columns": columns,
+                "filters": filters, "endpoint_url": endpoint_url}
+        tasks.append(ReadTask(
+            fn=functools.partial(_read_parquet_group, list(g), columns,
+                                 filters, endpoint_url),
+            meta=meta))
+    return Dataset(tasks)
 
 
 def read_csv(paths: str | list, *, override_num_blocks: int | None = None
@@ -143,7 +166,7 @@ def read_csv(paths: str | list, *, override_num_blocks: int | None = None
     def read_one(p):
         import csv
 
-        with open(p) as f:
+        with _open(p) as f:
             return [dict(r) for r in csv.DictReader(f)]
 
     return _lazy_read(_expand(paths), read_one, override_num_blocks)
@@ -165,7 +188,7 @@ def read_binary_files(paths: str | list, *, include_paths: bool = False,
     data/read_api.py read_binary_files)."""
 
     def read_one(p, include_paths=include_paths):
-        with open(p, "rb") as f:
+        with _open(p, "rb") as f:
             data = f.read()
         row = {"bytes": data}
         if include_paths:
@@ -206,14 +229,34 @@ def read_images(paths: str | list, *, include_paths: bool = False,
     return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
-def _expand(paths: str | list) -> list:
+def _expand(paths: str | list, endpoint_url: str | None = None) -> list:
+    from ray_tpu.data import s3 as _s3
+
     if isinstance(paths, str):
         paths = [paths]
     out = []
     for p in paths:
+        if _s3.is_s3_uri(p):
+            listed = sorted(_s3.expand_uri(p, endpoint_url))
+            out.extend(listed if listed else [p])
+            continue
         matches = sorted(_glob.glob(p))
         out.extend(matches if matches else [p])
     return out
+
+
+def _open(path: str, mode: str = "r", endpoint_url: str | None = None):
+    """Open a local path or s3:// object for the row-based readers."""
+    from ray_tpu.data import s3 as _s3
+
+    if _s3.is_s3_uri(path):
+        buf = _s3.open_uri(path, endpoint_url)
+        if "b" in mode:
+            return buf
+        import io as _io
+
+        return _io.TextIOWrapper(buf, encoding="utf-8")
+    return open(path, mode)
 
 
 __all__ = [

@@ -37,6 +37,13 @@ from ray_tpu.data.block import (
 class _Stage:
     name: str
     fn: Callable  # block -> block  (run remotely)
+    # Logical-plan pushdown tags (reference: data/_internal/logical
+    # optimizer rules): when the stage directly follows parquet ReadTasks,
+    # the executor folds it into the read itself — a projection prunes
+    # columns at the file reader, a (col, op, literal) predicate prunes
+    # row groups/rows — and drops the stage from the physical plan.
+    pushdown_projection: list | None = None
+    pushdown_filter: tuple | None = None
     all_to_all: bool = False  # needs every input block materialized first
     all_to_all_fn: Callable | None = None  # blocks(list of refs) -> list[blocks]
     num_cpus: float = 1.0
@@ -98,6 +105,56 @@ class ReadTask:
     # Metadata the driver may know without reading (row count for
     # splits/estimates; None when unknown).
     num_rows: int | None = None
+    # Structured description for optimizer pushdown; parquet shape:
+    # {"kind": "parquet", "group": [paths], "columns": list|None,
+    #  "filters": list|None, "endpoint_url": str|None}. None = opaque fn.
+    meta: dict | None = None
+
+
+def _pushdown_rewrite(source: list, stages: list) -> tuple[list, list]:
+    """Fold leading projection/predicate stages into parquet ReadTasks
+    (reference: the logical optimizer's pushdown rules run before
+    physical planning; here the plan IS the stage list)."""
+    if not source or not all(
+            isinstance(s, ReadTask) and s.meta
+            and s.meta.get("kind") == "parquet" for s in source):
+        return source, stages
+    metas = [dict(s.meta) for s in source]
+    i = 0
+    for st in stages:
+        # Fold only when transparent: a projection/predicate referencing
+        # a column OUTSIDE the current projection must keep its stage
+        # (which raises KeyError at runtime) — folding it into pyarrow
+        # would silently succeed, diverging from the non-parquet path.
+        current_cols = metas[0].get("columns")
+        if st.pushdown_projection is not None:
+            cols = st.pushdown_projection
+            if current_cols is not None and \
+                    not set(cols) <= set(current_cols):
+                break
+            for m in metas:
+                m["columns"] = list(cols)
+        elif st.pushdown_filter is not None:
+            col, _op, _lit = st.pushdown_filter
+            if current_cols is not None and col not in current_cols:
+                break
+            for m in metas:
+                m["filters"] = (m.get("filters") or []) + \
+                    [tuple(st.pushdown_filter)]
+        else:
+            break
+        i += 1
+    if i == 0:
+        return source, stages
+    from ray_tpu.data import _read_parquet_group  # late: avoid cycle
+    import functools
+
+    new_source = [
+        ReadTask(fn=functools.partial(
+            _read_parquet_group, m["group"], m.get("columns"),
+            m.get("filters"), m.get("endpoint_url")), meta=m)
+        for m in metas]
+    return new_source, stages[i:]
 
 
 @ray_tpu.remote
@@ -208,7 +265,32 @@ class Dataset:
 
         return self._with(_Stage("map", stage_fn))
 
-    def filter(self, fn: Callable) -> "Dataset":
+    def filter(self, fn: Callable | None = None, *,
+               expr: tuple | None = None) -> "Dataset":
+        """Keep rows matching `fn`, or a structured `expr` of the form
+        (column, op, literal) with op in {==, !=, <, <=, >, >=, in,
+        not in}. Expression form is optimizer-visible: directly after a
+        parquet read it pushes down to row-group/row pruning inside the
+        read task (reference: logical-plan predicate pushdown)."""
+        if (fn is None) == (expr is None):
+            raise ValueError("filter takes exactly one of fn or expr")
+        if expr is not None:
+            col, op, lit = expr
+            import operator as _op
+
+            ops = {"==": _op.eq, "!=": _op.ne, "<": _op.lt, "<=": _op.le,
+                   ">": _op.gt, ">=": _op.ge,
+                   "in": lambda a, b: a in b,
+                   "not in": lambda a, b: a not in b}
+            if op not in ops:
+                raise ValueError(f"unsupported filter op {op!r}")
+
+            def stage_fn(block, col=col, f=ops[op], lit=lit):
+                return [r for r in block_to_rows(block) if f(r[col], lit)]
+
+            return self._with(_Stage("filter", stage_fn,
+                                     pushdown_filter=(col, op, lit)))
+
         def stage_fn(block, fn=fn):
             return [r for r in block_to_rows(block) if fn(r)]
 
@@ -313,7 +395,8 @@ class Dataset:
             batch = block_to_batch(block)
             return {k: batch[k] for k in cols}
 
-        return self._with(_Stage("select_columns", stage_fn))
+        return self._with(_Stage("select_columns", stage_fn,
+                                 pushdown_projection=list(cols)))
 
     def zip(self, other: "Dataset") -> "Dataset":
         """Row-wise zip of two datasets (parity: Dataset.zip)."""
@@ -439,18 +522,20 @@ class Dataset:
 
         task_timeout = DataContext.get_current().block_task_timeout_s
 
+        source, stages = _pushdown_rewrite(list(self._source),
+                                           list(self._stages))
+
         def resolve_sources() -> Iterator:
             """Launch deferred reads as remote tasks; their ObjectRefs feed
             straight into downstream stage tasks (blocks never route
             through the driver)."""
-            for src in self._source:
+            for src in source:
                 if isinstance(src, ReadTask):
                     yield _exec_read.remote(serialization.dumps_func(src.fn))
                 else:
                     yield src
 
         blocks: Iterable = resolve_sources()
-        stages = list(self._stages)
         # Split into segments at all-to-all/shuffle barriers and actor-pool
         # stages.
         segment: list[_Stage] = []

@@ -1,0 +1,126 @@
+"""Minimal S3-protocol object-store client for Dataset IO.
+
+Parity target: the reference reads cloud storage through pyarrow
+filesystems + per-datasource glue (reference: python/ray/data/datasource/,
+tested hermetically against a local mock server —
+data/tests/mock_s3_server.py). This image has no boto3 and zero egress,
+so the client is stdlib http.client speaking the two S3 REST calls
+Dataset IO needs: ListObjectsV2 and GetObject. It targets S3-COMPATIBLE
+endpoints (set ``RAY_TPU_S3_ENDPOINT`` or pass ``endpoint_url=``) —
+SigV4-signed AWS auth is out of scope; compatible stores (minio-style,
+the test mock) accept anonymous reads.
+
+URI form: ``s3://bucket/key-or-prefix``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import os
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+ENDPOINT_ENV = "RAY_TPU_S3_ENDPOINT"
+
+
+def is_s3_uri(path: str) -> bool:
+    return isinstance(path, str) and path.startswith("s3://")
+
+
+def parse_uri(uri: str) -> tuple[str, str]:
+    rest = uri[len("s3://"):]
+    bucket, _, key = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"malformed s3 uri {uri!r}")
+    return bucket, key
+
+
+class S3Client:
+    def __init__(self, endpoint_url: str | None = None):
+        endpoint_url = endpoint_url or os.environ.get(ENDPOINT_ENV)
+        if not endpoint_url:
+            raise ValueError(
+                "s3:// paths need an endpoint: pass endpoint_url= or set "
+                f"{ENDPOINT_ENV} (SigV4 AWS auth is not supported; use an "
+                "S3-compatible endpoint)")
+        u = urllib.parse.urlparse(endpoint_url)
+        if u.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported endpoint scheme {u.scheme!r}")
+        self._https = u.scheme == "https"
+        self._host = u.hostname
+        self._port = u.port or (443 if self._https else 80)
+
+    def _conn(self) -> http.client.HTTPConnection:
+        cls = (http.client.HTTPSConnection if self._https
+               else http.client.HTTPConnection)
+        return cls(self._host, self._port, timeout=60)
+
+    def list_keys(self, bucket: str, prefix: str = "") -> list[str]:
+        """ListObjectsV2 with continuation support."""
+        keys: list[str] = []
+        token = None
+        while True:
+            q = {"list-type": "2", "prefix": prefix}
+            if token:
+                q["continuation-token"] = token
+            conn = self._conn()
+            try:
+                conn.request(
+                    "GET", f"/{bucket}?{urllib.parse.urlencode(q)}")
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    raise IOError(
+                        f"s3 list {bucket!r} prefix={prefix!r} -> "
+                        f"{resp.status}: {body[:200]!r}")
+            finally:
+                conn.close()
+            root = ET.fromstring(body)
+            ns = ""
+            if root.tag.startswith("{"):
+                ns = root.tag.split("}")[0] + "}"
+            for c in root.findall(f"{ns}Contents"):
+                k = c.find(f"{ns}Key")
+                if k is not None and k.text:
+                    keys.append(k.text)
+            trunc = root.find(f"{ns}IsTruncated")
+            if trunc is None or (trunc.text or "").lower() != "true":
+                break
+            tok = root.find(f"{ns}NextContinuationToken")
+            token = tok.text if tok is not None else None
+            if not token:
+                break
+        return keys
+
+    def get_object(self, bucket: str, key: str,
+                   byte_range: tuple[int, int] | None = None) -> bytes:
+        headers = {}
+        if byte_range is not None:
+            headers["Range"] = f"bytes={byte_range[0]}-{byte_range[1]}"
+        conn = self._conn()
+        try:
+            conn.request(
+                "GET", f"/{bucket}/{urllib.parse.quote(key)}",
+                headers=headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status not in (200, 206):
+                raise FileNotFoundError(
+                    f"s3://{bucket}/{key}: {resp.status} {body[:200]!r}")
+            return body
+        finally:
+            conn.close()
+
+
+def expand_uri(uri: str, endpoint_url: str | None = None) -> list[str]:
+    """Expand an s3:// prefix into the full object URIs under it."""
+    bucket, prefix = parse_uri(uri)
+    client = S3Client(endpoint_url)
+    return [f"s3://{bucket}/{k}" for k in client.list_keys(bucket, prefix)]
+
+
+def open_uri(path: str, endpoint_url: str | None = None) -> io.BytesIO:
+    """Fetch an object into a seekable buffer (parquet readers seek)."""
+    bucket, key = parse_uri(path)
+    return io.BytesIO(S3Client(endpoint_url).get_object(bucket, key))

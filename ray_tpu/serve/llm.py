@@ -397,14 +397,20 @@ class LLMEngine:
             k_one, v_one = kv_one[li]
             self._kv[li] = (k_full.at[slot].set(k_one),
                             v_full.at[slot].set(v_one))
-        first_logits = logits[len(prompt) - 1]
+        self._commit_first_token(slot, handle,
+                                 logits[len(prompt) - 1], len(prompt))
+
+    def _commit_first_token(self, slot: int, handle: RequestHandle,
+                            first_logits, prompt_len: int):
+        """Shared prefill->decode handoff: sample the first token and
+        commit all per-slot decode state (one protocol, dense AND paged)."""
         self._rng, srng = self._jax.random.split(self._rng)
         sp = handle.sampling
         tok = int(np.asarray(self._sample(
             first_logits[None], np.float32([sp.temperature]),
             np.int32([sp.top_k]), np.float32([sp.top_p]), srng))[0])
-        self._lens[slot] = len(prompt)
-        self._pos[slot] = len(prompt)
+        self._lens[slot] = prompt_len
+        self._pos[slot] = prompt_len
         self._token[slot] = tok
         self._temps[slot] = sp.temperature
         self._topks[slot] = sp.top_k
@@ -436,21 +442,8 @@ class LLMEngine:
             # leak from the pool forever.
             self._free_slot_pages(slot)
             raise
-        first_logits = logits[len(prompt) - 1]
-        self._rng, srng = self._jax.random.split(self._rng)
-        tok = int(np.asarray(self._sample(
-            first_logits[None], np.float32([sp.temperature]),
-            np.int32([sp.top_k]), np.float32([sp.top_p]), srng))[0])
-        self._lens[slot] = len(prompt)
-        self._pos[slot] = len(prompt)
-        self._token[slot] = tok
-        self._temps[slot] = sp.temperature
-        self._topks[slot] = sp.top_k
-        self._topps[slot] = sp.top_p
-        st.request = handle
-        st.generated = 0
-        st.prefill_prompt = None
-        self._emit(slot, tok)
+        self._commit_first_token(slot, handle,
+                                 logits[len(prompt) - 1], len(prompt))
 
     def _init_paged_state(self):
         """(Re)build the page pool: allocator + dummy page + zeroed

@@ -1,0 +1,94 @@
+"""Hermetic in-process S3-compatible server for Dataset IO tests
+(parity target: reference python/ray/data/tests/mock_s3_server.py —
+cloud-connector tests run against a local mock, never the network).
+
+Implements the slice of the S3 REST protocol ray_tpu.data.s3 speaks:
+  PUT /bucket/key           store an object
+  GET /bucket/key           fetch (Range supported)
+  GET /bucket?list-type=2   ListObjectsV2 (prefix, XML response)
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MockS3Server:
+    def __init__(self):
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.get_count = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _parse(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+                return bucket, key, urllib.parse.parse_qs(parsed.query)
+
+            def do_PUT(self):
+                bucket, key, _q = self._parse()
+                n = int(self.headers.get("Content-Length", 0))
+                outer.objects[(bucket, key)] = self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                bucket, key, q = self._parse()
+                if not key and "list-type" in q:
+                    prefix = (q.get("prefix") or [""])[0]
+                    keys = sorted(k for (b, k) in outer.objects
+                                  if b == bucket and k.startswith(prefix))
+                    body = ["<?xml version='1.0'?><ListBucketResult>",
+                            "<IsTruncated>false</IsTruncated>"]
+                    body += [f"<Contents><Key>{k}</Key><Size>"
+                             f"{len(outer.objects[(bucket, k)])}</Size>"
+                             f"</Contents>" for k in keys]
+                    body.append("</ListBucketResult>")
+                    data = "".join(body).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/xml")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                obj = outer.objects.get((bucket, key))
+                if obj is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                outer.get_count += 1
+                rng = self.headers.get("Range")
+                status = 200
+                if rng and rng.startswith("bytes="):
+                    lo, _, hi = rng[len("bytes="):].partition("-")
+                    obj = obj[int(lo): (int(hi) + 1) if hi else None]
+                    status = 206
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(obj)))
+                self.end_headers()
+                self.wfile.write(obj)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def put(self, bucket: str, key: str, data: bytes):
+        self.objects[(bucket, key)] = data
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,139 @@
+"""Cloud datasource + optimizer pushdown tests: parquet over a hermetic
+mock S3 server (reference model: data/tests/mock_s3_server.py), plus
+projection/filter pushdown into the read tasks, plus a
+larger-than-object-store streaming run (VERDICT r2 #7)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.data import s3 as s3mod
+from ray_tpu.data.dataset import ReadTask, _pushdown_rewrite
+
+from tests.mock_s3_server import MockS3Server
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def s3():
+    server = MockS3Server()
+    os.environ[s3mod.ENDPOINT_ENV] = server.endpoint
+    yield server
+    os.environ.pop(s3mod.ENDPOINT_ENV, None)
+    server.close()
+
+
+def _put_parquet(s3, bucket, key, table):
+    buf = io.BytesIO()
+    pq.write_table(table, buf)
+    s3.put(bucket, key, buf.getvalue())
+
+
+def test_s3_client_list_and_get(s3):
+    s3.put("b", "pre/x.bin", b"hello")
+    s3.put("b", "pre/y.bin", b"world")
+    s3.put("b", "other.bin", b"nope")
+    from ray_tpu.data.s3 import S3Client
+
+    c = S3Client(s3.endpoint)
+    assert c.list_keys("b", "pre/") == ["pre/x.bin", "pre/y.bin"]
+    assert c.get_object("b", "pre/x.bin") == b"hello"
+    assert c.get_object("b", "pre/x.bin", byte_range=(1, 3)) == b"ell"
+
+
+def test_read_parquet_from_mock_s3(s3, ray_start_regular):
+    t = pa.table({"a": list(range(10)), "b": [f"r{i}" for i in range(10)]})
+    _put_parquet(s3, "data", "ds/part-0.parquet", t.slice(0, 5))
+    _put_parquet(s3, "data", "ds/part-1.parquet", t.slice(5, 5))
+    ds = data.read_parquet("s3://data/ds/")
+    rows = sorted(r["a"] for r in ds.iter_rows())
+    assert rows == list(range(10))
+
+
+def test_projection_and_filter_pushdown_plan(s3):
+    """The optimizer folds select_columns + filter(expr) INTO the parquet
+    ReadTasks and drops the stages from the physical plan."""
+    t = pa.table({"a": list(range(8)), "b": list(range(8)),
+                  "c": list(range(8))})
+    _put_parquet(s3, "data", "pd/f.parquet", t)
+    ds = data.read_parquet("s3://data/pd/") \
+        .select_columns(["a", "b"]).filter(expr=("a", ">=", 4))
+    source, stages = _pushdown_rewrite(list(ds._source), list(ds._stages))
+    assert stages == []  # both folded away
+    (task,) = source
+    assert isinstance(task, ReadTask)
+    assert task.meta["columns"] == ["a", "b"]
+    assert task.meta["filters"] == [("a", ">=", 4)]
+
+
+def test_pushdown_results_match_unpushed(s3, ray_start_regular):
+    t = pa.table({"a": list(range(20)), "b": [i * 10 for i in range(20)],
+                  "c": ["x"] * 20})
+    _put_parquet(s3, "data", "eq/f.parquet", t)
+    pushed = data.read_parquet("s3://data/eq/") \
+        .select_columns(["a", "b"]).filter(expr=("a", "<", 5))
+    plain = data.read_parquet("s3://data/eq/") \
+        .filter(fn=lambda r: r["a"] < 5)
+    got = sorted((r["a"], r["b"]) for r in pushed.iter_rows())
+    want = sorted((r["a"], r["b"]) for r in plain.iter_rows())
+    assert got == want == [(i, i * 10) for i in range(5)]
+
+
+def test_arbitrary_filter_fn_not_pushed(s3):
+    t = pa.table({"a": [1, 2]})
+    _put_parquet(s3, "data", "nf/f.parquet", t)
+    ds = data.read_parquet("s3://data/nf/").filter(fn=lambda r: r["a"] > 1)
+    _source, stages = _pushdown_rewrite(list(ds._source), list(ds._stages))
+    assert [s.name for s in stages] == ["filter"]
+
+
+def test_read_text_from_mock_s3(s3, ray_start_regular):
+    s3.put("data", "txt/a.txt", b"one\ntwo\n")
+    s3.put("data", "txt/b.txt", b"three\n")
+    ds = data.read_text("s3://data/txt/")
+    assert sorted(r["text"] for r in ds.iter_rows()) == \
+        ["one", "three", "two"]
+
+
+def test_streaming_larger_than_object_store(s3):
+    """Parquet-on-mock-S3 dataset LARGER than the object-store arena
+    streams end-to-end: bounded in-flight + spilling keep it moving
+    (reference: streaming executor with resource backpressure)."""
+    from ray_tpu._private.config import Config
+
+    n_files, rows_per_file = 6, 120_000
+    total_bytes = 0
+    for i in range(n_files):
+        arr = np.arange(i * rows_per_file, (i + 1) * rows_per_file,
+                        dtype=np.int64)
+        t = pa.table({"v": arr, "pad": np.random.default_rng(i)
+                      .standard_normal(rows_per_file)})
+        buf = io.BytesIO()
+        pq.write_table(t, buf, compression="none")
+        total_bytes += buf.getbuffer().nbytes
+        s3.put("big", f"p/part-{i}.parquet", buf.getvalue())
+
+    cfg = Config()
+    cfg.object_store_memory = 8 << 20  # smaller than the dataset
+    assert total_bytes > cfg.object_store_memory
+    ray_tpu.init(num_cpus=4, config=cfg)
+    try:
+        ds = data.read_parquet("s3://big/p/",
+                               endpoint_url=s3.endpoint).select_columns(["v"])
+        total = 0
+        count = 0
+        for batch in ds.iter_batches(batch_size=50_000):
+            vs = batch["v"] if isinstance(batch, dict) else batch
+            total += int(np.sum(np.asarray(vs)))
+            count += len(vs)
+        n = n_files * rows_per_file
+        assert count == n
+        assert total == n * (n - 1) // 2
+    finally:
+        ray_tpu.shutdown()
