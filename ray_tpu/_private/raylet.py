@@ -218,7 +218,14 @@ class _ZygoteClient:
             line = self._file.readline()
             if not line:
                 raise OSError("zygote hung up")
-            return json.loads(line)["pid"]
+            resp = json.loads(line)
+            if "pid" not in resp:
+                # Per-request failure (e.g. fork EAGAIN): the template
+                # itself is fine, keep the connection.
+                logger.warning("zygote spawn error: %s; cold-spawning",
+                               resp.get("error"))
+                return None
+            return resp["pid"]
         except (OSError, ValueError, KeyError) as e:
             logger.warning("zygote spawn failed (%s); cold-spawning", e)
             self._drop_conn()

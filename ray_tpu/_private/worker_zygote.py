@@ -154,25 +154,40 @@ def main() -> None:
                     line = f.readline()
                 except InterruptedError:
                     continue
+                except OSError:
+                    break
                 if not line:
                     break  # raylet went away; await a reconnect
-                req = json.loads(line)
-                if req.get("shutdown"):
-                    _kill_children()
-                    return
-                # SIGCHLD is blocked across fork + bookkeeping: a child
-                # crashing instantly would otherwise be reaped BEFORE
-                # _children.add, leaving a stale pid that _kill_children
-                # could later deliver to a recycled process.
-                signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGCHLD})
+                # Per-request errors (fork EAGAIN under memory pressure,
+                # malformed frame) must NOT kill the zygote: its death
+                # SIGTERMs every live forked worker via pdeathsig. Reply
+                # with the error; the raylet falls back to a cold spawn.
                 try:
-                    pid = _spawn(req, [server.fileno(), conn.fileno()])
-                    _children.add(pid)
-                finally:
-                    signal.pthread_sigmask(signal.SIG_UNBLOCK,
+                    req = json.loads(line)
+                    if req.get("shutdown"):
+                        _kill_children()
+                        return
+                    # SIGCHLD is blocked across fork + bookkeeping: a
+                    # child crashing instantly would otherwise be reaped
+                    # BEFORE _children.add, leaving a stale pid that
+                    # _kill_children could later deliver to a recycled
+                    # process.
+                    signal.pthread_sigmask(signal.SIG_BLOCK,
                                            {signal.SIGCHLD})
-                f.write((json.dumps({"pid": pid}) + "\n").encode())
-                f.flush()
+                    try:
+                        pid = _spawn(req, [server.fileno(), conn.fileno()])
+                        _children.add(pid)
+                    finally:
+                        signal.pthread_sigmask(signal.SIG_UNBLOCK,
+                                               {signal.SIGCHLD})
+                    reply = {"pid": pid}
+                except Exception as e:  # noqa: BLE001
+                    reply = {"error": f"{type(e).__name__}: {e}"}
+                try:
+                    f.write((json.dumps(reply) + "\n").encode())
+                    f.flush()
+                except OSError:
+                    break  # raylet hung up mid-reply; await a reconnect
 
 
 if __name__ == "__main__":
