@@ -2,21 +2,33 @@ from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.catalog import (MODEL_REGISTRY, ModelSpec, get_model,
                                    register_model)
+from ray_tpu.rllib.connectors import (ClipActions, Connector,
+                                      ConnectorPipeline, FlattenObs,
+                                      FrameStack, NormalizeObs,
+                                      RescaleActions)
+from ray_tpu.rllib.cql import CQL, CQLConfig
+from ray_tpu.rllib.ddpg import DDPG, TD3, DDPGConfig, TD3Config
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, Env, Pendulum, make_env
+from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.offline import (BC, MARWIL, BCConfig, JsonReader,
                                    MARWILConfig, write_offline_json)
+from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay import PrioritizedReplayBuffer
 from ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "Impala", "ImpalaConfig", "APPO", "APPOConfig", "A2C", "A2CConfig",
+           "TD3", "TD3Config", "DDPG", "DDPGConfig", "CQL", "CQLConfig",
+           "PG", "PGConfig",
            "BC", "BCConfig", "MARWIL", "MARWILConfig", "JsonReader",
            "write_offline_json", "ReplayBuffer", "PrioritizedReplayBuffer",
            "ModelSpec", "MODEL_REGISTRY", "get_model", "register_model",
-           "Env", "CartPole", "Pendulum", "ENV_REGISTRY", "make_env"]
+           "Env", "CartPole", "Pendulum", "ENV_REGISTRY", "make_env",
+           "Connector", "ConnectorPipeline", "FlattenObs", "NormalizeObs",
+           "FrameStack", "ClipActions", "RescaleActions", "EnvRunner"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu('rllib')

@@ -1,0 +1,191 @@
+"""CQL: conservative Q-learning for offline RL (discrete actions).
+
+Parity: reference rllib/algorithms/cql/ — offline batches only (no env
+interaction during training), with the conservative penalty
+E[logsumexp_a Q(s,a)] - E[Q(s, a_data)] added to the Bellman loss so
+out-of-distribution actions are pushed DOWN instead of exploited. Built
+on the DQN learner shape (discrete double-Q target) over JsonReader
+batches; evaluation rolls the greedy policy in the real env.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.offline import JsonReader
+
+
+def init_q_params(obs_size: int, num_actions: int, hidden: int = 64,
+                  seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def dense(i, o):
+        return {"w": (rng.standard_normal((i, o)) / np.sqrt(i)
+                      ).astype(np.float32),
+                "b": np.zeros(o, np.float32)}
+
+    return {"h1": dense(obs_size, hidden), "h2": dense(hidden, hidden),
+            "out": dense(hidden, num_actions)}
+
+
+def numpy_q(params: dict, obs: np.ndarray) -> np.ndarray:
+    h = np.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
+    h = np.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+    return h @ params["out"]["w"] + params["out"]["b"]
+
+
+@dataclass
+class CQLConfig:
+    env: Any = "CartPole-v1"          # evaluation env only
+    input_path: str = ""              # offline JSON data (JsonReader)
+    train_batch_size: int = 256
+    num_updates_per_iter: int = 200
+    gamma: float = 0.99
+    lr: float = 3e-4
+    cql_alpha: float = 1.0            # conservative penalty weight
+    target_update_every: int = 100
+    hidden_size: int = 64
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def offline_data(self, input_path: str):
+        self.input_path = input_path
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown CQL option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    def __init__(self, config: CQLConfig):
+        if not config.input_path:
+            raise ValueError("CQL is offline-only: set offline_data(path)")
+        self.config = config
+        probe = make_env(config.env)
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        self.params = init_q_params(self.obs_size, self.num_actions,
+                                    config.hidden_size, config.seed)
+        import copy
+
+        self.target = copy.deepcopy(self.params)
+        data = JsonReader(config.input_path).read_all()
+        n = len(data["obs"])
+        if n < 2:
+            raise ValueError("offline dataset too small")
+        # next_obs/dones reconstructed from the flat log (step i -> i+1;
+        # a done at i ends the episode, obs[i+1] starts the next).
+        self.data = {
+            "obs": data["obs"][:-1],
+            "actions": data["actions"][:-1],
+            "rewards": data["rewards"][:-1],
+            "next_obs": data["obs"][1:],
+            "dones": data["dones"][:-1].astype(np.float32),
+        }
+        self._rng = np.random.default_rng(config.seed)
+        self._update = None
+        self.iteration = 0
+        self._updates = 0
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def q_fn(p, obs):
+            h = jnp.tanh(obs @ p["h1"]["w"] + p["h1"]["b"])
+            h = jnp.tanh(h @ p["h2"]["w"] + p["h2"]["b"])
+            return h @ p["out"]["w"] + p["out"]["b"]
+
+        def update(params, target, opt_state, batch):
+            q_next = q_fn(target, batch["next_obs"])
+            y = jax.lax.stop_gradient(
+                batch["rewards"] + cfg.gamma * (1 - batch["dones"])
+                * q_next.max(-1))
+
+            def loss_fn(p):
+                q = q_fn(p, batch["obs"])
+                q_data = jnp.take_along_axis(
+                    q, batch["actions"][:, None].astype(jnp.int32), 1)[:, 0]
+                bellman = ((q_data - y) ** 2).mean()
+                # Conservative penalty: push down the soft-max over ALL
+                # actions, push up the dataset action.
+                conservative = (jax.scipy.special.logsumexp(q, axis=-1)
+                                - q_data).mean()
+                return bellman + cfg.cql_alpha * conservative, (
+                    bellman, conservative)
+
+            (loss, (bellman, conservative)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, "bellman": bellman,
+                                       "cql_penalty": conservative}
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        t0 = time.time()
+        n = len(self.data["obs"])
+        metrics = {}
+        for _ in range(cfg.num_updates_per_iter):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            batch = {k: v[idx] for k, v in self.data.items()}
+            self.params, self._opt_state, metrics = self._update(
+                self.params, self.target, self._opt_state, batch)
+            self._updates += 1
+            if self._updates % cfg.target_update_every == 0:
+                import copy
+                import jax
+
+                self.target = copy.deepcopy(jax.tree_util.tree_map(
+                    np.asarray, self.params))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "learn_time_s": round(time.time() - t0, 3),
+                **{k: float(v) for k, v in metrics.items()}}
+
+    def evaluate(self, num_episodes: int = 5) -> dict:
+        """Greedy rollout in the real env (offline training never touches
+        it — this is the measurement, reference: evaluation workers)."""
+        import jax
+
+        params = jax.tree_util.tree_map(np.asarray, self.params)
+        env = make_env(self.config.env)
+        returns = []
+        for ep in range(num_episodes):
+            obs = env.reset(seed=1000 + ep)
+            ret, done = 0.0, False
+            while not done:
+                a = int(np.argmax(numpy_q(params, obs[None])[0]))
+                obs, rew, done, _ = env.step(a)
+                ret += rew
+            returns.append(ret)
+        return {"episode_reward_mean": float(np.mean(returns)),
+                "episodes": num_episodes}
+
+    def stop(self):
+        pass

@@ -1,0 +1,330 @@
+"""TD3 / DDPG: deterministic-policy continuous control.
+
+Parity: reference rllib/algorithms/td3/ and /ddpg/ rebuilt on the
+rollout/learner split — numpy deterministic-policy rollout actors with
+Gaussian exploration noise feed a replay buffer; the learner runs the
+(twin-)Q Bellman update and delayed deterministic policy-gradient step
+as ONE jitted jax program. DDPG is TD3 with twin_q=False,
+policy_delay=1 and no target-policy smoothing — one implementation,
+two algorithm names, the reference's own lineage.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env import make_env
+
+
+def init_td3_params(obs_size: int, act_size: int, hidden: int = 64,
+                    seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def dense(i, o):
+        return {"w": (rng.standard_normal((i, o)) / np.sqrt(i)
+                      ).astype(np.float32),
+                "b": np.zeros(o, np.float32)}
+
+    def q_net():
+        return {"h1": dense(obs_size + act_size, hidden),
+                "h2": dense(hidden, hidden), "out": dense(hidden, 1)}
+
+    return {
+        "pi": {"h1": dense(obs_size, hidden), "h2": dense(hidden, hidden),
+               "mu": dense(hidden, act_size)},
+        "q1": q_net(),
+        "q2": q_net(),
+    }
+
+
+def numpy_actor(params: dict, obs: np.ndarray) -> np.ndarray:
+    pi = params["pi"]
+    h = np.tanh(obs @ pi["h1"]["w"] + pi["h1"]["b"])
+    h = np.tanh(h @ pi["h2"]["w"] + pi["h2"]["b"])
+    return np.tanh(h @ pi["mu"]["w"] + pi["mu"]["b"])
+
+
+@ray_tpu.remote
+class TD3RolloutWorker:
+    """CPU sampling actor: deterministic policy + exploration noise."""
+
+    def __init__(self, env_spec, worker_index: int, explore_noise: float):
+        self.env = make_env(env_spec)
+        self.index = worker_index
+        self.noise = explore_noise
+        self.rng = np.random.default_rng(3000 + worker_index)
+        self.obs = self.env.reset(seed=worker_index)
+        self.scale = (self.env.action_high - self.env.action_low) / 2.0
+        self.mid = (self.env.action_high + self.env.action_low) / 2.0
+
+    def sample(self, params: dict, num_steps: int,
+               random_policy: bool = False) -> dict:
+        obs_b, act_b, rew_b, next_b, done_b = [], [], [], [], []
+        episode_returns, ep_ret = [], 0.0
+        for _ in range(num_steps):
+            if random_policy:
+                a = self.rng.uniform(-1.0, 1.0, self.env.action_size)
+            else:
+                a = numpy_actor(params, self.obs[None, :])[0]
+                a = np.clip(a + self.noise
+                            * self.rng.standard_normal(a.shape), -1.0, 1.0)
+            next_obs, reward, done, info = self.env.step(
+                self.mid + self.scale * a)
+            obs_b.append(self.obs)
+            act_b.append(a.astype(np.float32))
+            rew_b.append(reward)
+            next_b.append(next_obs)
+            # True terminals block bootstrapping; time-limit truncations
+            # (info["truncated"]) still bootstrap through the cut.
+            done_b.append(bool(done) and not info.get("truncated", False))
+            ep_ret += reward
+            if done:
+                episode_returns.append(ep_ret)
+                ep_ret = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        return {
+            "obs": np.asarray(obs_b, np.float32),
+            "actions": np.asarray(act_b, np.float32),
+            "rewards": np.asarray(rew_b, np.float32),
+            "next_obs": np.asarray(next_b, np.float32),
+            "dones": np.asarray(done_b, np.float32),
+            "episode_returns": episode_returns,
+        }
+
+
+@dataclass
+class TD3Config:
+    """Parity: rllib TD3Config fluent-config object."""
+
+    env: Any = "Pendulum-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 200
+    train_batch_size: int = 256
+    num_updates_per_iter: int = 64
+    replay_buffer_capacity: int = 100_000
+    learning_starts: int = 500
+    gamma: float = 0.99
+    tau: float = 0.005
+    lr: float = 3e-4
+    explore_noise: float = 0.1
+    # TD3 tricks; DDPGConfig flips them off.
+    twin_q: bool = True
+    policy_delay: int = 2
+    target_noise: float = 0.2
+    target_noise_clip: float = 0.5
+    hidden_size: int = 64
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: int | None = None, **kw):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown TD3/DDPG option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "TD3":
+        return TD3(self)
+
+
+@dataclass
+class DDPGConfig(TD3Config):
+    """DDPG = TD3 minus the three addressing tricks."""
+
+    twin_q: bool = False
+    policy_delay: int = 1
+    target_noise: float = 0.0
+
+    def build(self) -> "TD3":
+        return TD3(self)
+
+
+class TD3:
+    """Algorithm driver (parity: Algorithm.step for TD3/DDPG)."""
+
+    def __init__(self, config: TD3Config):
+        self.config = config
+        probe = make_env(config.env)
+        if getattr(probe, "action_size", 0) < 1:
+            raise ValueError("TD3/DDPG needs a continuous-action env")
+        self.obs_size = probe.observation_size
+        self.act_size = probe.action_size
+        self._action_mid = (probe.action_high + probe.action_low) / 2.0
+        self._action_scale = (probe.action_high - probe.action_low) / 2.0
+        self.params = init_td3_params(self.obs_size, self.act_size,
+                                      config.hidden_size, config.seed)
+        import copy
+
+        self.target = copy.deepcopy(self.params)
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   self.obs_size, seed=config.seed,
+                                   action_shape=(self.act_size,),
+                                   action_dtype=np.float32)
+        self.workers = [
+            TD3RolloutWorker.remote(config.env, i, config.explore_noise)
+            for i in range(config.num_rollout_workers)]
+        self._update = None
+        self.iteration = 0
+        self.total_steps = 0
+        self._update_calls = 0
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def mlp(net, x):
+            h = jnp.tanh(x @ net["h1"]["w"] + net["h1"]["b"])
+            return jnp.tanh(h @ net["h2"]["w"] + net["h2"]["b"])
+
+        def q_val(net, obs, act):
+            h = mlp(net, jnp.concatenate([obs, act], -1))
+            return (h @ net["out"]["w"] + net["out"]["b"])[..., 0]
+
+        def actor(pi, obs):
+            return jnp.tanh(mlp(pi, obs) @ pi["mu"]["w"] + pi["mu"]["b"])
+
+        def update(params, target, opt_state, batch, key, do_policy):
+            # Target action with clipped smoothing noise (TD3 trick #3).
+            next_a = actor(target["pi"], batch["next_obs"])
+            if cfg.target_noise > 0:
+                noise = jnp.clip(
+                    cfg.target_noise * jax.random.normal(key, next_a.shape),
+                    -cfg.target_noise_clip, cfg.target_noise_clip)
+                next_a = jnp.clip(next_a + noise, -1.0, 1.0)
+            tq1 = q_val(target["q1"], batch["next_obs"], next_a)
+            if cfg.twin_q:  # TD3 trick #1: clipped double-Q
+                tq = jnp.minimum(tq1, q_val(target["q2"],
+                                            batch["next_obs"], next_a))
+            else:
+                tq = tq1
+            y = jax.lax.stop_gradient(
+                batch["rewards"] + cfg.gamma * (1 - batch["dones"]) * tq)
+
+            def critic_loss(p):
+                l = ((q_val(p["q1"], batch["obs"], batch["actions"]) - y)
+                     ** 2).mean()
+                if cfg.twin_q:
+                    l = l + ((q_val(p["q2"], batch["obs"], batch["actions"])
+                              - y) ** 2).mean()
+                return l
+
+            def actor_loss(p):
+                a = actor(p["pi"], batch["obs"])
+                return -q_val(jax.lax.stop_gradient(p["q1"]),
+                              batch["obs"], a).mean()
+
+            closs, cgrads = jax.value_and_grad(critic_loss)(params)
+            aloss, agrads = jax.value_and_grad(actor_loss)(params)
+
+            # Delayed policy update (TD3 trick #2): actor + targets move
+            # only every policy_delay critic steps — lax.cond keeps one
+            # compiled program.
+            def with_actor(_):
+                return {"pi": agrads["pi"], "q1": cgrads["q1"],
+                        "q2": cgrads["q2"]}
+
+            def critic_only(_):
+                zero_pi = jax.tree_util.tree_map(jnp.zeros_like,
+                                                 agrads["pi"])
+                return {"pi": zero_pi, "q1": cgrads["q1"],
+                        "q2": cgrads["q2"]}
+
+            grads = jax.lax.cond(do_policy, with_actor, critic_only, None)
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+
+            def polyak(_):
+                return jax.tree_util.tree_map(
+                    lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+                    target, params)
+
+            target = jax.lax.cond(do_policy, polyak, lambda _: target, None)
+            return params, target, opt_state, {
+                "critic_loss": closs, "actor_loss": aloss}
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        import jax
+
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        t0 = time.time()
+        host = jax.tree_util.tree_map(np.asarray, self.params)
+        random_phase = self.total_steps < cfg.learning_starts
+        batches = ray_tpu.get(
+            [w.sample.remote(host, cfg.rollout_fragment_length, random_phase)
+             for w in self.workers], timeout=600)
+        episode_returns = []
+        for b in batches:
+            episode_returns += b.pop("episode_returns")
+            self.buffer.add_batch(b)
+            self.total_steps += len(b["obs"])
+        sample_time = time.time() - t0
+
+        t1 = time.time()
+        metrics = {}
+        if self.total_steps >= cfg.learning_starts:
+            for i in range(cfg.num_updates_per_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                key = jax.random.PRNGKey(cfg.seed * 99991
+                                         + self.iteration * 613 + i)
+                self._update_calls += 1
+                do_policy = (self._update_calls % cfg.policy_delay) == 0
+                self.params, self.target, self._opt_state, metrics = \
+                    self._update(self.params, self.target, self._opt_state,
+                                 batch, key, do_policy)
+        learn_time = time.time() - t1
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(episode_returns))
+            if episode_returns else float("nan"),
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_total": self.total_steps,
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(learn_time, 3),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def get_policy_params(self) -> dict:
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def compute_single_action(self, obs) -> np.ndarray:
+        a = numpy_actor(self.get_policy_params(), obs[None, :])[0]
+        return self._action_mid + self._action_scale * a
+
+
+DDPG = TD3  # algorithm alias: construct via DDPGConfig

@@ -67,10 +67,14 @@ class CartPole(Env):
         theta_dot += self.tau * thetaacc
         self.state = np.array([x, x_dot, theta, theta_dot])
         self.steps += 1
-        done = bool(abs(x) > self.x_threshold
-                    or abs(theta) > self.theta_threshold
-                    or self.steps >= self.max_episode_steps)
-        return self.state.astype(np.float32), 1.0, done, {}
+        terminal = bool(abs(x) > self.x_threshold
+                        or abs(theta) > self.theta_threshold)
+        truncated = self.steps >= self.max_episode_steps
+        # info["truncated"]: the episode ended by TIME LIMIT, not failure —
+        # off-policy targets should still bootstrap through it (gym's
+        # TimeLimit.truncated convention).
+        return (self.state.astype(np.float32), 1.0, terminal or truncated,
+                {"truncated": truncated and not terminal})
 
 
 class Pendulum(Env):
@@ -118,7 +122,7 @@ class Pendulum(Env):
         self.state = np.array([th, thdot])
         self.steps += 1
         done = self.steps >= self.max_episode_steps
-        return self._obs(), -cost, done, {}
+        return self._obs(), -cost, done, {"truncated": done}
 
 
 ENV_REGISTRY = {"CartPole-v1": CartPole, "CartPole": CartPole,

@@ -1,0 +1,155 @@
+"""PG: vanilla policy gradient (REINFORCE with value baseline).
+
+Parity: reference rllib/algorithms/pg/ — the minimal on-policy
+algorithm, sharing PPO's rollout actors (GAE advantages double as the
+return-minus-baseline signal) with a plain -logp * advantage learner
+update; no clipping, no multiple epochs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.ppo import RolloutWorker, init_policy_params
+
+
+@dataclass
+class PGConfig:
+    env: Any = "CartPole-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 256
+    gamma: float = 0.99
+    lambda_: float = 1.0             # pure returns by default
+    lr: float = 5e-3
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.0
+    hidden_size: int = 64
+    model: str = "mlp"
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: int | None = None, **kw):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown PG option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "PG":
+        return PG(self)
+
+
+class PG:
+    def __init__(self, config: PGConfig):
+        from ray_tpu.rllib.env import make_env
+
+        self.config = config
+        probe = make_env(config.env)
+        self.params = init_policy_params(
+            probe.observation_size, probe.num_actions, config.hidden_size,
+            config.seed)
+        self.workers = [
+            RolloutWorker.remote(config.env, i, config.gamma,
+                                 config.lambda_, config.model)
+            for i in range(config.num_rollout_workers)]
+        self._update = None
+        self.iteration = 0
+        self.total_steps = 0
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def forward(p, obs):
+            h = jnp.tanh(obs @ p["h1"]["w"] + p["h1"]["b"])
+            h = jnp.tanh(h @ p["h2"]["w"] + p["h2"]["b"])
+            logits = h @ p["pi"]["w"] + p["pi"]["b"]
+            value = (h @ p["vf"]["w"] + p["vf"]["b"])[..., 0]
+            return logits, value
+
+        def update(params, opt_state, batch):
+            def loss_fn(p):
+                logits, value = forward(p, batch["obs"])
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, batch["actions"][:, None], 1)[:, 0]
+                adv = batch["advantages"]
+                adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                pg_loss = -(logp * adv).mean()
+                vf_loss = ((value - batch["returns"]) ** 2).mean()
+                entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+                return (pg_loss + cfg.vf_coeff * vf_loss
+                        - cfg.entropy_coeff * entropy), (pg_loss, vf_loss,
+                                                         entropy)
+
+            (loss, (pg_l, vf_l, ent)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, "pg_loss": pg_l,
+                                       "vf_loss": vf_l, "entropy": ent}
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        import jax
+
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        t0 = time.time()
+        host = jax.tree_util.tree_map(np.asarray, self.params)
+        frags = ray_tpu.get(
+            [w.sample.remote(host, cfg.rollout_fragment_length)
+             for w in self.workers], timeout=600)
+        episode_returns = []
+        batch = {}
+        for f in frags:
+            episode_returns += f.pop("episode_returns")
+            for k, v in f.items():
+                batch.setdefault(k, []).append(np.asarray(v))
+        batch = {k: np.concatenate(v) for k, v in batch.items()}
+        self.total_steps += len(batch["obs"])
+        sample_time = time.time() - t0
+
+        t1 = time.time()
+        self.params, self._opt_state, metrics = self._update(
+            self.params, self._opt_state, batch)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(episode_returns))
+            if episode_returns else float("nan"),
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_total": self.total_steps,
+            "timesteps_this_iter": len(batch["obs"]),
+            "sample_time_s": round(sample_time, 3),
+            "learn_time_s": round(time.time() - t1, 3),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -77,14 +77,15 @@ class SACRolloutWorker:
                 a = np.tanh(mu[0] + np.exp(log_std[0])
                             * self.rng.standard_normal(mu.shape[1]))
             env_action = self.mid + self.scale * a
-            next_obs, reward, done, _ = self.env.step(env_action)
+            next_obs, reward, done, info = self.env.step(env_action)
             obs_b.append(self.obs)
             act_b.append(a.astype(np.float32))
             rew_b.append(reward)
             next_b.append(next_obs)
-            # Time-limit terminations still bootstrap (done=False for the
-            # Bellman target) — the pendulum never "fails", it just times out.
-            done_b.append(False)
+            # True terminals block bootstrapping; time-limit truncations
+            # (info["truncated"], e.g. every Pendulum episode) bootstrap
+            # through the cut.
+            done_b.append(bool(done) and not info.get("truncated", False))
             ep_ret += reward
             if done:
                 episode_returns.append(ep_ret)
