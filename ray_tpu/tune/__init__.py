@@ -3,13 +3,17 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     ResourceChangingScheduler,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    ExternalSearcher,
+    OptunaSearch,
     Searcher,
     TPESearcher,
+    bohb,
     choice,
     grid_search,
     loguniform,
@@ -24,7 +28,7 @@ __all__ = [
     "HyperBandScheduler", "PopulationBasedTraining", "MedianStoppingRule",
     "ResourceChangingScheduler", "Searcher", "BasicVariantGenerator",
     "TPESearcher", "uniform", "loguniform", "choice", "randint", "quniform",
-    "grid_search",
+    "grid_search", "PB2", "ExternalSearcher", "OptunaSearch", "bohb",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

@@ -133,6 +133,92 @@ class PopulationBasedTraining:
         return out
 
 
+class PB2(PopulationBasedTraining):
+    """Population-based bandits (reference: tune/schedulers/pb2.py).
+
+    Exploitation is PBT's (bottom-quantile trials clone a top trial's
+    checkpoint); EXPLORATION replaces random perturbation with a
+    GP-UCB model fit to (hyperparameters -> recent reward improvement)
+    observations from the whole population, selecting the new
+    hyperparameters inside `hyperparam_bounds` that maximize predicted
+    improvement plus an exploration bonus. The GP is an RBF-kernel
+    ridge regression over normalized hyperparameters — closed form, no
+    external dependency."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: dict,
+                 quantile_fraction: float = 0.25,
+                 kappa: float = 1.0, n_candidates: int = 64,
+                 seed: int | None = None):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds: "
+                             "{key: [low, high]}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = kappa
+        self.n_candidates = n_candidates
+        self._last_metric: dict[str, float] = {}
+        self._history: list[tuple[list[float], float]] = []
+
+    def _vec(self, config: dict) -> list[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    def on_result(self, trial, metric_value: float, iteration: int) -> str:
+        prev = self._last_metric.get(trial.trial_id)
+        if prev is not None:
+            sign = 1.0 if self.mode == "max" else -1.0
+            self._history.append((self._vec(trial.config),
+                                  sign * (metric_value - prev)))
+            self._history = self._history[-200:]  # bounded model data
+        self._last_metric[trial.trial_id] = metric_value
+        return super().on_result(trial, metric_value, iteration)
+
+    def perturb(self, config: dict) -> dict:
+        """Model-guided explore step (replaces PBT's random factors)."""
+        import numpy as np
+
+        out = dict(config)
+        keys = list(self.bounds)
+        if len(self._history) < 4:
+            # Cold start: uniform within bounds.
+            for k in keys:
+                lo, hi = self.bounds[k]
+                out[k] = type(config.get(k, lo))(self.rng.uniform(lo, hi))
+            return out
+        X = np.asarray([x for x, _ in self._history])
+        y = np.asarray([d for _, d in self._history])
+        y = (y - y.mean()) / (y.std() + 1e-8)
+        ell, lam = 0.2, 0.1
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-d2 / (2 * ell * ell)) + lam * np.eye(len(X))
+        Kinv_y = np.linalg.solve(K, y)
+        Kinv = np.linalg.inv(K)
+        cands = np.asarray([[self.rng.random() for _ in keys]
+                            for _ in range(self.n_candidates)])
+        kstar = np.exp(-((cands[:, None, :] - X[None, :, :]) ** 2
+                         ).sum(-1) / (2 * ell * ell))
+        mu = kstar @ Kinv_y
+        var = np.clip(1.0 - np.einsum("ci,ij,cj->c", kstar, Kinv, kstar),
+                      1e-9, None)
+        best = cands[int(np.argmax(mu + self.kappa * np.sqrt(var)))]
+        for k, u in zip(keys, best):
+            lo, hi = self.bounds[k]
+            val = lo + float(u) * (hi - lo)
+            cur = config.get(k, lo)
+            out[k] = type(cur)(val) if isinstance(cur, (int, float)) \
+                and not isinstance(cur, bool) else val
+        return out
+
+
 class HyperBandScheduler:
     """HyperBand (Li et al. 2017): several successive-halving brackets with
     staggered starting budgets, so some trials get long uninterrupted runs

@@ -22,6 +22,10 @@ class Domain:
     low: float | None = None
     high: float | None = None
     integer: bool = False
+    # Scale/shape metadata for external optimizers (OptunaSearch maps
+    # log -> suggest_float(log=True), options -> suggest_categorical).
+    log: bool = False
+    options: list | None = None
 
     def sample(self, rng: random.Random):
         return self.sampler(rng)
@@ -45,7 +49,7 @@ def uniform(low: float, high: float) -> Domain:
 def loguniform(low: float, high: float) -> Domain:
     return Domain(
         lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))),
-        low=low, high=high)
+        low=low, high=high, log=True)
 
 
 def randint(low: int, high: int) -> Domain:
@@ -55,7 +59,7 @@ def randint(low: int, high: int) -> Domain:
 
 def choice(options: list) -> Domain:
     opts = list(options)
-    return Domain(lambda rng: rng.choice(opts))
+    return Domain(lambda rng: rng.choice(opts), options=opts)
 
 
 def quniform(low: float, high: float, q: float) -> Domain:
@@ -270,3 +274,91 @@ class TPESearcher(Searcher):
             if score > best_score:
                 best_score, best_cfg = score, cand
         return _unflatten(best_cfg)
+
+
+class ExternalSearcher(Searcher):
+    """Adapter surface for third-party ask/tell optimizers (reference:
+    tune/search/ wraps Optuna/HyperOpt/Ax behind Searcher). Any object
+    pair (ask() -> config | None, tell(config, value)) plugs in; the
+    Tuner only ever sees the Searcher protocol."""
+
+    def __init__(self, ask, tell=None):
+        self._ask = ask
+        self._tell = tell
+
+    def suggest(self, trial_id: str) -> dict | None:
+        return self._ask()
+
+    def on_trial_complete(self, trial_id: str, config: dict,
+                          metric_value: float | None) -> None:
+        if self._tell is not None and metric_value is not None:
+            self._tell(config, metric_value)
+
+
+class OptunaSearch(ExternalSearcher):
+    """Optuna-backed searcher (reference: tune/search/optuna/). Requires
+    the optuna package; this image does not bundle it, so construction
+    raises ImportError with a clear message when absent."""
+
+    def __init__(self, param_space: dict, *, metric: str, mode: str = "max",
+                 num_samples: int = 32, seed: int | None = None):
+        try:
+            import optuna
+        except ImportError as e:  # pragma: no cover - dep not in image
+            raise ImportError(
+                "OptunaSearch requires the 'optuna' package") from e
+        space = _flatten(param_space)
+        study = optuna.create_study(
+            direction="maximize" if mode == "max" else "minimize",
+            sampler=optuna.samplers.TPESampler(seed=seed))
+        self._budget = num_samples
+        self._asked = 0
+        self._rng = random.Random(seed)
+        self._trials: dict[int, Any] = {}
+
+        def ask():
+            if self._asked >= self._budget:
+                return None
+            self._asked += 1
+            t = study.ask()
+            cfg = {}
+            for k, v in space.items():
+                if isinstance(v, Domain) and v.options is not None:
+                    cfg[k] = t.suggest_categorical(k, v.options)
+                elif isinstance(v, Domain) and v.low is not None:
+                    if v.integer:
+                        cfg[k] = t.suggest_int(k, int(v.low),
+                                               int(v.high) - 1)
+                    else:
+                        cfg[k] = t.suggest_float(k, v.low, v.high,
+                                                 log=v.log)
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(self._rng)
+                else:
+                    cfg[k] = v
+            self._trials[tuple(sorted(cfg.items()))] = t
+            return _unflatten(cfg)
+
+        def tell(config, value):
+            t = self._trials.pop(
+                tuple(sorted(_flatten(config).items())), None)
+            if t is not None:
+                study.tell(t, value)
+
+        super().__init__(ask, tell)
+
+
+def bohb(param_space: dict, *, metric: str, mode: str = "max",
+         num_samples: int = 16, max_t: int = 32, reduction_factor: int = 3,
+         seed: int | None = None):
+    """BOHB (Falkner et al. 2018) = HyperBand's budget allocation + a
+    TPE-style KDE model proposing configs (reference:
+    tune/schedulers/hb_bohb.py + tune/search/bohb/). Returns
+    (searcher, scheduler) to pass to the Tuner."""
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    searcher = TPESearcher(param_space, metric=metric, mode=mode,
+                           num_samples=num_samples, seed=seed)
+    scheduler = HyperBandScheduler(metric=metric, mode=mode, max_t=max_t,
+                                   reduction_factor=reduction_factor)
+    return searcher, scheduler
