@@ -360,6 +360,9 @@ class Raylet:
             "Drain": self.handle_drain,
             "GetState": self.handle_get_state,
             "NodeStacks": self.handle_node_stacks,
+            "ListLogs": self.handle_list_logs,
+            "TailLog": self.handle_tail_log,
+            "WorkerStats": self.handle_worker_stats,
         }
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
@@ -989,6 +992,78 @@ class Raylet:
         dumps = list(await asyncio.gather(*(dump_one(w) for w in live)))
         return {"node_id": self.node_id, "workers": dumps,
                 "skipped": skipped}
+
+    # ---- observability: log files + per-worker profiling stats ----
+    # (reference: dashboard/modules/log — per-node log index/tail — and
+    # dashboard/modules/reporter — per-worker cpu/rss stats)
+
+    async def handle_list_logs(self, conn, payload):
+        logs_dir = os.path.join(self.session_dir, "logs")
+        out = []
+        try:
+            for name in sorted(os.listdir(logs_dir)):
+                try:
+                    st = os.stat(os.path.join(logs_dir, name))
+                    out.append({"name": name, "size": st.st_size,
+                                "mtime": st.st_mtime})
+                except OSError:
+                    continue
+        except FileNotFoundError:
+            pass
+        return {"node_id": self.node_id, "logs": out}
+
+    async def handle_tail_log(self, conn, payload):
+        name = payload.get("name", "")
+        max_bytes = min(int(payload.get("max_bytes", 64 << 10)), 4 << 20)
+        logs_dir = os.path.realpath(os.path.join(self.session_dir, "logs"))
+        path = os.path.realpath(os.path.join(logs_dir, name))
+        # Traversal guard: only files directly inside the logs dir.
+        if os.path.dirname(path) != logs_dir:
+            return {"error": "invalid log name"}
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                if size > max_bytes:
+                    f.seek(size - max_bytes)
+                data = f.read(max_bytes)
+        except OSError as e:
+            return {"error": str(e)}
+        return {"node_id": self.node_id, "name": name, "size": size,
+                "data": data.decode("utf-8", "replace")}
+
+    @staticmethod
+    def _proc_stats(pid: int) -> dict:
+        """CPU seconds + RSS bytes from /proc (reporter-module parity
+        without psutil)."""
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                parts = f.read().rsplit(")", 1)[1].split()
+            with open(f"/proc/{pid}/statm") as f:
+                rss_pages = int(f.read().split()[1])
+            tick = os.sysconf("SC_CLK_TCK")
+            return {
+                "cpu_s": round((int(parts[11]) + int(parts[12])) / tick, 2),
+                "rss_bytes": rss_pages * os.sysconf("SC_PAGE_SIZE"),
+            }
+        except (OSError, IndexError, ValueError):
+            return {}
+
+    async def handle_worker_stats(self, conn, payload):
+        workers = []
+        for w in list(self.workers.values()):
+            # _PendingProc (pid 0) = still materializing: no /proc entry
+            # yet, reporting it as a live pid-0 worker would be noise.
+            if w.dead or not w.proc.pid:
+                continue
+            entry = {"worker_id": w.worker_id, "pid": w.proc.pid,
+                     "actor_id": w.actor_id or "",
+                     "leased": w.leased, "blocked": w.blocked}
+            entry.update(self._proc_stats(w.proc.pid))
+            workers.append(entry)
+        node = {"node_id": self.node_id, "pid": os.getpid(),
+                "workers": workers}
+        node.update(self._proc_stats(os.getpid()))
+        return node
 
     def handle_worker_blocked(self, conn, payload):
         w = self.workers.get(payload["worker_id"])

@@ -7,7 +7,10 @@ single self-contained HTML page; it runs inside any connected process
 (`ray_tpu.dashboard.start()`, or `ray_tpu dashboard` from the CLI).
 
 Endpoints: /api/version /api/nodes /api/actors /api/jobs /api/tasks
-/api/summary /api/cluster_status /api/submission_jobs
+/api/summary /api/cluster_status /api/submission_jobs /api/logs
+/logs/view?node=&name= /api/stacks /api/worker_stats (the last four are
+the reference's log + reporter module data views: per-node log browser
+with tail, all-worker stack dumps, per-worker cpu/rss).
 """
 
 from __future__ import annotations
@@ -36,14 +39,20 @@ const SECTIONS = [
   ["Placement groups", "/api/placement_groups"],
   ["Serve deployments", "/api/serve"],
   ["Workflows", "/api/workflows"],
-  ["Task summary", "/api/summary"]];
+  ["Task summary", "/api/summary"],
+  ["Worker stats (cpu/rss)", "/api/worker_stats"],
+  ["Logs", "/api/logs"]];
 function table(rows) {
   if (!Array.isArray(rows)) rows = [rows];
   if (!rows.length) return "<i>none</i>";
   const keys = Object.keys(rows[0]);
   let h = "<table><tr>" + keys.map(k => `<th>${k}</th>`).join("") + "</tr>";
-  for (const r of rows) h += "<tr>" + keys.map(
-    k => `<td>${JSON.stringify(r[k])}</td>`).join("") + "</tr>";
+  for (const r of rows) h += "<tr>" + keys.map(k => {
+    const v = r[k];
+    if (k === "view" && typeof v === "string")
+      return `<td><a href="${v}" target="_blank">view</a></td>`;
+    return `<td>${JSON.stringify(v)}</td>`;
+  }).join("") + "</tr>";
   return h + "</table>";
 }
 async function refresh() {
@@ -137,6 +146,49 @@ class _Handler(BaseHTTPRequestHandler):
 
                 data = [{"workflow_id": w, "status": workflow.get_status(w)}
                         for w in workflow.list_workflows()]
+            elif path == "/api/logs":
+                # Log index with view links (reference: dashboard log
+                # module's per-node file browser).
+                data = []
+                for node in state.list_logs():
+                    for f in node.get("logs", []):
+                        data.append({
+                            "node": node.get("node_id", "?")[:8],
+                            "file": f["name"], "size": f["size"],
+                            "view": (f"/logs/view?node="
+                                     f"{node.get('node_id', '')}"
+                                     f"&name={f['name']}")})
+            elif path == "/logs/view":
+                import urllib.parse
+
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                node = (q.get("node") or [""])[0]
+                name = (q.get("name") or [""])[0]
+                out = state.tail_log(node, name)
+                body = out.get("data", out.get("error", "")) or ""
+                return self._send(200, body.encode(), "text/plain")
+            elif path == "/api/stacks":
+                # All-worker stack dumps per node (reference:
+                # dashboard/modules/reporter profiling views / ray stack).
+                data = state.dump_stacks()
+            elif path == "/api/worker_stats":
+                data = []
+                for node in state.worker_stats():
+                    nid = node.get("node_id", "?")[:8]
+                    data.append({"node": nid, "worker_id": "(raylet)",
+                                 "pid": node.get("pid"),
+                                 "cpu_s": node.get("cpu_s"),
+                                 "rss_mb": round(
+                                     node.get("rss_bytes", 0) / 2**20, 1)})
+                    for w in node.get("workers", []):
+                        data.append({
+                            "node": nid,
+                            "worker_id": w["worker_id"][:8],
+                            "pid": w.get("pid"),
+                            "cpu_s": w.get("cpu_s"),
+                            "rss_mb": round(
+                                w.get("rss_bytes", 0) / 2**20, 1)})
             else:
                 return self._send(404, b'{"error": "not found"}',
                                   "application/json")

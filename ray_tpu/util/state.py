@@ -45,33 +45,7 @@ def list_tasks(limit: int = 1000) -> list[dict]:
 def dump_stacks() -> list[dict]:
     """All-thread stacks of every worker on every node (reference:
     `ray stack`, scripts.py:2453)."""
-    import asyncio
-
-    from ray_tpu._private import rpc
-
-    import asyncio
-
-    cw = get_core_worker()
-    nodes = cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"]
-
-    async def one(n):
-        try:
-            conn = await rpc.connect(n["host"], n["raylet_port"],
-                                     name="stack-dump")
-            try:
-                return await conn.call("NodeStacks", {}, timeout=30)
-            finally:
-                await conn.close()
-        except Exception as e:
-            return {"node_id": n["node_id"],
-                    "error": f"{type(e).__name__}: {e}"}
-
-    async def collect():
-        # Concurrent per node: degraded nodes cost one timeout, not one each.
-        return list(await asyncio.gather(
-            *(one(n) for n in nodes if n.get("alive"))))
-
-    return cw._run(collect())
+    return _per_node_call("NodeStacks", timeout=30)
 
 
 def node_stats() -> list[dict]:
@@ -79,19 +53,29 @@ def node_stats() -> list[dict]:
     concurrently from every alive node — the data source for the
     dashboard's core metrics (parity: reference per-node stats via the
     dashboard reporter agent)."""
+    return _per_node_call("GetState", timeout=10)
+
+
+def _per_node_call(method: str, payload: dict | None = None,
+                   node_id: str | None = None, timeout: float = 15.0
+                   ) -> list[dict]:
+    """Fan a raylet RPC out to every alive node (or one) concurrently."""
     import asyncio
 
     from ray_tpu._private import rpc
 
     cw = get_core_worker()
-    nodes = cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"]
+    nodes = [n for n in cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"]
+             if n.get("alive") and (node_id is None
+                                    or n["node_id"] == node_id)]
 
     async def one(n):
         try:
             conn = await rpc.connect(n["host"], n["raylet_port"],
-                                     name="node-stats")
+                                     name=f"state-{method}")
             try:
-                return await conn.call("GetState", {}, timeout=10)
+                return await conn.call(method, payload or {},
+                                       timeout=timeout)
             finally:
                 await conn.close()
         except Exception as e:
@@ -99,10 +83,28 @@ def node_stats() -> list[dict]:
                     "error": f"{type(e).__name__}: {e}"}
 
     async def collect():
-        return list(await asyncio.gather(
-            *(one(n) for n in nodes if n.get("alive"))))
+        return list(await asyncio.gather(*(one(n) for n in nodes)))
 
     return cw._run(collect())
+
+
+def list_logs(node_id: str | None = None) -> list[dict]:
+    """Per-node log-file index (reference: dashboard log module /
+    `ray logs`)."""
+    return _per_node_call("ListLogs", node_id=node_id)
+
+
+def tail_log(node_id: str, name: str, max_bytes: int = 64 << 10) -> dict:
+    """Tail one log file on one node."""
+    out = _per_node_call("TailLog", {"name": name, "max_bytes": max_bytes},
+                         node_id=node_id)
+    return out[0] if out else {"error": f"node {node_id} not found"}
+
+
+def worker_stats() -> list[dict]:
+    """Per-worker CPU/RSS across the cluster (reference:
+    dashboard/modules/reporter per-node stats)."""
+    return _per_node_call("WorkerStats")
 
 
 def list_objects() -> list[dict]:

@@ -186,3 +186,37 @@ def test_custom_scheme_registration(tmp_path):
         assert calls[0] == ("put", "k1")
     finally:
         ext._SCHEMES.pop("fakes3", None)
+
+
+def test_spill_churn_under_pressure_no_object_loss():
+    """Stress: put/get churn with dropped refs in a small arena — spills,
+    restores, and frees interleave; every LIVE ref must stay readable
+    (regression net for a once-observed ObjectLostError under exactly
+    this pattern)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.config import Config
+
+    cfg = Config()
+    cfg.object_store_memory = 48 << 20
+    ray_tpu.init(num_cpus=2, config=cfg)
+    rng = np.random.default_rng(7)
+    try:
+        live: list = []
+        for i in range(30):
+            arr = np.full(1 << 20, i, dtype=np.float64)  # 8 MiB
+            ref = ray_tpu.put(arr)
+            live.append((i, ref))
+            # Drop a random live ref ~half the time (free churn).
+            if len(live) > 3 and rng.random() < 0.5:
+                live.pop(int(rng.integers(0, len(live))))
+            # Read a random live ref every iteration (restore churn).
+            j, r = live[int(rng.integers(0, len(live)))]
+            out = ray_tpu.get(r, timeout=120)
+            assert out[0] == j and out[-1] == j
+        for j, r in live:
+            out = ray_tpu.get(r, timeout=120)
+            assert out[0] == j and len(out) == 1 << 20
+    finally:
+        ray_tpu.shutdown()
