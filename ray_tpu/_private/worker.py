@@ -263,6 +263,7 @@ class CoreWorker:
         self._fetched_prereg: dict[str, set] = {}
         self._borrow_watches: dict = {}  # (oid, borrower) -> generation
         self._task_events: list = []
+        self._tqdm_renderer = None  # lazy; driver-side progress bars
         self._run(self._async_init())
 
     # ---------- plumbing ----------
@@ -2081,9 +2082,20 @@ class CoreWorker:
         if payload.get("channel") == "LOGS":
             # Worker stdout/stderr streamed to the driver (reference:
             # log_monitor lines are printed with (pid=..., ip=...) prefixes).
+            # Progress-bar records (experimental.tqdm_ray) are consumed by
+            # the driver-side renderer instead of printed raw.
             msg = payload["message"]
             prefix = f"(pid={msg.get('pid')}, node={msg.get('node_id', '')[:8]})"
             for line in msg.get("lines", []):
+                if "__ray_tpu_tqdm__:" in line:
+                    if self._tqdm_renderer is None:
+                        from ray_tpu.experimental.tqdm_ray import (
+                            DriverSideRenderer)
+
+                        self._tqdm_renderer = DriverSideRenderer()
+                    if self._tqdm_renderer.maybe_render(
+                            str(msg.get("worker_id", msg.get("pid"))), line):
+                        continue
                 print(f"{prefix} {line}", flush=True)
             return
         if payload.get("channel") != "ACTOR":

@@ -147,8 +147,12 @@ class _Handler(BaseHTTPRequestHandler):
                 data = [{"workflow_id": w, "status": workflow.get_status(w)}
                         for w in workflow.list_workflows()]
             elif path == "/api/logs":
+                import urllib.parse
+
                 # Log index with view links (reference: dashboard log
-                # module's per-node file browser).
+                # module's per-node file browser). Names are URL-quoted —
+                # '&'/'#'/'\"'/spaces in a filename must not break the
+                # query string or the href attribute.
                 data = []
                 for node in state.list_logs():
                     for f in node.get("logs", []):
@@ -156,8 +160,9 @@ class _Handler(BaseHTTPRequestHandler):
                             "node": node.get("node_id", "?")[:8],
                             "file": f["name"], "size": f["size"],
                             "view": (f"/logs/view?node="
-                                     f"{node.get('node_id', '')}"
-                                     f"&name={f['name']}")})
+                                     f"{node.get('node_id', '')}&name="
+                                     + urllib.parse.quote(f["name"],
+                                                          safe=""))})
             elif path == "/logs/view":
                 import urllib.parse
 

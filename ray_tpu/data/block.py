@@ -61,10 +61,15 @@ def rows_to_batch(rows: list) -> dict:
 def block_to_batch(block) -> dict:
     if is_arrow(block):
         # Columnar → numpy dict; fixed-width columns come out zero-copy
-        # when the table is a single chunk.
+        # when the table is a single chunk. Tensor-extension columns
+        # (fixed-shape ndarrays — reference: ray.data tensor extensions)
+        # come back as (n, *shape) arrays.
         out = {}
         for name in block.column_names:
             col = block.column(name)
+            if isinstance(col.type, pa.FixedShapeTensorType):
+                out[name] = col.combine_chunks().to_numpy_ndarray()
+                continue
             try:
                 out[name] = col.to_numpy(zero_copy_only=False)
             except Exception:
@@ -75,16 +80,46 @@ def block_to_batch(block) -> dict:
     return rows_to_batch(block)
 
 
+def _column_to_arrow(arr):
+    """numpy column → arrow array; multi-dim columns become fixed-shape
+    tensor extension arrays (one tensor per row), which survive parquet
+    round-trips with their shape."""
+    arr = np.asarray(arr)
+    if arr.ndim > 1:
+        return pa.FixedShapeTensorArray.from_numpy_ndarray(
+            np.ascontiguousarray(arr))
+    return pa.array(arr)
+
+
 def block_to_arrow(block):
     if pa is None:
         raise ImportError("pyarrow is required for arrow blocks")
     if is_arrow(block):
         return block
     if isinstance(block, dict):
-        return pa.table({k: np.asarray(v) for k, v in block.items()})
+        return pa.table({k: _column_to_arrow(v) for k, v in block.items()})
     rows = block_to_rows(block)
     if rows and not isinstance(rows[0], dict):
         rows = [{"item": r} for r in rows]
+    if rows and isinstance(rows[0], dict):
+        # Rows whose values are ndarrays of one fixed shape batch into
+        # tensor columns; ragged/mixed shapes fall back to pylist.
+        cols = {}
+        tensorable = True
+        for k in rows[0]:
+            vals = [r[k] for r in rows]
+            if isinstance(vals[0], np.ndarray) and all(
+                    isinstance(v, np.ndarray)
+                    and v.shape == vals[0].shape
+                    and v.dtype == vals[0].dtype for v in vals):
+                cols[k] = _column_to_arrow(np.stack(vals))
+            elif isinstance(vals[0], np.ndarray):
+                tensorable = False
+                break
+            else:
+                cols[k] = pa.array(vals)
+        if tensorable and cols:
+            return pa.table(cols)
     return pa.Table.from_pylist(rows)
 
 

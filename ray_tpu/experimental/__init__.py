@@ -36,3 +36,7 @@ class internal_kv:
     def _kv_list(prefix: bytes, namespace: str = "") -> list[bytes]:
         cw = get_core_worker()
         return cw._run(cw.gcs.call("KVKeys", {"ns": namespace, "prefix": prefix}))["keys"]
+
+
+from ray_tpu.experimental import tqdm_ray  # noqa: E402,F401
+from ray_tpu.experimental.shuffle import raysort, shuffle  # noqa: E402,F401

@@ -1,0 +1,85 @@
+"""Distributed map-shuffle-reduce primitive + raysort-style benchmark.
+
+Parity: python/ray/experimental/shuffle.py (the standalone two-stage
+shuffle the reference uses to exercise the object store at scale) and
+raysort (the sort benchmark built on it). Map tasks partition their
+input into R objects each; reduce task j consumes partition j of every
+map — all M*R intermediate objects move through the object store /
+transfer plane, never the driver.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+import ray_tpu
+
+
+def shuffle(num_maps: int, num_reduces: int, map_fn: Callable,
+            reduce_fn: Callable) -> list:
+    """map_fn(map_index, num_reduces) -> list of num_reduces partitions;
+    reduce_fn(reduce_index, partitions) -> result. Returns the reduce
+    results in order."""
+
+    @ray_tpu.remote
+    def _map(i, r):
+        parts = map_fn(i, r)
+        assert len(parts) == r, "map_fn must return num_reduces partitions"
+        return tuple(parts) if r > 1 else parts[0]
+
+    @ray_tpu.remote
+    def _reduce(j, *parts):
+        return reduce_fn(j, list(parts))
+
+    # num_returns: each partition is its OWN object, so reduce j pulls
+    # exactly partition j of every map — not the whole map output R
+    # times (the reference shuffle's layout).
+    map_refs = [_map.options(num_returns=num_reduces).remote(i, num_reduces)
+                for i in range(num_maps)]
+    if num_reduces == 1:
+        map_refs = [[m] for m in map_refs]
+    out = []
+    for j in range(num_reduces):
+        out.append(_reduce.remote(j, *[m[j] for m in map_refs]))
+    return ray_tpu.get(out, timeout=1200)
+
+
+def raysort(total_items: int, *, num_maps: int = 4, num_reduces: int = 4,
+            seed: int = 0) -> dict:
+    """Distributed sort benchmark (parity: experimental/raysort): random
+    u64 keys are range-partitioned by the maps, each reduce sorts its
+    range; validates global order and returns throughput stats."""
+    import time
+
+    per_map = total_items // num_maps
+    t0 = time.perf_counter()
+
+    def map_fn(i, r):
+        rng = np.random.default_rng(seed + i)
+        data = rng.integers(0, 2 ** 62, per_map, dtype=np.uint64)
+        bounds = np.linspace(0, 2 ** 62, r + 1)
+        return [data[(data >= bounds[j]) & (data < bounds[j + 1])]
+                for j in range(r)]
+
+    def reduce_fn(j, parts):
+        merged = np.concatenate(parts)
+        merged.sort()
+        return merged
+
+    ranges = shuffle(num_maps, num_reduces, map_fn, reduce_fn)
+    dt = time.perf_counter() - t0
+
+    # Validate: each range sorted, ranges ordered, count preserved.
+    n = 0
+    prev_max = -1
+    for rng_sorted in ranges:
+        if len(rng_sorted):
+            assert np.all(np.diff(rng_sorted.astype(np.int64)) >= 0)
+            assert int(rng_sorted[0]) >= prev_max
+            prev_max = int(rng_sorted[-1])
+        n += len(rng_sorted)
+    assert n == per_map * num_maps
+    return {"items_sorted": n, "wall_s": round(dt, 3),
+            "items_per_s": round(n / dt, 1)}

@@ -1,0 +1,149 @@
+"""Distributed progress bars (parity: python/ray/experimental/tqdm_ray).
+
+Workers cannot draw terminal progress bars — their stdout is a log file
+tailed to the driver, and N workers would interleave N carriage-return
+streams. The reference's answer: workers emit structured progress
+records; the DRIVER owns the terminal and multiplexes one bar per
+(worker, description). Here the records ride the existing LOGS pubsub
+channel as magic-prefixed lines, so no new plumbing is needed and bars
+survive worker death like any other log line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+
+MAGIC = "__ray_tpu_tqdm__:"
+
+_renderer_lock = threading.Lock()
+_renderer = None
+
+
+def _in_driver() -> bool:
+    try:
+        from ray_tpu._private.api_internal import get_core_worker
+
+        return bool(get_core_worker().is_driver)
+    except Exception:
+        # Not connected: a plain process owns its own terminal too.
+        return True
+
+
+def _driver_renderer() -> "DriverSideRenderer":
+    global _renderer
+    with _renderer_lock:
+        if _renderer is None:
+            _renderer = DriverSideRenderer()
+        return _renderer
+
+
+class tqdm:
+    """Drop-in subset of tqdm's API for use inside tasks/actors (and the
+    driver). In a worker, updates print magic lines the driver renders;
+    on the driver, updates draw directly."""
+
+    def __init__(self, iterable=None, desc: str = "", total: int | None = None,
+                 position: int | None = None):
+        self.iterable = iterable
+        self.desc = desc
+        if total is None and iterable is not None:
+            try:
+                total = len(iterable)
+            except TypeError:
+                total = None
+        self.total = total
+        self.n = 0
+        self._closed = False
+        self._emit()
+
+    def __iter__(self):
+        for x in self.iterable:
+            yield x
+            self.update(1)
+        self.close()
+
+    def update(self, n: int = 1):
+        self.n += n
+        self._emit()
+
+    def set_description(self, desc: str):
+        self.desc = desc
+        self._emit()
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._emit(closed=True)
+
+    def _emit(self, closed: bool = False):
+        rec = {"desc": self.desc, "n": self.n, "total": self.total,
+               "closed": closed, "id": id(self)}
+        if _in_driver():
+            # Driver owns the terminal: render directly instead of
+            # emitting a record nobody would consume.
+            _driver_renderer().maybe_render(
+                "driver", MAGIC + json.dumps(rec))
+        else:
+            print(MAGIC + json.dumps(rec), flush=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _BarState:
+    __slots__ = ("desc", "n", "total")
+
+    def __init__(self):
+        self.desc = ""
+        self.n = 0
+        self.total = None
+
+
+class DriverSideRenderer:
+    """Driver-side multiplexer: feed it raw log lines (the driver's log
+    subscriber calls maybe_render per line); magic lines update bars
+    drawn on one terminal region, everything else passes through."""
+
+    def __init__(self, out=None):
+        self.out = out or sys.stderr
+        self._bars: dict[tuple, _BarState] = {}
+        self._lock = threading.Lock()
+
+    def maybe_render(self, worker_id: str, line: str) -> bool:
+        """True if the line was a progress record (consumed)."""
+        idx = line.find(MAGIC)
+        if idx < 0:
+            return False
+        try:
+            rec = json.loads(line[idx + len(MAGIC):])
+        except ValueError:
+            return False
+        key = (worker_id, rec.get("id"))
+        with self._lock:
+            if rec.get("closed"):
+                self._bars.pop(key, None)
+            else:
+                bar = self._bars.setdefault(key, _BarState())
+                bar.desc = rec.get("desc", "")
+                bar.n = rec.get("n", 0)
+                bar.total = rec.get("total")
+            self._draw()
+        return True
+
+    def _draw(self):
+        parts = []
+        for (wid, _bid), bar in self._bars.items():
+            if bar.total:
+                pct = 100.0 * bar.n / max(bar.total, 1)
+                parts.append(f"{bar.desc or wid[:6]}: "
+                             f"{bar.n}/{bar.total} ({pct:.0f}%)")
+            else:
+                parts.append(f"{bar.desc or wid[:6]}: {bar.n}")
+        if parts:
+            self.out.write("\r" + " | ".join(parts) + "\x1b[K")
+            self.out.flush()

@@ -137,3 +137,31 @@ def test_streaming_larger_than_object_store(s3):
         assert total == n * (n - 1) // 2
     finally:
         ray_tpu.shutdown()
+
+
+def test_tensor_extension_columns_roundtrip(tmp_path):
+    """ndarray columns become Arrow fixed-shape tensor extension columns
+    (reference: ray.data tensor extensions) and survive arrow->batch and
+    parquet round-trips with shape intact."""
+    from ray_tpu.data.block import block_to_arrow, block_to_batch
+
+    imgs = np.arange(4 * 2 * 3, dtype=np.float32).reshape(4, 2, 3)
+    table = block_to_arrow({"image": imgs, "label": np.arange(4)})
+    assert isinstance(table.column("image").type, pa.FixedShapeTensorType)
+    batch = block_to_batch(table)
+    np.testing.assert_array_equal(batch["image"], imgs)
+    np.testing.assert_array_equal(batch["label"], np.arange(4))
+
+    # Parquet round-trip preserves the extension type.
+    path = str(tmp_path / "tensors.parquet")
+    pq.write_table(table, path)
+    back = block_to_batch(pq.read_table(path))
+    np.testing.assert_array_equal(back["image"], imgs)
+
+    # Row-of-ndarray blocks batch into tensor columns too.
+    rows = [{"x": np.full((2, 2), i, np.int64)} for i in range(3)]
+    t2 = block_to_arrow(rows)
+    assert isinstance(t2.column("x").type, pa.FixedShapeTensorType)
+    np.testing.assert_array_equal(
+        block_to_batch(t2)["x"],
+        np.stack([np.full((2, 2), i) for i in range(3)]))

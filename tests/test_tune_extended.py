@@ -147,3 +147,111 @@ def test_pg_per_trial_bundles(ray_start_regular):
 
     assert all(p.get("state") == "REMOVED"
                for p in list_placement_groups()) or not list_placement_groups()
+
+
+def test_pb2_model_guided_perturbation():
+    """PB2 unit: with history showing higher lr -> bigger improvement, the
+    GP-UCB explore step proposes lr in the upper region of the bounds."""
+    from ray_tpu.tune.schedulers import PB2
+
+    class _T:
+        def __init__(self, tid, lr):
+            self.trial_id = tid
+            self.config = {"lr": lr}
+
+    sched = PB2(metric="reward", mode="max", perturbation_interval=2,
+                hyperparam_bounds={"lr": [0.0, 1.0]}, seed=0)
+    # Feed deltas: improvement proportional to lr.
+    for step in range(6):
+        for i, lr in enumerate([0.1, 0.5, 0.9]):
+            t = _T(f"t{i}", lr)
+            sched.on_result(t, metric_value=step * lr, iteration=step)
+    new = [sched.perturb({"lr": 0.1})["lr"] for _ in range(5)]
+    assert all(0.0 <= v <= 1.0 for v in new)
+    assert np.mean(new) > 0.45, f"model should favor high lr, got {new}"
+
+
+def test_pb2_in_tuner(ray_start_regular, tmp_path):
+    def trainable(config):
+        import os
+
+        from ray_tpu.train import session
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        w = 0.0
+        if config.get("_checkpoint_path"):
+            w = float(np.asarray(
+                Checkpoint(config["_checkpoint_path"]).to_pytree()["w"]))
+        for i in range(8):
+            w += config["lr"]
+            ck = Checkpoint.from_pytree(
+                {"w": np.float64(w)},
+                os.path.join(config["dir"],
+                             f"pb2_{os.getpid()}_{i}"))
+            session.report({"w": w}, checkpoint=ck)
+
+    sched = tune.PB2(metric="w", mode="max", perturbation_interval=3,
+                     hyperparam_bounds={"lr": [0.05, 1.0]},
+                     quantile_fraction=0.5, seed=0)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.05, 1.0]),
+                     "dir": str(tmp_path)},
+        tune_config=tune.TuneConfig(metric="w", mode="max", scheduler=sched,
+                                    max_concurrent_trials=2),
+    ).fit()
+    assert grid.get_best_result().metrics["w"] >= 2.0
+    assert len(grid) == 2
+
+
+def test_bohb_factory_in_tuner(ray_start_regular):
+    """BOHB = TPE searcher + HyperBand budgets driving one Tuner run."""
+    from ray_tpu.tune.search import bohb
+
+    def objective(config):
+        from ray_tpu.train import session
+
+        for i in range(8):
+            session.report(
+                {"loss": (config["lr"] - 0.01) ** 2 + 0.1 / (i + 1)})
+
+    searcher, scheduler = bohb({"lr": tune.loguniform(1e-4, 1.0)},
+                               metric="loss", mode="min", num_samples=8,
+                               max_t=8, seed=2)
+    results = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    search_alg=searcher,
+                                    scheduler=scheduler,
+                                    max_concurrent_trials=2)).fit()
+    assert len(results) == 8
+    assert results.get_best_result().metrics["loss"] < 0.3
+
+
+def test_external_searcher_adapter(ray_start_regular):
+    """Any ask/tell pair drives the Tuner through ExternalSearcher."""
+    suggested, observed = [], []
+
+    def ask():
+        if len(suggested) >= 4:
+            return None
+        cfg = {"x": 0.25 * len(suggested)}
+        suggested.append(cfg)
+        return cfg
+
+    def tell(config, value):
+        observed.append((config["x"], value))
+
+    def objective(config):
+        from ray_tpu.train import session
+
+        session.report({"score": -abs(config["x"] - 0.5)})
+
+    searcher = tune.ExternalSearcher(ask, tell)
+    results = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    search_alg=searcher,
+                                    max_concurrent_trials=2)).fit()
+    assert len(results) == 4 and len(observed) == 4
+    assert results.get_best_result().config["x"] == 0.5

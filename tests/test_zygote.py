@@ -1,0 +1,95 @@
+"""Fork-server (zygote) protocol tests, no cluster needed: spawn
+replies, per-request error isolation (a bad request must NOT kill the
+template — its death would SIGTERM every forked worker), and shutdown
+child reaping."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture()
+def zygote(tmp_path):
+    sock_path = str(tmp_path / "zy.sock")
+    env = dict(os.environ)
+    env["RAY_TPU_ZYGOTE_SOCKET"] = sock_path
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.worker_zygote"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 120
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    while True:
+        try:
+            s.connect(sock_path)
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise TimeoutError("zygote never became ready")
+            time.sleep(0.2)
+    f = s.makefile("rwb")
+    yield proc, f, tmp_path
+    try:
+        s.close()
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def _rpc(f, obj):
+    f.write((json.dumps(obj) + "\n").encode())
+    f.flush()
+    return json.loads(f.readline())
+
+
+def test_spawn_error_reply_and_shutdown_reaping(zygote):
+    proc, f, tmp_path = zygote
+    # A malformed request yields an ERROR REPLY, not a dead template.
+    f.write(b"this is not json\n")
+    f.flush()
+    assert "error" in json.loads(f.readline())
+    assert proc.poll() is None
+
+    # A real spawn forks a live child (the worker itself will fail to
+    # reach its raylet and exit, but the fork + pid reply must work).
+    log = str(tmp_path / "w.log")
+    resp = _rpc(f, {"env": {
+        "RAY_TPU_WORKER_ID": "w" * 40, "RAY_TPU_NODE_ID": "n" * 40,
+        "RAY_TPU_RAYLET_HOST": "127.0.0.1", "RAY_TPU_RAYLET_PORT": "1",
+        "RAY_TPU_GCS_HOST": "127.0.0.1", "RAY_TPU_GCS_PORT": "1",
+        "RAY_TPU_STORE_PATH": str(tmp_path / "store"),
+        "RAY_TPU_SESSION_DIR": str(tmp_path),
+    }, "log_path": log})
+    pid = resp["pid"]
+    assert pid > 0
+    # Template still healthy after serving errors AND spawns.
+    resp2 = _rpc(f, {"env": {"RAY_TPU_WORKER_ID": "x" * 40,
+                             "RAY_TPU_NODE_ID": "n" * 40,
+                             "RAY_TPU_RAYLET_HOST": "127.0.0.1",
+                             "RAY_TPU_RAYLET_PORT": "1",
+                             "RAY_TPU_GCS_HOST": "127.0.0.1",
+                             "RAY_TPU_GCS_PORT": "1",
+                             "RAY_TPU_STORE_PATH": str(tmp_path / "store"),
+                             "RAY_TPU_SESSION_DIR": str(tmp_path)},
+                    "log_path": log})
+    assert resp2["pid"] > 0 and resp2["pid"] != pid
+
+    # Shutdown request: zygote exits and reaps any still-live children.
+    f.write((json.dumps({"shutdown": True}) + "\n").encode())
+    f.flush()
+    proc.wait(timeout=30)
+    deadline = time.monotonic() + 30
+    for p in (pid, resp2["pid"]):
+        while time.monotonic() < deadline:
+            try:
+                os.kill(p, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"forked child {p} outlived the zygote")
