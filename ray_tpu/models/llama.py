@@ -40,8 +40,8 @@ class PagedKVCache(NamedTuple):
     max_len x slots.
     """
 
-    k_pool: Any    # (P, page_size, Hkv, D)
-    v_pool: Any    # (P, page_size, Hkv, D)
+    k_pool: Any    # (P, Hkv, page_size, D) — head-then-page minor layout
+    v_pool: Any    # (P, Hkv, page_size, D)   (see ops/paged_attention.py)
     table: Any     # (B, NP) int32 pool indices per sequence
     length: Any    # (B,) int32 tokens already cached (= write offset)
 
@@ -147,12 +147,14 @@ class Attention(nn.Module):
                 paged_decode_attention_batch)
 
             pc = kv_cache
-            ps = pc.k_pool.shape[1]
+            ps = pc.k_pool.shape[2]
             pages = jnp.take_along_axis(
                 pc.table, (pc.length // ps)[:, None], axis=1)[:, 0]
             offs = pc.length % ps
-            k_pool = pc.k_pool.at[pages, offs].set(k[:, :, 0, :])
-            v_pool = pc.v_pool.at[pages, offs].set(v[:, :, 0, :])
+            # pool is (P, Hkv, page, D): [pages, :, offs] scatters one
+            # (B, Hkv, D) row set across the batch
+            k_pool = pc.k_pool.at[pages, :, offs].set(k[:, :, 0, :])
+            v_pool = pc.v_pool.at[pages, :, offs].set(v[:, :, 0, :])
             out = paged_decode_attention_batch(
                 q[:, :, 0, :], k_pool, v_pool, pc.table, pc.length + 1)
             out = out[:, :, None, :].astype(cfg.dtype)

@@ -37,45 +37,70 @@ def _interpret_mode() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _paged_decode_kernel(page_table_ref, length_ref,  # scalar prefetch
-                         q_ref, k_ref, v_ref, o_ref,
-                         m_scratch, l_scratch, acc_scratch,
-                         *, page_size: int, num_pages: int, groups: int,
-                         sm_scale: float):
-    pi = pl.program_id(0)
-    q = q_ref[...].astype(jnp.float32)          # (Hkv, G, D)
-    k = k_ref[0].astype(jnp.float32)            # (page, Hkv, D)
-    v = v_ref[0].astype(jnp.float32)            # (page, Hkv, D)
+def _online_softmax_page_step(pi, num_page_steps, length, q, k, v,
+                              o_write, m_scratch, l_scratch, acc_scratch,
+                              *, page_size: int, sm_scale: float):
+    """One grid step of paged online-softmax attention, shared by the
+    single-sequence and grid-batched kernels.
 
+    The KV head rides the GRID in both callers, so every dot here is a
+    plain 2D (G, D) x (page, D) matmul: Mosaic lowers 2D dots onto the
+    MXU but rejects the batched `hgd,thd` einsum form ("batch dims must
+    be equal" on real TPU; caught by scripts/tpu_kernel_sweep.py
+    on-chip validation).
+
+    pi: page-step program id; q: (G, D); k/v: (page, D); o_write:
+    callback writing the normalized (G, D) output on the last step.
+    """
     @pl.when(pi == 0)
     def _init():
         m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
         l_scratch[...] = jnp.zeros_like(l_scratch)
         acc_scratch[...] = jnp.zeros_like(acc_scratch)
 
-    # scores[h, g, t] = q[h, g, :] . k[t, h, :]
-    scores = jnp.einsum("hgd,thd->hgt", q, k) * sm_scale
+    # scores[g, t] = q[g, :] . k[t, :]  — 2D dot, MXU-safe
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ()))) * sm_scale
     token_idx = pi * page_size + jax.lax.broadcasted_iota(
-        jnp.int32, scores.shape, 2)
-    scores = jnp.where(token_idx < length_ref[0], scores, _NEG_INF)
+        jnp.int32, scores.shape, 1)
+    scores = jnp.where(token_idx < length, scores, _NEG_INF)
 
-    m_prev = m_scratch[...]                     # (Hkv, G, 1)
+    m_prev = m_scratch[...]                     # (G, 1)
     m_cur = jnp.max(scores, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(scores - m_new)                 # (Hkv, G, page)
+    p = jnp.exp(scores - m_new)                 # (G, page)
     l_new = alpha * l_scratch[...] + jnp.sum(p, axis=-1, keepdims=True)
-    # pv[h, g, d] = p[h, g, t] v[t, h, d]
-    pv = jnp.einsum("hgt,thd->hgd", p, v)
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))  # (G, D)
     acc_scratch[...] = acc_scratch[...] * alpha + pv
     m_scratch[...] = m_new
     l_scratch[...] = l_new
 
-    @pl.when(pi == pl.num_programs(0) - 1)
+    @pl.when(pi == num_page_steps - 1)
     def _finish():
         l = l_scratch[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[...] = (acc_scratch[...] / l_safe).astype(o_ref.dtype)
+        o_write((acc_scratch[...] / l_safe))
+
+
+def _paged_decode_kernel(page_table_ref, length_ref,  # scalar prefetch
+                         q_ref, k_ref, v_ref, o_ref,
+                         m_scratch, l_scratch, acc_scratch,
+                         *, page_size: int, num_pages: int, groups: int,
+                         sm_scale: float):
+    # Grid: (Hkv, npages)
+    pi = pl.program_id(1)
+
+    def write(out):
+        o_ref[0] = out.astype(o_ref.dtype)
+
+    _online_softmax_page_step(
+        pi, pl.num_programs(1), length_ref[0],
+        q_ref[0].astype(jnp.float32),           # (G, D)
+        k_ref[0, 0].astype(jnp.float32),        # (page, D)
+        v_ref[0, 0].astype(jnp.float32),
+        write, m_scratch, l_scratch, acc_scratch,
+        page_size=page_size, sm_scale=sm_scale)
 
 
 def paged_decode_attention(q, k_pool, v_pool, page_table, length,
@@ -83,7 +108,10 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, length,
     """Single-token decode attention over paged KV.
 
     q:          (H, D) query for ONE sequence's current token
-    k_pool/v_pool: (P, page_size, Hkv, D) shared pools
+    k_pool/v_pool: (P, Hkv, page_size, D) shared pools — head-then-page
+                minor layout so each (head, page) block is a contiguous
+                (page, D) tile (Mosaic requires the last two block dims
+                to tile as (sublane, lane))
     page_table: (NP,) int32 pool indices owned by this sequence (entries
                 past the live length may be arbitrary valid indices)
     length:     () int32 valid token count (incl. the current token,
@@ -91,7 +119,7 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, length,
     Returns (H, D). vmap over sequences for a batch.
     """
     H, D = q.shape
-    P, page_size, Hkv, _ = k_pool.shape
+    P, Hkv, page_size, _ = k_pool.shape
     groups = H // Hkv
     npages = page_table.shape[0]
     if sm_scale is None:
@@ -100,19 +128,20 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, length,
     q3 = q.reshape(Hkv, groups, D)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(npages,),
+        grid=(Hkv, npages),
         in_specs=[
-            pl.BlockSpec((Hkv, groups, D), lambda i, pt, ln: (0, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D),
-                         lambda i, pt, ln: (pt[i], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D),
-                         lambda i, pt, ln: (pt[i], 0, 0, 0)),
+            pl.BlockSpec((1, groups, D), lambda h, i, pt, ln: (h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, D),
+                         lambda h, i, pt, ln: (pt[i], h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, D),
+                         lambda h, i, pt, ln: (pt[i], h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((Hkv, groups, D), lambda i, pt, ln: (0, 0, 0)),
+        out_specs=pl.BlockSpec((1, groups, D),
+                               lambda h, i, pt, ln: (h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((Hkv, groups, 1), jnp.float32),
-            pltpu.VMEM((Hkv, groups, 1), jnp.float32),
-            pltpu.VMEM((Hkv, groups, D), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, D), jnp.float32),
         ],
     ) if pltpu else None
     out = pl.pallas_call(
@@ -131,39 +160,21 @@ def _paged_decode_batch_kernel(page_table_ref, length_ref,  # scalar prefetch
                                q_ref, k_ref, v_ref, o_ref,
                                m_scratch, l_scratch, acc_scratch,
                                *, page_size: int, sm_scale: float):
+    # Grid: (B, Hkv, npages); pages iterate fastest, so per-(b, h)
+    # scratch resets at pi == 0 and writes back on the last page step.
     b = pl.program_id(0)
-    pi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)            # (Hkv, G, D)
-    k = k_ref[0].astype(jnp.float32)            # (page, Hkv, D)
-    v = v_ref[0].astype(jnp.float32)
+    pi = pl.program_id(2)
 
-    @pl.when(pi == 0)
-    def _init():
-        m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
-        l_scratch[...] = jnp.zeros_like(l_scratch)
-        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+    def write(out):
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
-    scores = jnp.einsum("hgd,thd->hgt", q, k) * sm_scale
-    token_idx = pi * page_size + jax.lax.broadcasted_iota(
-        jnp.int32, scores.shape, 2)
-    scores = jnp.where(token_idx < length_ref[b], scores, _NEG_INF)
-
-    m_prev = m_scratch[...]
-    m_cur = jnp.max(scores, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(scores - m_new)
-    l_new = alpha * l_scratch[...] + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jnp.einsum("hgt,thd->hgd", p, v)
-    acc_scratch[...] = acc_scratch[...] * alpha + pv
-    m_scratch[...] = m_new
-    l_scratch[...] = l_new
-
-    @pl.when(pi == pl.num_programs(1) - 1)
-    def _finish():
-        l = l_scratch[...]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scratch[...] / l_safe).astype(o_ref.dtype)
+    _online_softmax_page_step(
+        pi, pl.num_programs(2), length_ref[b],
+        q_ref[0, 0].astype(jnp.float32),        # (G, D)
+        k_ref[0, 0].astype(jnp.float32),        # (page, D)
+        v_ref[0, 0].astype(jnp.float32),
+        write, m_scratch, l_scratch, acc_scratch,
+        page_size=page_size, sm_scale=sm_scale)
 
 
 def paged_decode_attention_batch(q, k_pool, v_pool, page_tables, lengths,
@@ -175,13 +186,14 @@ def paged_decode_attention_batch(q, k_pool, v_pool, page_tables, lengths,
     of a continuous-batching engine per decode step.
 
     q:           (B, H, D) one query per sequence
-    k/v_pool:    (P, page_size, Hkv, D) pools SHARED by all sequences
+    k/v_pool:    (P, Hkv, page_size, D) pools SHARED by all sequences
+                 (head-then-page minor layout; see paged_decode_attention)
     page_tables: (B, NP) int32 pool indices per sequence
     lengths:     (B,) int32 valid token counts (incl. current tokens)
     Returns (B, H, D).
     """
     B, H, D = q.shape
-    P, page_size, Hkv, _ = k_pool.shape
+    P, Hkv, page_size, _ = k_pool.shape
     groups = H // Hkv
     npages = page_tables.shape[1]
     if sm_scale is None:
@@ -190,21 +202,21 @@ def paged_decode_attention_batch(q, k_pool, v_pool, page_tables, lengths,
     q4 = q.reshape(B, Hkv, groups, D)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, npages),
+        grid=(B, Hkv, npages),
         in_specs=[
-            pl.BlockSpec((1, Hkv, groups, D),
-                         lambda b, i, pt, ln: (b, 0, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D),
-                         lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, Hkv, D),
-                         lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, 1, groups, D),
+                         lambda b, h, i, pt, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, D),
+                         lambda b, h, i, pt, ln: (pt[b, i], h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, D),
+                         lambda b, h, i, pt, ln: (pt[b, i], h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, Hkv, groups, D),
-                               lambda b, i, pt, ln: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, groups, D),
+                               lambda b, h, i, pt, ln: (b, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((Hkv, groups, 1), jnp.float32),
-            pltpu.VMEM((Hkv, groups, 1), jnp.float32),
-            pltpu.VMEM((Hkv, groups, D), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, D), jnp.float32),
         ],
     ) if pltpu else None
     out = pl.pallas_call(

@@ -253,15 +253,16 @@ class LLMEngine:
             @functools.partial(jax.jit, donate_argnums=(0,))
             def write_prompt_pages(pools, kv_one, page_ids):
                 # Scatter a bucketed prefill's (Hkv, max_len, D) caches
-                # into pool pages. page_ids rows past the prompt point at
-                # the dummy page (garbage there is fine).
+                # into pool pages (pool layout (P, Hkv, page, D)).
+                # page_ids rows past the prompt point at the dummy page
+                # (garbage there is fine).
                 out = []
                 for (kp, vp), (k1, v1) in zip(pools, kv_one):
                     Hkv_, L_, D_ = k1.shape
-                    kpg = k1.transpose(1, 0, 2).reshape(
-                        L_ // ps_, ps_, Hkv_, D_)
-                    vpg = v1.transpose(1, 0, 2).reshape(
-                        L_ // ps_, ps_, Hkv_, D_)
+                    kpg = k1.reshape(Hkv_, L_ // ps_, ps_, D_).transpose(
+                        1, 0, 2, 3)
+                    vpg = v1.reshape(Hkv_, L_ // ps_, ps_, D_).transpose(
+                        1, 0, 2, 3)
                     out.append((kp.at[page_ids].set(kpg),
                                 vp.at[page_ids].set(vpg)))
                 return out
@@ -458,9 +459,9 @@ class LLMEngine:
         self._dummy_page = self._alloc.allocate("__dummy__", 1)[0]
         Hkv, Dh = self.cfg.n_kv_heads, self.cfg.head_dim
         self._pools = [
-            (jnp.zeros((self._num_pages, self.page_size, Hkv, Dh),
+            (jnp.zeros((self._num_pages, Hkv, self.page_size, Dh),
                        self.cfg.dtype),
-             jnp.zeros((self._num_pages, self.page_size, Hkv, Dh),
+             jnp.zeros((self._num_pages, Hkv, self.page_size, Dh),
                        self.cfg.dtype))
             for _ in range(self.cfg.n_layers)]
         self._tables = np.full((self.max_batch, self._np_pages),

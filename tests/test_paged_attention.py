@@ -34,16 +34,17 @@ def test_paged_matches_dense(length):
     keys = rng.standard_normal((length, Hkv, D)).astype(np.float32)
     values = rng.standard_normal((length, Hkv, D)).astype(np.float32)
 
-    # Scatter the sequence into a shuffled page pool.
+    # Scatter the sequence into a shuffled page pool (P, Hkv, page, D).
     npages = -(-length // page)
     pool_pages = 8
     order = rng.permutation(pool_pages)[:npages]
-    k_pool = np.zeros((pool_pages, page, Hkv, D), np.float32)
-    v_pool = np.zeros((pool_pages, page, Hkv, D), np.float32)
+    k_pool = np.zeros((pool_pages, Hkv, page, D), np.float32)
+    v_pool = np.zeros((pool_pages, Hkv, page, D), np.float32)
     for i, pg in enumerate(order):
         chunk = keys[i * page:(i + 1) * page]
-        k_pool[pg, :len(chunk)] = chunk
-        v_pool[pg, :len(chunk)] = values[i * page:(i + 1) * page]
+        k_pool[pg, :, :len(chunk)] = chunk.transpose(1, 0, 2)
+        v_pool[pg, :, :len(chunk)] = \
+            values[i * page:(i + 1) * page].transpose(1, 0, 2)
     table = np.concatenate([order, np.full(4 - npages, order[-1])]) \
         if npages < 4 else order[:4]
 
@@ -61,8 +62,8 @@ def test_paged_batch_vmap():
     B, pool_pages, npages = 3, 12, 3
     rng = np.random.default_rng(1)
     lengths = np.array([5, 17, 24], np.int32)
-    k_pool = rng.standard_normal((pool_pages, page, Hkv, D)).astype(np.float32)
-    v_pool = rng.standard_normal((pool_pages, page, Hkv, D)).astype(np.float32)
+    k_pool = rng.standard_normal((pool_pages, Hkv, page, D)).astype(np.float32)
+    v_pool = rng.standard_normal((pool_pages, Hkv, page, D)).astype(np.float32)
     tables = np.array([[0, 1, 2], [3, 4, 5], [6, 7, 8]], np.int32)
     qs = rng.standard_normal((B, H, D)).astype(np.float32)
 
@@ -73,8 +74,10 @@ def test_paged_batch_vmap():
     assert out.shape == (B, H, D)
     for b in range(B):
         ln = int(lengths[b])
-        keys = k_pool[tables[b]].reshape(-1, Hkv, D)[:ln]
-        values = v_pool[tables[b]].reshape(-1, Hkv, D)[:ln]
+        keys = k_pool[tables[b]].transpose(0, 2, 1, 3).reshape(
+            -1, Hkv, D)[:ln]
+        values = v_pool[tables[b]].transpose(0, 2, 1, 3).reshape(
+            -1, Hkv, D)[:ln]
         ref = _ref_attention(qs[b], keys, values, groups=1)
         np.testing.assert_allclose(np.asarray(out[b]), ref,
                                    rtol=2e-4, atol=2e-4)
@@ -106,8 +109,8 @@ def test_paged_batch_kernel_matches_dense():
     rng = np.random.default_rng(1)
     lengths = np.array([3, 17, 40], np.int32)
     q = rng.standard_normal((B, H, D)).astype(np.float32)
-    k_pool = np.zeros((pool_pages, page, Hkv, D), np.float32)
-    v_pool = np.zeros((pool_pages, page, Hkv, D), np.float32)
+    k_pool = np.zeros((pool_pages, Hkv, page, D), np.float32)
+    v_pool = np.zeros((pool_pages, Hkv, page, D), np.float32)
     tables = np.zeros((B, NP), np.int32)
     seqs = []
     free = list(rng.permutation(pool_pages))
@@ -120,8 +123,9 @@ def test_paged_batch_kernel_matches_dense():
         own = [free.pop() for _ in range(npg)]
         for i, pg in enumerate(own):
             chunk = keys[i * page:(i + 1) * page]
-            k_pool[pg, :len(chunk)] = chunk
-            v_pool[pg, :len(chunk)] = values[i * page:(i + 1) * page]
+            k_pool[pg, :, :len(chunk)] = chunk.transpose(1, 0, 2)
+            v_pool[pg, :, :len(chunk)] = \
+                values[i * page:(i + 1) * page].transpose(1, 0, 2)
         tables[b] = (own + [own[-1]] * NP)[:NP]
 
     from ray_tpu.ops.paged_attention import paged_decode_attention_batch
