@@ -232,6 +232,12 @@ class CoreWorker:
         self.raylet: rpc.Connection | None = None
         self.server: rpc.RpcServer | None = None
         self.address: Address | None = None
+        # Cached outbound conns (per owner / per raylet) + per-key connect
+        # locks: concurrent first uses must not each open a connection
+        # and orphan the losers' sockets + recv tasks.
+        self._owner_conns: dict = {}
+        self._raylet_conns: dict = {}
+        self._conn_locks: dict = {}
         self._leases: dict[str, list[_LeaseSlot]] = defaultdict(list)
         self._lease_requests_in_flight: dict[str, int] = defaultdict(int)
         self._queues: dict[str, list] = defaultdict(list)  # shape -> [task_id]
@@ -683,16 +689,28 @@ class CoreWorker:
                                       f"owner does not know object {oid.hex()}")
         return None  # pending
 
-    _owner_conns: dict = {}
+    async def _connect_cached(self, cache: dict, key, host, port,
+                              name: str, kind: str) -> rpc.Connection:
+        """Double-checked locked connect: one live connection per key.
+
+        `kind` namespaces the lock table — owner and raylet cache keys
+        are both (host, port)-shaped and must not share locks.
+        """
+        conn = cache.get(key)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._conn_locks.setdefault((kind, key), asyncio.Lock())
+        async with lock:
+            conn = cache.get(key)
+            if conn is None or conn.closed:
+                conn = await rpc.connect(host, port, name=name)
+                cache[key] = conn
+        return conn
 
     async def _owner_conn(self, owner: Address) -> rpc.Connection:
-        key = owner.key()
-        conn = self._owner_conns.get(key)
-        if conn is None or conn.closed:
-            conn = await rpc.connect(owner.host, owner.port,
-                                     name=f"w{self.worker_id[:6]}->owner")
-            self._owner_conns[key] = conn
-        return conn
+        return await self._connect_cached(
+            self._owner_conns, owner.key(), owner.host, owner.port,
+            name=f"w{self.worker_id[:6]}->owner", kind="owner")
 
     async def _pull_to_local(self, oid_hex: str, locations: list[str]) -> bool:
         resp = await self.raylet.call("PullObject", {
@@ -1427,15 +1445,10 @@ class CoreWorker:
                     exc.RayTpuError(f"task unschedulable: {reason}"))
                 self._complete_task_error(pt, err)
 
-    _raylet_conns: dict = {}
-
     async def _raylet_conn(self, host, port):
-        key = (host, port)
-        conn = self._raylet_conns.get(key)
-        if conn is None or conn.closed:
-            conn = await rpc.connect(host, port, name="owner->raylet")
-            self._raylet_conns[key] = conn
-        return conn
+        return await self._connect_cached(
+            self._raylet_conns, (host, port), host, port,
+            name="owner->raylet", kind="raylet")
 
     async def _on_slot_idle(self, slot: _LeaseSlot, shape: str):
         if slot.outstanding or slot.conn.closed:
@@ -2187,9 +2200,23 @@ class CoreWorker:
                         pass
                     continue
             if st["conn"] is None or st["conn"].closed:
-                addr = Address.from_wire(st["address"])
-                st["conn"] = await rpc.connect(addr.host, addr.port,
-                                               name=f"->actor{actor_id[:6]}")
+                # Serialize connects: concurrent submits racing here would
+                # each open a connection and overwrite st["conn"], leaking
+                # the losers' sockets + recv tasks ("Task was destroyed
+                # but it is pending!" mid-run).
+                lock = st.get("conn_lock")
+                if lock is None:
+                    lock = st["conn_lock"] = asyncio.Lock()
+                async with lock:
+                    if st["dead"] or st["address"] is None:
+                        continue   # state changed while waiting; re-resolve
+                    if st["conn"] is None or st["conn"].closed:
+                        addr = Address.from_wire(st["address"])
+                        st["conn"] = await rpc.connect(
+                            addr.host, addr.port,
+                            name=f"->actor{actor_id[:6]}")
+            if st["conn"] is None or st["conn"].closed:
+                continue
             return st["conn"]
 
     async def _submit_actor_task_async(self, actor_id: str, spec: TaskSpec,
@@ -2199,6 +2226,7 @@ class CoreWorker:
         st = self._actor_state(actor_id)
         try:
             for _ in range(max(1, attempts)):
+                conn = None
                 try:
                     conn = await self._actor_conn(actor_id, st)
                     resp = await conn.call("ActorCall", {
@@ -2219,8 +2247,16 @@ class CoreWorker:
                     break
                 except (rpc.RpcError, OSError, asyncio.TimeoutError) as e:
                     last_reason = str(e)
-                    st["conn"] = None
-                    st["address"] = None
+                    # Never close the SHARED conn here — other submits
+                    # may have calls in flight on it. Drop the cache
+                    # entry only when the transport actually died, and
+                    # only if it still holds the conn THIS call used (a
+                    # concurrent submit may have reconnected already).
+                    if conn is None:
+                        st["address"] = None       # connect failed: re-resolve
+                    elif conn.closed and st["conn"] is conn:
+                        st["conn"] = None
+                        st["address"] = None
                     await asyncio.sleep(0.2)
                     continue
             err = serialization.serialize_exception(
