@@ -10,10 +10,13 @@ the RAY_TPU_USAGE_STATS_ENABLED=0 opt-out match the reference's shape.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
 import threading
 import time
+
+logger = logging.getLogger(__name__)
 
 _lock = threading.Lock()
 _library_usages: set[str] = set()
@@ -49,13 +52,15 @@ def _collect(gcs_call=None) -> dict:
         "libraries": sorted(_library_usages),
         "extra_tags": dict(_extra_tags),
     }
-    try:
-        import jax
-
-        data["jax_version"] = jax.__version__
-        data["accelerator"] = jax.default_backend()
-    except Exception:
-        pass
+    # Passive only: NEVER import jax or initialize a backend from the
+    # reporter. `jax.default_backend()` here used to spin up a PJRT
+    # client inside every driver — a multi-second import racing user
+    # work, a second tunnel client per driver on TPU machines, and PJRT
+    # teardown aborts at exit. Record what's already in the process;
+    # accelerator inventory comes from the cluster resource view below.
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        data["jax_version"] = getattr(jax_mod, "__version__", "unknown")
     try:
         nodes = ray_tpu.nodes()
         data["num_nodes"] = sum(1 for n in nodes if n.get("alive"))
@@ -97,3 +102,19 @@ class UsageStatsReporter:
 
     def stop(self) -> None:
         self._stop.set()
+        # Join, don't just signal: a daemon thread still unwinding when
+        # the interpreter finalizes gets pthread_exit'd mid-GIL-acquire,
+        # which glibc turns into 'FATAL: exception not rethrown' + abort
+        # (seen ~1-in-5 under load). Aim for dead-before-stop-returns;
+        # if a report is wedged mid-RPC past the timeout, KEEP the
+        # handle so a second stop() can re-join instead of losing track
+        # of a live thread.
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+            if t.is_alive():
+                logger.warning(
+                    "usage-stats reporter still alive after stop(): a "
+                    "report is blocked; interpreter exit may race it")
+                return
+        self._thread = None

@@ -130,6 +130,19 @@ def main() -> None:
     # fork inherits the warm module set copy-on-write.
     from ray_tpu._private import worker as _worker_mod  # noqa: F401
 
+    # Pre-import the jax MODULE too: worker.main() imports it for
+    # platform pinning, and paying that (~250 ms) per fork serialized
+    # every worker/actor bring-up through the zygote. Importing jax does
+    # NOT initialize a backend or touch devices — children still pin
+    # their platform via jax.config.update post-fork, so workers stay
+    # byte-identical to a cold spawn where it matters.
+    if os.environ.get("RAY_TPU_ZYGOTE_PREIMPORT_JAX", "1") not in (
+            "0", "false"):
+        try:
+            import jax  # noqa: F401
+        except ImportError:
+            pass
+
     signal.signal(signal.SIGCHLD, _reap)
     signal.signal(signal.SIGTERM,
                   lambda s, f: (_kill_children(), os._exit(0)))
