@@ -34,12 +34,7 @@ import time
 from collections import defaultdict, deque
 
 from ray_tpu._private import rpc
-from ray_tpu._private.common import (
-    add_resources,
-    normalize_resources,
-    resources_fit,
-    subtract_resources,
-)
+from ray_tpu._private.common import normalize_resources, resources_fit
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullError
@@ -278,7 +273,12 @@ class Raylet:
         if resources is None:
             resources = {"CPU": float(os.cpu_count() or 1)}
         self.total_resources = normalize_resources(resources)
-        self.available = dict(self.total_resources)
+        # ALL node-local accounting (resource pool, PG bundle pools,
+        # lease records, blocked-worker credits) lives in the native
+        # core (src/raylet_core.cc) — there is no Python shadow copy.
+        from ray_tpu._private.native_raylet_core import RayletResourceCore
+
+        self.rcore = RayletResourceCore(self.total_resources)
         # Arena on tmpfs when possible (reference: plasma allocates on
         # /dev/shm; a disk-backed mmap makes every put run at disk speed).
         store_dir = self.config.object_store_dir
@@ -302,7 +302,6 @@ class Raylet:
         self.workers: dict[str, WorkerHandle] = {}
         self.idle_workers: deque[WorkerHandle] = deque()
         self.pending_leases: deque = deque()
-        self.pg_bundles: dict[tuple[str, int], dict] = {}  # (pg_id, idx) -> pools
         self.cluster_view: dict = {}
         self.gcs_conn: rpc.Connection | None = None
         self.server = rpc.RpcServer(self._handlers(), name=f"raylet-{self.node_id[:8]}")
@@ -500,6 +499,7 @@ class Raylet:
                 os.unlink(self.store_path)
             except OSError:
                 pass
+        self.rcore.close()
 
     async def _reconcile_actors(self, conn) -> None:
         """After an outage the GCS may have failed our actors over
@@ -924,40 +924,31 @@ class Raylet:
 
     # ---------- leases / scheduling ----------
 
-    def _bundle_pool(self, pg_id: str, index: int):
-        if index >= 0:
-            return self.pg_bundles.get((pg_id, index))
-        # index -1: any bundle of this pg on this node
-        for (pid, _idx), pool in self.pg_bundles.items():
-            if pid == pg_id:
-                return pool
+    @property
+    def available(self) -> dict:
+        """Node-pool availability snapshot from the native core (what
+        heartbeats report and spillback checks read)."""
+        return self.rcore.available()
+
+    def _acquire(self, resources: dict, pg_id: str,
+                 bundle_index: int) -> str | None:
+        """Acquire resources in the native core under a fresh lease id.
+
+        Returns the lease id, or None when the demand does not fit now
+        (or the PG bundle is absent/uncommitted — queued either way)."""
+        self._lease_seq += 1
+        lease_id = f"{self.node_id[:8]}-{self._lease_seq}"
+        if self.rcore.try_acquire(lease_id, resources, pg_id or "",
+                                  bundle_index):
+            return lease_id
         return None
 
-    def _try_acquire(self, resources: dict, pg_id: str, bundle_index: int) -> bool:
-        if pg_id:
-            pool = self._bundle_pool(pg_id, bundle_index)
-            if pool is None or not pool["committed"]:
-                return False
-            if not resources_fit(pool["available"], resources):
-                return False
-            subtract_resources(pool["available"], resources)
-            return True
-        if not resources_fit(self.available, resources):
-            return False
-        subtract_resources(self.available, resources)
-        return True
-
     def _release_lease_resources(self, w: WorkerHandle):
-        if w.blocked:
-            # Resources were already returned when the worker blocked in
-            # ray.get — adding again would double-count.
-            w.blocked = False
-        elif w.lease_pg is not None:
-            pool = self.pg_bundles.get(w.lease_pg)
-            if pool is not None:
-                add_resources(pool["available"], w.lease_resources)
-        else:
-            add_resources(self.available, w.lease_resources)
+        if w.lease_id:
+            # The core knows which pool the lease drew from and whether
+            # a blocked worker already returned its resources.
+            self.rcore.release(w.lease_id)
+        w.blocked = False
         w.leased = False
         w.lease_id = None
         w.lease_resources = {}
@@ -1067,33 +1058,23 @@ class Raylet:
 
     def handle_worker_blocked(self, conn, payload):
         w = self.workers.get(payload["worker_id"])
-        if w is None or not w.leased or w.blocked:
+        if w is None or not w.leased or not w.lease_id:
             return {}
-        w.blocked = True
-        if w.lease_pg is not None:
-            pool = self.pg_bundles.get(w.lease_pg)
-            if pool is not None:
-                add_resources(pool["available"], w.lease_resources)
-        else:
-            add_resources(self.available, w.lease_resources)
-        self._pump_pending_leases()
+        if self.rcore.block(w.lease_id):
+            w.blocked = True
+            self._pump_pending_leases()
         return {}
 
     def handle_worker_unblocked(self, conn, payload):
         w = self.workers.get(payload["worker_id"])
-        if w is None or not w.blocked:
+        if w is None or not w.lease_id:
             return {}
-        w.blocked = False
-        # Re-acquire immediately; available may go briefly negative
+        # Re-acquire immediately; the pool may go briefly negative
         # (dispatch only proceeds when fit, so this self-corrects as
         # other leases finish — same oversubscription the reference
         # tolerates on unblock).
-        if w.lease_pg is not None:
-            pool = self.pg_bundles.get(w.lease_pg)
-            if pool is not None:
-                subtract_resources(pool["available"], w.lease_resources)
-        else:
-            subtract_resources(self.available, w.lease_resources)
+        if self.rcore.unblock(w.lease_id):
+            w.blocked = False
         return {}
 
     def _sync_native_view(self):
@@ -1111,20 +1092,29 @@ class Raylet:
             self._native_sched.remove_node(nid)
         self._native_known = seen
 
-    def _pick_spillback(self, resources: dict, view: dict | None = None
-                        ) -> dict | None:
+    def _pick_spillback(self, resources: dict, view: dict | None = None,
+                        debit: bool = False) -> dict | None:
         """Hybrid policy tail: among alive peers that fit the demand, pick
         the best-utilized (pack) candidate (reference: top-k hybrid policy,
         hybrid_scheduling_policy.h:107-124 — we take k=1 of the sorted list
         since the cluster view is already fresh).  Pass `view` to pick
-        against a locally-debited copy (bulk spill decisions); the native
-        path debits its own mirrored table instead."""
+        against a locally-debited copy (bulk spill decisions).
+
+        `debit=True` immediately charges the demand against the chosen
+        node in the native mirror, so CONCURRENT spill decisions fan out
+        across peers instead of herding onto one stale "best" node (the
+        next heartbeat restores ground truth). Without it, a burst of
+        direct-path lease requests all redirect to the same peer. Callers
+        that pick conditionally use _debit_spill at the decision point
+        instead."""
         if self._native_sched is not None and view is None:
             nid = self._native_sched.pick_node(resources, "pack",
                                                exclude=self.node_id)
             info = self.cluster_view.get(nid) if nid else None
             if info is None:
                 return None
+            if debit:
+                self._native_sched.debit_node(nid, resources)
             return {"node_id": nid, "host": info["host"],
                     "port": info["raylet_port"]}
         candidates = []
@@ -1142,6 +1132,13 @@ class Raylet:
         candidates.sort(key=lambda c: -c[0])
         _, nid, info = candidates[0]
         return {"node_id": nid, "host": info["host"], "port": info["raylet_port"]}
+
+    def _debit_spill(self, spill: dict, resources: dict) -> dict:
+        """Charge a taken spill decision against the native mirror (see
+        _pick_spillback's debit note) and pass the decision through."""
+        if self._native_sched is not None:
+            self._native_sched.debit_node(spill["node_id"], resources)
+        return spill
 
     def _note_infeasible(self, resources: dict):
         now = time.monotonic()
@@ -1162,16 +1159,18 @@ class Raylet:
         if self.draining:
             spill = self._pick_spillback(resources)
             if spill:
-                return {"spillback": spill}
+                return {"spillback": self._debit_spill(spill, resources)}
             return {"error": "node draining"}
 
         allow_spill = not (strategy and strategy[0] == "node_affinity") and not pg_id
         hops = payload.get("hops", 0)
         is_spread = bool(strategy and strategy[0] == "spread") and hops == 0
         locally_feasible = pg_id or resources_fit(self.total_resources, resources)
-        if (not allow_spill or not is_spread) \
-                and self._try_acquire(resources, pg_id, bundle_index):
-            return await self._grant_lease(resources, pg_id, bundle_index)
+        if not allow_spill or not is_spread:
+            lease_id = self._acquire(resources, pg_id, bundle_index)
+            if lease_id:
+                return await self._grant_lease(lease_id, resources, pg_id,
+                                               bundle_index)
         if allow_spill:
             # Prefer a peer with capacity available right now; for SPREAD,
             # prefer spilling even when we could run locally (one hop max,
@@ -1179,11 +1178,13 @@ class Raylet:
             spill = self._pick_spillback(resources)
             if spill is not None and (
                     is_spread or not resources_fit(self.available, resources)):
-                return {"spillback": spill}
+                return {"spillback": self._debit_spill(spill, resources)}
             if is_spread:
                 # No better peer: run locally if possible.
-                if self._try_acquire(resources, pg_id, bundle_index):
-                    return await self._grant_lease(resources, pg_id, bundle_index)
+                lease_id = self._acquire(resources, pg_id, bundle_index)
+                if lease_id:
+                    return await self._grant_lease(lease_id, resources, pg_id,
+                                                   bundle_index)
             if not locally_feasible:
                 # This node can never run it; hand off to any peer whose
                 # TOTAL capacity fits (it will queue there), else error.
@@ -1213,34 +1214,24 @@ class Raylet:
                 pass
             spill = self._pick_spillback(resources)
             if spill:
-                return {"spillback": spill}
+                return {"spillback": self._debit_spill(spill, resources)}
             return {"error": "lease timeout: insufficient resources", "retry": True}
 
-    async def _grant_lease(self, resources, pg_id, bundle_index):
+    async def _grant_lease(self, lease_id, resources, pg_id, bundle_index):
+        """Attach an already-acquired lease (see _acquire) to a worker."""
         w = await self._get_ready_worker()
         if w is None:
-            # Couldn't start a worker: give resources back, report error.
-            if pg_id:
-                pool = self._bundle_pool(pg_id, bundle_index)
-                if pool:
-                    add_resources(pool["available"], resources)
-            else:
-                add_resources(self.available, resources)
+            # Couldn't start a worker: give the acquisition back.
+            self.rcore.release(lease_id)
             return {"error": "worker startup failed"}
-        self._lease_seq += 1
         self._num_leases_granted += 1
-        lease_id = f"{self.node_id[:8]}-{self._lease_seq}"
         w.leased = True
         w.leased_at = time.monotonic()
         w.lease_id = lease_id
         w.lease_resources = resources
+        # Observability only (which pool the lease drew from is tracked
+        # natively; -1 records the wildcard request as made).
         w.lease_pg = (pg_id, bundle_index) if pg_id else None
-        if w.lease_pg is not None and w.lease_pg not in self.pg_bundles:
-            # -1 wildcard matched some bundle; find which pool we debited
-            for key, pool in self.pg_bundles.items():
-                if key[0] == pg_id:
-                    w.lease_pg = key
-                    break
         return {"granted": True, "lease_id": lease_id,
                 "worker_id": w.worker_id,
                 "worker_host": w.address[0], "worker_port": w.address[1],
@@ -1269,15 +1260,24 @@ class Raylet:
         import copy
 
         debit_view = None
+        # One availability snapshot per pass (each is a native call +
+        # wire round-trip; per-item reads would be O(queue depth) on the
+        # hottest scheduling path), refreshed after successful acquires.
+        avail = None
         for item in list(self.pending_leases):
             resources, pg_id, bundle_index, fut, spillable = item
             if fut.done():
                 self.pending_leases.remove(item)
                 continue
-            if self._try_acquire(resources, pg_id, bundle_index):
+            lease_id = self._acquire(resources, pg_id, bundle_index)
+            if lease_id:
                 self.pending_leases.remove(item)
-                granted.append(item)
-            elif spillable and not resources_fit(self.available, resources):
+                granted.append((lease_id, item))
+                avail = None
+                continue
+            if avail is None:
+                avail = self.available
+            if spillable and not resources_fit(avail, resources):
                 # Re-run the scheduling policy over queued work: a peer may
                 # have gained capacity (or just joined) since this lease
                 # queued (reference: ClusterTaskManager::ScheduleAndDispatch
@@ -1285,10 +1285,8 @@ class Raylet:
                 # spill decision debits the target locally so a burst fans
                 # out across peers instead of herding onto one node.
                 if self._native_sched is not None:
-                    spill = self._pick_spillback(resources)
+                    spill = self._pick_spillback(resources, debit=True)
                     if spill is not None:
-                        self._native_sched.debit_node(spill["node_id"],
-                                                      resources)
                         self.pending_leases.remove(item)
                         fut.set_result({"spillback": spill})
                     continue
@@ -1296,17 +1294,30 @@ class Raylet:
                     debit_view = copy.deepcopy(self.cluster_view)
                 spill = self._pick_spillback(resources, view=debit_view)
                 if spill is not None:
-                    avail = debit_view[spill["node_id"]]["available_resources"]
+                    peer_avail = \
+                        debit_view[spill["node_id"]]["available_resources"]
                     for k, v in resources.items():
-                        avail[k] = avail.get(k, 0) - v
+                        peer_avail[k] = peer_avail.get(k, 0) - v
                     self.pending_leases.remove(item)
                     fut.set_result({"spillback": spill})
-        for resources, pg_id, bundle_index, fut, _sp in granted:
-            async def grant(resources=resources, pg_id=pg_id,
-                            bundle_index=bundle_index, fut=fut):
-                result = await self._grant_lease(resources, pg_id, bundle_index)
+        for lease_id, (resources, pg_id, bundle_index, fut, _sp) in granted:
+            async def grant(lease_id=lease_id, resources=resources,
+                            pg_id=pg_id, bundle_index=bundle_index, fut=fut):
+                result = await self._grant_lease(lease_id, resources, pg_id,
+                                                 bundle_index)
                 if not fut.done():
                     fut.set_result(result)
+                elif result.get("granted"):
+                    # Requester gave up (lease timeout) while we granted:
+                    # reclaim the worker and its resources.
+                    for w in self.workers.values():
+                        if w.lease_id == lease_id:
+                            self._release_lease_resources(w)
+                            w.idle_since = time.monotonic()
+                            self.idle_workers.append(w)
+                            break
+                    else:
+                        self.rcore.release(lease_id)
             asyncio.ensure_future(grant())
 
     # ---------- actors ----------
@@ -1315,7 +1326,8 @@ class Raylet:
         resources = normalize_resources(payload.get("resources"))
         pg_id = payload.get("placement_group", "")
         bundle_index = payload.get("pg_bundle_index", -1)
-        if not self._try_acquire(resources, pg_id, bundle_index):
+        lease_id = self._acquire(resources, pg_id, bundle_index)
+        if lease_id is None:
             if pg_id or resources_fit(self.total_resources, resources):
                 # Feasible later: wait for resources like a queued lease.
                 fut = asyncio.get_running_loop().create_future()
@@ -1335,10 +1347,11 @@ class Raylet:
             return {"ok": False, "reason": f"infeasible actor resources {resources}"}
         w = await self._get_ready_worker()
         if w is None:
-            add_resources(self.available, resources)
+            self.rcore.release(lease_id)
             return {"ok": False, "reason": "worker startup failed"}
         w.leased = True
         w.leased_at = time.monotonic()
+        w.lease_id = lease_id
         w.lease_resources = resources
         w.lease_pg = (pg_id, bundle_index) if pg_id else None
         return await self._assign_actor(w, payload, resources)
@@ -1346,8 +1359,10 @@ class Raylet:
     async def _assign_actor(self, w: WorkerHandle | None, payload, resources):
         if w is None:
             return {"ok": False, "reason": "no worker"}
+        # The accounting lease (w.lease_id) stays attached for the
+        # actor's lifetime; release happens on actor-worker death/kill
+        # via _release_lease_resources.
         w.actor_id = payload["actor_id"]
-        w.lease_id = None
         try:
             resp = await w.conn.call("AssignActor", {"spec": payload["spec"]},
                                      timeout=self.config.rpc_call_timeout_s)
@@ -1370,35 +1385,36 @@ class Raylet:
     # ---------- placement group bundles ----------
 
     async def handle_prepare_pg_bundle(self, conn, payload):
-        key = (payload["pg_id"], payload["bundle_index"])
         resources = normalize_resources(payload["resources"])
-        if key in self.pg_bundles:
+        if self.rcore.pg_prepare(payload["pg_id"], payload["bundle_index"],
+                                 resources):
             return {"ok": True}
-        if not resources_fit(self.available, resources):
-            return {"ok": False, "reason": "insufficient resources"}
-        subtract_resources(self.available, resources)
-        self.pg_bundles[key] = {"resources": resources,
-                                "available": dict(resources), "committed": False}
-        return {"ok": True}
+        return {"ok": False, "reason": "insufficient resources"}
 
     async def handle_commit_pg_bundle(self, conn, payload):
-        key = (payload["pg_id"], payload["bundle_index"])
-        pool = self.pg_bundles.get(key)
-        if pool is None:
+        if not self.rcore.pg_commit(payload["pg_id"],
+                                    payload["bundle_index"]):
             return {"ok": False}
-        pool["committed"] = True
         self._pump_pending_leases()
         return {"ok": True}
 
     async def handle_return_pg_bundle(self, conn, payload):
-        key = (payload["pg_id"], payload["bundle_index"])
-        pool = self.pg_bundles.pop(key, None)
-        if pool is not None:
-            # Kill workers still leased against this bundle.
-            for w in list(self.workers.values()):
-                if w.lease_pg == key:
-                    self._kill_worker(w)
-            add_resources(self.available, pool["resources"])
+        held = self.rcore.pg_return(payload["pg_id"],
+                                    payload["bundle_index"])
+        if held is not None:
+            # Kill workers still leased against this bundle. Their lease
+            # RECORDS must be released explicitly — _kill_worker pops the
+            # worker, so no death path will do it later — but the credit
+            # inside release is a no-op (the pool is already gone; its
+            # whole reservation went back to the node pool above).
+            for lease_id in held:
+                for w in list(self.workers.values()):
+                    if w.lease_id == lease_id:
+                        self._release_lease_resources(w)
+                        self._kill_worker(w)
+                        break
+                else:
+                    self.rcore.release(lease_id)
             self._pump_pending_leases()
         return {"ok": True}
 
@@ -1735,7 +1751,8 @@ class Raylet:
             "idle_workers": len(self.idle_workers),
             "pending_leases": len(self.pending_leases),
             "leases_granted": self._num_leases_granted,
-            "pg_bundles": [list(k) for k in self.pg_bundles],
+            "active_leases": self.rcore.num_leases(),
+            "pg_bundles": self.rcore.num_bundles(),
             "store": self.store.stats() if self.store else {},
             "spilled_objects": len(self.spilled),
             "spilled_bytes": self._spilled_bytes,

@@ -26,6 +26,7 @@ def test_cpp_unit_tests():
     assert res.returncode == 0, res.stdout + res.stderr
     assert "store_test: OK" in res.stdout
     assert "scheduler_test: OK" in res.stdout
+    assert "raylet_core_test: all passed" in res.stdout
 
 
 @pytest.mark.skipif(os.environ.get("RAY_TPU_SANITIZE") != "1",
