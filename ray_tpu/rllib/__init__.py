@@ -1,5 +1,13 @@
 from ray_tpu.rllib.a2c import A2C, A2CConfig
+from ray_tpu.rllib.a3c import A3C, A3CConfig
 from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.bandit import (Bandit, BanditConfig,
+                                  LinearDiscreteBandit)
+from ray_tpu.rllib.crr import CRR, CRRConfig
+from ray_tpu.rllib.es import ARS, ES, ARSConfig, ESConfig
+from ray_tpu.rllib.random_agent import RandomAgent, RandomAgentConfig
+from ray_tpu.rllib.simple_q import (ApexDQN, ApexDQNConfig, SimpleQ,
+                                    SimpleQConfig)
 from ray_tpu.rllib.catalog import (MODEL_REGISTRY, ModelSpec, get_model,
                                    register_model)
 from ray_tpu.rllib.connectors import (ClipActions, Connector,
@@ -28,7 +36,11 @@ __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "ModelSpec", "MODEL_REGISTRY", "get_model", "register_model",
            "Env", "CartPole", "Pendulum", "ENV_REGISTRY", "make_env",
            "Connector", "ConnectorPipeline", "FlattenObs", "NormalizeObs",
-           "FrameStack", "ClipActions", "RescaleActions", "EnvRunner"]
+           "FrameStack", "ClipActions", "RescaleActions", "EnvRunner",
+           "A3C", "A3CConfig", "ES", "ESConfig", "ARS", "ARSConfig",
+           "SimpleQ", "SimpleQConfig", "ApexDQN", "ApexDQNConfig",
+           "Bandit", "BanditConfig", "LinearDiscreteBandit",
+           "CRR", "CRRConfig", "RandomAgent", "RandomAgentConfig"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu('rllib')

@@ -1,0 +1,149 @@
+"""CRR: Critic-Regularized Regression (offline RL).
+
+Parity: reference rllib/algorithms/crr/ — learn a Q critic on the
+logged transitions, then imitate only advantage-positive actions:
+policy loss = -w(s,a) * log pi(a|s) with w = exp(A/beta) ("exp" mode,
+clipped) or w = 1[A > 0] ("binary" mode). Sits between BC (no critic)
+and CQL (pessimistic critic + SAC) in the offline family.
+
+Discrete-action variant over the same JSONL/Dataset inputs as
+BC/MARWIL (offline.py); the critic's advantage baseline is the
+policy-expected Q under the current policy distribution.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ray_tpu.rllib.dqn import init_q_params
+from ray_tpu.rllib.offline import MARWIL, MARWILConfig
+
+
+@dataclass
+class CRRConfig(MARWILConfig):
+    """Fluent config (parity: rllib CRRConfig)."""
+
+    weight_mode: str = "exp"      # "exp" | "binary"
+    beta: float = 1.0             # temperature for exp weights
+    weight_clip: float = 20.0
+    critic_lr: float = 1e-3
+    target_update_freq: int = 4   # iterations between critic target syncs
+
+    def build(self) -> "CRR":  # type: ignore[override]
+        return CRR(self)
+
+
+class CRR(MARWIL):
+    def __init__(self, config: CRRConfig):
+        super().__init__(config)
+        self.q_params = init_q_params(self.obs_size, self.num_actions,
+                                      config.hidden_size, config.seed + 1)
+        self.q_target = self.q_params
+        self._q_update = None
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg: CRRConfig = self.config  # type: ignore[assignment]
+        pi_opt = optax.adam(cfg.lr)
+        q_opt = optax.adam(cfg.critic_lr)
+        self._opt = pi_opt
+        self._opt_state = pi_opt.init(self.params)
+        self._q_opt = q_opt
+        self._q_opt_state = q_opt.init(self.q_params)
+
+        def q_fn(params, obs):
+            h = jnp.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
+            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+            return h @ params["q"]["w"] + params["q"]["b"]
+
+        def pi_logits(params, obs):
+            h = jnp.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
+            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+            return h @ params["pi"]["w"] + params["pi"]["b"]
+
+        def q_loss(q_params, q_target, pi_params, batch):
+            q = q_fn(q_params, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            # SARSA-style bootstrap through the CURRENT policy's
+            # expectation at s' (the offline-safe choice: no max over
+            # out-of-distribution actions).
+            probs_next = jax.nn.softmax(pi_logits(pi_params,
+                                                  batch["next_obs"]))
+            v_next = (probs_next * q_fn(q_target, batch["next_obs"])).sum(-1)
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) \
+                * v_next
+            return ((q_sel - jax.lax.stop_gradient(target)) ** 2).mean()
+
+        def pi_loss(pi_params, q_params, batch):
+            logits = pi_logits(pi_params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            q = q_fn(q_params, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            v = (jax.nn.softmax(logits) * q).sum(-1)
+            adv = jax.lax.stop_gradient(q_sel - v)
+            if cfg.weight_mode == "binary":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.minimum(jnp.exp(adv / cfg.beta), cfg.weight_clip)
+            return -(jax.lax.stop_gradient(w) * logp).mean()
+
+        def update(pi_params, q_params, q_target, pi_state, q_state, batch):
+            ql, q_grads = jax.value_and_grad(q_loss)(
+                q_params, q_target, pi_params, batch)
+            q_up, q_state = q_opt.update(q_grads, q_state)
+            q_params = optax.apply_updates(q_params, q_up)
+            pl, pi_grads = jax.value_and_grad(pi_loss)(
+                pi_params, q_params, batch)
+            pi_up, pi_state = pi_opt.update(pi_grads, pi_state)
+            pi_params = optax.apply_updates(pi_params, pi_up)
+            return pi_params, q_params, pi_state, q_state, ql, pl
+
+        self._update = jax.jit(update)
+
+    def train(self) -> dict:
+        if self._update is None:
+            self._build_update()
+        cfg: CRRConfig = self.config  # type: ignore[assignment]
+        t0 = time.time()
+        n = len(self.data["obs"])
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        q_losses, pi_losses = [], []
+        for _ in range(cfg.num_sgd_iter_per_train):
+            idx = rng.integers(0, n, cfg.train_batch_size)
+            # Logged steps are sequential, so obs[i+1] is next_obs within
+            # an episode; at boundaries (dones=1) the bootstrap is masked,
+            # so the wrong-next-obs there never enters the target.
+            batch = {
+                "obs": self.data["obs"][idx],
+                "actions": self.data["actions"][idx],
+                "rewards": self.data["rewards"][idx],
+                "next_obs": self.data["obs"][np.minimum(idx + 1, n - 1)],
+                "dones": self.data["dones"][idx].astype(np.float32),
+            }
+            (self.params, self.q_params, self._opt_state, self._q_opt_state,
+             ql, pl) = self._update(self.params, self.q_params, self.q_target,
+                                    self._opt_state, self._q_opt_state, batch)
+            q_losses.append(float(ql))
+            pi_losses.append(float(pl))
+        self.iteration += 1
+        if self.iteration % cfg.target_update_freq == 0:
+            self.q_target = self.q_params
+        return {
+            "training_iteration": self.iteration,
+            "critic_loss": float(np.mean(q_losses)),
+            "policy_loss": float(np.mean(pi_losses)),
+            "num_samples_trained": cfg.num_sgd_iter_per_train
+            * cfg.train_batch_size,
+            "iter_time_s": round(time.time() - t0, 3),
+        }

@@ -1,0 +1,186 @@
+"""SimpleQ and Ape-X DQN: the two ends of the Q-learning family.
+
+Parity: reference rllib/algorithms/simple_q/ (vanilla Q-learning —
+uniform replay, no double-Q, periodic hard target sync) and
+rllib/algorithms/apex_dqn/ (Ape-X — MANY rollout workers with a
+per-worker epsilon ladder feeding a shared prioritized replay buffer
+asynchronously; the learner consumes batches as they arrive instead of
+lock-stepping with sampling).
+
+Both reuse the DQN machinery (models, rollout workers, jitted update);
+what differs is the replay/synchronization topology — which in this
+runtime is exactly the actor topology, so each variant is a short
+driver.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNRolloutWorker
+from ray_tpu.rllib.replay import PrioritizedReplayBuffer
+
+
+@dataclass
+class SimpleQConfig(DQNConfig):
+    """Vanilla Q-learning (the reference's relationship is mirrored:
+    there DQN extends SimpleQ; here SimpleQ restricts DQN)."""
+
+    double_q: bool = False
+    num_sgd_iter: int = 8
+
+    def build(self) -> "SimpleQ":  # type: ignore[override]
+        return SimpleQ(self)
+
+
+class SimpleQ(DQN):
+    """DQN driver with the vanilla loss (no double-Q selection)."""
+
+
+@dataclass
+class ApexDQNConfig(DQNConfig):
+    """Ape-X: async sampling + prioritized replay (reference:
+    apex_dqn.py; the epsilon ladder is per-worker and constant,
+    eps_i = base ** (1 + i/(n-1) * alpha) — exploration diversity comes
+    from the ladder, not a schedule)."""
+
+    num_rollout_workers: int = 4
+    buffer_capacity: int = 100_000
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    epsilon_base: float = 0.4
+    epsilon_alpha: float = 7.0
+    # learner sgd steps per arriving rollout batch
+    sgd_steps_per_batch: int = 8
+    batches_per_iter: int = 8
+
+    def build(self) -> "ApexDQN":  # type: ignore[override]
+        return ApexDQN(self)
+
+
+class ApexDQN(DQN):
+    def __init__(self, config: ApexDQNConfig):
+        super().__init__(config)
+        # Prioritized buffer replaces the uniform one.
+        self.buffer = PrioritizedReplayBuffer(
+            config.buffer_capacity, self.obs_size, config.seed,
+            alpha=config.per_alpha, beta=config.per_beta)
+        n = max(1, config.num_rollout_workers)
+        self._epsilons = [
+            config.epsilon_base ** (1 + i / max(1, n - 1) *
+                                    config.epsilon_alpha)
+            for i in range(n)]
+        self._inflight: dict = {}
+
+    def _launch(self, i: int, host_params):
+        fut = self.workers[i].sample.remote(
+            host_params, self.config.rollout_fragment_length,
+            self._epsilons[i])
+        self._inflight[fut] = i
+
+    def _build_update(self):
+        """Ape-X update: IS-weighted Huber loss that also RETURNS the
+        per-sample TD errors (they become the new priorities)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def q_fn(params, obs):
+            h = jnp.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
+            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+            return h @ params["q"]["w"] + params["q"]["b"]
+
+        def loss_fn(params, target_params, batch):
+            q = q_fn(params, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            q_next_target = q_fn(target_params, batch["next_obs"])
+            a_star = jnp.argmax(q_fn(params, batch["next_obs"]), axis=1)
+            q_next = jnp.take_along_axis(
+                q_next_target, a_star[:, None], axis=1)[:, 0]
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) \
+                * q_next
+            td = q_sel - jax.lax.stop_gradient(target)
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
+                              jnp.abs(td) - 0.5)
+            loss = (batch["weights"] * huber).mean()
+            return loss, td
+
+        def update(params, target_params, opt_state, batch):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch)
+            updates, opt_state = opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._update = jax.jit(update)
+
+    def _sgd_step(self, sample: dict) -> dict:
+        batch = {k: v for k, v in sample.items() if k != "indices"}
+        self.params, self._opt_state, loss, td = self._update(
+            self.params, self.target_params, self._opt_state, batch)
+        return {"loss": float(loss), "td_error": np.asarray(td)}
+
+    def train(self) -> dict:
+        import jax
+
+        if self._update is None:
+            self._build_update()
+        cfg: ApexDQNConfig = self.config  # type: ignore[assignment]
+        t0 = time.time()
+        host_params = jax.tree_util.tree_map(np.asarray, self.params)
+        for i in range(len(self.workers)):
+            if i not in self._inflight.values():
+                self._launch(i, host_params)
+
+        episode_returns: list = []
+        losses: list = []
+        consumed = 0
+        while consumed < cfg.batches_per_iter:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=600)
+            fut = ready[0]
+            i = self._inflight.pop(fut)
+            batch = ray_tpu.get(fut, timeout=60)
+            episode_returns.extend(batch.pop("episode_returns", []))
+            self.buffer.add_batch(batch)
+            self.total_steps += len(batch["obs"])
+            consumed += 1
+            # Relaunch immediately with fresh weights: sampling never
+            # blocks on learning (the Ape-X point).
+            host_params = jax.tree_util.tree_map(np.asarray, self.params)
+            self._launch(i, host_params)
+            if self.buffer.size >= max(cfg.train_batch_size,
+                                       cfg.learning_starts):
+                for _ in range(cfg.sgd_steps_per_batch):
+                    sample = self.buffer.sample(cfg.train_batch_size)
+                    out = self._sgd_step(sample)
+                    losses.append(out["loss"])
+                    self.buffer.update_priorities(
+                        sample["indices"], np.abs(out["td_error"]))
+        self.iteration += 1
+        if self.iteration % cfg.target_network_update_freq == 0:
+            # Functional updates never mutate in place, so aliasing the
+            # current tree IS a snapshot (same as DQN's sync).
+            self.target_params = jax.tree_util.tree_map(
+                lambda x: x, self.params)
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": float(np.mean(episode_returns))
+            if episode_returns else 0.0,
+            "episodes_this_iter": len(episode_returns),
+            "timesteps_this_iter": cfg.batches_per_iter
+            * cfg.rollout_fragment_length,
+            "timesteps_total": self.total_steps,
+            "mean_loss": float(np.mean(losses)) if losses else 0.0,
+            "iter_time_s": round(time.time() - t0, 3),
+        }
