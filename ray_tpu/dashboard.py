@@ -8,6 +8,7 @@ single self-contained HTML page; it runs inside any connected process
 
 Endpoints: /api/version /api/nodes /api/actors /api/jobs /api/tasks
 /api/summary /api/cluster_status /api/submission_jobs /api/logs
+/api/grafana/dashboard (generated Grafana JSON, metrics-module parity)
 /logs/view?node=&name= /api/stacks /api/worker_stats (the last four are
 the reference's log + reporter module data views: per-node log browser
 with tail, all-worker stack dumps, per-worker cpu/rss).
@@ -177,6 +178,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # All-worker stack dumps per node (reference:
                 # dashboard/modules/reporter profiling views / ray stack).
                 data = state.dump_stacks()
+            elif path == "/api/grafana/dashboard":
+                # Generated Grafana dashboard JSON (reference:
+                # dashboard/modules/metrics grafana_dashboard_factory).
+                from ray_tpu.util.grafana import generate_dashboard
+
+                data = generate_dashboard()
             elif path == "/api/worker_stats":
                 data = []
                 for node in state.worker_stats():

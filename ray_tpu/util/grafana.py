@@ -1,0 +1,102 @@
+"""Grafana dashboard generation from the metric registry.
+
+Parity: reference dashboard/modules/metrics/ — the reference ships
+pre-built Grafana dashboard JSON (grafana_dashboard_factory.py builds
+"default" and "serve" dashboards from panel templates targeting the
+Prometheus datasource). Here panels are generated from what is actually
+registered: every `ray_tpu.util.metrics` Counter becomes a rate() graph,
+every Gauge a timeseries, every Histogram a p50/p95/p99
+histogram_quantile panel, plus a fixed core-health row (nodes, workers,
+task throughput) that exists whether or not user code registered
+metrics. Serve at `/api/grafana/dashboard` (dashboard.py) or write to
+disk for provisioning:
+
+    from ray_tpu.util.grafana import write_dashboard
+    write_dashboard("/etc/grafana/provisioning/dashboards/ray_tpu.json")
+"""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu.util import metrics as _metrics
+
+# Core panels always present. Every expr targets a metric name the
+# dashboard's /metrics endpoint actually emits (ray_tpu/util/metrics.py
+# exporter — names verified against it; tests/test_job_dashboard.py
+# cross-checks the two stay in sync).
+_CORE_PANELS = [
+    ("Cluster nodes", "gauge", "ray_tpu_cluster_nodes_alive"),
+    ("Workers per node", "timeseries", "ray_tpu_node_workers"),
+    ("Lease queue depth", "timeseries", "ray_tpu_node_pending_leases"),
+    ("Task throughput", "timeseries",
+     'rate(ray_tpu_tasks{state="FINISHED"}[1m])'),
+    ("Object store bytes", "timeseries", "ray_tpu_store_bytes_in_use"),
+    ("Actors by state", "timeseries", "ray_tpu_actors"),
+]
+
+
+def _panel(panel_id: int, title: str, kind: str, expr: str,
+           x: int, y: int) -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "gauge" if kind == "gauge" else "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "targets": [{"expr": expr, "refId": "A",
+                     "legendFormat": "{{instance}}"}],
+    }
+
+
+def generate_dashboard(title: str = "ray_tpu") -> dict:
+    """Build a complete Grafana dashboard JSON model (schema v36-ish —
+    importable via the Grafana UI or file provisioning)."""
+    panels = []
+    pid = 1
+    x = y = 0
+
+    def place(title_, kind, expr):
+        nonlocal pid, x, y
+        panels.append(_panel(pid, title_, kind, expr, x, y))
+        pid += 1
+        x = 12 if x == 0 else 0
+        if x == 0:
+            y += 8
+
+    for title_, kind, expr in _CORE_PANELS:
+        place(title_, kind, expr)
+
+    with _metrics._registry_lock:
+        registered = {name: type(m).__name__
+                      for name, m in _metrics._registry.items()}
+    for name, kind in sorted(registered.items()):
+        if kind == "Counter":
+            place(f"{name} (rate)", "timeseries", f"rate({name}_total[1m])")
+        elif kind == "Histogram":
+            for q in ("0.5", "0.95", "0.99"):
+                place(f"{name} p{int(float(q) * 100)}", "timeseries",
+                      f"histogram_quantile({q}, "
+                      f"rate({name}_bucket[5m]))")
+        else:
+            place(name, "timeseries", name)
+
+    return {
+        "title": title,
+        "uid": f"{title}-autogen",
+        "schemaVersion": 36,
+        "version": 1,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus", "label": "Datasource",
+        }]},
+        "panels": panels,
+    }
+
+
+def write_dashboard(path: str, title: str = "ray_tpu") -> str:
+    with open(path, "w") as f:
+        json.dump(generate_dashboard(title), f, indent=2)
+    return path

@@ -208,3 +208,47 @@ def test_dashboard_log_and_reporter_views(ray_start_regular):
         assert stacks and any(n.get("workers") for n in stacks)
     finally:
         dashboard.stop()
+
+
+def test_grafana_dashboard_generation():
+    """Generated Grafana JSON (reference: dashboard/modules/metrics
+    grafana_dashboard_factory): core panels always present, registered
+    user metrics appended with type-appropriate queries."""
+    from ray_tpu.util import metrics
+    from ray_tpu.util.grafana import generate_dashboard, write_dashboard
+
+    metrics.Counter("graftest_requests", "test counter")
+    metrics.Histogram("graftest_latency", "test histogram",
+                      boundaries=[0.1, 1.0])
+    dash = generate_dashboard()
+    assert dash["schemaVersion"] >= 30 and dash["panels"]
+    titles = [p["title"] for p in dash["panels"]]
+    assert any("Task throughput" in t for t in titles)
+    # Every core panel must target a metric the /metrics exporter can
+    # actually emit — keep grafana.py and metrics.py mechanically in
+    # sync (a renamed gauge must fail here, not show 'No data' live).
+    import inspect
+    import re
+
+    from ray_tpu.util import metrics as _metrics
+    from ray_tpu.util import grafana as _grafana
+
+    exporter_src = inspect.getsource(_metrics)
+    for _title, _kind, expr in _grafana._CORE_PANELS:
+        base = re.findall(r"ray_tpu_[a-z_]+", expr)[0]
+        assert base in exporter_src, f"core panel metric {base} not exported"
+    exprs = [p["targets"][0]["expr"] for p in dash["panels"]]
+    assert any("rate(graftest_requests_total[1m])" in e for e in exprs)
+    assert any("histogram_quantile(0.95" in e and "graftest_latency" in e
+               for e in exprs)
+    # Every panel targets the templated prometheus datasource.
+    assert all(p["datasource"]["uid"] == "${datasource}"
+               for p in dash["panels"])
+
+    import json as _json
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", mode="r") as f:
+        write_dashboard(f.name)
+        model = _json.load(open(f.name))
+    assert model["uid"] == "ray_tpu-autogen"
