@@ -253,11 +253,75 @@ def ppo_throughput(iters: int, num_workers: int, model: str = "mlp",
         algo.stop()
 
 
+def queued_tasks_envelope(num_tasks: int) -> dict:
+    """Queue-depth envelope: submit far more tasks than the node can run
+    (1 CPU of execution) and drain them all (reference envelope row:
+    1M+ tasks queued on a single node, release/benchmarks/README.md:30).
+    Exercises the pending-lease queue + batched dispatch under depth,
+    not steady-state rate."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    t0 = time.perf_counter()
+    refs = [noop.remote(i) for i in range(num_tasks)]
+    submit_dt = time.perf_counter() - t0
+    out = ray_tpu.get(refs, timeout=1800)
+    total_dt = time.perf_counter() - t0
+    assert out == list(range(num_tasks))
+    return {"tasks_queued": num_tasks,
+            "submit_per_s": round(num_tasks / submit_dt, 1),
+            "drain_per_s": round(num_tasks / total_dt, 1)}
+
+
+def many_nodes(num_nodes: int, tasks_per_node: int) -> dict:
+    """Cluster-width envelope: a head plus fake worker raylets on one
+    machine (the reference's scalability trick, cluster_utils.Cluster),
+    SPREAD tasks across them, and require every node to execute
+    (reference envelope row: nodes-in-cluster,
+    release/benchmarks/README.md:9)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    try:
+        ray_tpu.init(address=cluster.address)
+        for _ in range(num_nodes - 1):
+            cluster.add_node(num_cpus=1)
+        cluster.wait_for_nodes(num_nodes)
+
+        @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+        def where(i):
+            import ray_tpu as rt
+
+            # Hold the CPU briefly: instant tasks would drain through the
+            # first warm lease before the lease ramp fans out, measuring
+            # pipelining rather than cluster width. The envelope row is
+            # about SIMULTANEOUS work across nodes.
+            time.sleep(0.5)
+            return rt.get_runtime_context().node_id
+
+        t0 = time.perf_counter()
+        homes = ray_tpu.get(
+            [where.remote(i) for i in range(num_nodes * tasks_per_node)],
+            timeout=1800)
+        dt = time.perf_counter() - t0
+        return {"nodes": num_nodes, "nodes_used": len(set(homes)),
+                "tasks": len(homes), "wall_s": round(dt, 1)}
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 ENTRIES["object_broadcast"] = object_broadcast
 ENTRIES["ppo_throughput"] = ppo_throughput
+ENTRIES["queued_tasks_envelope"] = queued_tasks_envelope
+ENTRIES["many_nodes"] = many_nodes
 
 # Workloads that manage their own cluster lifecycle.
-_SELF_MANAGED = {"kill_node_mid_run", "object_broadcast"}
+_SELF_MANAGED = {"kill_node_mid_run", "object_broadcast", "many_nodes"}
 
 
 def _load_manifest() -> dict:
