@@ -268,6 +268,54 @@ class LLMEngine:
                 return out
 
             self._write_prompt_pages = write_prompt_pages
+
+            # ---- batched prefill admission --------------------------------
+            # Sequential slot prefills dominate end-to-end serving at
+            # large batch (each is a full program dispatch; measured on a
+            # real v5e in BENCH_NOTES.md). When several same-bucket
+            # requests are pending, ONE (W, bucket) prefill serves all of
+            # them. W is FIXED (padding with rows that scatter into the
+            # dummy page) so exactly one extra program per bucket
+            # compiles, regardless of arrival pattern.
+            self._batch_prefill_width = min(8, max_batch)
+
+            @jax.jit
+            def prefill_many(params, tokens, last_idx):
+                # tokens: (W, bucket) right-padded; last_idx: (W,) index
+                # of each row's last prompt token. Returns the last-token
+                # logits row per sequence (gathered INSIDE jit: the full
+                # (W, bucket, vocab) logits never reach the host) and the
+                # per-layer (W, Hkv, L, D) caches.
+                positions = jnp.arange(tokens.shape[1])[None, :]
+                caches = init_kv_caches(cfg_, tokens.shape[0], max_len_)
+                logits, new = model.apply(params, tokens, positions,
+                                          kv_caches=caches)
+                last = jnp.take_along_axis(
+                    logits, last_idx[:, None, None], axis=1)[:, 0]
+                return last, [(k, v) for k, v, _l in new]
+
+            self._prefill_many = prefill_many
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def write_prompt_pages_many(pools, kv_many, page_ids):
+                # Batched variant of write_prompt_pages: kv_many per
+                # layer (W, Hkv, L, D), page_ids (W, L/ps). Rows flatten
+                # into one scatter; padding rows target the dummy page.
+                out = []
+                flat = page_ids.reshape(-1)
+                for (kp, vp), (k1, v1) in zip(pools, kv_many):
+                    W_, Hkv_, L_, D_ = k1.shape
+                    kpg = k1.reshape(W_, Hkv_, L_ // ps_, ps_, D_) \
+                        .transpose(0, 2, 1, 3, 4) \
+                        .reshape(-1, Hkv_, ps_, D_)
+                    vpg = v1.reshape(W_, Hkv_, L_ // ps_, ps_, D_) \
+                        .transpose(0, 2, 1, 3, 4) \
+                        .reshape(-1, Hkv_, ps_, D_)
+                    out.append((kp.at[flat].set(kpg),
+                                vp.at[flat].set(vpg)))
+                return out
+
+            self._write_prompt_pages_many = write_prompt_pages_many
             self._deferred: list = []  # pool-dry admissions, FIFO retry
 
         # ---- engine state (host-managed; device caches stacked by slot) --
@@ -361,10 +409,11 @@ class LLMEngine:
         return min(b, self.max_len)
 
     def _admit(self, prompt: np.ndarray, handle: RequestHandle):
+        """DENSE-mode admission. Paged admissions go through
+        _reserve_paged + _admit_paged_group in the loop instead."""
+        assert not self.page_size
         jnp = self._jnp
         slot = next(i for i, s in enumerate(self._slots) if s.request is None)
-        if self.page_size:
-            return self._admit_paged(slot, prompt, handle)
         # Chunked only when the chunk GRID fits the cache: the final
         # chunk's write window [start, start+C) must not run past max_len,
         # where dynamic_update_slice clamping would silently relocate it
@@ -410,6 +459,13 @@ class LLMEngine:
         tok = int(np.asarray(self._sample(
             first_logits[None], np.float32([sp.temperature]),
             np.int32([sp.top_k]), np.float32([sp.top_p]), srng))[0])
+        self._commit_token(slot, handle, tok, prompt_len)
+
+    def _commit_token(self, slot: int, handle: RequestHandle, tok: int,
+                      prompt_len: int):
+        """Commit an already-sampled first token + per-slot decode state
+        (batched admission samples a whole group in one dispatch)."""
+        sp = handle.sampling
         self._lens[slot] = prompt_len
         self._pos[slot] = prompt_len
         self._token[slot] = tok
@@ -422,29 +478,102 @@ class LLMEngine:
         st.prefill_prompt = None
         self._emit(slot, tok)
 
-    def _admit_paged(self, slot: int, prompt: np.ndarray,
-                     handle: RequestHandle):
-        """Paged admission: reserve pages for the stream's WHOLE lifetime
-        (prompt + max_new + chunk overshoot) up front, so decode can
-        never fail mid-stream on an empty pool; MemoryError here defers
-        the request instead (admission control by resident tokens)."""
-        jnp = self._jnp
+    def _reserve_paged(self, slot: int, prompt: np.ndarray,
+                       handle: RequestHandle) -> str:
+        """Reserve pages for the stream's WHOLE lifetime (prompt +
+        max_new + chunk overshoot) up front, so decode can never fail
+        mid-stream on an empty pool; MemoryError here defers the request
+        instead (admission control by resident tokens)."""
         sp = handle.sampling
         st = self._slots[slot]
         seq_id = f"slot{slot}-{id(handle):x}"
         need = len(prompt) + sp.max_new_tokens + self.decode_chunk
         self._alloc.allocate(seq_id, need)  # MemoryError -> caller defers
         st.seq_id = seq_id
-        try:
-            logits = self._prefill_into_pages(slot, seq_id, prompt)
-        except BaseException:
-            # A failed prefill (device OOM, ...) must return the pages —
-            # the next admission overwrites st.seq_id and they would
-            # leak from the pool forever.
-            self._free_slot_pages(slot)
-            raise
-        self._commit_first_token(slot, handle,
-                                 logits[len(prompt) - 1], len(prompt))
+        return seq_id
+
+    def _admit_paged_group(self, cands: list) -> None:
+        """Prefill reserved candidates, batching same-bucket requests
+        through the fixed-width prefill_many program (one dispatch for
+        up to _batch_prefill_width streams). Singleton groups keep the
+        single-sequence program. cands: (slot, seq_id, prompt, handle)
+        with pages already reserved."""
+        jnp = self._jnp
+        groups: dict = {}
+        for c in cands:
+            bucket = max(self._bucket(len(c[2])), self.page_size)
+            groups.setdefault(bucket, []).append(c)
+        for bucket, group in groups.items():
+            while group:
+                chunk = group[: self._batch_prefill_width]
+                group = group[len(chunk):]
+                if len(chunk) == 1:
+                    slot, seq_id, prompt, handle = chunk[0]
+                    try:
+                        logits = self._prefill_into_pages(slot, seq_id,
+                                                          prompt)
+                        # _commit_first_token dispatches _sample: it must
+                        # be covered too, or a transient device error
+                        # kills the engine thread and strands every
+                        # waiter (no sentinel ever lands).
+                        self._commit_first_token(slot, handle,
+                                                 logits[len(prompt) - 1],
+                                                 len(prompt))
+                    except BaseException as e:
+                        self._free_slot_pages(slot)
+                        handle.error = e
+                        handle._q.put(_SENTINEL)
+                    continue
+                W = self._batch_prefill_width
+                npages_row = self.max_len // self.page_size
+                tokens = np.zeros((W, bucket), np.int32)
+                last_idx = np.zeros((W,), np.int32)
+                page_rows = np.full((W, npages_row), self._dummy_page,
+                                    np.int32)
+                rows = []
+                for r, (slot, seq_id, prompt, handle) in enumerate(chunk):
+                    tokens[r, : len(prompt)] = prompt
+                    last_idx[r] = len(prompt) - 1
+                    row = np.asarray(self._alloc.table(seq_id,
+                                                       self._np_pages))
+                    rows.append(row)
+                    npp = self._alloc.pages_needed(len(prompt))
+                    page_rows[r, :npp] = row[:npp]
+                # Sampling params padded to the FIXED width W: a partial
+                # group must not compile its own (n, V) _sample variant.
+                temps = np.zeros(W, np.float32)
+                topks = np.zeros(W, np.int32)
+                topps = np.ones(W, np.float32)
+                for r, c in enumerate(chunk):
+                    temps[r] = c[3].sampling.temperature
+                    topks[r] = c[3].sampling.top_k
+                    topps[r] = c[3].sampling.top_p
+                try:
+                    last_logits, kv_many = self._prefill_many(
+                        self.params, jnp.asarray(tokens),
+                        jnp.asarray(last_idx))
+                    self._pools = self._write_prompt_pages_many(
+                        self._pools, kv_many, jnp.asarray(page_rows))
+                    # ONE sampling dispatch + host sync for the whole
+                    # group (the sequential path pays one per request;
+                    # greedy stays bit-equal — argmax ignores the rng
+                    # mapping).
+                    self._rng, srng = self._jax.random.split(self._rng)
+                    toks = np.asarray(self._sample(
+                        last_logits, temps, topks, topps, srng))
+                except BaseException as e:
+                    # Device-level failure sinks the whole dispatch: fail
+                    # every member and return their pages.
+                    for slot, seq_id, prompt, handle in chunk:
+                        self._free_slot_pages(slot)
+                        handle.error = e
+                        handle._q.put(_SENTINEL)
+                    continue
+                # Host-only from here: no device call can strand waiters.
+                for r, (slot, seq_id, prompt, handle) in enumerate(chunk):
+                    self._tables[slot] = rows[r]
+                    self._commit_token(slot, handle, int(toks[r]),
+                                       len(prompt))
 
     def _init_paged_state(self):
         """(Re)build the page pool: allocator + dummy page + zeroed
@@ -544,32 +673,57 @@ class LLMEngine:
             # Admit as many pending requests as there are free slots —
             # without stalling slots that are mid-decode. Paged mode also
             # gates on pool pages: a dry pool defers the request (FIFO)
-            # until completions free pages.
-            while any(s.request is None for s in self._slots):
+            # until completions free pages. Paged admissions gathered in
+            # one pass PREFILL TOGETHER (see _admit_paged_group) —
+            # sequential slot prefills were the measured end-to-end
+            # serving bottleneck at large batch.
+            paged_cands: list = []
+            picked: set = set()
+            while any(i not in picked and s.request is None
+                      for i, s in enumerate(self._slots)):
                 from_deferred = bool(self.page_size and self._deferred)
                 if from_deferred:
                     prompt, handle = self._deferred[0]
                 else:
                     try:
                         prompt, handle = self._pending.get(
-                            block=(self.num_active() == 0), timeout=0.05)
+                            block=(self.num_active() == 0
+                                   and not paged_cands), timeout=0.05)
                     except queue.Empty:
                         break
+                if not self.page_size:
+                    try:
+                        self._admit(prompt, handle)
+                        if from_deferred:
+                            self._deferred.pop(0)
+                    except Exception as e:  # surfacing beats a dead stream
+                        if from_deferred:
+                            self._deferred.pop(0)
+                        handle.error = e
+                        handle._q.put(_SENTINEL)
+                    continue
+                slot = next(i for i, s in enumerate(self._slots)
+                            if s.request is None and i not in picked)
                 try:
-                    self._admit(prompt, handle)
-                    if from_deferred:
-                        self._deferred.pop(0)
+                    seq_id = self._reserve_paged(slot, prompt, handle)
                 except MemoryError:
                     # Pool dry: keep FIFO order and stop admitting until
                     # a completion frees pages.
                     if not from_deferred:
                         self._deferred.append((prompt, handle))
                     break
-                except Exception as e:  # surfacing beats a dead stream
+                except Exception as e:
                     if from_deferred:
                         self._deferred.pop(0)
                     handle.error = e
                     handle._q.put(_SENTINEL)
+                    continue
+                if from_deferred:
+                    self._deferred.pop(0)
+                picked.add(slot)
+                paged_cands.append((slot, seq_id, prompt, handle))
+            if paged_cands:
+                self._admit_paged_group(paged_cands)
             if self.num_active() == 0:
                 continue
             # Advance ONE chunk of ONE prefilling slot per tick — long

@@ -45,10 +45,14 @@ def main():
         prompts = [list(rng.integers(1, cfg.vocab_size, 64))
                    for _ in range(batch)]
         sp = SamplingParams(max_new_tokens=new_tokens, temperature=0.0)
-        # Warm: compile prefill + decode programs on one short request.
-        engine.submit(prompts[0][:64],
-                      SamplingParams(max_new_tokens=8,
-                                     temperature=0.0)).tokens()
+        # Warm: compile the batched prefill + decode programs with a
+        # burst (a single warm request would leave prefill_many's first
+        # compile inside the timed window).
+        warm = [engine.submit(p[:64], SamplingParams(max_new_tokens=8,
+                                                     temperature=0.0))
+                for p in prompts[: min(len(prompts), 8)]]
+        for h in warm:
+            h.tokens()
 
         t0 = time.perf_counter()
         handles = [engine.submit(p, sp) for p in prompts]

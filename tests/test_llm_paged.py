@@ -99,3 +99,37 @@ def test_paged_pages_freed_on_completion(tiny_model):
         assert eng._alloc.free_pages == baseline
     finally:
         eng.shutdown()
+
+
+def test_batched_prefill_used_and_bit_equal(tiny_model):
+    """A burst of same-bucket requests must go through the fixed-width
+    prefill_many program (one dispatch for the group) AND stay greedy
+    bit-equal to the one-shot Generator — batched rows may not perturb
+    single-sequence numerics."""
+    cfg, params = tiny_model
+    eng = LLMEngine(cfg, params, max_batch=4, max_len=96, page_size=16)
+    calls = {"many": 0, "one": 0}
+    real_many, real_one = eng._prefill_many, eng._prefill_one
+
+    def spy_many(*a, **k):
+        calls["many"] += 1
+        return real_many(*a, **k)
+
+    def spy_one(*a, **k):
+        calls["one"] += 1
+        return real_one(*a, **k)
+
+    eng._prefill_many, eng._prefill_one = spy_many, spy_one
+    try:
+        # Same bucket (lengths 3-5 pad to one bucket of >= page_size).
+        prompts = [[1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11, 12], [13, 14, 15]]
+        expected = [_reference_greedy(cfg, params, p, 8) for p in prompts]
+        handles = [eng.submit(p, SamplingParams(max_new_tokens=8))
+                   for p in prompts]
+        assert [h.tokens() for h in handles] == expected
+        assert calls["many"] >= 1, (
+            "burst of same-bucket admissions never used the batched "
+            f"prefill program (calls={calls})")
+    finally:
+        eng._prefill_many, eng._prefill_one = real_many, real_one
+        eng.shutdown()
