@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import threading
 import time
 from collections import defaultdict, deque
 
@@ -61,6 +62,13 @@ class GcsServer:
         # (reference: NotifyGCSRestart resync, node_manager.cc:1168).
         self.persistence_path = persistence_path
         self._dirty = False
+        # Native durable table store (src/gcs_store.cc): rows are written
+        # through as WAL appends on each flush — only CHANGED rows hit
+        # disk (hash-diffed), and a compaction rewrites the snapshot when
+        # the WAL outgrows it. Opened in start().
+        self._store = None
+        self._row_hashes: dict[tuple[str, str], int] = {}
+        self._flush_lock = threading.Lock()
         self.nodes: dict[str, NodeInfo] = {}
         self.node_conns: dict[str, rpc.Connection] = {}
         self.kv: dict[str, dict[bytes, bytes]] = defaultdict(dict)
@@ -141,6 +149,9 @@ class GcsServer:
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         if self.persistence_path:
+            from ray_tpu._private.native_gcs_store import GcsTableStore
+
+            self._store = GcsTableStore(self.persistence_path)
             self._load_state()
             from ray_tpu.util import events
 
@@ -159,64 +170,120 @@ class GcsServer:
             self._health_task.cancel()
         if getattr(self, "_persist_task", None):
             self._persist_task.cancel()
-        if self.persistence_path and self._dirty:
-            # Flush acknowledged mutations from the last <0.5s window.
+        if self._store is not None:
+            # Flush acknowledged mutations from the last <0.5s window,
+            # then compact so restart replays a snapshot, not a long WAL.
             try:
-                tmp = f"{self.persistence_path}.tmp"
-                with open(tmp, "wb") as f:
-                    f.write(rpc.pack(self._snapshot()))
-                os.replace(tmp, self.persistence_path)
+                if self._dirty:
+                    self._flush_rows(self._table_rows())
+                self._store.compact()
             except Exception:
                 logger.exception("final GCS persistence flush failed")
+            self._store.close()
         await self._server.stop()
 
     # ---------- persistence ----------
+    # Tables persist as (namespace, key) -> msgpack'd row in the native
+    # WAL store (src/gcs_store.cc — the reference's gcs_table_storage /
+    # store_client role). Flushes are row-INCREMENTAL: rows are packed
+    # and hash-diffed against the last flush, so disk writes are O(rows
+    # changed), not O(cluster state), and a restart replays snapshot +
+    # WAL. Store keys are hex (binary-safe for user internal_kv keys).
 
     def mark_dirty(self):
         self._dirty = True
 
-    def _snapshot(self) -> dict:
-        import copy
-
-        return copy.deepcopy(self._snapshot_live())
-
-    def _snapshot_live(self) -> dict:
-        actors = {}
+    def _table_rows(self) -> dict:
+        """Pack the live tables into {(namespace, hex_key): row_bytes}."""
+        rows: dict[tuple[str, str], bytes] = {}
+        for ns, table in self.kv.items():
+            for k, v in table.items():
+                rows[("kv", rpc.pack([ns, k]).hex())] = rpc.pack(v)
         for aid, a in self.actors.items():
             a = dict(a)
             if isinstance(a.get("dead_worker_ids"), set):
                 a["dead_worker_ids"] = sorted(a["dead_worker_ids"])
-            actors[aid] = a
-        return {
-            "kv": {ns: dict(table) for ns, table in self.kv.items()},
-            "actors": actors,
-            "named_actors": [[list(k), v] for k, v in self.named_actors.items()],
-            "jobs": self.jobs,
-            "placement_groups": self.placement_groups,
-            "nodes": [n.to_wire() for n in self.nodes.values()],
-        }
+            rows[("actors", aid.encode().hex())] = rpc.pack(a)
+        for k, v in self.named_actors.items():
+            rows[("named_actors", rpc.pack(list(k)).hex())] = rpc.pack(v)
+        for jid, j in self.jobs.items():
+            rows[("jobs", jid.encode().hex())] = rpc.pack(j)
+        for pgid, pg in self.placement_groups.items():
+            rows[("placement_groups", pgid.encode().hex())] = rpc.pack(pg)
+        for n in self.nodes.values():
+            rows[("nodes", n.node_id.encode().hex())] = rpc.pack(n.to_wire())
+        return rows
+
+    def _flush_rows(self, rows: dict) -> int:
+        """Write changed rows through to the native store; delete rows
+        that vanished. Returns the number of rows touched. Serialized by
+        a lock: stop()'s final flush may overlap a cancelled-but-still-
+        running to_thread flush, and the two must not race the hash map.
+        A failed WAL append (disk full) leaves the row unhashed so a
+        later flush retries it."""
+        with self._flush_lock:
+            touched = 0
+            failed = 0
+            for (ns, key), blob in rows.items():
+                h = hash(blob)
+                if self._row_hashes.get((ns, key)) != h:
+                    if self._store.put(ns, key, blob):
+                        self._row_hashes[(ns, key)] = h
+                    else:
+                        self._row_hashes.pop((ns, key), None)
+                        failed += 1
+                        self._dirty = True  # retry next window
+                    touched += 1
+            for (ns, key) in list(self._row_hashes):
+                if (ns, key) not in rows:
+                    if self._store.delete(ns, key):
+                        del self._row_hashes[(ns, key)]
+                    else:
+                        failed += 1
+                        self._dirty = True
+                    touched += 1
+            if failed:
+                logger.error("GCS persistence: %d row writes failed "
+                             "(disk full?); will retry", failed)
+            return touched
 
     def _load_state(self):
-        try:
-            with open(self.persistence_path, "rb") as f:
-                snap = rpc.unpack(f.read())
-        except FileNotFoundError:
+        if self._store.num_rows() == 0:
+            # A file AT the bare prefix is the pre-WAL single-snapshot
+            # format (replaced this round); it is not migrated — surface
+            # that instead of silently starting fresh over it.
+            if os.path.exists(self.persistence_path):
+                logger.warning(
+                    "found legacy single-file GCS snapshot at %s; the WAL "
+                    "store does not migrate it — starting fresh",
+                    self.persistence_path)
             return  # first start of this session
-        except Exception:
-            logger.exception("GCS persistence read failed; starting fresh")
-            return
-
-        for ns, table in snap.get("kv", {}).items():
-            self.kv[ns] = {(k if isinstance(k, bytes) else k.encode()): v
-                           for k, v in table.items()}
-        for aid, a in snap.get("actors", {}).items():
+        for key_hex, blob in self._store.scan("kv"):
+            ns, k = rpc.unpack(bytes.fromhex(key_hex))
+            k = k if isinstance(k, bytes) else k.encode()
+            self.kv[ns][k] = rpc.unpack(blob)
+            self._row_hashes[("kv", key_hex)] = hash(blob)
+        for key_hex, blob in self._store.scan("actors"):
+            a = rpc.unpack(blob)
             a["dead_worker_ids"] = set(a.get("dead_worker_ids", ()))
-            self.actors[aid] = a
-        for k, v in snap.get("named_actors", []):
-            self.named_actors[tuple(k)] = v
-        self.jobs.update(snap.get("jobs", {}))
-        self.placement_groups.update(snap.get("placement_groups", {}))
-        for w in snap.get("nodes", []):
+            self.actors[bytes.fromhex(key_hex).decode()] = a
+            self._row_hashes[("actors", key_hex)] = hash(blob)
+        for key_hex, blob in self._store.scan("named_actors"):
+            self.named_actors[tuple(rpc.unpack(bytes.fromhex(key_hex)))] = \
+                rpc.unpack(blob)
+            self._row_hashes[("named_actors", key_hex)] = hash(blob)
+        for key_hex, blob in self._store.scan("jobs"):
+            self.jobs[bytes.fromhex(key_hex).decode()] = rpc.unpack(blob)
+            self._row_hashes[("jobs", key_hex)] = hash(blob)
+        for key_hex, blob in self._store.scan("placement_groups"):
+            self.placement_groups[bytes.fromhex(key_hex).decode()] = \
+                rpc.unpack(blob)
+            self._row_hashes[("placement_groups", key_hex)] = hash(blob)
+        snap_nodes = []
+        for key_hex, blob in self._store.scan("nodes"):
+            snap_nodes.append(rpc.unpack(blob))
+            self._row_hashes[("nodes", key_hex)] = hash(blob)
+        for w in snap_nodes:
             info = NodeInfo(
                 node_id=w["node_id"], host=w["host"],
                 raylet_port=w["raylet_port"],
@@ -273,15 +340,17 @@ class GcsServer:
                 continue
             self._dirty = False
             try:
-                snap = self._snapshot()  # consistent view, on the loop
-
-                def write(snap=snap):
-                    tmp = f"{self.persistence_path}.tmp"
-                    with open(tmp, "wb") as f:
-                        f.write(rpc.pack(snap))
-                    os.replace(tmp, self.persistence_path)
-
-                await asyncio.to_thread(write)
+                # Pack rows ON the loop (consistent view of the tables —
+                # same role the old deepcopy played, at similar cost);
+                # the diff + WAL writes run off-loop (the store is
+                # thread-safe).
+                rows = self._table_rows()
+                await asyncio.to_thread(self._flush_rows, rows)
+                # Compact once the WAL outgrows the state: replay stays
+                # bounded and old row versions don't accumulate.
+                if self._store.wal_bytes() > max(
+                        1 << 20, 4 * sum(len(b) for b in rows.values())):
+                    await asyncio.to_thread(self._store.compact)
             except Exception:
                 logger.exception("GCS persistence write failed")
 

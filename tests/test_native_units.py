@@ -27,6 +27,7 @@ def test_cpp_unit_tests():
     assert "store_test: OK" in res.stdout
     assert "scheduler_test: OK" in res.stdout
     assert "raylet_core_test: all passed" in res.stdout
+    assert "gcs_store_test: all passed" in res.stdout
 
 
 @pytest.mark.skipif(os.environ.get("RAY_TPU_SANITIZE") != "1",
