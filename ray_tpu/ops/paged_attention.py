@@ -37,27 +37,19 @@ def _interpret_mode() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _online_softmax_page_step(pi, num_page_steps, length, q, k, v,
-                              o_write, m_scratch, l_scratch, acc_scratch,
-                              *, page_size: int, sm_scale: float):
-    """One grid step of paged online-softmax attention, shared by the
-    single-sequence and grid-batched kernels.
+def _online_softmax_update(pi, length, q, k, v, m_prev, l_prev, acc_prev,
+                           *, page_size: int, sm_scale: float):
+    """One page of the online-softmax recurrence, shared by EVERY paged
+    kernel variant (single-sequence, grid-batched, fused-heads) so a
+    numerics change cannot silently miss one of them.
 
-    The KV head rides the GRID in both callers, so every dot here is a
-    plain 2D (G, D) x (page, D) matmul: Mosaic lowers 2D dots onto the
-    MXU but rejects the batched `hgd,thd` einsum form ("batch dims must
-    be equal" on real TPU; caught by scripts/tpu_kernel_sweep.py
-    on-chip validation).
-
-    pi: page-step program id; q: (G, D); k/v: (page, D); o_write:
-    callback writing the normalized (G, D) output on the last step.
+    Pure function of values: callers own the scratch-ref IO (the fused
+    kernel updates row SLICES of shared scratch). Every dot is a plain
+    2D (G, D) x (page, D) matmul: Mosaic lowers 2D dots onto the MXU
+    but rejects the batched `hgd,thd` einsum form ("batch dims must be
+    equal" on real TPU; caught by scripts/tpu_kernel_sweep.py on-chip
+    validation). Returns (m_new, l_new, acc_new).
     """
-    @pl.when(pi == 0)
-    def _init():
-        m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
-        l_scratch[...] = jnp.zeros_like(l_scratch)
-        acc_scratch[...] = jnp.zeros_like(acc_scratch)
-
     # scores[g, t] = q[g, :] . k[t, :]  — 2D dot, MXU-safe
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ()))) * sm_scale
@@ -65,22 +57,43 @@ def _online_softmax_page_step(pi, num_page_steps, length, q, k, v,
         jnp.int32, scores.shape, 1)
     scores = jnp.where(token_idx < length, scores, _NEG_INF)
 
-    m_prev = m_scratch[...]                     # (G, 1)
     m_cur = jnp.max(scores, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     alpha = jnp.exp(m_prev - m_new)
     p = jnp.exp(scores - m_new)                 # (G, page)
-    l_new = alpha * l_scratch[...] + jnp.sum(p, axis=-1, keepdims=True)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
     pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))  # (G, D)
-    acc_scratch[...] = acc_scratch[...] * alpha + pv
+    return m_new, l_new, acc_prev * alpha + pv
+
+
+def _normalized(l, acc):
+    """Final softmax normalization with the all-masked guard (l == 0)."""
+    return acc / jnp.where(l == 0.0, 1.0, l)
+
+
+def _online_softmax_page_step(pi, num_page_steps, length, q, k, v,
+                              o_write, m_scratch, l_scratch, acc_scratch,
+                              *, page_size: int, sm_scale: float):
+    """One grid step over whole-scratch refs (single-sequence and
+    head-on-grid batched kernels). pi: page-step program id; q: (G, D);
+    k/v: (page, D); o_write: callback writing the normalized (G, D)
+    output on the last step."""
+    @pl.when(pi == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    m_new, l_new, acc_new = _online_softmax_update(
+        pi, length, q, k, v, m_scratch[...], l_scratch[...],
+        acc_scratch[...], page_size=page_size, sm_scale=sm_scale)
     m_scratch[...] = m_new
     l_scratch[...] = l_new
+    acc_scratch[...] = acc_new
 
     @pl.when(pi == num_page_steps - 1)
     def _finish():
-        l = l_scratch[...]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_write((acc_scratch[...] / l_safe))
+        o_write(_normalized(l_scratch[...], acc_scratch[...]))
 
 
 def _paged_decode_kernel(page_table_ref, length_ref,  # scalar prefetch
@@ -177,8 +190,48 @@ def _paged_decode_batch_kernel(page_table_ref, length_ref,  # scalar prefetch
         page_size=page_size, sm_scale=sm_scale)
 
 
+def _paged_decode_batch_fused_kernel(page_table_ref, length_ref,  # prefetch
+                                     q_ref, k_ref, v_ref, o_ref,
+                                     m_scratch, l_scratch, acc_scratch,
+                                     *, page_size: int, num_heads: int,
+                                     groups: int, sm_scale: float):
+    # Grid: (B, npages) — each step DMAs a FULL pool page (all Hkv heads
+    # contiguous in the (P, Hkv, page, D) layout) and unrolls a static
+    # per-head loop of 2D dots. Hkv-times fewer grid steps and
+    # Hkv-times larger transfers than the head-on-grid variant: this
+    # kernel is DMA-bound, so transfer size sets throughput.
+    b = pl.program_id(0)
+    pi = pl.program_id(1)
+    length = length_ref[b]
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scratch[...] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[...] = jnp.zeros_like(l_scratch)
+        acc_scratch[...] = jnp.zeros_like(acc_scratch)
+
+    for h in range(num_heads):      # static: unrolled at trace time
+        rows = slice(h * groups, (h + 1) * groups)
+        m_new, l_new, acc_new = _online_softmax_update(
+            pi, length,
+            q_ref[0, h].astype(jnp.float32),       # (G, D)
+            k_ref[0, h].astype(jnp.float32),       # (page, D)
+            v_ref[0, h].astype(jnp.float32),
+            m_scratch[rows], l_scratch[rows], acc_scratch[rows],
+            page_size=page_size, sm_scale=sm_scale)
+        m_scratch[rows] = m_new
+        l_scratch[rows] = l_new
+        acc_scratch[rows] = acc_new
+
+    @pl.when(pi == pl.num_programs(1) - 1)
+    def _finish():
+        o_ref[0] = _normalized(l_scratch[...],
+                               acc_scratch[...]).astype(o_ref.dtype)
+
+
 def paged_decode_attention_batch(q, k_pool, v_pool, page_tables, lengths,
-                                 *, sm_scale: float | None = None):
+                                 *, sm_scale: float | None = None,
+                                 fused_heads: bool = False):
     """Batched single-token decode attention over paged KV.
 
     The batch dimension is a leading GRID axis (not vmap — scalar-prefetch
@@ -190,6 +243,12 @@ def paged_decode_attention_batch(q, k_pool, v_pool, page_tables, lengths,
                  (head-then-page minor layout; see paged_decode_attention)
     page_tables: (B, NP) int32 pool indices per sequence
     lengths:     (B,) int32 valid token counts (incl. current tokens)
+    fused_heads: one grid step per (sequence, page) covering ALL KV
+                 heads (full-page contiguous DMA, Hkv-times fewer grid
+                 steps) vs one per (sequence, head, page). Default stays
+                 False until the fused variant passes on-chip Mosaic
+                 validation (scripts/tpu_kernel_sweep.py) — interpret
+                 mode has accepted kernels real TPU rejects before.
     Returns (B, H, D).
     """
     B, H, D = q.shape
@@ -200,6 +259,37 @@ def paged_decode_attention_batch(q, k_pool, v_pool, page_tables, lengths,
         sm_scale = 1.0 / (D ** 0.5)
 
     q4 = q.reshape(B, Hkv, groups, D)
+    if fused_heads:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, npages),
+            in_specs=[
+                pl.BlockSpec((1, Hkv, groups, D),
+                             lambda b, i, pt, ln: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, page_size, D),
+                             lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, page_size, D),
+                             lambda b, i, pt, ln: (pt[b, i], 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, Hkv * groups, D),
+                                   lambda b, i, pt, ln: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((Hkv * groups, 1), jnp.float32),
+                pltpu.VMEM((Hkv * groups, 1), jnp.float32),
+                pltpu.VMEM((Hkv * groups, D), jnp.float32),
+            ],
+        ) if pltpu else None
+        out = pl.pallas_call(
+            functools.partial(_paged_decode_batch_fused_kernel,
+                              page_size=page_size, num_heads=Hkv,
+                              groups=groups, sm_scale=sm_scale),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, Hkv * groups, D), q.dtype),
+            interpret=_interpret_mode(),
+        )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+          q4, k_pool, v_pool)
+        return out.reshape(B, H, D)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, Hkv, npages),

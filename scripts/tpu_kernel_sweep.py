@@ -74,9 +74,10 @@ def check_flash():
     return ok
 
 
-def check_paged(Hkv: int = 8):
+def check_paged(Hkv: int = 8, fused_heads: bool = False):
     """Hkv == H exercises MHA; Hkv < H exercises the GQA grouped-query
-    q-block path (groups > 1), which must be validated on-chip too."""
+    q-block path (groups > 1), which must be validated on-chip too.
+    fused_heads validates the all-heads-per-page-step grid variant."""
     from ray_tpu.ops.paged_attention import paged_decode_attention_batch
     B, H, D, page, npages_seq, pool_pages = 4, 8, 128, 16, 8, 64
     groups = H // Hkv
@@ -100,7 +101,9 @@ def check_paged(Hkv: int = 8):
     tables = jnp.asarray(tables)
     lengths_j = jnp.asarray(lengths)
 
-    out = paged_decode_attention_batch(q, k_pool, v_pool, tables, lengths_j)
+    out = paged_decode_attention_batch(q, k_pool, v_pool, tables,
+                                       lengths_j,
+                                       fused_heads=fused_heads)
 
     # dense reference per sequence
     err = 0.0
@@ -123,7 +126,7 @@ def check_paged(Hkv: int = 8):
             np.asarray(out[b], np.float32) - ref))))
     ok = err < 0.05
     print(json.dumps({"check": "paged_decode_onchip", "Hkv": Hkv,
-                      "groups": groups,
+                      "groups": groups, "fused": fused_heads,
                       "max_abs_err": round(err, 5), "ok": ok}))
     return ok
 
@@ -178,6 +181,8 @@ def main():
         ok = check_flash() and ok
         ok = check_paged(Hkv=8) and ok   # MHA
         ok = check_paged(Hkv=2) and ok   # GQA, groups=4
+        ok = check_paged(Hkv=8, fused_heads=True) and ok
+        ok = check_paged(Hkv=2, fused_heads=True) and ok
     if mode != "--check-only":
         sweep_flash()
     sys.exit(0 if ok else 1)

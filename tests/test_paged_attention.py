@@ -100,10 +100,14 @@ def test_page_allocator_lifecycle():
     assert len(b) == 4
 
 
-def test_paged_batch_kernel_matches_dense():
+@pytest.mark.parametrize("fused_heads", [True, False])
+def test_paged_batch_kernel_matches_dense(fused_heads):
     """The grid-batched kernel (batch as leading grid axis, per-row
     scratch reset) against the dense reference, with mixed lengths and
-    shuffled page tables — the exact shape the paged LLM engine uses."""
+    shuffled page tables — the exact shape the paged LLM engine uses.
+    Covers BOTH grid strategies: fused all-heads-per-page-step and the
+    default head-on-grid (the fused variant becomes the default once it
+    passes on-chip Mosaic validation)."""
     H, Hkv, D, page = 8, 4, 32, 8
     B, NP, pool_pages = 3, 5, 32
     rng = np.random.default_rng(1)
@@ -132,7 +136,8 @@ def test_paged_batch_kernel_matches_dense():
 
     out = paged_decode_attention_batch(
         jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
-        jnp.asarray(tables), jnp.asarray(lengths))
+        jnp.asarray(tables), jnp.asarray(lengths),
+        fused_heads=fused_heads)
     for b in range(B):
         ref = _ref_attention(q[b], seqs[b][0], seqs[b][1],
                              groups=H // Hkv)
