@@ -182,6 +182,23 @@ def read_numpy(paths: str | list, *, override_num_blocks: int | None = None
     return _lazy_read(_expand(paths), read_one, override_num_blocks)
 
 
+def read_tfrecords(paths: str | list, *,
+                   override_num_blocks: int | None = None,
+                   verify_crc: bool = False) -> Dataset:
+    """Rows from TFRecord files of tf.train.Example protos (reference:
+    data/read_api.py read_tfrecords — parsed here by the dependency-free
+    codec in data/tfrecord.py; no TensorFlow required)."""
+
+    def read_one(p, verify=verify_crc):
+        from ray_tpu.data import tfrecord as _tfr
+
+        with _open(p, "rb") as f:    # s3:// URIs route through _open
+            return [_tfr.parse_example(rec)
+                    for rec in _tfr.read_records(f, verify=verify)]
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
+
+
 def read_binary_files(paths: str | list, *, include_paths: bool = False,
                       override_num_blocks: int | None = None) -> Dataset:
     """One row per file with raw bytes (reference:
@@ -264,7 +281,7 @@ __all__ = [
     "Dataset", "DataIterator", "GroupedData", "from_items", "range",
     "range_tensor", "from_numpy", "from_pandas", "from_arrow", "read_text",
     "read_json", "read_csv", "read_numpy", "read_parquet",
-    "read_binary_files", "read_images",
+    "read_binary_files", "read_images", "read_tfrecords",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

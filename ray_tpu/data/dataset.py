@@ -866,6 +866,20 @@ class Dataset:
             if column in batch:
                 np.save(os.path.join(path, f"part-{i:05d}.npy"), batch[column])
 
+    def write_tfrecords(self, path: str) -> None:
+        """One TFRecord file of tf.train.Example protos per output block
+        (reference: Dataset.write_tfrecords; codec in data/tfrecord.py)."""
+        import os
+
+        from ray_tpu.data import tfrecord as _tfr
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self._iter_output_blocks()):
+            rows = block_to_rows(block)
+            _tfr.write_records(
+                os.path.join(path, f"part-{i:05d}.tfrecords"),
+                (_tfr.encode_example(_jsonable(r)) for r in rows))
+
     def __repr__(self):
         names = [s.name for s in self._stages]
         return f"Dataset(blocks={len(self._source)}, stages={names})"

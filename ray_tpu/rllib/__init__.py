@@ -4,7 +4,9 @@ from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.bandit import (Bandit, BanditConfig,
                                   LinearDiscreteBandit)
 from ray_tpu.rllib.crr import CRR, CRRConfig
+from ray_tpu.rllib.dt import DT, DTConfig
 from ray_tpu.rllib.es import ARS, ES, ARSConfig, ESConfig
+from ray_tpu.rllib.qmix import QMIX, CoopSwitch, QMIXConfig
 from ray_tpu.rllib.random_agent import RandomAgent, RandomAgentConfig
 from ray_tpu.rllib.simple_q import (ApexDQN, ApexDQNConfig, SimpleQ,
                                     SimpleQConfig)
@@ -40,7 +42,8 @@ __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "A3C", "A3CConfig", "ES", "ESConfig", "ARS", "ARSConfig",
            "SimpleQ", "SimpleQConfig", "ApexDQN", "ApexDQNConfig",
            "Bandit", "BanditConfig", "LinearDiscreteBandit",
-           "CRR", "CRRConfig", "RandomAgent", "RandomAgentConfig"]
+           "CRR", "CRRConfig", "RandomAgent", "RandomAgentConfig",
+           "DT", "DTConfig", "QMIX", "QMIXConfig", "CoopSwitch"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu('rllib')

@@ -1,0 +1,314 @@
+"""DT: Decision Transformer — offline RL as sequence modeling.
+
+Parity: reference rllib/algorithms/dt/ (return-conditioned behavior
+cloning: a causal transformer over (return-to-go, state, action) token
+triples predicts the next action; acting conditions on a target
+return). This is the most TPU-native algorithm in the family — training
+IS a transformer train step under jit, no simulator in the loop.
+
+A compact JAX transformer is built inline (token embeddings per
+modality + learned positions, pre-LN causal blocks); episodes come from
+the same JSONL logs BC/MARWIL/CRR read.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.offline import JsonReader
+
+
+@dataclass
+class DTConfig:
+    """Fluent config (parity: rllib DTConfig)."""
+
+    env: Any = "CartPole-v1"
+    input_path: str | None = None
+    context_len: int = 8          # K timesteps => 3K tokens
+    embed_dim: int = 64
+    n_layers: int = 2
+    n_heads: int = 2
+    gamma: float = 1.0            # DT uses undiscounted returns-to-go
+    lr: float = 1e-3
+    train_batch_size: int = 64
+    num_sgd_iter_per_train: int = 20
+    target_return: float | None = None  # None: best return in the data
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def offline_data(self, input_path: str):
+        self.input_path = input_path
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown DT option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "DT":
+        return DT(self)
+
+
+class DT:
+    def __init__(self, config: DTConfig):
+        self.config = config
+        probe = make_env(config.env)
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        self.episodes = self._load_episodes()
+        self.max_return = max(ep["rtg"][0] for ep in self.episodes)
+        self.params = self._init_params()
+        self._update = None
+        self.iteration = 0
+
+    # ---- data ----
+
+    def _load_episodes(self) -> list:
+        cfg = self.config
+        if cfg.input_path is None:
+            raise ValueError("DT needs offline_data(input_path=...)")
+        d = JsonReader(cfg.input_path).read_all()
+        obs, acts = d["obs"], d["actions"]
+        rews, dones = d["rewards"], d["dones"]
+        episodes, start = [], 0
+        for t in range(len(obs)):
+            if dones[t] or t == len(obs) - 1:
+                ep_r = rews[start:t + 1]
+                # (Discounted) return-to-go; DT's canonical setting is
+                # gamma=1 but the knob is honored.
+                rtg = np.zeros(len(ep_r), np.float32)
+                acc = 0.0
+                for i in range(len(ep_r) - 1, -1, -1):
+                    acc = ep_r[i] + cfg.gamma * acc
+                    rtg[i] = acc
+                episodes.append({"obs": obs[start:t + 1],
+                                 "actions": acts[start:t + 1],
+                                 "rtg": rtg})
+                start = t + 1
+        return [e for e in episodes if len(e["obs"]) > 0]
+
+    # ---- model ----
+
+    def _init_params(self) -> dict:
+        cfg = self.config
+        rng = np.random.default_rng(cfg.seed)
+        E, K = cfg.embed_dim, cfg.context_len
+
+        def dense(i, o):
+            return {"w": (rng.standard_normal((i, o)) *
+                          (1.0 / np.sqrt(i))).astype(np.float32),
+                    "b": np.zeros(o, np.float32)}
+
+        p = {
+            "emb_rtg": dense(1, E),
+            "emb_obs": dense(self.obs_size, E),
+            "emb_act": dense(self.num_actions, E),  # one-hot actions
+            "pos": (rng.standard_normal((3 * K, E)) * 0.02
+                    ).astype(np.float32),
+            "head": dense(E, self.num_actions),
+        }
+        for li in range(cfg.n_layers):
+            p[f"blk{li}"] = {
+                "ln1_g": np.ones(E, np.float32),
+                "ln1_b": np.zeros(E, np.float32),
+                "qkv": dense(E, 3 * E),
+                "proj": dense(E, E),
+                "ln2_g": np.ones(E, np.float32),
+                "ln2_b": np.zeros(E, np.float32),
+                "mlp1": dense(E, 4 * E),
+                "mlp2": dense(4 * E, E),
+            }
+        return p
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        E, H, K = cfg.embed_dim, cfg.n_heads, cfg.context_len
+        T = 3 * K
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def ln(x, g, b):
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+        def block(p, x):
+            B = x.shape[0]
+            h = ln(x, p["ln1_g"], p["ln1_b"])
+            qkv = h @ p["qkv"]["w"] + p["qkv"]["b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, T, H, E // H).transpose(0, 2, 1, 3)
+            k = k.reshape(B, T, H, E // H).transpose(0, 2, 1, 3)
+            v = v.reshape(B, T, H, E // H).transpose(0, 2, 1, 3)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(E // H)
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask, s, -1e30)
+            a = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+            o = o.transpose(0, 2, 1, 3).reshape(B, T, E)
+            x = x + o @ p["proj"]["w"] + p["proj"]["b"]
+            h = ln(x, p["ln2_g"], p["ln2_b"])
+            h = jax.nn.gelu(h @ p["mlp1"]["w"] + p["mlp1"]["b"])
+            return x + h @ p["mlp2"]["w"] + p["mlp2"]["b"]
+
+        def forward(params, rtg, obs, act_onehot):
+            # Interleave (rtg, obs, act) tokens: position 3t..3t+2.
+            B = rtg.shape[0]
+            e_r = rtg[..., None] @ params["emb_rtg"]["w"] \
+                + params["emb_rtg"]["b"]
+            e_o = obs @ params["emb_obs"]["w"] + params["emb_obs"]["b"]
+            e_a = act_onehot @ params["emb_act"]["w"] \
+                + params["emb_act"]["b"]
+            x = jnp.stack([e_r, e_o, e_a], axis=2).reshape(B, T, E)
+            x = x + params["pos"][None]
+            for li in range(cfg.n_layers):
+                x = block(params[f"blk{li}"], x)
+            # Predict action t from the OBS token at position 3t+1.
+            return x[:, 1::3] @ params["head"]["w"] + params["head"]["b"]
+
+        self._forward = jax.jit(forward)
+
+        def loss_fn(params, rtg, obs, act_onehot, actions, mask):
+            logits = forward(params, rtg, obs, act_onehot)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, actions[..., None], axis=-1)[..., 0]
+            return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+        def update(params, opt_state, *batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+
+    def _sample_batch(self, rng):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        K = cfg.context_len
+        B = cfg.train_batch_size
+        rtg = np.zeros((B, K), np.float32)
+        obs = np.zeros((B, K, self.obs_size), np.float32)
+        act = np.zeros((B, K), np.int32)
+        mask = np.zeros((B, K), np.float32)
+        for i in range(B):
+            ep = self.episodes[rng.integers(len(self.episodes))]
+            L = len(ep["obs"])
+            start = rng.integers(max(1, L - K + 1))
+            n = min(K, L - start)
+            rtg[i, :n] = ep["rtg"][start:start + n]
+            obs[i, :n] = ep["obs"][start:start + n]
+            act[i, :n] = ep["actions"][start:start + n]
+            mask[i, :n] = 1.0
+        onehot = np.eye(self.num_actions, dtype=np.float32)[act]
+        # Action token t must not leak action t into its own prediction:
+        # the causal mask handles it (action token sits AFTER the obs
+        # token the prediction reads from).
+        return (jnp.asarray(rtg), jnp.asarray(obs), jnp.asarray(onehot),
+                jnp.asarray(act), jnp.asarray(mask))
+
+    def train(self) -> dict:
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        t0 = time.time()
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        losses = []
+        for _ in range(cfg.num_sgd_iter_per_train):
+            batch = self._sample_batch(rng)
+            self.params, self._opt_state, loss = self._update(
+                self.params, self._opt_state, *batch)
+            losses.append(float(loss))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "loss": float(np.mean(losses)),
+            "num_samples_trained": cfg.num_sgd_iter_per_train
+            * cfg.train_batch_size,
+            "episodes_in_dataset": len(self.episodes),
+            "max_dataset_return": float(self.max_return),
+            "iter_time_s": round(time.time() - t0, 3),
+        }
+
+    def evaluate(self, episodes: int = 4,
+                 target_return: float | None = None,
+                 max_steps: int = 200) -> dict:
+        """Roll out conditioning on the target return (DT's whole point:
+        aim for a return, act accordingly)."""
+        import jax.numpy as jnp
+
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        K = cfg.context_len
+        env = make_env(cfg.env)
+        target = (target_return if target_return is not None
+                  else cfg.target_return
+                  if cfg.target_return is not None else self.max_return)
+        totals = []
+        for ep in range(episodes):
+            obs_hist, act_hist, rtg_hist = [], [], []
+            o = env.reset(seed=cfg.seed + 100 + ep)
+            rtg = float(target)
+            total = 0.0
+            for _t in range(max_steps):
+                obs_hist.append(np.asarray(o, np.float32))
+                rtg_hist.append(rtg)
+                act_hist.append(0)   # placeholder for the current step
+                rtgs = np.zeros((1, K), np.float32)
+                obss = np.zeros((1, K, self.obs_size), np.float32)
+                acts = np.zeros((1, K), np.int32)
+                n = min(K, len(obs_hist))
+                rtgs[0, :n] = rtg_hist[-n:]
+                obss[0, :n] = obs_hist[-n:]
+                acts[0, :n] = act_hist[-n:]
+                onehot = np.eye(self.num_actions, dtype=np.float32)[acts]
+                logits = self._forward(self.params, jnp.asarray(rtgs),
+                                       jnp.asarray(obss),
+                                       jnp.asarray(onehot))
+                a = int(np.argmax(np.asarray(logits)[0, n - 1]))
+                act_hist[-1] = a
+                o, r, done, _ = env.step(a)
+                total += r
+                rtg -= r
+                if done:
+                    break
+            totals.append(total)
+        return {"episode_reward_mean": float(np.mean(totals)),
+                "target_return": float(target)}
+
+    def compute_single_action(self, obs) -> int:
+        import jax.numpy as jnp
+
+        if self._update is None:
+            self._build_update()
+        cfg = self.config
+        K = cfg.context_len
+        rtgs = np.zeros((1, K), np.float32)
+        rtgs[0, 0] = self.max_return
+        obss = np.zeros((1, K, self.obs_size), np.float32)
+        obss[0, 0] = obs
+        acts = np.zeros((1, K), np.int32)
+        onehot = np.eye(self.num_actions, dtype=np.float32)[acts]
+        logits = self._forward(self.params, jnp.asarray(rtgs),
+                               jnp.asarray(obss), jnp.asarray(onehot))
+        return int(np.argmax(np.asarray(logits)[0, 0]))
+
+    def stop(self):
+        pass

@@ -293,3 +293,44 @@ def test_streaming_bounded_memory(ray_start_regular):
         assert all(r["s"] == 0.0 for r in out)
     finally:
         ctx.max_in_flight_bytes = old
+
+
+def test_tfrecords_roundtrip(tmp_path):
+    """write_tfrecords -> read_tfrecords round-trips rows through the
+    dependency-free tf.train.Example codec (reference:
+    read_api.py read_tfrecords / Dataset.write_tfrecords), including
+    bytes/float/int features, lists, negative ints, and CRC framing."""
+    from ray_tpu import data
+    from ray_tpu.data import tfrecord as tfr
+
+    rows = [
+        {"i": 7, "f": 0.5, "s": "hello", "b": b"\x00\xff", "many": [1, 2, 3]},
+        {"i": -3, "f": -2.25, "s": "world", "b": b"", "many": [4, 5, 6]},
+    ]
+    ds = data.from_items(rows)
+    out = str(tmp_path / "tfr")
+    ds.write_tfrecords(out)
+
+    back = data.read_tfrecords(out + "/*.tfrecords", verify_crc=True).take_all()
+    # Proto BytesList has no string type: str features come back as
+    # bytes (reference read_tfrecords semantics).
+    back = sorted(back, key=lambda r: r["s"])
+    assert back[0]["s"] == b"hello" and back[1]["s"] == b"world"
+    assert back[0]["i"] == 7 and back[1]["i"] == -3
+    assert abs(back[0]["f"] - 0.5) < 1e-6 and abs(back[1]["f"] + 2.25) < 1e-6
+    assert back[0]["b"] == b"\x00\xff"
+    assert back[0]["many"] == [1, 2, 3] and back[1]["many"] == [4, 5, 6]
+
+    # Codec-level: known crc32c vector ("123456789" -> 0xE3069283).
+    assert tfr.crc32c(b"123456789") == 0xE3069283
+    # Corrupt a byte -> verify_crc catches it.
+    import glob as g
+
+    f = g.glob(out + "/*.tfrecords")[0]
+    blob = bytearray(open(f, "rb").read())
+    blob[20] ^= 0xFF
+    open(f, "wb").write(bytes(blob))
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        list(tfr.read_records(f, verify=True))

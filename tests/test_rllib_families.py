@@ -187,3 +187,70 @@ def test_random_agent_baseline():
     out = algo.train()
     assert out["episodes_this_iter"] == 8
     assert 5 <= out["episode_reward_mean"] <= 200
+
+
+def test_qmix_learns_coordination():
+    """On CoopSwitch the team reward needs BOTH agents to play the XOR
+    of private bits — QMIX's monotonic mixer must find it (random play
+    earns ~0.25/step; coordinated play 1.0/step when both bits visible
+    via... they aren't: each agent sees only its own bit, so the best
+    decentralized policy earns 0.5/step; require clearly above random)."""
+    from ray_tpu.rllib import QMIXConfig
+
+    algo = (QMIXConfig().environment("CoopSwitch-v0")
+            .training(episodes_per_iter=12, epsilon_decay_iters=8,
+                      train_batches=24, lr=1e-2)
+            .build())
+    first = algo.train()["episode_reward_mean"]
+    best = first
+    for _ in range(14):
+        best = max(best, algo.train()["episode_reward_mean"])
+    # Episode length 16; random ~4; decentralized optimum ~8.
+    assert best > 5.5, (first, best)
+    acts = algo.compute_actions(algo.env.reset(seed=123))
+    assert set(acts) == {"agent_0", "agent_1"}
+
+
+def test_dt_trains_and_conditions_on_return(tmp_path):
+    """Decision Transformer: offline sequence-model training loss falls
+    and return-conditioned evaluation runs end-to-end."""
+    import numpy as np
+
+    from ray_tpu.rllib import DTConfig
+    from ray_tpu.rllib.env import make_env
+    from ray_tpu.rllib.offline import write_offline_json
+
+    env = make_env("CartPole-v1")
+    rng = np.random.default_rng(5)
+    batches = []
+    for ep in range(40):
+        obs = env.reset(seed=200 + ep)
+        obs_l, act_l, rew_l, done_l = [], [], [], []
+        for _ in range(60):
+            a = int(rng.integers(env.num_actions))
+            nxt, r, done, _ = env.step(a)
+            obs_l.append(np.asarray(obs).tolist())
+            act_l.append(a)
+            rew_l.append(r)
+            done_l.append(float(done))
+            obs = nxt
+            if done:
+                break
+        batches.append({"obs": obs_l, "actions": act_l, "rewards": rew_l,
+                        "dones": done_l})
+    path = tmp_path / "eps.jsonl"
+    write_offline_json(str(path), batches)
+
+    algo = (DTConfig().environment("CartPole-v1")
+            .offline_data(str(path))
+            .training(context_len=8, embed_dim=32, n_layers=1, n_heads=2,
+                      train_batch_size=32, num_sgd_iter_per_train=30)
+            .build())
+    out = [algo.train() for _ in range(4)]
+    assert out[-1]["loss"] < out[0]["loss"]
+    # Episodes truncated at the 60-step cap carry no done marker and
+    # merge with their successor in the flat log.
+    assert 35 <= out[0]["episodes_in_dataset"] <= 40
+    ev = algo.evaluate(episodes=2, max_steps=60)
+    assert ev["episode_reward_mean"] > 0
+    assert algo.compute_single_action(np.zeros(4, np.float32)) in (0, 1)
