@@ -111,6 +111,31 @@ def from_arrow(tables, *, override_num_blocks: int | None = None) -> Dataset:
     return Dataset(list(tables))
 
 
+def from_huggingface(hf_dataset, *,
+                     override_num_blocks: int | None = None) -> Dataset:
+    """Dataset from a Hugging Face `datasets.Dataset` (reference:
+    ray.data.from_huggingface). Arrow-backed HF datasets hand over
+    their table directly (zero row materialization) — EXCEPT when an
+    indices mapping is live (shuffle/select/filter/train_test_split
+    apply lazily via _indices; .data.table would leak the unselected
+    rows), where rows materialize through the HF API instead."""
+    data = getattr(hf_dataset, "data", None)
+    table = getattr(data, "table", None) if data is not None else None
+    if table is not None and getattr(hf_dataset, "_indices", None) is None:
+        import pyarrow as pa
+
+        if isinstance(table, pa.Table):
+            n = override_num_blocks
+            if n and n > 1 and table.num_rows > 1:
+                per = math.ceil(table.num_rows / n)
+                return from_arrow([
+                    table.slice(i * per, per)
+                    for i in _builtins.range(n) if i * per < table.num_rows])
+            return from_arrow(table)
+    return from_items(list(hf_dataset),
+                      override_num_blocks=override_num_blocks)
+
+
 def _read_parquet_group(group, columns, filters, endpoint_url=None):
     """One parquet read task (module-level so pushdown can rebuild it with
     pruned columns/filters). s3:// objects fetch through the stdlib S3
@@ -281,7 +306,7 @@ __all__ = [
     "Dataset", "DataIterator", "GroupedData", "from_items", "range",
     "range_tensor", "from_numpy", "from_pandas", "from_arrow", "read_text",
     "read_json", "read_csv", "read_numpy", "read_parquet",
-    "read_binary_files", "read_images", "read_tfrecords",
+    "read_binary_files", "read_images", "read_tfrecords", "from_huggingface",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

@@ -1,0 +1,112 @@
+"""SklearnTrainer: fit a scikit-learn estimator as a Train run.
+
+Parity: reference python/ray/train/sklearn/sklearn_trainer.py — the
+estimator fits on ONE remote worker (sklearn has no distributed
+engine; `parallelize_cv` maps CV folds over joblib workers, which the
+ray_tpu joblib backend can in turn fan out), metrics report through
+the session, and the fitted estimator lands in the checkpoint.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import JaxTrainer, Result
+
+
+class SklearnTrainer(JaxTrainer):
+    """fit() runs estimator.fit(X, y) in a worker; the Result carries
+    scores and a checkpoint holding the pickled fitted estimator
+    (load it back with `SklearnTrainer.get_model(result.checkpoint)`).
+    """
+
+    def __init__(self, *, estimator: Any, datasets: dict,
+                 label_column: str | None = None,
+                 scoring: str | None = None,
+                 params: dict | None = None,
+                 run_config: RunConfig | None = None):
+        est_blob = pickle.dumps(estimator)
+
+        def rows_to_xy(rows, label):
+            import numpy as np
+
+            if label is None:
+                X = np.asarray([[r[k] for k in sorted(r)] for r in rows])
+                return X, None
+            feats = [k for k in sorted(rows[0]) if k != label]
+            X = np.asarray([[r[k] for k in feats] for r in rows],
+                           np.float64)
+            y = np.asarray([r[label] for r in rows])
+            return X, y
+
+        def materialize(ds):
+            # Datasets ship LAZY (the plan pickles with the loop) and
+            # execute on the worker at fit time — constructing the
+            # trainer must not pull rows onto the driver.
+            if ds is None:
+                return None
+            return ds.take_all() if hasattr(ds, "take_all") else list(ds)
+
+        def score_of(est, X, y, scoring_name):
+            if scoring_name:
+                from sklearn.metrics import get_scorer
+
+                return float(get_scorer(scoring_name)(est, X, y))
+            return float(est.score(X, y))
+
+        train_ds = datasets["train"]
+        valid_ds = datasets.get("valid")
+
+        def loop(config):
+            import pickle as _pickle
+
+            import numpy as np
+
+            from ray_tpu.train import session
+
+            est = _pickle.loads(config["est_blob"])
+            if config["params"]:
+                est.set_params(**config["params"])
+            train_rows = materialize(train_ds)
+            X, y = rows_to_xy(train_rows, config["label"])
+            est.fit(X, y)
+            metrics = {}
+            if y is not None:
+                metrics["train_score"] = score_of(est, X, y,
+                                                  config["scoring"])
+            valid_rows = materialize(valid_ds)
+            if valid_rows:
+                Xv, yv = rows_to_xy(valid_rows, config["label"])
+                if yv is not None:
+                    metrics["valid_score"] = score_of(est, Xv, yv,
+                                                      config["scoring"])
+            # The checkpoint pytree store holds arrays, not raw bytes:
+            # ship the pickle as uint8.
+            blob = np.frombuffer(_pickle.dumps(est), dtype=np.uint8)
+            session.report(metrics, checkpoint={"estimator": blob})
+
+        super().__init__(
+            loop,
+            train_loop_config={"est_blob": est_blob,
+                               "label": label_column,
+                               "scoring": scoring,
+                               "params": params or {}},
+            scaling_config=ScalingConfig(num_workers=1, use_tpu=False),
+            run_config=run_config, collective_backend=None)
+
+    @staticmethod
+    def get_model(checkpoint) -> Any:
+        """Unpickle the fitted estimator from a fit() checkpoint."""
+        import numpy as np
+
+        data = checkpoint.to_dict() if hasattr(checkpoint, "to_dict") \
+            else checkpoint
+        blob = data["estimator"]
+        if not isinstance(blob, (bytes, bytearray)):
+            blob = np.asarray(blob, dtype=np.uint8).tobytes()
+        return pickle.loads(blob)
+
+
+__all__ = ["SklearnTrainer", "Result"]

@@ -334,3 +334,23 @@ def test_tfrecords_roundtrip(tmp_path):
 
     with _pytest.raises(Exception):
         list(tfr.read_records(f, verify=True))
+
+
+def test_from_huggingface_arrow_zero_copy():
+    """from_huggingface hands an Arrow-backed HF dataset's table over as
+    an Arrow block (reference: ray.data.from_huggingface)."""
+    import pytest
+
+    hfd = pytest.importorskip("datasets")
+
+    from ray_tpu import data
+
+    hf = hfd.Dataset.from_dict({"x": [1, 2, 3, 4], "y": ["a", "b", "c", "d"]})
+    ds = data.from_huggingface(hf)
+    rows = ds.take_all()
+    assert [r["x"] for r in rows] == [1, 2, 3, 4]
+    assert rows[2]["y"] == "c"
+    # map/batch flows still work downstream of the arrow block
+    doubled = data.from_huggingface(hf).map_batches(
+        lambda b: {"x2": [v * 2 for v in b["x"]]}).take_all()
+    assert [r["x2"] for r in doubled] == [2, 4, 6, 8]

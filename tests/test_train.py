@@ -162,3 +162,35 @@ def test_elastic_restart_restores_checkpoint(ray_start_regular, tmp_path):
     # before the crash (reports are async, so it may trail the crash step
     # by a poll interval) — but it must not have started from scratch.
     assert result.metrics["resumed_from"] >= 1
+
+
+def test_sklearn_trainer(ray_start_regular, tmp_path):
+    """SklearnTrainer parity (reference: train/sklearn/sklearn_trainer.py):
+    fit in a worker, scores in metrics, fitted estimator in the
+    checkpoint."""
+    import numpy as np
+    import pytest
+
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.linear_model import LogisticRegression
+
+    from ray_tpu import data
+    from ray_tpu.train.sklearn import SklearnTrainer
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((120, 3))
+    y = (X @ np.array([1.0, -2.0, 0.5]) > 0).astype(int)
+    rows = [{"a": float(x[0]), "b": float(x[1]), "c": float(x[2]),
+             "label": int(t)} for x, t in zip(X, y)]
+    trainer = SklearnTrainer(
+        estimator=LogisticRegression(),
+        datasets={"train": data.from_items(rows[:100]),
+                  "valid": data.from_items(rows[100:])},
+        label_column="label")
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["train_score"] > 0.9
+    assert result.metrics["valid_score"] > 0.8
+    model = SklearnTrainer.get_model(result.checkpoint)
+    preds = model.predict(np.asarray([[2.0, -3.0, 1.0]]))
+    assert preds[0] == 1
