@@ -13,7 +13,8 @@ from typing import Any, Iterable, Sequence
 
 from ray_tpu import exceptions
 from ray_tpu._private import api_internal
-from ray_tpu._private.api_internal import ActorClass, ActorHandle, ObjectRef
+from ray_tpu._private.api_internal import (ActorClass, ActorHandle,
+                                           ObjectRef, ObjectRefGenerator)
 from ray_tpu._private.common import Address
 from ray_tpu._private.config import Config
 
@@ -392,6 +393,7 @@ def method(num_returns: int = 1):
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "put", "get", "wait",
+    "ObjectRefGenerator",
     "kill", "cancel", "get_actor", "nodes", "cluster_resources",
     "available_resources", "get_runtime_context", "method",
     "ObjectRef", "ActorHandle", "ActorClass", "Config", "exceptions",

@@ -304,6 +304,7 @@ class RemoteFunction:
                 _build_resources(self._opts, default_cpus=1.0))
         (strategy, pg_id, bundle_index), resources = cached
         task_id = cw.next_task_id()
+        streaming = self._opts["num_returns"] in ("streaming", "dynamic")
         spec = TaskSpec(
             task_id=task_id.hex(),
             job_id=cw.job_id,
@@ -311,9 +312,11 @@ class RemoteFunction:
             func_key=func_key,
             args=wire_args,
             kwargs_keys=kwargs_keys,
-            num_returns=self._opts["num_returns"],
+            num_returns=-1 if streaming else self._opts["num_returns"],
             resources=dict(resources),  # spec owns a private copy
-            max_retries=self._opts["max_retries"],
+            # Streaming tasks never retry: consumed yields cannot be
+            # un-delivered (reference: generator tasks restrict retries).
+            max_retries=0 if streaming else self._opts["max_retries"],
             retry_exceptions=bool(self._opts["retry_exceptions"]),
             owner=cw.address.to_wire(),
             strategy=strategy,
@@ -323,13 +326,16 @@ class RemoteFunction:
         )
         from ray_tpu.util import tracing
 
+        submit = cw.submit_streaming_task if streaming else cw.submit_task
         if tracing.enabled():
             with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
                 spec.trace_ctx = trace_ctx
-                returns = cw.submit_task(spec, nested_args=nested)
+                out = submit(spec, nested_args=nested)
         else:  # hot path: skip two contextmanager frames per task
-            returns = cw.submit_task(spec, nested_args=nested)
-        refs = [ObjectRef(oid, cw.address) for oid in returns]
+            out = submit(spec, nested_args=nested)
+        if streaming:
+            return ObjectRefGenerator(spec.task_id, cw.address, out)
+        refs = [ObjectRef(oid, cw.address) for oid in out]
         if self._opts["num_returns"] == 1:
             return refs[0]
         return refs
@@ -340,6 +346,67 @@ class RemoteFunction:
             f"use .remote()")
 
 
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs from a num_returns="streaming" task
+    (reference: ray ObjectRefGenerator / DynamicObjectRefGenerator).
+    Items arrive as the remote generator yields; iteration blocks until
+    the next item, raises the task's error at the failure point, and
+    stops when the task completes."""
+
+    def __init__(self, task_id_hex: str, owner, queue):
+        self._task_id = task_id_hex
+        self._owner = owner
+        self._q = queue
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item[0] == "item":
+            from ray_tpu._private.ids import ObjectID
+
+            return ObjectRef(ObjectID.from_hex(item[1]), self._owner)
+        self._done = True
+        if item[0] == "end":
+            raise StopIteration
+        from ray_tpu import exceptions as _exc
+        from ray_tpu._private import serialization
+
+        kind, value = serialization.deserialize(bytes(item[1]),
+                                                bytes(item[2]))
+        if kind == serialization.KIND_EXCEPTION:
+            cause, tb = value
+            if isinstance(cause, _exc.RayTpuError):
+                raise cause
+            raise _exc.TaskError(cause, tb)
+        raise RuntimeError(str(value))
+
+    def completed(self) -> bool:
+        return self._done
+
+    def close(self) -> None:
+        """Release unconsumed yields (reference: Ray frees unconsumed
+        generator returns when the generator is destructed). The core
+        worker's IO loop drains buffered items and frees later arrivals
+        — draining here would race an in-flight yield dispatch."""
+        if self._done:
+            return
+        self._done = True
+        cw = _core_worker
+        if cw is not None:
+            cw.abandon_stream(self._task_id)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
         self._handle = handle
@@ -347,9 +414,12 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def options(self, **opts):
-        m = ActorMethod(self._handle, self._method_name,
-                        opts.get("num_returns", self._num_returns))
-        return m
+        n = opts.get("num_returns", self._num_returns)
+        if n in ("streaming", "dynamic"):
+            raise ValueError(
+                "num_returns='streaming' is not supported for actor "
+                "methods (yet) — only plain tasks stream")
+        return ActorMethod(self._handle, self._method_name, n)
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(

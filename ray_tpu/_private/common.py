@@ -71,6 +71,9 @@ class Address:
         return (self.host, self.port)
 
 
+STREAMING_RETURNS = -1  # TaskSpec.num_returns sentinel: streaming generator
+
+
 @dataclass
 class TaskSpec:
     """Wire form of a task invocation (reference: TaskSpecification).

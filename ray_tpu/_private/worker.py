@@ -52,7 +52,8 @@ from collections import defaultdict
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc, serialization
-from ray_tpu._private.common import Address, TaskSpec, normalize_resources
+from ray_tpu._private.common import (STREAMING_RETURNS, Address,
+                                     TaskSpec, normalize_resources)
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullError
@@ -62,6 +63,7 @@ logger = logging.getLogger(__name__)
 OBJ_PENDING = "pending"
 OBJ_READY = "ready"
 OBJ_FAILED = "failed"
+
 
 
 class _ShmPin:
@@ -140,7 +142,8 @@ _PRIMITIVE_TYPES = frozenset(
 
 class _PendingTask:
     __slots__ = ("spec", "retries_left", "constructor_like", "futures",
-                 "pushed_to", "nested_args", "seq", "return_hexes")
+                 "pushed_to", "nested_args", "seq", "return_hexes",
+                 "stream_q")
 
     def __init__(self, spec: TaskSpec, retries_left: int,
                  nested_args: list | None = None):
@@ -151,6 +154,10 @@ class _PendingTask:
         # Return ObjectID hexes, filled by submit_task so completion does
         # not re-derive them (each is a sha1).
         self.return_hexes: list[str] | None = None
+        # Streaming tasks (num_returns="streaming"): thread-safe queue the
+        # driver-side ObjectRefGenerator drains; items are ("item",
+        # oid_hex) / ("end",) / ("error", meta, data).
+        self.stream_q = None
         # Refs serialized INSIDE value args (not top-level): list of
         # (oid_hex, owner_wire|None); refcounted like top-level args and
         # released at completion per the borrower protocol.
@@ -268,6 +275,9 @@ class CoreWorker:
         # container's owner (consumed by get()'s deserialize).
         self._fetched_prereg: dict[str, set] = {}
         self._borrow_watches: dict = {}  # (oid, borrower) -> generation
+        # Streaming tasks whose driver-side generator was closed: later
+        # yields free on arrival instead of buffering forever.
+        self._abandoned_streams: set[str] = set()
         self._task_events: list = []
         self._tqdm_renderer = None  # lazy; driver-side progress bars
         self._run(self._async_init())
@@ -1251,19 +1261,25 @@ class CoreWorker:
             owner = Address.from_wire(owner_wire) if owner_wire else None
             self.borrow_incr(oid_hex, owner)
 
-    def submit_task(self, spec: TaskSpec,
-                    nested_args: list | None = None) -> list[ObjectID]:
-        """Submit; returns the return-object IDs (owner = this worker)."""
+    def _prepare_task(self, spec: TaskSpec,
+                      nested_args: list | None) -> tuple:
+        n_returns = (0 if spec.num_returns == STREAMING_RETURNS
+                     else spec.num_returns)
         returns = [ObjectID.for_task_return(TaskID.from_hex(spec.task_id), i + 1)
-                   for i in range(spec.num_returns)]
+                   for i in range(n_returns)]
         pt = _PendingTask(spec, retries_left=spec.max_retries,
                           nested_args=nested_args)
+        if spec.num_returns == STREAMING_RETURNS:
+            pt.stream_q = _queue.Queue()
         pt.return_hexes = [oid.hex() for oid in returns]
         for oid_hex in pt.return_hexes:
             o = self.objects.setdefault(oid_hex, _OwnedObject())
             o.lineage_task = spec.task_id
         self.pending_tasks[spec.task_id] = pt
         self._record_task_event(spec.task_id, spec.name, "PENDING")
+        return pt, returns
+
+    def _enqueue_prepared(self, pt: _PendingTask) -> None:
         with self._submit_lock:
             self._submit_buf.append(pt)
             wake = not self._submit_scheduled
@@ -1271,7 +1287,24 @@ class CoreWorker:
                 self._submit_scheduled = True
         if wake:
             self.loop.call_soon_threadsafe(self._drain_submit_buf)
+
+    def submit_task(self, spec: TaskSpec,
+                    nested_args: list | None = None) -> list[ObjectID]:
+        """Submit; returns the return-object IDs (owner = this worker)."""
+        pt, returns = self._prepare_task(spec, nested_args)
+        self._enqueue_prepared(pt)
         return returns
+
+    def submit_streaming_task(self, spec: TaskSpec,
+                              nested_args: list | None = None):
+        """Submit a num_returns="streaming" task; returns its yield
+        queue. The queue is captured BEFORE the submission is enqueued —
+        a fast task could complete (popping pending_tasks) before the
+        caller could look the queue up afterwards."""
+        pt, _ = self._prepare_task(spec, nested_args)
+        q = pt.stream_q
+        self._enqueue_prepared(pt)
+        return q
 
     def _drain_submit_buf(self):
         """Loop-side: queue every buffered submission, one pump per shape.
@@ -1404,6 +1437,7 @@ class CoreWorker:
                                      resp["worker_id"], resp["node_id"]])
                     conn.handlers["TaskDone"] = functools.partial(
                         self._handle_task_done, slot, shape)
+                    conn.handlers["TaskYield"] = self._handle_task_yield
                     conn.on_close(functools.partial(
                         self._on_slot_conn_closed, slot, shape))
                     self._leases[shape].append(slot)
@@ -1556,13 +1590,17 @@ class CoreWorker:
 
     def _complete_task_error(self, pt: _PendingTask, err):
         self.pending_tasks.pop(pt.spec.task_id, None)
+        self._abandoned_streams.discard(pt.spec.task_id)
         self._record_task_event(pt.spec.task_id, pt.spec.name, "FAILED")
-        for oid_hex in self._return_hexes(pt):
-            o = self.objects.setdefault(oid_hex, _OwnedObject())
-            o.state = OBJ_FAILED
-            o.error = (err.meta, err.to_bytes())
-            if o.ready_event:
-                o.ready_event.set()
+        if pt.stream_q is not None:
+            pt.stream_q.put(("error", err.meta, err.to_bytes()))
+        else:
+            for oid_hex in self._return_hexes(pt):
+                o = self.objects.setdefault(oid_hex, _OwnedObject())
+                o.state = OBJ_FAILED
+                o.error = (err.meta, err.to_bytes())
+                if o.ready_event:
+                    o.ready_event.set()
         self._release_submitted_refs(pt)
 
     async def _complete_task(self, pt: _PendingTask, resp: dict, node_id: str,
@@ -1590,22 +1628,34 @@ class CoreWorker:
             self._enqueue_task(pt)
             return
         self.pending_tasks.pop(spec.task_id, None)
+        self._abandoned_streams.discard(spec.task_id)
         hexes = self._return_hexes(pt)
         if resp.get("status") == "error":
             self._record_task_event(spec.task_id, spec.name, "FAILED")
             err_meta, err_data = resp["error"]
-            for oid_hex in hexes:
-                o = self.objects.setdefault(oid_hex, _OwnedObject())
-                o.state = OBJ_FAILED
-                o.error = (bytes(err_meta), bytes(err_data))
-                if o.ready_event:
-                    o.ready_event.set()
+            if pt.stream_q is not None:
+                # Items already yielded stay valid (they were produced);
+                # the generator raises at the failure point.
+                pt.stream_q.put(("error", bytes(err_meta),
+                                 bytes(err_data)))
+            else:
+                for oid_hex in hexes:
+                    o = self.objects.setdefault(oid_hex, _OwnedObject())
+                    o.state = OBJ_FAILED
+                    o.error = (bytes(err_meta), bytes(err_data))
+                    if o.ready_event:
+                        o.ready_event.set()
         else:
             self._record_task_event(spec.task_id, spec.name, "FINISHED")
             # Keep lineage for reconstruction (bounded). Size estimate is
             # structural, not str(args) — str() of wire args costs more
             # than the rest of completion at trivial-task rates.
-            if self._lineage_bytes < self.config.max_lineage_bytes:
+            # Streaming tasks record NO lineage: re-running a generator
+            # could not re-deliver yields through the consumed generator,
+            # so lost streamed objects raise ObjectLostError instead of
+            # reconstructing (documented streaming limitation).
+            if pt.stream_q is None and \
+                    self._lineage_bytes < self.config.max_lineage_bytes:
                 self.lineage[spec.task_id] = spec
                 est = 64
                 for a in spec.args:
@@ -1615,23 +1665,9 @@ class CoreWorker:
                 oid_hex = hexes[i] if i < len(hexes) else \
                     ObjectID.for_task_return(
                         TaskID.from_hex(spec.task_id), i + 1).hex()
-                o = self.objects.setdefault(oid_hex, _OwnedObject())
-                if result[0] == "v":
-                    o.inline = (bytes(result[1]), bytes(result[2]))
-                    o.size = len(o.inline[1])
-                else:  # ["s", node_id, size, (nested)]
-                    o.locations.add(result[1])
-                    o.size = result[2]
-                o.state = OBJ_READY
-                o.lineage_task = spec.task_id
-                # Refs embedded in the returned payload: the executing
-                # worker pre-registered us with their owners; hold them
-                # for as long as this return object lives.
-                if len(result) > 3 and result[3]:
-                    self._track_container(
-                        oid_hex, [tuple(n) for n in result[3]])
-                if o.ready_event:
-                    o.ready_event.set()
+                self._register_return(spec.task_id, oid_hex, result)
+            if pt.stream_q is not None:
+                pt.stream_q.put(("end",))
         # Borrower handoff BEFORE releasing our own holds: args the worker
         # still references are registered with their owners first, on the
         # same ordered owner connections our releases use. Forwards can
@@ -1645,6 +1681,78 @@ class CoreWorker:
                 pt, borrows, borrower_id, borrower_addr))
         else:
             self._release_submitted_refs(pt)
+
+    def _register_return(self, task_id_hex: str, oid_hex: str, result,
+                         lineage: bool = True):
+        """Record one arrived return/yield entry as an owned READY
+        object (shared by TaskDone results and TaskYield streams —
+        streamed yields pass lineage=False: generators do not
+        reconstruct)."""
+        o = self.objects.setdefault(oid_hex, _OwnedObject())
+        if result[0] == "v":
+            o.inline = (bytes(result[1]), bytes(result[2]))
+            o.size = len(o.inline[1])
+        else:  # ["s", node_id, size, (nested)]
+            o.locations.add(result[1])
+            o.size = result[2]
+        o.state = OBJ_READY
+        o.lineage_task = task_id_hex if lineage else None
+        # Refs embedded in the returned payload: the executing worker
+        # pre-registered us with their owners; hold them for as long as
+        # this return object lives.
+        if len(result) > 3 and result[3]:
+            self._track_container(oid_hex, [tuple(n) for n in result[3]])
+        if o.ready_event:
+            o.ready_event.set()
+
+    async def _handle_task_yield(self, conn, payload):
+        """One streamed item from a num_returns='streaming' task: give
+        it a return id, register ownership, and hand the ref to the
+        driver-side generator (reference: streaming ObjectRefGenerator,
+        task_manager.cc HandleReportGeneratorItemReturns)."""
+        pt = self.pending_tasks.get(payload["task_id"])
+        if pt is None or pt.stream_q is None:
+            return  # task already completed/failed; late yield dropped
+        index = payload["index"]
+        oid_hex = ObjectID.for_task_return(
+            TaskID.from_hex(pt.spec.task_id), index + 1).hex()
+        pt.return_hexes.append(oid_hex)
+        # No ref added here: the ObjectRef the generator constructs on
+        # iteration registers the local ref (owned objects are not
+        # collected before any ref transition occurs).
+        self._register_return(pt.spec.task_id, oid_hex, payload["result"],
+                              lineage=False)
+        if payload["task_id"] in self._abandoned_streams:
+            # Generator was closed/dropped: free the item immediately
+            # instead of buffering it forever.
+            self._add_local_ref_impl(oid_hex)
+            self._remove_local_ref_impl(oid_hex)
+            return
+        pt.stream_q.put(("item", oid_hex))
+
+    def abandon_stream(self, task_id_hex: str) -> None:
+        """Mark a streaming task's remaining yields free-on-arrival and
+        free already-buffered ones (called from
+        ObjectRefGenerator.close)."""
+        self._post(self._abandon_stream_impl, task_id_hex)
+
+    def _abandon_stream_impl(self, task_id_hex: str) -> None:
+        pt = self.pending_tasks.get(task_id_hex)
+        if pt is None:
+            return
+        self._abandoned_streams.add(task_id_hex)
+        # Drain ON THE LOOP (every put happens here too): a yield whose
+        # dispatch raced a caller-thread drain would otherwise land in
+        # the orphaned queue after the drain saw it empty and leak.
+        if pt.stream_q is not None:
+            while True:
+                try:
+                    item = pt.stream_q.get_nowait()
+                except _queue.Empty:
+                    return
+                if item[0] == "item":
+                    self._add_local_ref_impl(item[1])
+                    self._remove_local_ref_impl(item[1])
 
     async def _forward_borrows_then_release(self, pt, borrows, borrower_id,
                                             borrower_addr):
@@ -1792,9 +1900,19 @@ class CoreWorker:
                 break
             spec, sink = item
             if isinstance(spec, list):  # batch item: sink is the owner conn
+                def emit(task_id, index, entry, conn=sink):
+                    # Yields notify IMMEDIATELY (not coalesced like
+                    # TaskDone): loop FIFO keeps them ahead of the
+                    # task's completion on the same connection.
+                    self.loop.call_soon_threadsafe(
+                        lambda: asyncio.ensure_future(conn.notify(
+                            "TaskYield",
+                            {"task_id": task_id, "index": index,
+                             "result": entry})))
+
                 for s in spec:
                     self._queue_task_done(sink, s.task_id,
-                                          self._execute_task(s))
+                                          self._execute_task(s, emit))
             else:  # single item: sink is a future
                 result = self._execute_task(spec)
                 self.loop.call_soon_threadsafe(
@@ -1875,7 +1993,7 @@ class CoreWorker:
                             owner.to_wire() if owner is not None else None])
         return out
 
-    def _execute_task(self, spec: TaskSpec) -> dict:
+    def _execute_task(self, spec: TaskSpec, yield_emit=None) -> dict:
         from ray_tpu.runtime_env import runtime_env_context
 
         prev_task_id = self._current_task_id
@@ -1941,17 +2059,45 @@ class CoreWorker:
                 if fn is None:
                     fn = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
+
+                def run_fn():
+                    result = fn(*args, **kwargs)
+                    if spec.num_returns != STREAMING_RETURNS:
+                        return result
+                    # Streaming generator task (reference: num_returns=
+                    # "streaming" / ObjectRefGenerator): each yielded
+                    # item packages like a return and flows back
+                    # IMMEDIATELY as a TaskYield. The iteration runs
+                    # HERE so the generator body executes inside the
+                    # same runtime_env/tracing contexts as the call.
+                    if yield_emit is None:
+                        raise exc.RayTpuError(
+                            "streaming tasks require the batched task "
+                            "path")
+                    count = 0
+                    pctx = self._task_packaging_ctx(spec)
+                    for value in result:
+                        yield_emit(spec.task_id, count,
+                                   self._package_one(spec, count, value,
+                                                     pctx))
+                        count += 1
+                    return count
+
                 if not spec.runtime_env and not spec.trace_ctx \
                         and not tracing.enabled():
                     # Hot path: no env to activate, no span to open —
                     # skip both contextmanagers.
-                    result = fn(*args, **kwargs)
+                    result = run_fn()
                 else:
                     with runtime_env_context(spec.runtime_env,
                                              job_id=spec.job_id):
                         with tracing.execute_span(spec.name, spec.task_id,
                                                   spec.trace_ctx):
-                            result = fn(*args, **kwargs)
+                            result = run_fn()
+            if spec.num_returns == STREAMING_RETURNS:
+                return {"status": "ok", "results": [],
+                        "stream_count": result,
+                        "borrows": self._surviving_borrows()}
             return {"status": "ok",
                     "results": self._package_results(spec, result),
                     "borrows": self._surviving_borrows()}
@@ -1964,6 +2110,49 @@ class CoreWorker:
         finally:
             self._current_task_id = prev_task_id
 
+    def _task_packaging_ctx(self, spec: TaskSpec) -> tuple:
+        """Per-task constants for _package_one, computed ONCE (a
+        streaming task calls _package_one per yield — re-parsing the
+        owner address per item would sit on the emit hot path)."""
+        caller = Address.from_wire(spec.owner).worker_id if spec.owner else ""
+        return caller, self.config.max_inline_object_size
+
+    def _package_one(self, spec: TaskSpec, index: int, value,
+                     ctx: tuple | None = None) -> list:
+        """Package ONE return value as a wire entry — ["v", meta, data,
+        nested] inline or ["s", node_id, size, nested] via the store at
+        the return object id (task_id, index+1). Shared by fixed-arity
+        returns and streaming yields."""
+        from ray_tpu._private.api_internal import collect_nested_refs
+
+        caller, max_inline = ctx if ctx is not None \
+            else self._task_packaging_ctx(spec)
+        # Mirror of the submit-side primitive fast path: ref-free
+        # builtin returns skip the collector + SerializedObject.
+        if type(value) in _PRIMITIVE_TYPES and not (
+                type(value) in (str, bytes)
+                and len(value) >= max_inline):
+            meta, data = serialization.serialize_primitive(value)
+            if len(data) <= max_inline:
+                return ["v", meta, data, []]
+        with collect_nested_refs() as sink:
+            sobj = serialization.serialize(value)
+        if sink and caller:
+            # Refs embedded in the return payload: register the CALLER
+            # as borrower with each owner NOW (on our ordered owner
+            # connections), before our own holds can be released —
+            # this is what makes the return handoff race-free.
+            for oid_hex, owner_wire in sink:
+                self._run(self._forward_borrow(oid_hex, owner_wire,
+                                               caller, spec.owner))
+        nested = [[oid_hex, owner_wire] for oid_hex, owner_wire in sink]
+        if sobj.total_size <= self.config.max_inline_object_size:
+            return ["v", sobj.meta, sobj.to_bytes(), nested]
+        oid = ObjectID.for_task_return(TaskID.from_hex(spec.task_id),
+                                       index + 1)
+        self._run(self._write_to_store_safe(oid, sobj))
+        return ["s", self.node_id, sobj.total_size, nested]
+
     def _package_results(self, spec: TaskSpec, result) -> list:
         if spec.num_returns == 0:
             return []
@@ -1975,40 +2164,9 @@ class CoreWorker:
                 raise ValueError(
                     f"task {spec.name} declared num_returns={spec.num_returns} "
                     f"but returned {len(results)} values")
-        out = []
-        task_id = TaskID.from_hex(spec.task_id)
-        from ray_tpu._private.api_internal import collect_nested_refs
-
-        caller = Address.from_wire(spec.owner).worker_id if spec.owner else ""
-        max_inline = self.config.max_inline_object_size
-        for i, value in enumerate(results):
-            # Mirror of the submit-side primitive fast path: ref-free
-            # builtin returns skip the collector + SerializedObject.
-            if type(value) in _PRIMITIVE_TYPES and not (
-                    type(value) in (str, bytes)
-                    and len(value) >= max_inline):
-                meta, data = serialization.serialize_primitive(value)
-                if len(data) <= max_inline:
-                    out.append(["v", meta, data, []])
-                    continue
-            with collect_nested_refs() as sink:
-                sobj = serialization.serialize(value)
-            if sink and caller:
-                # Refs embedded in the return payload: register the CALLER
-                # as borrower with each owner NOW (on our ordered owner
-                # connections), before our own holds can be released —
-                # this is what makes the return handoff race-free.
-                for oid_hex, owner_wire in sink:
-                    self._run(self._forward_borrow(oid_hex, owner_wire,
-                                                   caller, spec.owner))
-            nested = [[oid_hex, owner_wire] for oid_hex, owner_wire in sink]
-            if sobj.total_size <= self.config.max_inline_object_size:
-                out.append(["v", sobj.meta, sobj.to_bytes(), nested])
-            else:
-                oid = ObjectID.for_task_return(task_id, i + 1)
-                self._run(self._write_to_store_safe(oid, sobj))
-                out.append(["s", self.node_id, sobj.total_size, nested])
-        return out
+        pctx = self._task_packaging_ctx(spec)
+        return [self._package_one(spec, i, v, pctx)
+                for i, v in enumerate(results)]
 
     async def _write_to_store_safe(self, oid, sobj):
         await self._write_to_store(oid, sobj)

@@ -46,6 +46,10 @@ enum : uint32_t {
   kStateCreated = 1,
   kStateSealed = 2,
   kStateTombstone = 3,
+  // Deleted while readers still hold refs: invisible to get/contains,
+  // extent freed on the LAST release (owner-driven GC must not yank
+  // memory out from under a live zero-copy view).
+  kStateDoomed = 4,
 };
 
 // Error codes (returned as negative ints through the C ABI).
@@ -368,6 +372,11 @@ int store_create(void* handle, const uint8_t* id, uint64_t data_size, uint64_t m
   ObjectEntry* slot = nullptr;
   ObjectEntry* existing = table_find(s, id, &slot);
   if (existing) {
+    // A kStateDoomed entry also lands here: re-creating an id whose
+    // old extent is still pinned fails until the last reader releases
+    // (plasma-parity — the alternative, freeing under the pin, is the
+    // corruption the Doomed state exists to prevent). Rare: requires
+    // force-delete + live local pin + same-id recreate on one node.
     unlock(s);
     return kErrExists;
   }
@@ -451,6 +460,10 @@ int store_release(void* handle, const uint8_t* id) {
     return kErrNotFound;
   }
   if (e->refcount > 0) e->refcount--;
+  if (e->state == kStateDoomed && e->refcount <= 0) {
+    heap_free(s, e->offset, e->alloc_size);
+    e->state = kStateTombstone;
+  }
   unlock(s);
   return kOK;
 }
@@ -464,19 +477,28 @@ int store_contains(void* handle, const uint8_t* id) {
   return r;
 }
 
-// Delete regardless of refcount==0 check when force!=0 (used by owner-driven
-// refcount GC: once the distributed refcount hits zero nobody may read it).
+// Delete. force!=0 (owner-driven refcount GC: once the distributed
+// refcount hits zero no NEW reader may appear) hides the object
+// immediately, but an extent with live local pins is only reclaimed on
+// the LAST release — freeing under a pinned zero-copy view would hand
+// its memory to the next create and corrupt the reader.
 int store_delete(void* handle, const uint8_t* id, int force) {
   Store* s = reinterpret_cast<Store*>(handle);
   lock(s);
   ObjectEntry* e = table_find(s, id, nullptr);
-  if (!e) {
+  if (!e || e->state == kStateTombstone || e->state == kStateDoomed) {
     unlock(s);
     return kErrNotFound;
   }
-  if (!force && e->refcount > 0) {
+  if (e->refcount > 0) {
+    if (!force) {
+      unlock(s);
+      return kErrInUse;
+    }
+    e->state = kStateDoomed;   // no new gets; freed on last release
+    s->hdr->num_objects--;
     unlock(s);
-    return kErrInUse;
+    return kOK;
   }
   heap_free(s, e->offset, e->alloc_size);
   e->state = kStateTombstone;

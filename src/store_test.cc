@@ -167,12 +167,53 @@ static void test_concurrent_clients() {
   store_detach(h);
 }
 
+static void test_forced_delete_defers_under_pins() {
+  // Owner-driven GC (force delete) while a reader holds a pin: the
+  // object becomes invisible immediately, but its EXTENT must survive
+  // until the last release — a new create reusing the memory would
+  // corrupt the reader's zero-copy view.
+  void* h = store_create_arena(kPath, 1 << 20, 64);
+  assert(h);
+  uint8_t id[20], id2[20];
+  make_id(id, 41);
+  make_id(id2, 42);
+  uint64_t off = 0;
+  assert(store_create(h, id, 1000, 16, &off) == 0);
+  uint8_t* base = (uint8_t*)store_base(h);
+  memset(base + off, 0x5A, 1000);
+  assert(store_seal(h, id) == 0);
+  uint64_t goff, gsize, gmeta;
+  assert(store_get(h, id, &goff, &gsize, &gmeta) == 0);  // pin
+  assert(store_delete(h, id, 1) == 0);                   // doomed
+  assert(store_contains(h, id) == 0);                    // invisible
+  uint64_t o2, s2, m2;
+  assert(store_get(h, id, &o2, &s2, &m2) != 0);          // no new gets
+  // Fill the heap with creates: none may land on the pinned extent.
+  for (int i = 0; i < 32; i++) {
+    uint8_t idn[20];
+    make_id(idn, 100 + i);
+    uint64_t offn = 0;
+    if (store_create(h, idn, 1000, 16, &offn) != 0) break;
+    assert(offn != goff);
+    memset(base + offn, 0xEE, 1000);
+    assert(store_seal(h, idn) == 0);
+  }
+  assert(base[goff] == 0x5A);                            // view intact
+  assert(store_release(h, id) == 0);                     // last ref: freed
+  // The extent is reusable now.
+  uint64_t off2 = 0;
+  assert(store_create(h, id2, 1000, 16, &off2) == 0);
+  assert(store_seal(h, id2) == 0);
+  store_detach(h);
+}
+
 int main() {
   test_create_seal_get();
   test_attach_shares_state();
   test_oom_and_auto_evict();
   test_abort_frees();
   test_concurrent_clients();
+  test_forced_delete_defers_under_pins();
   unlink(kPath);
   printf("store_test: OK\n");
   return 0;
