@@ -187,6 +187,12 @@ class ClientContext:
                          {"fn": common.client_dumps(fn)})["key"]
 
     def _task(self, key: str, args, kwargs, opts):
+        if opts.get("num_returns") in ("streaming", "dynamic"):
+            # The proxy protocol has no per-yield push channel yet; an
+            # explicit error beats the server crashing on range(str).
+            raise ValueError(
+                "num_returns='streaming' is not supported through "
+                "client:// drivers yet — run the driver in-cluster")
         resp = self._rpc("ClientTask", {
             "key": key, "args": common.client_dumps((args, kwargs)),
             "opts_pkl": common.client_dumps(opts)})
