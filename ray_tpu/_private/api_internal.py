@@ -388,6 +388,29 @@ class ObjectRefGenerator:
     def completed(self) -> bool:
         return self._done
 
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        """`async for ref in gen` — the blocking queue wait offloads to
+        a thread so the event loop stays free (reference: ObjectRef
+        generators are async-iterable inside async actors)."""
+        import asyncio
+
+        _end = object()
+
+        def step():
+            # StopIteration cannot cross a Future boundary; sentinel it.
+            try:
+                return self.__next__()
+            except StopIteration:
+                return _end
+
+        out = await asyncio.to_thread(step)
+        if out is _end:
+            raise StopAsyncIteration
+        return out
+
     def close(self) -> None:
         """Release unconsumed yields (reference: Ray frees unconsumed
         generator returns when the generator is destructed). The core

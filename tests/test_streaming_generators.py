@@ -124,3 +124,22 @@ def test_streaming_abandoned_generator_frees(ray_start_regular):
     # The consumed first item may still be referenced; the other five
     # must not all linger.
     assert len(live) <= 2, f"{len(live)} large yields still resident"
+
+
+def test_streaming_async_iteration(ray_start_regular):
+    """`async for` over the generator (reference: async-iterable
+    ObjectRef generators)."""
+    import asyncio
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i + 10
+
+    async def consume():
+        out = []
+        async for ref in gen.remote(4):
+            out.append(ray_tpu.get(ref))
+        return out
+
+    assert asyncio.run(consume()) == [10, 11, 12, 13]
