@@ -401,6 +401,12 @@ def run_test(test: dict, quick: bool) -> dict:
             # single-core hosts where 30+ interpreter spawns serialize.
             cfg = Config(prestart_workers=4)
             cfg.worker_startup_timeout_s = 300.0
+            # Size the arena to the workload: full-mode put/get moves
+            # `mb`-MiB objects (arena must hold several + slack).
+            if "mb" in kwargs:
+                cfg.object_store_memory = max(
+                    cfg.object_store_memory,
+                    int(kwargs["mb"]) * 4 * 1024 * 1024)
             ray_tpu.init(num_cpus=8, config=cfg)
             try:
                 metrics = fn(**kwargs)
