@@ -61,13 +61,17 @@ class GcsServer:
         # resumes with its actor/PG/KV/job state; raylets re-register
         # (reference: NotifyGCSRestart resync, node_manager.cc:1168).
         self.persistence_path = persistence_path
-        self._dirty = False
+        # Dirty TABLE names awaiting flush (see _MUTATING); a direct
+        # mark_dirty() with no argument dirties everything.
+        self._dirty: set = set()
         # Native durable table store (src/gcs_store.cc): rows are written
         # through as WAL appends on each flush — only CHANGED rows hit
         # disk (hash-diffed), and a compaction rewrites the snapshot when
         # the WAL outgrows it. Opened in start().
         self._store = None
         self._row_hashes: dict[tuple[str, str], int] = {}
+        self._row_sizes: dict[tuple[str, str], int] = {}
+        self._persisted_bytes = 0  # total state size for compaction ratio
         self._flush_lock = threading.Lock()
         self.nodes: dict[str, NodeInfo] = {}
         self.node_conns: dict[str, rpc.Connection] = {}
@@ -95,22 +99,40 @@ class GcsServer:
         except Exception:
             logger.info("native scheduler unavailable; using Python policies")
 
+    # Mutating RPC -> the persistence tables the HANDLER ITSELF touches.
+    # The flush packs + hash-diffs only DIRTY tables, so a KV-heavy
+    # cluster does not re-serialize the kv namespace when an actor
+    # changed state. Cascades (node death failing over actors, job
+    # finish killing actors or GCing kv packages) run through internal
+    # paths that call mark_dirty with their OWN tables — listing them
+    # here too would force full repacks of the largest tables for
+    # handlers that changed nothing in them.
     _MUTATING = {
-        "RegisterNode", "NotifyNodeDead", "KVPut", "KVDel", "RegisterActor",
-        "ActorReady", "ReportActorDeath", "KillActor", "RegisterJob",
-        "FinishJob", "CreatePlacementGroup", "RemovePlacementGroup",
+        "RegisterNode": ("nodes",),
+        "NotifyNodeDead": ("nodes",),
+        "KVPut": ("kv",),
+        "KVDel": ("kv",),
+        "RegisterActor": ("actors", "named_actors"),
+        "ActorReady": ("actors",),
+        "ReportActorDeath": ("actors", "named_actors"),
+        "KillActor": ("actors", "named_actors"),
+        "RegisterJob": ("jobs",),
+        "FinishJob": ("jobs",),
+        "CreatePlacementGroup": ("placement_groups",),
+        "RemovePlacementGroup": ("placement_groups",),
     }
 
     def _handlers(self):
         def wrap(name, fn):
-            if name not in self._MUTATING:
+            tables = self._MUTATING.get(name)
+            if tables is None:
                 return fn
 
-            async def dirty(conn, payload, fn=fn):
+            async def dirty(conn, payload, fn=fn, tables=tables):
                 try:
                     return await fn(conn, payload)
                 finally:
-                    self.mark_dirty()
+                    self.mark_dirty(tables)
 
             return dirty
 
@@ -173,11 +195,14 @@ class GcsServer:
         if self._store is not None:
             # Flush acknowledged mutations from the last <0.5s window,
             # then compact so restart replays a snapshot, not a long WAL.
+            tables = set()
             try:
                 if self._dirty:
-                    self._flush_rows(self._table_rows())
+                    tables, self._dirty = self._dirty, set()
+                    self._flush_rows(self._table_rows(only=tables), tables)
                 self._store.compact()
             except Exception:
+                self.mark_dirty(tables)
                 logger.exception("final GCS persistence flush failed")
             self._store.close()
         await self._server.stop()
@@ -190,37 +215,55 @@ class GcsServer:
     # changed), not O(cluster state), and a restart replays snapshot +
     # WAL. Store keys are hex (binary-safe for user internal_kv keys).
 
-    def mark_dirty(self):
-        self._dirty = True
+    _ALL_TABLES = ("kv", "actors", "named_actors", "jobs",
+                   "placement_groups", "nodes")
 
-    def _table_rows(self) -> dict:
-        """Pack the live tables into {(namespace, hex_key): row_bytes}."""
+    def mark_dirty(self, tables=None):
+        self._dirty.update(tables if tables is not None else
+                           self._ALL_TABLES)
+
+    def _table_rows(self, only=None) -> dict:
+        """Pack live tables into {(namespace, hex_key): row_bytes}.
+        `only` limits packing to the named (dirty) tables — a KV-heavy
+        cluster must not re-serialize every kv row because one actor
+        changed state."""
+        want = set(only) if only is not None else set(self._ALL_TABLES)
         rows: dict[tuple[str, str], bytes] = {}
-        for ns, table in self.kv.items():
-            for k, v in table.items():
-                rows[("kv", rpc.pack([ns, k]).hex())] = rpc.pack(v)
-        for aid, a in self.actors.items():
-            a = dict(a)
-            if isinstance(a.get("dead_worker_ids"), set):
-                a["dead_worker_ids"] = sorted(a["dead_worker_ids"])
-            rows[("actors", aid.encode().hex())] = rpc.pack(a)
-        for k, v in self.named_actors.items():
-            rows[("named_actors", rpc.pack(list(k)).hex())] = rpc.pack(v)
-        for jid, j in self.jobs.items():
-            rows[("jobs", jid.encode().hex())] = rpc.pack(j)
-        for pgid, pg in self.placement_groups.items():
-            rows[("placement_groups", pgid.encode().hex())] = rpc.pack(pg)
-        for n in self.nodes.values():
-            rows[("nodes", n.node_id.encode().hex())] = rpc.pack(n.to_wire())
+        if "kv" in want:
+            for ns, table in self.kv.items():
+                for k, v in table.items():
+                    rows[("kv", rpc.pack([ns, k]).hex())] = rpc.pack(v)
+        if "actors" in want:
+            for aid, a in self.actors.items():
+                a = dict(a)
+                if isinstance(a.get("dead_worker_ids"), set):
+                    a["dead_worker_ids"] = sorted(a["dead_worker_ids"])
+                rows[("actors", aid.encode().hex())] = rpc.pack(a)
+        if "named_actors" in want:
+            for k, v in self.named_actors.items():
+                rows[("named_actors", rpc.pack(list(k)).hex())] = rpc.pack(v)
+        if "jobs" in want:
+            for jid, j in self.jobs.items():
+                rows[("jobs", jid.encode().hex())] = rpc.pack(j)
+        if "placement_groups" in want:
+            for pgid, pg in self.placement_groups.items():
+                rows[("placement_groups", pgid.encode().hex())] = rpc.pack(pg)
+        if "nodes" in want:
+            for n in self.nodes.values():
+                rows[("nodes", n.node_id.encode().hex())] = \
+                    rpc.pack(n.to_wire())
         return rows
 
-    def _flush_rows(self, rows: dict) -> int:
-        """Write changed rows through to the native store; delete rows
-        that vanished. Returns the number of rows touched. Serialized by
-        a lock: stop()'s final flush may overlap a cancelled-but-still-
-        running to_thread flush, and the two must not race the hash map.
-        A failed WAL append (disk full) leaves the row unhashed so a
+    def _flush_rows(self, rows: dict, tables=None) -> int:
+        """Write changed rows through to the native store; delete
+        vanished rows (sweep limited to the flushed `tables` — rows of
+        unflushed tables are absent from `rows` but not deleted).
+        Returns the number of rows touched. Serialized by a lock:
+        stop()'s final flush may overlap a cancelled-but-still-running
+        to_thread flush, and the two must not race the hash map. A
+        failed WAL append (disk full) leaves the row unhashed so a
         later flush retries it."""
+        swept = set(tables) if tables is not None else set(self._ALL_TABLES)
         with self._flush_lock:
             touched = 0
             failed = 0
@@ -229,18 +272,23 @@ class GcsServer:
                 if self._row_hashes.get((ns, key)) != h:
                     if self._store.put(ns, key, blob):
                         self._row_hashes[(ns, key)] = h
+                        self._persisted_bytes += (
+                            len(blob) - self._row_sizes.get((ns, key), 0))
+                        self._row_sizes[(ns, key)] = len(blob)
                     else:
                         self._row_hashes.pop((ns, key), None)
                         failed += 1
-                        self._dirty = True  # retry next window
+                        self.mark_dirty((ns,))  # retry next window
                     touched += 1
             for (ns, key) in list(self._row_hashes):
-                if (ns, key) not in rows:
+                if ns in swept and (ns, key) not in rows:
                     if self._store.delete(ns, key):
                         del self._row_hashes[(ns, key)]
+                        self._persisted_bytes -= \
+                            self._row_sizes.pop((ns, key), 0)
                     else:
                         failed += 1
-                        self._dirty = True
+                        self.mark_dirty((ns,))
                     touched += 1
             if failed:
                 logger.error("GCS persistence: %d row writes failed "
@@ -263,26 +311,38 @@ class GcsServer:
             k = k if isinstance(k, bytes) else k.encode()
             self.kv[ns][k] = rpc.unpack(blob)
             self._row_hashes[("kv", key_hex)] = hash(blob)
+            self._row_sizes[("kv", key_hex)] = len(blob)
+            self._persisted_bytes += len(blob)
         for key_hex, blob in self._store.scan("actors"):
             a = rpc.unpack(blob)
             a["dead_worker_ids"] = set(a.get("dead_worker_ids", ()))
             self.actors[bytes.fromhex(key_hex).decode()] = a
             self._row_hashes[("actors", key_hex)] = hash(blob)
+            self._row_sizes[("actors", key_hex)] = len(blob)
+            self._persisted_bytes += len(blob)
         for key_hex, blob in self._store.scan("named_actors"):
             self.named_actors[tuple(rpc.unpack(bytes.fromhex(key_hex)))] = \
                 rpc.unpack(blob)
             self._row_hashes[("named_actors", key_hex)] = hash(blob)
+            self._row_sizes[("named_actors", key_hex)] = len(blob)
+            self._persisted_bytes += len(blob)
         for key_hex, blob in self._store.scan("jobs"):
             self.jobs[bytes.fromhex(key_hex).decode()] = rpc.unpack(blob)
             self._row_hashes[("jobs", key_hex)] = hash(blob)
+            self._row_sizes[("jobs", key_hex)] = len(blob)
+            self._persisted_bytes += len(blob)
         for key_hex, blob in self._store.scan("placement_groups"):
             self.placement_groups[bytes.fromhex(key_hex).decode()] = \
                 rpc.unpack(blob)
             self._row_hashes[("placement_groups", key_hex)] = hash(blob)
+            self._row_sizes[("placement_groups", key_hex)] = len(blob)
+            self._persisted_bytes += len(blob)
         snap_nodes = []
         for key_hex, blob in self._store.scan("nodes"):
             snap_nodes.append(rpc.unpack(blob))
             self._row_hashes[("nodes", key_hex)] = hash(blob)
+            self._row_sizes[("nodes", key_hex)] = len(blob)
+            self._persisted_bytes += len(blob)
         for w in snap_nodes:
             info = NodeInfo(
                 node_id=w["node_id"], host=w["host"],
@@ -331,27 +391,32 @@ class GcsServer:
                         ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
                     await self._on_actor_worker_death(
                         actor_id, f"node {nid[:8]} lost across GCS restart")
-            self.mark_dirty()
+            self.mark_dirty(("actors", "named_actors"))
 
     async def _persist_loop(self):
         while True:
             await asyncio.sleep(0.5)
             if not self._dirty:
                 continue
-            self._dirty = False
+            tables, self._dirty = self._dirty, set()
             try:
-                # Pack rows ON the loop (consistent view of the tables —
-                # same role the old deepcopy played, at similar cost);
-                # the diff + WAL writes run off-loop (the store is
-                # thread-safe).
-                rows = self._table_rows()
-                await asyncio.to_thread(self._flush_rows, rows)
-                # Compact once the WAL outgrows the state: replay stays
-                # bounded and old row versions don't accumulate.
+                # Pack DIRTY tables' rows ON the loop (consistent view —
+                # same role the old deepcopy played, at a cost bounded by
+                # what actually changed); the diff + WAL writes run
+                # off-loop (the store is thread-safe).
+                rows = self._table_rows(only=tables)
+                await asyncio.to_thread(self._flush_rows, rows, tables)
+                # Compact once the WAL outgrows the TOTAL persisted
+                # state (not this flush's dirty subset — that would
+                # trigger full-snapshot rewrites on every small change).
                 if self._store.wal_bytes() > max(
-                        1 << 20, 4 * sum(len(b) for b in rows.values())):
+                        1 << 20, 4 * self._persisted_bytes):
                     await asyncio.to_thread(self._store.compact)
             except Exception:
+                # Re-dirty the swapped tables: with per-table dirtying,
+                # an unrelated later mutation would no longer re-flush
+                # the rows this failed window carried.
+                self.mark_dirty(tables)
                 logger.exception("GCS persistence write failed")
 
     # ---------- pubsub ----------
@@ -465,7 +530,7 @@ class GcsServer:
             self.native_sched.update_node(node_id, available={}, alive=False)
         self.pending_demand.pop(node_id, None)
         logger.warning("node %s dead: %s", node_id[:8], reason)
-        self.mark_dirty()
+        self.mark_dirty(("nodes", "actors", "placement_groups"))
         from ray_tpu.util import events
 
         events.record("ERROR", "gcs", f"node dead: {reason}",
@@ -638,7 +703,7 @@ class GcsServer:
         if self.native_sched is not None:
             self.native_sched.debit_node(node_id, placement_demand)
         a["node_id"] = node_id
-        self.mark_dirty()
+        self.mark_dirty(("actors",))
         try:
             resp = await self.node_conns[node_id].call(
                 "CreateActor",
@@ -701,13 +766,13 @@ class GcsServer:
             a["restarts"] += 1
             a["state"] = ACTOR_RESTARTING
             a["address"] = None
-            self.mark_dirty()
+            self.mark_dirty(("actors",))
             await self.publish("ACTOR", {"actor_id": actor_id, "state": ACTOR_RESTARTING,
                                          "reason": reason})
             asyncio.ensure_future(self._schedule_actor(actor_id))
         else:
             a["state"] = ACTOR_DEAD
-            self.mark_dirty()
+            self.mark_dirty(("actors", "named_actors"))
             a["address"] = None
             a["death_cause"] = reason
             self.named_actors.pop((a["namespace"], a["name"]), None)
@@ -876,7 +941,7 @@ class GcsServer:
             pg["bundles"][idx]["node_id"] = node_id
             pg["bundles"][idx]["available"] = dict(pg["bundles"][idx]["resources"])
         pg["state"] = PG_CREATED
-        self.mark_dirty()
+        self.mark_dirty(("placement_groups",))
         await self.publish("PG", {"pg_id": pg_id, "state": PG_CREATED,
                                   "bundles": [(b["node_id"]) for b in pg["bundles"]]})
 
