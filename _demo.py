@@ -1,0 +1,54 @@
+import numpy as np
+import ray_tpu
+
+ray_tpu.init(num_cpus=4)
+
+@ray_tpu.remote
+def sq(x):
+    return x * x
+
+assert ray_tpu.get([sq.remote(i) for i in range(20)]) == [i*i for i in range(20)]
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self): self.n = 0
+    def incr(self): self.n += 1; return self.n
+
+c = Counter.remote()
+assert ray_tpu.get([c.incr.remote() for _ in range(5)])[-1] == 5
+
+# chained deps through fastpath (the coalescing deadlock probe)
+@ray_tpu.remote
+def add1(x): return x + 1
+r = sq.remote(3)
+for _ in range(10):
+    r = add1.remote(r)
+assert ray_tpu.get(r) == 19
+
+# nested fan-out (workers submitting through their own pumps)
+@ray_tpu.remote
+def fan(n):
+    return sum(ray_tpu.get([sq.remote(i) for i in range(n)]))
+assert ray_tpu.get(fan.remote(5)) == 30
+
+# placement group
+from ray_tpu.util.placement_group import placement_group
+pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+ray_tpu.get(pg.ready())
+
+# big object round trip
+arr = np.arange(1_000_000, dtype=np.float64)
+out = ray_tpu.get(ray_tpu.put(arr))
+assert (out == arr).all()
+
+# streaming generator
+@ray_tpu.remote(num_returns="streaming")
+def gen(n):
+    for i in range(n):
+        yield i
+got = [ray_tpu.get(ref) for ref in gen.remote(4)]
+assert got == [0,1,2,3], got
+
+print("DEMO OK")
+ray_tpu.shutdown()
+print("SHUTDOWN OK")

@@ -15,6 +15,7 @@ from typing import Any
 from ray_tpu import exceptions as exc
 from ray_tpu._private.common import Address, TaskSpec, normalize_resources
 from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu.util import tracing
 
 _core_worker = None
 _lock = threading.RLock()
@@ -222,15 +223,23 @@ def _build_resources(opts: dict, default_cpus: float) -> dict:
     return normalize_resources(res)
 
 
+_runtime_env_mod = None
+
+
 def _effective_runtime_env(task_env: dict | None) -> dict | None:
     """Task env merged over the job-level default (reference semantics:
     job runtime_env inherited unless the task overrides per-field), with
     local working_dir/py_modules dirs packed + uploaded to the GCS KV as
     content-addressed packages (reference: working_dir upload)."""
-    from ray_tpu.runtime_env import (RuntimeEnv, get_job_runtime_env,
-                                     prepare_for_wire)
-
-    return prepare_for_wire(RuntimeEnv.merge(get_job_runtime_env(), task_env))
+    global _runtime_env_mod
+    if _runtime_env_mod is None:
+        from ray_tpu import runtime_env as _runtime_env_mod_  # cycle-free
+        _runtime_env_mod = _runtime_env_mod_
+    m = _runtime_env_mod
+    if task_env is None and m.get_job_runtime_env() is None:
+        return None  # hot path: no env anywhere, skip merge machinery
+    return m.prepare_for_wire(
+        m.RuntimeEnv.merge(m.get_job_runtime_env(), task_env))
 
 
 def _wire_strategy(opts: dict):
@@ -324,15 +333,13 @@ class RemoteFunction:
             pg_bundle_index=bundle_index,
             runtime_env=_effective_runtime_env(self._opts["runtime_env"]),
         )
-        from ray_tpu.util import tracing
-
         submit = cw.submit_streaming_task if streaming else cw.submit_task
         if tracing.enabled():
             with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
                 spec.trace_ctx = trace_ctx
-                out = submit(spec, nested_args=nested)
+                out = submit(spec, nested_args=nested, task_id=task_id)
         else:  # hot path: skip two contextmanager frames per task
-            out = submit(spec, nested_args=nested)
+            out = submit(spec, nested_args=nested, task_id=task_id)
         if streaming:
             return ObjectRefGenerator(spec.task_id, cw.address, out)
         refs = [ObjectRef(oid, cw.address) for oid in out]
@@ -487,8 +494,6 @@ class ActorHandle:
             owner=cw.address.to_wire(),
             actor_id=self._actor_id.hex(),
         )
-        from ray_tpu.util import tracing
-
         with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
             spec.trace_ctx = trace_ctx
             returns = cw.submit_actor_task(self._actor_id.hex(), spec,
@@ -562,8 +567,6 @@ class ActorClass:
             runtime_env=_effective_runtime_env(self._opts["runtime_env"]),
             max_concurrency=int(self._opts["max_concurrency"] or 1),
         )
-        from ray_tpu.util import tracing
-
         with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
             spec.trace_ctx = trace_ctx
             resp = cw.create_actor(

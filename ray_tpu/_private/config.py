@@ -54,6 +54,12 @@ class Config:
     # worker lease reuse (reference: direct_task_transport.cc OnWorkerIdle).
     idle_worker_keep_s: float = 2.0
 
+    # Native fastpath IO plane (src/fastpath.cc): the worker task loop
+    # and the submitter push/done cycle ride a C++ epoll frame pump
+    # instead of asyncio (reference analog: the daemons' gRPC/asio event
+    # loops are C++ end-to-end). Env kill-switch: RAY_TPU_FASTPATH=0.
+    fastpath: bool = True
+
     # --- health / failure detection ---
     # (reference: ray_config_def.h:813-819 health check knobs)
     health_check_period_s: float = 1.0

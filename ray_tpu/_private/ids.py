@@ -39,6 +39,16 @@ class BaseID:
         return cls(os.urandom(cls.SIZE))
 
     @classmethod
+    def _wrap(cls, id_bytes: bytes):
+        """Trusted-caller constructor: skips the length check and the
+        defensive copy (submission hot path builds thousands of ids/s
+        from bytes it just concatenated)."""
+        o = object.__new__(cls)
+        o._bytes = id_bytes
+        o._hex = None
+        return o
+
+    @classmethod
     def from_hex(cls, hex_str: str):
         return cls(binascii.unhexlify(hex_str))
 

@@ -107,6 +107,7 @@ class WorkerHandle:
         self.worker_id = worker_id
         self.conn: rpc.Connection | None = None   # worker -> raylet channel
         self.address: tuple[str, int] | None = None  # worker's own rpc server
+        self.fp_port = 0  # native fastpath listener (0 = asyncio only)
         self.registered = asyncio.Event()
         self.leased = False
         self.lease_id: str | None = None
@@ -896,6 +897,7 @@ class Raylet:
                     "node_id": self.node_id}
         w.conn = conn
         w.address = (payload["host"], payload["port"])
+        w.fp_port = payload.get("fp_port", 0)
         conn.on_close(lambda: None if w.dead else asyncio.ensure_future(
             self._on_worker_death(w, "worker connection lost")))
         w.registered.set()
@@ -1235,6 +1237,7 @@ class Raylet:
         return {"granted": True, "lease_id": lease_id,
                 "worker_id": w.worker_id,
                 "worker_host": w.address[0], "worker_port": w.address[1],
+                "worker_fp_port": getattr(w, "fp_port", 0),
                 "node_id": self.node_id}
 
     async def handle_return_worker(self, conn, payload):

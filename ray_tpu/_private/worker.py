@@ -176,7 +176,7 @@ class _LeaseSlot:
     completed (streamed TaskDone notifies drain it; a closed connection
     fails/retries everything left in it)."""
     __slots__ = ("conn", "lease_id", "worker_id", "node_id", "raylet", "busy",
-                 "idle_since", "outstanding", "worker_addr")
+                 "idle_since", "outstanding", "worker_addr", "fp_id")
 
     def __init__(self, conn, lease_id, worker_id, node_id, raylet,
                  worker_addr=None):
@@ -189,6 +189,7 @@ class _LeaseSlot:
         self.idle_since = time.monotonic()
         self.outstanding: dict = {}  # task_id -> _PendingTask
         self.worker_addr = worker_addr  # Address wire of the worker
+        self.fp_id = None  # native fastpath conn id (None = asyncio path)
 
 
 def _shape_key(resources: dict) -> str:
@@ -223,6 +224,31 @@ class CoreWorker:
         self._exec_tls = threading.local()  # per-thread current task id
         # executor
         self._exec_queue: _queue.Queue = _queue.Queue()
+        # Native fastpath IO plane (src/fastpath.cc): C++ epoll pumps own
+        # the steady-state task cycle. _fp_exec_pump (pool workers only)
+        # serves inbound PushTaskBatch and carries TaskDone/TaskYield
+        # back; _fp_sub_pump (lazily, any submitter) carries this
+        # process's outbound pushes and completion drains.
+        from ray_tpu._private import native_fastpath
+        self._fp = native_fastpath if (
+            self.config.fastpath and native_fastpath.available()) else None
+        self._fp_exec_pump = None
+        self._fp_sub_pump = None
+        self.fp_port = 0
+        self._fp_slots: dict = {}      # fp conn_id -> (_LeaseSlot, shape)
+        self._fp_backlog: list = []
+        self._fp_processing = False
+        self._inject_items: dict = {}  # token -> exec item (queue bypass)
+        self._inject_token = itertools.count(1)
+        self._inject_lock = threading.Lock()
+        if self._fp is not None and not is_driver:
+            try:
+                self._fp_exec_pump = native_fastpath.FastPump()
+                self.fp_port = self._fp_exec_pump.listen()
+            except Exception:
+                logger.exception("fastpath exec pump unavailable; "
+                                 "falling back to asyncio task loop")
+                self._fp_exec_pump = None
         self._actor_instance = None
         self._actor_id: str | None = None
         self._actor_callers: dict[str, dict] = {}
@@ -334,7 +360,8 @@ class CoreWorker:
             name=f"w{self.worker_id[:8]}->raylet",
             timeout=self.config.rpc_connect_timeout_s)
         await self.raylet.call("RegisterWorker", {
-            "worker_id": self.worker_id, "host": host, "port": port})
+            "worker_id": self.worker_id, "host": host, "port": port,
+            "fp_port": self.fp_port})
         if not self.is_driver:
             # Pool workers die with their raylet (reference: workers exit on
             # raylet socket disconnect), so a dead node leaves no orphans
@@ -369,12 +396,27 @@ class CoreWorker:
             pass
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._loop_thread.join(timeout=2)
+        # Native pumps go after the loop stops (the reader was removed in
+        # _async_shutdown; destroy wakes any exec thread still blocked in
+        # next() — the C side keeps the handle's sync primitives alive).
+        for pump in (self._fp_exec_pump, self._fp_sub_pump):
+            if pump is not None:
+                try:
+                    pump.close()
+                except Exception:
+                    pass
+        self._fp_exec_pump = self._fp_sub_pump = None
         try:
             self.store.close()
         except Exception:
             pass
 
     async def _async_shutdown(self):
+        if self._fp_sub_pump is not None:
+            try:
+                self.loop.remove_reader(self._fp_sub_pump.eventfd)
+            except Exception:
+                pass
         if self.is_driver and self.gcs and not self.gcs.closed:
             try:
                 await self.gcs.call("FinishJob", {"job_id": self.job_id}, timeout=2)
@@ -514,6 +556,14 @@ class CoreWorker:
         All fetches run concurrently on the IO loop (one threadsafe
         round-trip total; remote pulls overlap — reference: Get batches
         plasma + remote fetches, core_worker.cc:1353)."""
+        # Fastpath workers buffer TaskDone results while executing a
+        # batch; entering a (possibly blocking) get from the exec thread
+        # must flush them first — a task may be waiting on a result this
+        # very thread is holding back (the one deadlock case of
+        # completion coalescing).
+        fp_flush = getattr(self._exec_tls, "fp_flush", None)
+        if fp_flush is not None:
+            fp_flush()
         async def fetch_all():
             # A worker blocked here still holds its lease's CPU — release
             # it for the duration so nested/fan-out tasks can run on this
@@ -628,9 +678,15 @@ class CoreWorker:
                 return o.error[0], o.error[1], None
             if o is not None and o.state == OBJ_READY and o.inline is not None:
                 return o.inline[0], o.inline[1], None
-            got = self.store.get_buffer(oid)
-            if got is not None:
-                return got[0], got[1], oid_hex
+            # Self-owned PENDING objects cannot be sealed in the store yet
+            # (results register through _register_return first): skip the
+            # shm index probe and go straight to the ready-event wait —
+            # at burst-get rates the probe is measurable (~5 us/object).
+            if not (o is not None and o.state == OBJ_PENDING
+                    and (owner is None or owner.worker_id == self.worker_id)):
+                got = self.store.get_buffer(oid)
+                if got is not None:
+                    return got[0], got[1], oid_hex
             if o is not None and o.state == OBJ_READY and o.locations:
                 ok = await self._pull_to_local(oid_hex, list(o.locations))
                 if ok:
@@ -756,6 +812,9 @@ class CoreWorker:
 
     def wait(self, refs: list, num_returns: int = 1, timeout: float | None = None):
         """Returns (ready, not_ready) index lists."""
+        fp_flush = getattr(self._exec_tls, "fp_flush", None)
+        if fp_flush is not None:  # see get(): flush buffered completions
+            fp_flush()
         return self._run(self._wait_async(refs, num_returns, timeout))
 
     async def _wait_async(self, refs, num_returns, timeout):
@@ -1209,6 +1268,8 @@ class CoreWorker:
         from ray_tpu._private.api_internal import (  # cycle-free import
             ObjectRef, collect_nested_refs)
 
+        if not args and not kwargs:  # hot path: trivial no-arg tasks
+            return [], [], [], []
         wire = []
         deps = []
         nested: list = []
@@ -1261,11 +1322,17 @@ class CoreWorker:
             owner = Address.from_wire(owner_wire) if owner_wire else None
             self.borrow_incr(oid_hex, owner)
 
-    def _prepare_task(self, spec: TaskSpec,
-                      nested_args: list | None) -> tuple:
+    def _prepare_task(self, spec: TaskSpec, nested_args: list | None,
+                      task_id: TaskID | None = None) -> tuple:
         n_returns = (0 if spec.num_returns == STREAMING_RETURNS
                      else spec.num_returns)
-        returns = [ObjectID.for_task_return(TaskID.from_hex(spec.task_id), i + 1)
+        # Hot path: build return ids by concatenation off the TaskID the
+        # caller already holds (ObjectID = TaskID + BE index,
+        # ids.for_task_return) instead of a hex→bytes→hex round trip.
+        if task_id is None:
+            task_id = TaskID.from_hex(spec.task_id)
+        tb = task_id.binary()
+        returns = [ObjectID._wrap(tb + (i + 1).to_bytes(4, "big"))
                    for i in range(n_returns)]
         pt = _PendingTask(spec, retries_left=spec.max_retries,
                           nested_args=nested_args)
@@ -1288,20 +1355,21 @@ class CoreWorker:
         if wake:
             self.loop.call_soon_threadsafe(self._drain_submit_buf)
 
-    def submit_task(self, spec: TaskSpec,
-                    nested_args: list | None = None) -> list[ObjectID]:
+    def submit_task(self, spec: TaskSpec, nested_args: list | None = None,
+                    task_id: TaskID | None = None) -> list[ObjectID]:
         """Submit; returns the return-object IDs (owner = this worker)."""
-        pt, returns = self._prepare_task(spec, nested_args)
+        pt, returns = self._prepare_task(spec, nested_args, task_id)
         self._enqueue_prepared(pt)
         return returns
 
     def submit_streaming_task(self, spec: TaskSpec,
-                              nested_args: list | None = None):
+                              nested_args: list | None = None,
+                              task_id: TaskID | None = None):
         """Submit a num_returns="streaming" task; returns its yield
         queue. The queue is captured BEFORE the submission is enqueued —
         a fast task could complete (popping pending_tasks) before the
         caller could look the queue up afterwards."""
-        pt, _ = self._prepare_task(spec, nested_args)
+        pt, _ = self._prepare_task(spec, nested_args, task_id)
         q = pt.stream_q
         self._enqueue_prepared(pt)
         return q
@@ -1440,6 +1508,22 @@ class CoreWorker:
                     conn.handlers["TaskYield"] = self._handle_task_yield
                     conn.on_close(functools.partial(
                         self._on_slot_conn_closed, slot, shape))
+                    fp_port = resp.get("worker_fp_port") or 0
+                    if fp_port and self._fp is not None:
+                        pump = self._ensure_sub_pump()
+                        if pump is not None:
+                            try:
+                                # connect() blocks in the kernel; a
+                                # remote host that died post-grant would
+                                # stall the whole IO loop through SYN
+                                # retransmits — keep it off-loop.
+                                slot.fp_id = await asyncio.get_running_loop(
+                                    ).run_in_executor(
+                                        None, pump.connect,
+                                        resp["worker_host"], fp_port)
+                                self._fp_slots[slot.fp_id] = (slot, shape)
+                            except OSError:
+                                slot.fp_id = None  # asyncio fallback
                     self._leases[shape].append(slot)
                     await self._on_slot_idle(slot, shape)
                     return
@@ -1484,6 +1568,93 @@ class CoreWorker:
             self._raylet_conns, (host, port), host, port,
             name="owner->raylet", kind="raylet")
 
+    # ---------- fastpath submitter plane ----------
+
+    def _ensure_sub_pump(self):
+        """Lazily create the outbound fastpath pump + hook its recv
+        eventfd into the IO loop (loop thread only)."""
+        if self._fp_sub_pump is None and self._fp is not None:
+            try:
+                pump = self._fp.FastPump()
+            except Exception:
+                self._fp = None
+                return None
+            pump.arm_eventfd(True)
+            self.loop.add_reader(pump.eventfd, self._fp_drain_ready)
+            self._fp_sub_pump = pump
+        return self._fp_sub_pump
+
+    def _fp_drain_ready(self):
+        """recv eventfd became readable: batch-drain native events and
+        process them in ONE loop task (ordering: the pump FIFO preserves
+        per-socket frame order; processing is sequential)."""
+        try:
+            os.read(self._fp_sub_pump.eventfd, 8)
+        except (BlockingIOError, OSError, ValueError, AttributeError):
+            pass
+        # Drain to EMPTY: the eventfd was just zeroed, so any event left
+        # queued here would strand until unrelated future traffic.
+        while True:
+            evs = self._fp_sub_pump.drain(4096)
+            if not evs:
+                break
+            self._fp_backlog.extend(evs)
+        if not self._fp_processing and self._fp_backlog:
+            self._fp_processing = True
+            asyncio.ensure_future(self._fp_process())
+
+    async def _fp_process(self):
+        from ray_tpu._private.native_fastpath import EV_CLOSE, EV_FRAME
+        while True:
+            if not self._fp_backlog:
+                # No await between this check and the flag clear: the
+                # loop is single-threaded, so no event can be stranded.
+                self._fp_processing = False
+                return
+            batch, self._fp_backlog = self._fp_backlog, []
+            for kind, cid, payload in batch:
+                try:
+                    if kind == EV_FRAME:
+                        _mt, _seq, method, pl = rpc.unpack(payload)
+                        if method == "TaskDone":
+                            entry = self._fp_slots.get(cid)
+                            if entry is not None:
+                                await self._handle_task_done(
+                                    entry[0], entry[1], None, pl)
+                        elif method == "TaskYield":
+                            await self._handle_task_yield(None, pl)
+                    elif kind == EV_CLOSE:
+                        entry = self._fp_slots.pop(cid, None)
+                        if entry is not None:
+                            slot = entry[0]
+                            slot.fp_id = None
+                            self._on_slot_conn_closed(slot, entry[1])
+                            # Usually the worker died and the asyncio conn
+                            # is closing too; if only the fp socket died,
+                            # the lease must still be handed back and the
+                            # (possibly mid-batch) worker retired — its
+                            # tasks were just re-enqueued elsewhere.
+                            if not slot.conn.closed:
+                                try:
+                                    await slot.raylet.call(
+                                        "ReturnWorker",
+                                        {"lease_id": slot.lease_id,
+                                         "kill": True}, timeout=5)
+                                except Exception:
+                                    pass
+                                await slot.conn.close()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("fastpath event handling failed")
+
+    def _drop_slot_fp(self, slot) -> None:
+        if slot.fp_id is not None:
+            self._fp_slots.pop(slot.fp_id, None)
+            if self._fp_sub_pump is not None:
+                self._fp_sub_pump.close_conn(slot.fp_id)
+            slot.fp_id = None
+
     async def _on_slot_idle(self, slot: _LeaseSlot, shape: str):
         if slot.outstanding or slot.conn.closed:
             # A concurrent TaskDone handler already refilled this slot
@@ -1508,6 +1679,7 @@ class CoreWorker:
                 await slot.raylet.call("ReturnWorker", {"lease_id": slot.lease_id})
             except Exception:
                 pass
+            self._drop_slot_fp(slot)
             await slot.conn.close()
 
     async def _push_tasks(self, slot: _LeaseSlot, pts: list, shape: str):
@@ -1529,6 +1701,39 @@ class CoreWorker:
             slot.outstanding[pt.spec.task_id] = pt
             self._record_task_event(pt.spec.task_id, pt.spec.name, "RUNNING",
                                     target_node=slot.node_id)
+        if slot.fp_id is not None and self._fp_sub_pump is not None:
+            frame = rpc.pack([rpc.MSG_NOTIFY, 0, "PushTaskBatch",
+                              {"specs": [pt.spec.to_wire() for pt in pts]}])
+            if self._fp_sub_pump.send(slot.fp_id, frame):
+                return
+            # fp conn gone mid-lease: NOT silently degraded to the asyncio
+            # channel — earlier fp batches may still be queued worker-side
+            # and a later asyncio push could overtake them, inverting
+            # producer-before-consumer order within this worker's single
+            # exec thread (dependency-chain deadlock). Treat it like the
+            # connection loss it almost certainly is: retire the slot,
+            # give the lease back (kill: the worker may still be running
+            # half a batch we are about to retry elsewhere), and
+            # fail/retry the tasks.
+            self._drop_slot_fp(slot)
+            for pt in pts:
+                slot.outstanding.pop(pt.spec.task_id, None)
+            if slot in self._leases[shape]:
+                self._leases[shape].remove(slot)
+
+            async def give_back(slot=slot):
+                try:
+                    await slot.raylet.call(
+                        "ReturnWorker",
+                        {"lease_id": slot.lease_id, "kill": True})
+                except Exception:
+                    pass
+                await slot.conn.close()
+            asyncio.ensure_future(give_back())
+            for pt in pts:
+                await self._handle_worker_failure(
+                    pt, shape, "fastpath connection lost")
+            return
         try:
             await slot.conn.notify(
                 "PushTaskBatch",
@@ -1555,6 +1760,7 @@ class CoreWorker:
     def _on_slot_conn_closed(self, slot: _LeaseSlot, shape: str):
         """Worker connection died: drop the slot (idle or not) and
         fail/retry everything still pushed."""
+        self._drop_slot_fp(slot)
         if slot in self._leases[shape]:
             self._leases[shape].remove(slot)
         if self._shutdown or not slot.outstanding:
@@ -1827,7 +2033,7 @@ class CoreWorker:
     async def _handle_push_task(self, conn, payload):
         spec = TaskSpec.from_wire(payload["spec"])
         fut = asyncio.get_running_loop().create_future()
-        self._exec_queue.put((spec, fut))
+        self._exec_enqueue((spec, fut))
         return await fut
 
     async def _handle_push_task_batch(self, conn, payload):
@@ -1836,7 +2042,7 @@ class CoreWorker:
         _queue_task_done). The whole batch is ONE exec-queue item so a
         burst of trivial tasks costs one thread handoff, not N."""
         specs = [TaskSpec.from_wire(w) for w in payload["specs"]]
-        self._exec_queue.put((specs, conn))
+        self._exec_enqueue((specs, conn))
 
     def _queue_task_done(self, conn, task_id: str, result: dict):
         """Exec-thread side: buffer a completion for `conn` and schedule
@@ -1888,9 +2094,48 @@ class CoreWorker:
         return {"pid": os.getpid(), "worker_id": self.worker_id,
                 "actor_id": self._actor_id, "threads": out}
 
+    def _run_exec_item(self, item) -> None:
+        """Execute one queued item (shared by the asyncio-fed queue path
+        and fastpath injection)."""
+        spec, sink = item
+        if isinstance(spec, list):  # batch item: sink is the owner conn
+            def emit(task_id, index, entry, conn=sink):
+                # Yields notify IMMEDIATELY (not coalesced like
+                # TaskDone): loop FIFO keeps them ahead of the
+                # task's completion on the same connection.
+                self.loop.call_soon_threadsafe(
+                    lambda: asyncio.ensure_future(conn.notify(
+                        "TaskYield",
+                        {"task_id": task_id, "index": index,
+                         "result": entry})))
+
+            for s in spec:
+                self._queue_task_done(sink, s.task_id,
+                                      self._execute_task(s, emit))
+        else:  # single item: sink is a future
+            result = self._execute_task(spec)
+            self.loop.call_soon_threadsafe(
+                lambda f=sink, r=result: (not f.done()) and
+                f.set_result(r))
+
+    def _exec_enqueue(self, item) -> None:
+        """Hand an exec item to the execution thread(s): fastpath
+        injection when the native pump runs the task loop, else the
+        plain queue."""
+        pump = self._fp_exec_pump
+        if pump is not None:
+            with self._inject_lock:
+                token = next(self._inject_token)
+                self._inject_items[token] = item
+            pump.inject(token)
+        else:
+            self._exec_queue.put(item)
+
     def execution_loop(self):
         """Main thread of a pool worker: executes tasks sequentially
         (reference: _raylet.pyx:3044 run_task_loop)."""
+        if self._fp_exec_pump is not None:
+            return self._execution_loop_fastpath(self._fp_exec_pump)
         while not self._shutdown:
             try:
                 item = self._exec_queue.get(timeout=0.5)
@@ -1898,26 +2143,78 @@ class CoreWorker:
                 continue
             if item is None:
                 break
-            spec, sink = item
-            if isinstance(spec, list):  # batch item: sink is the owner conn
-                def emit(task_id, index, entry, conn=sink):
-                    # Yields notify IMMEDIATELY (not coalesced like
-                    # TaskDone): loop FIFO keeps them ahead of the
-                    # task's completion on the same connection.
-                    self.loop.call_soon_threadsafe(
-                        lambda: asyncio.ensure_future(conn.notify(
-                            "TaskYield",
-                            {"task_id": task_id, "index": index,
-                             "result": entry})))
+            self._run_exec_item(item)
 
-                for s in spec:
-                    self._queue_task_done(sink, s.task_id,
-                                          self._execute_task(s, emit))
-            else:  # single item: sink is a future
-                result = self._execute_task(spec)
-                self.loop.call_soon_threadsafe(
-                    lambda f=sink, r=result: (not f.done()) and
-                    f.set_result(r))
+    def _execution_loop_fastpath(self, pump):
+        """Native task loop: block in C (GIL released) for the next
+        event — an inbound PushTaskBatch frame from an owner's fastpath
+        socket, or an injected loop-side item (actor calls, assigns).
+        Completions coalesce into TaskDone frames (flushed at batch end,
+        every 64 results, and — the deadlock-safe rule — whenever THIS
+        exec thread is about to block in get()/wait(), since a task
+        consuming an earlier buffered result in the same batch is the
+        only way a held completion could stall progress; see get()).
+        Yields go immediately; the socket FIFO is the ordering guarantee
+        (reference: the worker main loop in _raylet.pyx:3044 runs inside
+        the C++ CoreWorker the same way)."""
+        from ray_tpu._private.native_fastpath import EV_FRAME, EV_INJECT
+        while not self._shutdown:
+            ev = pump.next(0.5)
+            if ev is None:
+                continue
+            kind, cid, payload = ev
+            if kind == EV_FRAME:
+                try:
+                    self._fp_exec_frame(pump, cid, payload)
+                except Exception:
+                    # Must not escape: this is the worker's only task
+                    # loop — an owner bug (malformed spec) would
+                    # otherwise kill it silently with sockets left open.
+                    logger.exception("fastpath: frame handling failed")
+            elif kind == EV_INJECT:
+                with self._inject_lock:
+                    item = self._inject_items.pop(cid, None)
+                if item is None:
+                    continue
+                self._run_exec_item(item)
+            # EV_ACCEPT / EV_CLOSE: connection registry lives in C; the
+            # owner side drives retries, nothing to do here.
+
+    def _fp_exec_frame(self, pump, cid, payload):
+        """Handle one inbound fastpath frame on the exec thread."""
+        _mt, _seq, method, pl = rpc.unpack(payload)
+        if method != "PushTaskBatch":
+            logger.warning("fastpath: unexpected method %r", method)
+            return
+        buffered: list = []
+
+        def flush(cid=cid, buffered=buffered):
+            if buffered:
+                pump.send(cid, rpc.pack(
+                    [rpc.MSG_NOTIFY, 0, "TaskDone",
+                     {"results": buffered}]))
+                buffered.clear()
+
+        def emit(task_id, index, entry, cid=cid, flush=flush):
+            # A yield must not overtake completions of
+            # EARLIER tasks buffered on this conn.
+            flush()
+            pump.send(cid, rpc.pack(
+                [rpc.MSG_NOTIFY, 0, "TaskYield",
+                 {"task_id": task_id, "index": index,
+                  "result": entry}]))
+
+        self._exec_tls.fp_flush = flush
+        try:
+            for w in pl["specs"]:
+                s = TaskSpec.from_wire(w)
+                buffered.append(
+                    [s.task_id, self._execute_task(s, emit)])
+                if len(buffered) >= 64:
+                    flush()
+        finally:
+            self._exec_tls.fp_flush = None
+            flush()
 
     def _start_actor_concurrency(self, max_concurrency: int) -> None:
         """Spawn extra execution threads so up to max_concurrency actor
@@ -2177,7 +2474,7 @@ class CoreWorker:
         spec = TaskSpec.from_wire(payload["spec"])
         self._actor_id = spec.actor_id
         fut = asyncio.get_running_loop().create_future()
-        self._exec_queue.put((spec, fut))
+        self._exec_enqueue((spec, fut))
         result = await fut
         if result["status"] != "ok":
             err = result.get("error")
@@ -2214,7 +2511,7 @@ class CoreWorker:
             item = state["buffer"].pop(state["next_seq"])
             state["next_seq"] += 1
             if item is not None:  # None = abandoned seq (see ActorSeqSkip)
-                self._exec_queue.put(item)
+                self._exec_enqueue(item)
 
     async def _handle_actor_seq_skip(self, conn, payload):
         """A caller abandoned a seq-no it was assigned (its task failed
