@@ -73,6 +73,16 @@ class GcsServer:
         self._row_sizes: dict[tuple[str, str], int] = {}
         self._persisted_bytes = 0  # total state size for compaction ratio
         self._flush_lock = threading.Lock()
+        # Rows touched by in-flight mutating handlers: (table, key)
+        # entries recorded AFTER the in-memory mutation, drained by the
+        # handler wrapper and written through the WAL BEFORE the RPC
+        # reply (per-mutation durability — reference: redis
+        # store_client_kv write-through). Shared across concurrent
+        # handlers on purpose: flushing another handler's already-applied
+        # mutation early is harmless, and each wrapper drains the list
+        # after its own handler ran, so its own rows are always covered.
+        self._touched: list = []
+        self._needs_sync = False  # WAL appends since last fdatasync
         self.nodes: dict[str, NodeInfo] = {}
         self.node_conns: dict[str, rpc.Connection] = {}
         self.kv: dict[str, dict[bytes, bytes]] = defaultdict(dict)
@@ -132,6 +142,14 @@ class GcsServer:
                 try:
                     return await fn(conn, payload)
                 finally:
+                    # Write-through BEFORE the reply goes out: rows the
+                    # handler _touch()ed hit the WAL now, so a GCS
+                    # killed -9 right after the ack replays them.
+                    # mark_dirty stays as the hash-diffed catch-all for
+                    # mutation sites without a _touch.
+                    if self._touched:
+                        touched, self._touched = self._touched, []
+                        self._persist_touched(touched)
                     self.mark_dirty(tables)
 
             return dirty
@@ -221,6 +239,80 @@ class GcsServer:
     def mark_dirty(self, tables=None):
         self._dirty.update(tables if tables is not None else
                            self._ALL_TABLES)
+
+    def _touch(self, table: str, key) -> None:
+        """Record one mutated row for pre-reply write-through. Call
+        AFTER the in-memory mutation, with the live-table key:
+        kv=(ns, key_bytes), actors/jobs/placement_groups=str id,
+        named_actors=(name, namespace), nodes=node_id."""
+        if self._store is not None:
+            self._touched.append((table, key))
+
+    def _pack_row(self, table: str, key):
+        """(store_key_hex, row_bytes | None) for one live-table row —
+        None when the key is gone (row delete). Mirrors _table_rows."""
+        if table == "kv":
+            ns, k = key
+            v = self.kv.get(ns, {}).get(k)
+            return rpc.pack([ns, k]).hex(), (None if v is None
+                                             else rpc.pack(v))
+        if table == "actors":
+            a = self.actors.get(key)
+            if a is not None:
+                a = dict(a)
+                if isinstance(a.get("dead_worker_ids"), set):
+                    a["dead_worker_ids"] = sorted(a["dead_worker_ids"])
+            return key.encode().hex(), None if a is None else rpc.pack(a)
+        if table == "named_actors":
+            v = self.named_actors.get(key)
+            return (rpc.pack(list(key)).hex(),
+                    None if v is None else rpc.pack(v))
+        if table == "jobs":
+            j = self.jobs.get(key)
+            return key.encode().hex(), None if j is None else rpc.pack(j)
+        if table == "placement_groups":
+            pg = self.placement_groups.get(key)
+            return key.encode().hex(), None if pg is None else rpc.pack(pg)
+        if table == "nodes":
+            n = self.nodes.get(key)
+            return (key.encode().hex(),
+                    None if n is None else rpc.pack(n.to_wire()))
+        raise ValueError(f"unknown persistence table {table!r}")
+
+    def _persist_touched(self, touched: list) -> None:
+        """Write touched rows through the WAL synchronously (before the
+        RPC reply). Failures fall back to the debounced flush via
+        mark_dirty."""
+        with self._flush_lock:
+            for table, key in touched:
+                try:
+                    key_hex, blob = self._pack_row(table, key)
+                except Exception:
+                    logger.exception("write-through pack failed (%s)", table)
+                    self.mark_dirty((table,))
+                    continue
+                if blob is None:
+                    if (table, key_hex) in self._row_hashes:
+                        if self._store.delete(table, key_hex):
+                            del self._row_hashes[(table, key_hex)]
+                            self._persisted_bytes -= \
+                                self._row_sizes.pop((table, key_hex), 0)
+                            self._needs_sync = True
+                        else:
+                            self.mark_dirty((table,))
+                    continue
+                h = hash(blob)
+                if self._row_hashes.get((table, key_hex)) == h:
+                    continue  # unchanged (idempotent re-touch)
+                if self._store.put(table, key_hex, blob):
+                    self._row_hashes[(table, key_hex)] = h
+                    self._persisted_bytes += (
+                        len(blob) - self._row_sizes.get((table, key_hex), 0))
+                    self._row_sizes[(table, key_hex)] = len(blob)
+                    self._needs_sync = True
+                else:
+                    self._row_hashes.pop((table, key_hex), None)
+                    self.mark_dirty((table,))
 
     def _table_rows(self, only=None) -> dict:
         """Pack live tables into {(namespace, hex_key): row_bytes}.
@@ -396,6 +488,13 @@ class GcsServer:
     async def _persist_loop(self):
         while True:
             await asyncio.sleep(0.5)
+            if self._needs_sync:
+                # Batched fdatasync: write-through already made every
+                # acknowledged mutation process-crash durable; this
+                # bounds OS-crash exposure to one window (redis
+                # appendfsync-everysec semantics).
+                self._needs_sync = False
+                await asyncio.to_thread(self._store.sync)
             if not self._dirty:
                 continue
             tables, self._dirty = self._dirty, set()
@@ -457,6 +556,7 @@ class GcsServer:
         )
         self.nodes[info.node_id] = info
         self.node_conns[info.node_id] = conn
+        self._touch("nodes", info.node_id)
         if hasattr(self, "_restored_unregistered"):
             self._restored_unregistered.discard(info.node_id)
         if self.native_sched is not None:
@@ -529,6 +629,7 @@ class GcsServer:
         if self.native_sched is not None:
             self.native_sched.update_node(node_id, available={}, alive=False)
         self.pending_demand.pop(node_id, None)
+        self._touch("nodes", node_id)
         logger.warning("node %s dead: %s", node_id[:8], reason)
         self.mark_dirty(("nodes", "actors", "placement_groups"))
         from ray_tpu.util import events
@@ -568,6 +669,7 @@ class GcsServer:
         if not payload.get("overwrite", True) and key in table:
             return {"added": False}
         table[key] = payload["value"]
+        self._touch("kv", (ns, key))
         return {"added": True}
 
     async def handle_kv_get(self, conn, payload):
@@ -575,6 +677,8 @@ class GcsServer:
 
     async def handle_kv_del(self, conn, payload):
         existed = self.kv[payload.get("ns", "")].pop(payload["key"], None) is not None
+        if existed:
+            self._touch("kv", (payload.get("ns", ""), payload["key"]))
         return {"deleted": existed}
 
     async def handle_kv_keys(self, conn, payload):
@@ -603,6 +707,7 @@ class GcsServer:
                     return {"ok": False,
                             "reason": f"actor name {name!r} already taken in {namespace!r}"}
             self.named_actors[key] = actor_id
+            self._touch("named_actors", key)
         self.actors[actor_id] = {
             "actor_id": actor_id,
             "job_id": payload.get("job_id", ""),
@@ -623,6 +728,7 @@ class GcsServer:
             "placement_group": payload.get("placement_group", ""),
             "pg_bundle_index": payload.get("pg_bundle_index", -1),
         }
+        self._touch("actors", actor_id)
         asyncio.ensure_future(self._schedule_actor(actor_id))
         return {"ok": True}
 
@@ -726,6 +832,7 @@ class GcsServer:
             return {"ok": False}
         a["state"] = ACTOR_ALIVE
         a["address"] = payload["address"]
+        self._touch("actors", payload["actor_id"])
         # restarts doubles as the incarnation number: callers reset their
         # per-actor sequence numbers when it changes (reference: the client
         # queue resend path in direct_actor_task_submitter).
@@ -766,6 +873,7 @@ class GcsServer:
             a["restarts"] += 1
             a["state"] = ACTOR_RESTARTING
             a["address"] = None
+            self._touch("actors", actor_id)
             self.mark_dirty(("actors",))
             await self.publish("ACTOR", {"actor_id": actor_id, "state": ACTOR_RESTARTING,
                                          "reason": reason})
@@ -776,6 +884,8 @@ class GcsServer:
             a["address"] = None
             a["death_cause"] = reason
             self.named_actors.pop((a["namespace"], a["name"]), None)
+            self._touch("actors", actor_id)
+            self._touch("named_actors", (a["namespace"], a["name"]))
             from ray_tpu.util import events
 
             events.record("WARNING", "gcs", "actor dead",
@@ -854,6 +964,7 @@ class GcsServer:
             "status": "RUNNING",
             "entrypoint": payload.get("entrypoint", ""),
         }
+        self._touch("jobs", payload["job_id"])
         return {"ok": True}
 
     async def handle_finish_job(self, conn, payload):
@@ -861,6 +972,7 @@ class GcsServer:
         if job:
             job["status"] = payload.get("status", "SUCCEEDED")
             job["end_time"] = time.time()
+            self._touch("jobs", payload["job_id"])
         # Raylets release the job's runtime-env references on this event
         # (reference: runtime-env URI GC when the last referencing job
         # exits, runtime_env ARCHITECTURE.md).
@@ -885,6 +997,7 @@ class GcsServer:
             "state": PG_PENDING,
             "job_id": payload.get("job_id", ""),
         }
+        self._touch("placement_groups", pg_id)
         asyncio.ensure_future(self._schedule_pg(pg_id))
         return {"ok": True}
 
@@ -1015,6 +1128,7 @@ class GcsServer:
                 except Exception:
                     pass
         pg["state"] = PG_REMOVED
+        self._touch("placement_groups", payload["pg_id"])
         return {"ok": True}
 
     async def handle_get_pg(self, conn, payload):

@@ -8,13 +8,15 @@ redis_store_client.h — redis is what gives the reference per-mutation
 durability for GCS fault tolerance).
 
 Rows are opaque bytes keyed (namespace, key): every put/del appends one
-crash-safe WAL record (truncated tails stop replay at the last complete
-record). The GCS still flushes on its 0.5 s debounce — a crash can lose
-that final window, same as before — but each flush now writes only the
-CHANGED rows instead of deep-copying and rewriting the entire cluster
-state, and everything flushed survives any crash. `compact()` rewrites
-the snapshot and truncates the WAL; the GCS calls it when the WAL
-outgrows the snapshot.
+WAL record, fflush'd per append, so an acknowledged mutation survives a
+GCS PROCESS crash (kill -9) — the GCS writes rows through HERE before
+replying to mutating RPCs. OS-crash/power-loss durability additionally
+needs `sync()` (fdatasync), which the GCS batches on a short debounce —
+the same exposure window as the reference's default redis
+appendfsync-everysec. Truncated tails and corrupt length fields stop
+restart replay at the last complete record. `compact()` rewrites the
+snapshot and truncates the WAL; the GCS calls it when the WAL outgrows
+the snapshot.
 """
 
 from __future__ import annotations
@@ -58,6 +60,8 @@ def _get_lib():
                                           ctypes.c_int]
         lib.gstore_compact.restype = ctypes.c_int
         lib.gstore_compact.argtypes = [ctypes.c_void_p]
+        lib.gstore_sync.restype = ctypes.c_int
+        lib.gstore_sync.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -137,3 +141,7 @@ class GcsTableStore:
 
     def compact(self) -> bool:
         return self._lib.gstore_compact(self._h) == 0
+
+    def sync(self) -> bool:
+        """fdatasync the WAL (OS-crash durability; see module doc)."""
+        return self._lib.gstore_sync(self._h) == 0

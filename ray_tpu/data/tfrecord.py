@@ -83,7 +83,10 @@ def read_records(src, *, verify: bool = False):
             data = f.read(length)
             if len(data) < length:
                 raise ValueError(f"{path}: truncated record")
-            (data_crc,) = struct.unpack("<I", f.read(4))
+            crc_bytes = f.read(4)
+            if len(crc_bytes) < 4:  # cut between payload and its CRC
+                raise ValueError(f"{path}: truncated record")
+            (data_crc,) = struct.unpack("<I", crc_bytes)
             if verify and _masked_crc(data) != data_crc:
                 raise ValueError(f"{path}: corrupt record data CRC")
             yield data
@@ -110,7 +113,10 @@ def write_records(path: str, payloads) -> int:
 
 def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
     result = shift = 0
+    end = len(buf)
     while True:
+        if pos >= end:  # malformed message: varint runs past the buffer
+            raise ValueError("malformed protobuf: truncated varint")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift

@@ -7,14 +7,17 @@
 //
 // Design: an in-memory (namespace, key) -> bytes table plus a
 // write-ahead log. Every put/del appends one framed record to the WAL
-// and the in-memory table updates under a mutex; a restarted GCS
-// replays snapshot + WAL, so everything WRITTEN here survives any
-// crash (truncated tails stop replay at the last complete record).
-// The GCS caller still batches its writes on a debounced flush — what
-// this store changes is that each flush is row-incremental instead of
-// a full-state deep-copy + rewrite, and flushed rows are durable.
-// `compact` rewrites the snapshot file atomically and truncates the
-// WAL; callers trigger it when the WAL outgrows the snapshot.
+// (fflush'd per append) and the in-memory table updates under a mutex;
+// a restarted GCS replays snapshot + WAL, so everything written here
+// survives a GCS PROCESS crash (truncated tails and corrupt length
+// fields stop replay at the last complete record). OS-crash/power-loss
+// durability needs gstore_sync (fdatasync), which the GCS batches on a
+// short debounce — the same window redis's default appendfsync-everysec
+// gives the reference. The GCS calls put/del per acknowledged mutation
+// (write-through before the RPC reply); a debounced hash-diff flush
+// remains as the catch-all for internal cascades. `compact` rewrites
+// the snapshot file atomically and truncates the WAL; callers trigger
+// it when the WAL outgrows the snapshot.
 //
 // File formats (little-endian u32 lengths):
 //   snapshot: [u32 ns_len][ns][u32 key_len][key][u32 val_len][val]...
@@ -22,6 +25,8 @@
 //             ([u32 val_len][val] for put)...   appended per mutation
 //
 // Exposed as a C ABI for ctypes (ray_tpu/_private/native_gcs_store.py).
+
+#include <unistd.h>
 
 #include <cstdint>
 #include <cstdio>
@@ -67,30 +72,49 @@ bool WriteBlob(FILE* f, const std::string& s) {
 
 bool ReadU32(FILE* f, uint32_t* v) { return std::fread(v, 4, 1, f) == 1; }
 
-bool ReadBlob(FILE* f, std::string* s) {
+// Bounded read: a corrupted length field must stop replay at the bad
+// record, not bad_alloc the restarting GCS (the length is validated
+// against the bytes actually left in the file before resizing).
+bool ReadBlob(FILE* f, std::string* s, uint64_t* remaining) {
   uint32_t n;
-  if (!ReadU32(f, &n)) return false;
+  if (*remaining < 4 || !ReadU32(f, &n)) return false;
+  *remaining -= 4;
+  if (n > *remaining) return false;  // truncated/corrupt tail
   s->resize(n);
-  return n == 0 || std::fread(&(*s)[0], n, 1, f) == 1;
+  if (n != 0 && std::fread(&(*s)[0], n, 1, f) != 1) return false;
+  *remaining -= n;
+  return true;
 }
 
-// Load snapshot + replay WAL. Truncated tails (crash mid-append) stop
-// replay at the last complete record.
+uint64_t FileSize(FILE* f) {
+  long cur = std::ftell(f);
+  std::fseek(f, 0, SEEK_END);
+  long end = std::ftell(f);
+  std::fseek(f, cur, SEEK_SET);
+  return end > 0 ? static_cast<uint64_t>(end) : 0;
+}
+
+// Load snapshot + replay WAL. Truncated tails (crash mid-append) and
+// corrupt length fields stop replay at the last complete record.
 void LoadInto(GcsStore* g) {
   if (FILE* f = std::fopen(g->snap_path.c_str(), "rb")) {
+    uint64_t rem = FileSize(f);
     std::string ns, key, val;
-    while (ReadBlob(f, &ns) && ReadBlob(f, &key) && ReadBlob(f, &val))
+    while (ReadBlob(f, &ns, &rem) && ReadBlob(f, &key, &rem) &&
+           ReadBlob(f, &val, &rem))
       g->tables[ns][key] = val;
     std::fclose(f);
   }
   if (FILE* f = std::fopen(g->wal_path.c_str(), "rb")) {
+    uint64_t rem = FileSize(f);
     for (;;) {
       uint8_t op;
-      if (std::fread(&op, 1, 1, f) != 1) break;
+      if (rem < 1 || std::fread(&op, 1, 1, f) != 1) break;
+      rem -= 1;
       std::string ns, key, val;
-      if (!ReadBlob(f, &ns) || !ReadBlob(f, &key)) break;
+      if (!ReadBlob(f, &ns, &rem) || !ReadBlob(f, &key, &rem)) break;
       if (op == 1) {
-        if (!ReadBlob(f, &val)) break;
+        if (!ReadBlob(f, &val, &rem)) break;
         g->tables[ns][key] = val;
       } else {
         g->tables[ns].erase(key);
@@ -184,6 +208,19 @@ uint64_t gstore_wal_bytes(void* h) {
   auto* g = static_cast<GcsStore*>(h);
   std::lock_guard<std::mutex> lock(g->mu);
   return g->wal_bytes;
+}
+
+// fdatasync the WAL: every append is already fflush()ed (survives a GCS
+// PROCESS crash — the kernel page cache holds it); this pushes it to
+// stable storage for OS-crash/power-loss durability. Callers batch it
+// (redis appendfsync-everysec semantics) rather than paying a sync per
+// mutation.
+int gstore_sync(void* h) {
+  auto* g = static_cast<GcsStore*>(h);
+  std::lock_guard<std::mutex> lock(g->mu);
+  if (!g->wal) return 0;
+  if (std::fflush(g->wal) != 0) return -1;
+  return fdatasync(fileno(g->wal)) == 0 ? 0 : -1;
 }
 
 // Iterate all rows of one namespace: repeatedly call with a cursor

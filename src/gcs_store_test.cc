@@ -24,6 +24,7 @@ int gstore_scan(void*, const char* ns, int* cursor, char* kout, int klen,
                 char* vout, int vlen);
 int gstore_namespaces(void*, char* out, int len);
 int gstore_compact(void*);
+int gstore_sync(void*);
 }
 
 static char prefix[256];
@@ -122,6 +123,30 @@ static void test_truncated_wal_tail() {
   gstore_destroy(g2);
 }
 
+static void test_corrupt_length_field() {
+  // A corrupted length (e.g. bit flip to ~4 GiB) must stop replay at
+  // the bad record — not bad_alloc the restarting GCS.
+  fresh_prefix("corrupt");
+  void* g = gstore_create(prefix);
+  assert(gstore_put(g, "t", "good", "v", 1) == 0);
+  assert(gstore_sync(g) == 0);
+  gstore_destroy(g);
+  char p[300];
+  snprintf(p, sizeof(p), "%s.wal", prefix);
+  FILE* f = fopen(p, "ab");
+  uint8_t op = 1;
+  uint32_t huge = 0xfffffff0u;  // claims ~4 GiB
+  fwrite(&op, 1, 1, f);
+  fwrite(&huge, 4, 1, f);
+  fwrite("x", 1, 1, f);
+  fclose(f);
+  void* g2 = gstore_create(prefix);  // must not crash/alloc 4 GiB
+  char buf[8];
+  assert(gstore_get(g2, "t", "good", buf, sizeof(buf)) == 1);
+  assert(gstore_num_rows(g2) == 1);
+  gstore_destroy(g2);
+}
+
 static void test_scan_and_namespaces() {
   fresh_prefix("scan");
   void* g = gstore_create(prefix);
@@ -182,6 +207,7 @@ int main() {
   test_wal_replay_after_crash();
   test_compact_and_reload();
   test_truncated_wal_tail();
+  test_corrupt_length_field();
   test_scan_and_namespaces();
   test_concurrent_churn();
   printf("gcs_store_test: all passed\n");

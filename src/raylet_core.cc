@@ -187,13 +187,29 @@ int rcore_try_acquire(void* h, const char* lease_id, const char* resources,
     auto it = c->bundles.end();
     if (bundle_index >= 0) {
       it = c->bundles.find(key);
+      if (it == c->bundles.end() || !it->second.committed) return -1;
+      if (!Fits(it->second.avail, demand)) return 0;
     } else {
-      it = c->bundles.lower_bound(BundleKey{pg_id, -1});
-      if (it != c->bundles.end() && it->first.pg_id != key.pg_id)
-        it = c->bundles.end();
+      // Wildcard: any committed bundle of this PG on this node that
+      // FITS — like the reference's _group_ wildcard resources, which
+      // aggregate across all of the PG's bundles, a full lowest-index
+      // bundle must not mask capacity in a later one.
+      bool any_committed = false;
+      bool any_fits = false;
+      for (auto bit = c->bundles.lower_bound(BundleKey{pg_id, -1});
+           bit != c->bundles.end() && bit->first.pg_id == key.pg_id;
+           ++bit) {
+        if (!bit->second.committed) continue;
+        any_committed = true;
+        if (Fits(bit->second.avail, demand)) {
+          it = bit;
+          any_fits = true;
+          break;
+        }
+      }
+      if (!any_committed) return -1;
+      if (!any_fits) return 0;
     }
-    if (it == c->bundles.end() || !it->second.committed) return -1;
-    if (!Fits(it->second.avail, demand)) return 0;
     Subtract(it->second.avail, demand);
     l.has_pg = true;
     l.pg = it->first;

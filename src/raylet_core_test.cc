@@ -130,6 +130,29 @@ static void test_bundle_2pc_and_leases() {
   rcore_destroy(h);
 }
 
+static void test_wildcard_spans_all_bundles() {
+  // A full (or uncommitted) lowest-index bundle must not mask capacity
+  // in a later bundle of the same PG (reference: _group_ wildcard
+  // resources aggregate across all of the PG's bundles).
+  void* h = rcore_create("CPU=8");
+  assert(rcore_pg_prepare(h, "pg", 0, "CPU=1") == 1);
+  assert(rcore_pg_prepare(h, "pg", 1, "CPU=2") == 1);
+  assert(rcore_pg_commit(h, "pg", 0) == 0);
+  assert(rcore_pg_commit(h, "pg", 1) == 0);
+  // Fill bundle 0 entirely.
+  assert(rcore_try_acquire(h, "f", "CPU=1", "pg", 0) == 1);
+  // Wildcard must land in bundle 1, not report "no fit".
+  assert(rcore_try_acquire(h, "w1", "CPU=1", "pg", -1) == 1);
+  assert(rcore_try_acquire(h, "w2", "CPU=1", "pg", -1) == 1);
+  assert(rcore_try_acquire(h, "w3", "CPU=1", "pg", -1) == 0);  // all full
+  // Uncommitted lowest bundle: wildcard skips it rather than erroring.
+  assert(rcore_pg_prepare(h, "pg2", 0, "CPU=1") == 1);
+  assert(rcore_pg_prepare(h, "pg2", 1, "CPU=1") == 1);
+  assert(rcore_pg_commit(h, "pg2", 1) == 0);
+  assert(rcore_try_acquire(h, "x", "CPU=1", "pg2", -1) == 1);
+  rcore_destroy(h);
+}
+
 static void test_blocked_bundle_lease() {
   void* h = rcore_create("CPU=4");
   assert(rcore_pg_prepare(h, "pg", 0, "CPU=2") == 1);
@@ -189,6 +212,7 @@ int main() {
   test_node_pool_lifecycle();
   test_blocked_worker_release();
   test_bundle_2pc_and_leases();
+  test_wildcard_spans_all_bundles();
   test_blocked_bundle_lease();
   test_concurrent_churn();
   printf("raylet_core_test: all passed\n");

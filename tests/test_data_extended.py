@@ -336,6 +336,27 @@ def test_tfrecords_roundtrip(tmp_path):
         list(tfr.read_records(f, verify=True))
 
 
+def test_tfrecords_truncation_errors(tmp_path):
+    """Malformed files raise the intended ValueError, not bare
+    struct.error / IndexError: a file cut between payload and data-CRC,
+    and an Example whose varint runs past the buffer."""
+    import pytest as _pytest
+
+    from ray_tpu.data import tfrecord as tfr
+
+    f = str(tmp_path / "cut.tfrecords")
+    tfr.write_records(f, [b"payload-bytes"])
+    blob = open(f, "rb").read()
+    # Cut inside the trailing 4-byte data CRC.
+    open(f, "wb").write(blob[:-2])
+    with _pytest.raises(ValueError, match="truncated record"):
+        list(tfr.read_records(f))
+
+    # Varint running past the end of a malformed Example payload.
+    with _pytest.raises(ValueError, match="truncated varint"):
+        tfr.parse_example(b"\x0a\xff\xff\xff")
+
+
 def test_from_huggingface_arrow_zero_copy():
     """from_huggingface hands an Arrow-backed HF dataset's table over as
     an Arrow block (reference: ray.data.from_huggingface)."""

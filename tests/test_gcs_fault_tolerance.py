@@ -106,3 +106,46 @@ def test_gcs_restart_preserves_kv(ft_cluster):
             pass
         time.sleep(0.5)
     raise AssertionError("KV entry lost across GCS restart")
+
+
+def test_write_through_survives_immediate_kill9(ft_cluster):
+    """Per-mutation durability: an acknowledged mutation must survive a
+    GCS SIGKILL delivered IMMEDIATELY after the ack — no persistence-
+    window sleep (reference: redis store_client gives the GCS
+    write-through per mutation, store_client_kv.h). The WAL append runs
+    before the RPC reply, so there is nothing left to lose."""
+    from ray_tpu._private.api_internal import get_core_worker
+
+    cw = get_core_worker()
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    # Acked mutations: KVPut + named-actor registration. NO sleep after.
+    cw._run(cw.gcs.call("KVPut", {"ns": "wt", "key": b"k", "value": b"v"}))
+    a = Pinger.options(name="wt-actor").remote()
+    del a  # handle not needed; registration was acknowledged
+
+    node = ft_cluster._node
+    node.kill_gcs()  # SIGKILL, immediately after the acks
+    node.restart_gcs()
+
+    deadline = time.monotonic() + 60
+    kv_ok = actor_ok = False
+    while time.monotonic() < deadline and not (kv_ok and actor_ok):
+        try:
+            if not kv_ok:
+                got = cw._run(cw.gcs.call(
+                    "KVGet", {"ns": "wt", "key": b"k"}), timeout=5)
+                kv_ok = got.get("value") == b"v"
+            if not actor_ok:
+                # The registration was PENDING at kill time; the restarted
+                # GCS must replay it and re-kick scheduling.
+                h = ray_tpu.get_actor("wt-actor")
+                actor_ok = ray_tpu.get(h.ping.remote(), timeout=30) == "pong"
+        except Exception:
+            time.sleep(0.5)
+    assert kv_ok, "acknowledged KVPut lost across immediate kill -9"
+    assert actor_ok, "acknowledged actor registration lost across kill -9"
