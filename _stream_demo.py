@@ -1,0 +1,48 @@
+import ray_tpu
+ray_tpu.init(num_cpus=4)
+
+# plain streaming still works
+@ray_tpu.remote(num_returns="streaming")
+def gen(n):
+    for i in range(n):
+        yield i * 2
+assert [ray_tpu.get(r) for r in gen.remote(5)] == [0,2,4,6,8]
+
+# actor-method streaming
+@ray_tpu.remote
+class Streamer:
+    def __init__(self): self.base = 100
+    def stream(self, n):
+        for i in range(n):
+            yield self.base + i
+    def plain(self): return "ok"
+
+s = Streamer.remote()
+g = s.stream.options(num_returns="streaming").remote(4)
+got = [ray_tpu.get(r) for r in g]
+assert got == [100,101,102,103], got
+# interleave with plain calls and a second stream
+assert ray_tpu.get(s.plain.remote()) == "ok"
+g2 = s.stream.options(num_returns="streaming").remote(2)
+assert [ray_tpu.get(r) for r in g2] == [100,101]
+
+# mid-stream error from actor method keeps prior yields
+@ray_tpu.remote
+class Bad:
+    def boom(self):
+        yield 1
+        yield 2
+        raise ValueError("mid-stream")
+b = Bad.remote()
+g3 = b.boom.options(num_returns="streaming").remote()
+vals = []
+try:
+    for r in g3:
+        vals.append(ray_tpu.get(r))
+    raise AssertionError("no error raised")
+except ray_tpu.exceptions.TaskError as e:
+    assert "mid-stream" in str(e)
+assert vals == [1,2], vals
+
+print("STREAM DEMO OK")
+ray_tpu.shutdown()

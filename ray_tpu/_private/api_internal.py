@@ -323,9 +323,12 @@ class RemoteFunction:
             kwargs_keys=kwargs_keys,
             num_returns=-1 if streaming else self._opts["num_returns"],
             resources=dict(resources),  # spec owns a private copy
-            # Streaming tasks never retry: consumed yields cannot be
-            # un-delivered (reference: generator tasks restrict retries).
-            max_retries=0 if streaming else self._opts["max_retries"],
+            # Streaming tasks retry like plain tasks: a retried
+            # generator re-executes from scratch and the owner
+            # fast-forwards already-delivered yields by index
+            # (reference: generator retry semantics in task_manager.cc
+            # HandleReportGeneratorItemReturns).
+            max_retries=self._opts["max_retries"],
             retry_exceptions=bool(self._opts["retry_exceptions"]),
             owner=cw.address.to_wire(),
             strategy=strategy,
@@ -378,6 +381,12 @@ class ObjectRefGenerator:
 
             return ObjectRef(ObjectID.from_hex(item[1]), self._owner)
         self._done = True
+        cw = _core_worker
+        if cw is not None:
+            # Exhausted/failed: nothing buffered left to free — drop the
+            # owner-side stream bookkeeping now (close() after this is a
+            # no-op thanks to _done).
+            cw.stream_finished(self._task_id)
         if item[0] == "end":
             raise StopIteration
         from ray_tpu import exceptions as _exc
@@ -445,10 +454,6 @@ class ActorMethod:
 
     def options(self, **opts):
         n = opts.get("num_returns", self._num_returns)
-        if n in ("streaming", "dynamic"):
-            raise ValueError(
-                "num_returns='streaming' is not supported for actor "
-                "methods (yet) — only plain tasks stream")
         return ActorMethod(self._handle, self._method_name, n)
 
     def remote(self, *args, **kwargs):
@@ -477,8 +482,9 @@ class ActorHandle:
             raise AttributeError(name)
         return ActorMethod(self, name)
 
-    def _submit_method(self, method_name: str, args, kwargs, num_returns: int):
+    def _submit_method(self, method_name: str, args, kwargs, num_returns):
         cw = get_core_worker()
+        streaming = num_returns in ("streaming", "dynamic")
         wire_args, kwargs_keys, _, nested = cw.serialize_args(args, kwargs)
         task_id = cw.next_task_id()
         spec = TaskSpec(
@@ -488,7 +494,7 @@ class ActorHandle:
             func_key="",
             args=wire_args,
             kwargs_keys=kwargs_keys,
-            num_returns=num_returns,
+            num_returns=-1 if streaming else num_returns,
             resources={},
             max_retries=0,
             owner=cw.address.to_wire(),
@@ -496,10 +502,12 @@ class ActorHandle:
         )
         with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
             spec.trace_ctx = trace_ctx
-            returns = cw.submit_actor_task(self._actor_id.hex(), spec,
-                                           self._max_task_retries,
-                                           nested_args=nested)
-        refs = [ObjectRef(oid, cw.address) for oid in returns]
+            out = cw.submit_actor_task(self._actor_id.hex(), spec,
+                                       self._max_task_retries,
+                                       nested_args=nested)
+        if streaming:
+            return ObjectRefGenerator(spec.task_id, cw.address, out)
+        refs = [ObjectRef(oid, cw.address) for oid in out]
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):

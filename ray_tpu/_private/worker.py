@@ -143,7 +143,7 @@ _PRIMITIVE_TYPES = frozenset(
 class _PendingTask:
     __slots__ = ("spec", "retries_left", "constructor_like", "futures",
                  "pushed_to", "nested_args", "seq", "return_hexes",
-                 "stream_q")
+                 "stream_q", "next_yield_index")
 
     def __init__(self, spec: TaskSpec, retries_left: int,
                  nested_args: list | None = None):
@@ -158,6 +158,12 @@ class _PendingTask:
         # driver-side ObjectRefGenerator drains; items are ("item",
         # oid_hex) / ("end",) / ("error", meta, data).
         self.stream_q = None
+        # Next yield index expected from the stream. On a retry the
+        # generator re-executes from scratch; yields with index below
+        # this were already delivered and are dropped (fast-forward —
+        # reference: generator task retries replay only unconsumed
+        # returns, task_manager.cc HandleReportGeneratorItemReturns).
+        self.next_yield_index = 0
         # Refs serialized INSIDE value args (not top-level): list of
         # (oid_hex, owner_wire|None); refcounted like top-level args and
         # released at completion per the borrower protocol.
@@ -304,6 +310,10 @@ class CoreWorker:
         # Streaming tasks whose driver-side generator was closed: later
         # yields free on arrival instead of buffering forever.
         self._abandoned_streams: set[str] = set()
+        # task_id -> stream queue, for the whole life of the consumer
+        # generator (pending_tasks entries die at completion; the
+        # abandon path must outlive them — see _abandon_stream_impl).
+        self._stream_queues: dict[str, _queue.Queue] = {}
         self._task_events: list = []
         self._tqdm_renderer = None  # lazy; driver-side progress bars
         self._run(self._async_init())
@@ -1338,6 +1348,7 @@ class CoreWorker:
                           nested_args=nested_args)
         if spec.num_returns == STREAMING_RETURNS:
             pt.stream_q = _queue.Queue()
+            self._stream_queues[spec.task_id] = pt.stream_q
         pt.return_hexes = [oid.hex() for oid in returns]
         for oid_hex in pt.return_hexes:
             o = self.objects.setdefault(oid_hex, _OwnedObject())
@@ -1922,12 +1933,21 @@ class CoreWorker:
         index = payload["index"]
         oid_hex = ObjectID.for_task_return(
             TaskID.from_hex(pt.spec.task_id), index + 1).hex()
-        pt.return_hexes.append(oid_hex)
+        # Fast-forward: a retried generator replays from index 0; items
+        # below next_yield_index were already delivered (the re-computed
+        # value re-registers, refreshing any lost copy, but no duplicate
+        # ref is handed to the consumer).
+        replay = index < pt.next_yield_index
+        if not replay:
+            pt.return_hexes.append(oid_hex)
+            pt.next_yield_index = index + 1
         # No ref added here: the ObjectRef the generator constructs on
         # iteration registers the local ref (owned objects are not
         # collected before any ref transition occurs).
         self._register_return(pt.spec.task_id, oid_hex, payload["result"],
                               lineage=False)
+        if replay:
+            return
         if payload["task_id"] in self._abandoned_streams:
             # Generator was closed/dropped: free the item immediately
             # instead of buffering it forever.
@@ -1943,22 +1963,33 @@ class CoreWorker:
         self._post(self._abandon_stream_impl, task_id_hex)
 
     def _abandon_stream_impl(self, task_id_hex: str) -> None:
-        pt = self.pending_tasks.get(task_id_hex)
-        if pt is None:
+        # The queue registry (not pending_tasks) is the lookup: a
+        # generator dropped AFTER its task completed must still free
+        # the buffered unconsumed items (they hold owned objects with
+        # no ObjectRef ever created — leaked before this registry).
+        q = self._stream_queues.pop(task_id_hex, None)
+        if q is None:
             return
         self._abandoned_streams.add(task_id_hex)
         # Drain ON THE LOOP (every put happens here too): a yield whose
         # dispatch raced a caller-thread drain would otherwise land in
         # the orphaned queue after the drain saw it empty and leak.
-        if pt.stream_q is not None:
-            while True:
-                try:
-                    item = pt.stream_q.get_nowait()
-                except _queue.Empty:
-                    return
-                if item[0] == "item":
-                    self._add_local_ref_impl(item[1])
-                    self._remove_local_ref_impl(item[1])
+        while True:
+            try:
+                item = q.get_nowait()
+            except _queue.Empty:
+                break
+            if item[0] == "item":
+                self._add_local_ref_impl(item[1])
+                self._remove_local_ref_impl(item[1])
+        # Wake any OTHER consumer thread still blocked in next() (e.g. a
+        # client-proxy pump whose remote driver closed the stream).
+        q.put(("end",))
+
+    def stream_finished(self, task_id_hex: str) -> None:
+        """Consumer saw the stream's terminal entry: drop bookkeeping
+        (an exhausted stream has nothing left to free)."""
+        self._post(self._stream_queues.pop, task_id_hex, None)
 
     async def _forward_borrows_then_release(self, pt, borrows, borrower_id,
                                             borrower_addr):
@@ -2097,7 +2128,7 @@ class CoreWorker:
     def _run_exec_item(self, item) -> None:
         """Execute one queued item (shared by the asyncio-fed queue path
         and fastpath injection)."""
-        spec, sink = item
+        spec, sink = item[0], item[1]
         if isinstance(spec, list):  # batch item: sink is the owner conn
             def emit(task_id, index, entry, conn=sink):
                 # Yields notify IMMEDIATELY (not coalesced like
@@ -2112,8 +2143,18 @@ class CoreWorker:
             for s in spec:
                 self._queue_task_done(sink, s.task_id,
                                       self._execute_task(s, emit))
-        else:  # single item: sink is a future
-            result = self._execute_task(spec)
+        else:  # single item: sink is a future; item[2] (if present) is
+            # the caller conn for streaming actor-method yields
+            emit = None
+            if len(item) > 2 and spec.num_returns == STREAMING_RETURNS:
+                def emit(task_id, index, entry, conn=item[2]):
+                    self.loop.call_soon_threadsafe(
+                        lambda: asyncio.ensure_future(conn.notify(
+                            "TaskYield",
+                            {"task_id": task_id, "index": index,
+                             "result": entry})))
+
+            result = self._execute_task(spec, emit)
             self.loop.call_soon_threadsafe(
                 lambda f=sink, r=result: (not f.done()) and
                 f.set_result(r))
@@ -2349,6 +2390,12 @@ class CoreWorker:
                         # actors, fiber.h).
                         result = asyncio.run_coroutine_threadsafe(
                             result, self._actor_async_loop()).result()
+                    if spec.num_returns == STREAMING_RETURNS:
+                        # Streaming actor method: iterate HERE so the
+                        # generator body runs in the actor's contexts;
+                        # yields flow back over the caller conn.
+                        result = self._drain_stream(spec, result,
+                                                    yield_emit)
             else:
                 # Plain-dict cache hit avoids a cross-thread loop
                 # round-trip per task (hot path: every task execution).
@@ -2367,18 +2414,7 @@ class CoreWorker:
                     # IMMEDIATELY as a TaskYield. The iteration runs
                     # HERE so the generator body executes inside the
                     # same runtime_env/tracing contexts as the call.
-                    if yield_emit is None:
-                        raise exc.RayTpuError(
-                            "streaming tasks require the batched task "
-                            "path")
-                    count = 0
-                    pctx = self._task_packaging_ctx(spec)
-                    for value in result:
-                        yield_emit(spec.task_id, count,
-                                   self._package_one(spec, count, value,
-                                                     pctx))
-                        count += 1
-                    return count
+                    return self._drain_stream(spec, result, yield_emit)
 
                 if not spec.runtime_env and not spec.trace_ctx \
                         and not tracing.enabled():
@@ -2406,6 +2442,21 @@ class CoreWorker:
                     "borrows": self._surviving_borrows()}
         finally:
             self._current_task_id = prev_task_id
+
+    def _drain_stream(self, spec: TaskSpec, iterable, yield_emit) -> int:
+        """Iterate a streaming task's generator, emitting each packaged
+        yield immediately; returns the yield count (shared by plain
+        tasks and actor methods)."""
+        if yield_emit is None:
+            raise exc.RayTpuError(
+                "streaming tasks require a yield-capable dispatch path")
+        count = 0
+        pctx = self._task_packaging_ctx(spec)
+        for value in iterable:
+            yield_emit(spec.task_id, count,
+                       self._package_one(spec, count, value, pctx))
+            count += 1
+        return count
 
     def _task_packaging_ctx(self, spec: TaskSpec) -> tuple:
         """Per-task constants for _package_one, computed ONCE (a
@@ -2502,7 +2553,9 @@ class CoreWorker:
         state = self._actor_callers.setdefault(
             caller, {"next_seq": 0, "buffer": {}})
         fut = asyncio.get_running_loop().create_future()
-        state["buffer"][spec.actor_seq] = (spec, fut)
+        # conn rides along so streaming methods can push TaskYield
+        # notifies back over the caller's ordered connection.
+        state["buffer"][spec.actor_seq] = (spec, fut, conn)
         self._drain_actor_queue(state)
         return await fut
 
@@ -2614,7 +2667,11 @@ class CoreWorker:
 
     def submit_actor_task(self, actor_id: str, spec: TaskSpec,
                           max_task_retries: int = 0,
-                          nested_args: list | None = None) -> list[ObjectID]:
+                          nested_args: list | None = None):
+        """Submit an actor method call. Fixed-arity calls return the
+        return ObjectIDs; streaming calls (num_returns=-1) return the
+        yield queue for the caller-side ObjectRefGenerator (reference:
+        actor-method streaming generators)."""
         st = self._actor_state(actor_id)
         if nested_args:
             self._actor_task_nested[spec.task_id] = nested_args
@@ -2622,12 +2679,25 @@ class CoreWorker:
         spec.actor_incarnation = st["incarnation"]
         st["seq"] += 1
         st["inflight"].append(spec)
-        returns = [ObjectID.for_task_return(TaskID.from_hex(spec.task_id), i + 1)
-                   for i in range(spec.num_returns)]
-        for oid in returns:
-            self.objects.setdefault(oid.hex(), _OwnedObject())
+        stream_q = None
+        if spec.num_returns == STREAMING_RETURNS:
+            # Register the pending entry BEFORE the call goes out so
+            # mid-call TaskYield notifies find their queue; completion
+            # pops it (same lifecycle as plain streamed tasks).
+            pt = _PendingTask(spec, 0)
+            pt.stream_q = stream_q = _queue.Queue()
+            pt.return_hexes = []
+            self._stream_queues[spec.task_id] = stream_q
+            self.pending_tasks[spec.task_id] = pt
+            returns = []
+        else:
+            returns = [ObjectID.for_task_return(
+                TaskID.from_hex(spec.task_id), i + 1)
+                for i in range(spec.num_returns)]
+            for oid in returns:
+                self.objects.setdefault(oid.hex(), _OwnedObject())
         self._spawn(self._submit_actor_task_async(actor_id, spec, max_task_retries))
-        return returns
+        return stream_q if stream_q is not None else returns
 
     async def _actor_conn(self, actor_id: str, st) -> rpc.Connection:
         while True:
@@ -2669,6 +2739,9 @@ class CoreWorker:
                         addr = Address.from_wire(st["address"])
                         st["conn"] = await rpc.connect(
                             addr.host, addr.port,
+                            # Streaming actor methods push their yields
+                            # back over this same ordered connection.
+                            handlers={"TaskYield": self._handle_task_yield},
                             name=f"->actor{actor_id[:6]}")
             if st["conn"] is None or st["conn"].closed:
                 continue
@@ -2687,10 +2760,14 @@ class CoreWorker:
                     resp = await conn.call("ActorCall", {
                         "spec": spec.to_wire(), "caller_id": self.worker_id},
                         timeout=None)
-                    pt = _PendingTask(
-                        spec, 0,
-                        nested_args=self._actor_task_nested.pop(
-                            spec.task_id, None))
+                    # Streaming calls pre-registered their pending entry
+                    # (carrying the yield queue); reuse it so completion
+                    # closes the stream.
+                    pt = self.pending_tasks.get(spec.task_id)
+                    if pt is None:
+                        pt = _PendingTask(spec, 0)
+                    pt.nested_args = self._actor_task_nested.pop(
+                        spec.task_id, None) or []
                     actor_wid = (Address.from_wire(st["address"]).worker_id
                                  if st.get("address") else "")
                     await self._complete_task(pt, resp, "",
@@ -2716,9 +2793,11 @@ class CoreWorker:
                     continue
             err = serialization.serialize_exception(
                 exc.ActorDiedError(f"actor task {spec.name} failed: {last_reason}"))
-            pt = _PendingTask(
-                spec, 0,
-                nested_args=self._actor_task_nested.pop(spec.task_id, None))
+            pt = self.pending_tasks.get(spec.task_id)
+            if pt is None:
+                pt = _PendingTask(spec, 0)
+            pt.nested_args = self._actor_task_nested.pop(
+                spec.task_id, None) or []
             self._complete_task_error(pt, err)
             # This task holds a seq-no under the current incarnation that
             # will never be sent; tell the actor to skip it, or every later

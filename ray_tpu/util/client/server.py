@@ -57,6 +57,7 @@ class Session:
         self.actors: dict[str, object] = {}    # hex -> ActorHandle
         self.detached: set[str] = set()        # actor hexes to keep on close
         self.func_cache: dict[str, object] = {}  # key -> fn/class
+        self.streams: dict[str, object] = {}   # stream id -> live generator
 
     def pin_ref(self, ref) -> None:
         self.refs.setdefault(ref.hex(), ref)
@@ -80,6 +81,12 @@ class Session:
     def close(self) -> None:
         import ray_tpu
 
+        for gen in list(self.streams.values()):
+            try:
+                gen.close()
+            except Exception:
+                pass
+        self.streams.clear()
         self.refs.clear()
         for hex_id, handle in self.actors.items():
             if hex_id in self.detached:
@@ -185,6 +192,7 @@ class ClientServer:
             "ClientGetActor": self._wrap(self._get_actor),
             "ClientClusterInfo": self._wrap(self._cluster_info),
             "ClientGcsCall": self._wrap(self._gcs_call),
+            "ClientStreamClose": self._wrap(self._stream_close),
         }
 
     async def _ping(self, conn, payload):
@@ -300,11 +308,59 @@ class ClientServer:
             return obj.options(**opts) if opts else obj
         return make_remote(obj, opts)
 
+    def _start_stream(self, session, stream_id: str, gen) -> None:
+        """Pump a server-side ObjectRefGenerator to the remote client as
+        ClientStreamItem/End/Error notifies (reference: the gRPC client
+        server streams generator returns back to ray:// drivers). The
+        client pre-allocated `stream_id` and registered its queue before
+        sending the request, so no yield can outrun the plumbing."""
+        session.streams[stream_id] = gen
+        conn = session.conn
+
+        def notify(method, payload):
+            try:
+                fut = asyncio.run_coroutine_threadsafe(
+                    conn.notify(method, payload), self._loop)
+                fut.result(30.0)
+                return True
+            except Exception:
+                return False
+
+        def pump():
+            try:
+                for ref in gen:
+                    session.pin_ref(ref)
+                    if not notify("ClientStreamItem",
+                                  {"stream": stream_id, "ref": ref.hex()}):
+                        gen.close()  # client gone: free unconsumed yields
+                        return
+                notify("ClientStreamEnd", {"stream": stream_id})
+            except Exception as e:
+                notify("ClientStreamError",
+                       {"stream": stream_id,
+                        "error": common.server_dumps(e, session)})
+            finally:
+                session.streams.pop(stream_id, None)
+
+        threading.Thread(target=pump, daemon=True,
+                         name=f"client-stream-{stream_id[:8]}").start()
+
     def _task(self, session, payload):
+        from ray_tpu._private.api_internal import ObjectRefGenerator
+
         rf = self._resolve_callable(session, payload)
         args, kwargs = self._load_args(session, payload)
         refs = rf.remote(*args, **kwargs)
+        if isinstance(refs, ObjectRefGenerator):
+            self._start_stream(session, payload["stream"], refs)
+            return {"stream": payload["stream"]}
         return {"refs": self._new_refs(session, refs)}
+
+    def _stream_close(self, session, payload):
+        gen = session.streams.pop(payload["stream"], None)
+        if gen is not None:
+            gen.close()  # frees buffered + later yields; wakes the pump
+        return {}
 
     def _actor_create(self, session, payload):
         from ray_tpu._private.api_internal import ActorClass
@@ -320,6 +376,8 @@ class ClientServer:
         return {"actor_id": handle._id_hex, "class_name": handle._class_name}
 
     def _actor_call(self, session, payload):
+        from ray_tpu._private.api_internal import ObjectRefGenerator
+
         handle = session.resolve_actor(payload["actor"],
                                        payload.get("class_name", "Actor"))
         method = getattr(handle, payload["method"])
@@ -327,6 +385,9 @@ class ClientServer:
             method = method.options(num_returns=payload["num_returns"])
         args, kwargs = self._load_args(session, payload)
         refs = method.remote(*args, **kwargs)
+        if isinstance(refs, ObjectRefGenerator):
+            self._start_stream(session, payload["stream"], refs)
+            return {"stream": payload["stream"]}
         return {"refs": self._new_refs(session, refs)}
 
     def _kill(self, session, payload):

@@ -10,7 +10,9 @@ through the ClientContext here instead of a local CoreWorker.
 from __future__ import annotations
 
 import asyncio
+import queue as _queue
 import threading
+import uuid
 from typing import Any, Sequence
 
 from ray_tpu import exceptions
@@ -19,6 +21,63 @@ from ray_tpu.util.client import common
 from ray_tpu.util.client.common import ClientActorHandle, ClientObjectRef
 
 _OP_TIMEOUT = 60.0
+
+
+class ClientObjectRefGenerator:
+    """Client-side streaming generator: yields ClientObjectRefs as the
+    in-cluster generator produces them, pushed by the proxy as
+    ClientStreamItem notifies (reference: ray:// streaming generator
+    passthrough)."""
+
+    def __init__(self, ctx: "ClientContext", stream_id: str,
+                 q: "_queue.Queue"):
+        self._ctx = ctx
+        self._id = stream_id
+        self._q = q
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ClientObjectRef:
+        if self._done:
+            raise StopIteration
+        kind, val = self._q.get()
+        if kind == "item":
+            return ClientObjectRef(val, self._ctx)
+        self._done = True
+        self._ctx._streams.pop(self._id, None)
+        if kind == "end":
+            raise StopIteration
+        raise common.loads(val)  # server-shipped exception
+
+    def completed(self) -> bool:
+        return self._done
+
+    def close(self) -> None:
+        """Stop the stream: the proxy closes the in-cluster generator
+        (freeing unconsumed yields) and buffered refs are released."""
+        if self._done:
+            return
+        self._done = True
+        self._ctx._streams.pop(self._id, None)
+        try:
+            self._ctx._rpc("ClientStreamClose", {"stream": self._id})
+        except Exception:
+            pass
+        while True:
+            try:
+                kind, val = self._q.get_nowait()
+            except _queue.Empty:
+                return
+            if kind == "item":
+                self._ctx._release(val)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class ClientRemoteFunction:
@@ -76,8 +135,15 @@ class ClientContext:
         self._thread = threading.Thread(
             target=self._loop.run_forever, name="ray-tpu-client", daemon=True)
         self._thread.start()
+        self._streams: dict[str, _queue.Queue] = {}
         self._conn: rpc.Connection = self._call_soon(
-            rpc.connect_retry(host, port, name="client", timeout=connect_timeout),
+            rpc.connect_retry(host, port, name="client",
+                              handlers={
+                                  "ClientStreamItem": self._on_stream_ev,
+                                  "ClientStreamEnd": self._on_stream_ev,
+                                  "ClientStreamError": self._on_stream_ev,
+                              },
+                              timeout=connect_timeout),
             timeout=connect_timeout + 5.0)
         self._token = common.current_client.set(self)
         self._closed = False
@@ -102,6 +168,21 @@ class ClientContext:
             self._closed = True
             raise exceptions.RayTpuError(
                 f"lost connection to client server {self.host}:{self.port}")
+
+    async def _on_stream_ev(self, conn, payload):
+        """Stream notifies from the proxy (runs on the client loop)."""
+        q = self._streams.get(payload["stream"])
+        if q is None:
+            # Stream already closed client-side: free the orphan item.
+            if "ref" in payload:
+                self._release(payload["ref"])
+            return
+        if "ref" in payload:
+            q.put(("item", payload["ref"]))
+        elif "error" in payload:
+            q.put(("error", payload["error"]))
+        else:
+            q.put(("end", None))
 
     def _release(self, ref_hex: str):
         if self._closed or not self._loop.is_running():
@@ -186,16 +267,28 @@ class ClientContext:
         return self._rpc("ClientRegisterFunction",
                          {"fn": common.client_dumps(fn)})["key"]
 
+    def _begin_stream(self):
+        """Pre-allocate a stream id + queue BEFORE the request goes out:
+        yields may start arriving before the RPC reply."""
+        stream_id = uuid.uuid4().hex[:16]
+        q: _queue.Queue = _queue.Queue()
+        self._streams[stream_id] = q
+        return stream_id, q
+
     def _task(self, key: str, args, kwargs, opts):
-        if opts.get("num_returns") in ("streaming", "dynamic"):
-            # The proxy protocol has no per-yield push channel yet; an
-            # explicit error beats the server crashing on range(str).
-            raise ValueError(
-                "num_returns='streaming' is not supported through "
-                "client:// drivers yet — run the driver in-cluster")
-        resp = self._rpc("ClientTask", {
-            "key": key, "args": common.client_dumps((args, kwargs)),
-            "opts_pkl": common.client_dumps(opts)})
+        streaming = opts.get("num_returns") in ("streaming", "dynamic")
+        req = {"key": key, "args": common.client_dumps((args, kwargs)),
+               "opts_pkl": common.client_dumps(opts)}
+        if streaming:
+            stream_id, q = self._begin_stream()
+            req["stream"] = stream_id
+            try:
+                self._rpc("ClientTask", req)
+            except Exception:
+                self._streams.pop(stream_id, None)
+                raise
+            return ClientObjectRefGenerator(self, stream_id, q)
+        resp = self._rpc("ClientTask", req)
         refs = self._wire_refs(resp)
         if opts.get("num_returns", 1) == 1:
             return refs[0]
@@ -209,11 +302,20 @@ class ClientContext:
         return ClientActorHandle(resp["actor_id"], resp["class_name"], self)
 
     def _actor_call(self, actor_hex: str, method: str, args, kwargs,
-                    num_returns: int):
-        resp = self._rpc("ClientActorCall", {
-            "actor": actor_hex, "method": method,
-            "args": common.client_dumps((args, kwargs)),
-            "num_returns": num_returns})
+                    num_returns):
+        req = {"actor": actor_hex, "method": method,
+               "args": common.client_dumps((args, kwargs)),
+               "num_returns": num_returns}
+        if num_returns in ("streaming", "dynamic"):
+            stream_id, q = self._begin_stream()
+            req["stream"] = stream_id
+            try:
+                self._rpc("ClientActorCall", req)
+            except Exception:
+                self._streams.pop(stream_id, None)
+                raise
+            return ClientObjectRefGenerator(self, stream_id, q)
+        resp = self._rpc("ClientActorCall", req)
         refs = self._wire_refs(resp)
         return refs[0] if num_returns == 1 else refs
 
