@@ -327,10 +327,20 @@ class CoreWorker:
 
     def _run(self, coro, timeout: float | None = None):
         """Run a coroutine on the IO loop from any thread."""
-        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+        try:
+            fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        except RuntimeError:
+            # Loop already stopped (shutdown race): close the coroutine
+            # so it doesn't surface as a 'never awaited' RuntimeWarning.
+            coro.close()
+            raise
+        return fut.result(timeout)
 
     def _spawn(self, coro):
-        asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            asyncio.run_coroutine_threadsafe(coro, self.loop)
+        except RuntimeError:
+            coro.close()
 
     async def _async_init(self):
         self.server = rpc.RpcServer({
@@ -401,7 +411,15 @@ class CoreWorker:
             except Exception:
                 pass
         try:
-            self._run(self._async_shutdown(), timeout=5)
+            self._run(self._async_shutdown(), timeout=8)
+        except Exception:
+            pass
+        # Belt-and-braces second pass: whatever survived (or was spawned
+        # by close callbacks during) the graceful teardown is cancelled
+        # and AWAITED here, so loop.stop() finds a quiet loop — "Task was
+        # destroyed but it is pending!" is a bug, not noise.
+        try:
+            self._run(self._final_cancel(), timeout=3)
         except Exception:
             pass
         self.loop.call_soon_threadsafe(self.loop.stop)
@@ -432,29 +450,53 @@ class CoreWorker:
                 await self.gcs.call("FinishJob", {"job_id": self.job_id}, timeout=2)
             except Exception:
                 pass
-        for slots in self._leases.values():
-            for s in slots:
-                try:
-                    await s.raylet.call("ReturnWorker", {"lease_id": s.lease_id}, timeout=2)
-                except Exception:
-                    pass
+
+        # Leases go back in PARALLEL: the old sequential 2s-per-slot walk
+        # could outlive the whole shutdown budget, skipping the cancel
+        # sweep below — the actual source of the r3 teardown noise.
+        async def give_back(s):
+            try:
+                await s.raylet.call("ReturnWorker",
+                                    {"lease_id": s.lease_id}, timeout=2)
+            except Exception:
+                pass
+        all_slots = [s for slots in self._leases.values() for s in slots]
+        if all_slots:
+            await asyncio.gather(*(give_back(s) for s in all_slots),
+                                 return_exceptions=True)
         if self.server:
             await self.server.stop()
-        for c in (self.gcs, self.raylet):
-            if c:
-                await c.close()
-        # Cancel stragglers (event flusher, recv loops of cached conns) AND
-        # give them a cycle to unwind — cancelling without awaiting leaves
-        # "Task was destroyed but it is pending!" noise at loop teardown.
+        # EVERY connection this worker owns: gcs, raylet, lease slots,
+        # cached owner/raylet conns, actor conns.
+        conns = [self.gcs, self.raylet]
+        conns += [s.conn for s in all_slots]
+        conns += list(self._owner_conns.values())
+        conns += list(self._raylet_conns.values())
+        conns += [st.get("conn") for st in self.actor_handles_state.values()]
+        for c in conns:
+            if c is not None and not c.closed:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+        await self._cancel_stragglers()
+
+    async def _cancel_stragglers(self, timeout: float = 1.0):
+        """Cancel + AWAIT every other task on the loop — cancelling
+        without awaiting leaves 'Task was destroyed but it is pending!'
+        at loop teardown."""
         pending = [t for t in asyncio.all_tasks()
                    if t is not asyncio.current_task()]
         for t in pending:
             t.cancel()
         if pending:
             try:
-                await asyncio.wait(pending, timeout=1.0)
+                await asyncio.wait(pending, timeout=timeout)
             except Exception:
                 pass
+
+    async def _final_cancel(self):
+        await self._cancel_stragglers(timeout=1.5)
 
     # ---------- events ----------
 

@@ -71,3 +71,42 @@ def ray_start_cluster_head():
                       head_node_args={"num_cpus": 2}, config=_fast_config())
     yield cluster
     cluster.shutdown()
+
+
+# ---- teardown-hygiene enforcement (VERDICT r3 weak #5) ----
+# "Task was destroyed but it is pending!" is emitted through the asyncio
+# logger from Task.__del__, not as a warning, so filterwarnings cannot
+# catch it. This handler turns any such record produced while a test
+# (including its fixture teardown) runs into a test failure.
+
+import logging as _logging
+
+
+class _AsyncioNoiseCollector(_logging.Handler):
+    def __init__(self):
+        super().__init__(level=_logging.ERROR)
+        self.records: list[str] = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "Task was destroyed but it is pending" in msg \
+                or "Future exception was never retrieved" in msg:
+            self.records.append(msg)
+
+
+_asyncio_noise = _AsyncioNoiseCollector()
+_logging.getLogger("asyncio").addHandler(_asyncio_noise)
+
+
+@pytest.fixture(autouse=True)
+def _no_asyncio_teardown_noise(request):
+    import gc
+
+    start = len(_asyncio_noise.records)
+    yield
+    # Task.__del__ fires on gc; collect so a leak from THIS test is
+    # attributed to it, not a later one.
+    gc.collect()
+    new = _asyncio_noise.records[start:]
+    assert not new, (
+        f"asyncio teardown noise during {request.node.nodeid}: {new[:3]}")
