@@ -415,7 +415,14 @@ def run_test(test: dict, quick: bool) -> dict:
         record["metrics"] = metrics
         value = metrics[test["metric"]]
         record["value"] = value
-        record["passed"] = bool(value >= test["threshold"])
+        # full_threshold (when present) raises the floor for full mode —
+        # e.g. many_nodes requires nodes_used == num_nodes at BOTH
+        # scales, and those scales differ.
+        floor = test["threshold"]
+        if not quick and "full_threshold" in test:
+            floor = test["full_threshold"]
+        record["threshold"] = floor
+        record["passed"] = bool(value >= floor)
     except Exception as e:  # noqa: BLE001
         record["passed"] = False
         record["error"] = f"{type(e).__name__}: {e}"
