@@ -285,17 +285,22 @@ class _ProxyHandler(BaseHTTPRequestHandler):
 
 @ray_tpu.remote(num_cpus=0)
 class _ProxyActor:
-    """Runs the HTTP ingress inside a worker on a specific node
-    (reference: serve runs a proxy on every node; handles inside the
-    actor route to replicas cluster-wide)."""
+    """Runs BOTH ingress protocols inside a worker on a specific node —
+    HTTP and the binary msgpack-RPC ingress (reference: serve proxies
+    serve HTTP and gRPC on every node, serve/_private/proxy.py:13-38;
+    handles inside the actor route to replicas cluster-wide)."""
 
     def __init__(self, port: int):
         from ray_tpu import serve as _serve
 
         self.port = _serve.start_http_proxy(host="0.0.0.0", port=port)
+        self.rpc_port = _serve.start_rpc_proxy(host="0.0.0.0", port=0)
 
     def address(self) -> int:
         return self.port
+
+    def rpc_address(self) -> int:
+        return self.rpc_port
 
     def healthy(self) -> bool:
         return True
@@ -383,6 +388,21 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> int:
     return _proxy_server.server_address[1]
 
 
+_rpc_ingress = None
+
+
+def start_rpc_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Binary (msgpack-RPC) ingress beside HTTP — the second protocol
+    (reference: the proxy's gRPC listener, serve/_private/proxy.py:13-38).
+    See serve/rpc_ingress.py for the wire protocol; RpcIngressClient is
+    the in-repo caller."""
+    global _rpc_ingress
+    from ray_tpu.serve.rpc_ingress import RpcIngress
+
+    _rpc_ingress = RpcIngress()
+    return _rpc_ingress.start(host, port)
+
+
 def deploy_config(config):
     """Declarative multi-application deploy (reference: serve REST config /
     `serve deploy`); see serve/config_deploy.py for the schema."""
@@ -393,8 +413,8 @@ def deploy_config(config):
 
 __all__ = [
     "deployment", "run", "get_deployment_handle", "status", "delete",
-    "shutdown", "batch", "start_http_proxy", "start_proxies",
-    "deploy_config", "Deployment",
+    "shutdown", "batch", "start_http_proxy", "start_rpc_proxy",
+    "start_proxies", "deploy_config", "Deployment",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]
