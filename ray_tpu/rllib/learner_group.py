@@ -1,0 +1,207 @@
+"""Distributed learner gang: N learner actors with synchronized updates.
+
+Parity: reference rllib/core/learner/learner_group.py — remote Learner
+workers each hold a model replica, compute gradients on their shard of
+every batch, and synchronize via an allreduce before applying updates
+(the reference wraps modules in torch DDP, torch_learner.py:368). Here
+the gradient plane is the repo's collective ring (util/collective —
+peer-to-peer ring host plane; XLA collectives when learners share a
+mesh), and each learner applies the SAME reduced gradient with the same
+jitted optimizer math, so parameters stay bit-identical across the gang
+without any parameter server.
+
+Update cycle per minibatch:
+  1. each learner jits grads on its 1/N shard of the batch
+  2. grads flatten to ONE contiguous vector -> ring allreduce (mean)
+  3. each learner applies the reduced grads (jitted optax step)
+Optimizer state lives sharded-by-replication: every learner holds the
+full optimizer state, advanced identically (the degenerate but exact
+form of replicated data parallelism; ZeRO-style sharding of the state
+belongs to the Train SPMD path, train/spmd.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=1)
+class LearnerActor:
+    """One member of the gang (reference: Learner, rllib/core/learner)."""
+
+    def __init__(self, rank: int, world: int, group_name: str, model: str,
+                 obs_size, num_actions: int, hidden: int, lr: float,
+                 clip_param: float, vf_coeff: float, entropy_coeff: float,
+                 seed: int):
+        import jax
+        import optax
+
+        from ray_tpu.rllib.catalog import get_model
+        from ray_tpu.rllib.ppo import make_ppo_loss
+
+        self.rank, self.world, self.group = rank, world, group_name
+        spec = get_model(model)
+        # Same seed everywhere => bit-identical initial replicas (the
+        # reference broadcasts from rank 0; identical init is equivalent
+        # and needs no traffic).
+        self.params = spec.init_params(obs_size, num_actions, hidden, seed)
+        opt = optax.adam(lr)
+        self.opt_state = opt.init(self.params)
+        loss_fn = make_ppo_loss(spec.jax_forward, clip_param, vf_coeff,
+                                entropy_coeff)
+
+        def grad_fn(params, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            return grads, loss, aux
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = opt.update(grads, opt_state)
+            import optax as _optax
+
+            return _optax.apply_updates(params, updates), opt_state
+
+        self._grad = jax.jit(grad_fn)
+        self._apply = jax.jit(apply_fn)
+        self._tree_def = None
+
+    def join_group(self) -> bool:
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(self.world, self.rank,
+                                         backend="xla",
+                                         group_name=self.group)
+        return True
+
+    def _flatten(self, tree):
+        import jax
+
+        leaves, tree_def = jax.tree_util.tree_flatten(tree)
+        self._tree_def = tree_def
+        self._shapes = [np.asarray(x).shape for x in leaves]
+        self._sizes = [int(np.prod(s)) if s else 1 for s in self._shapes]
+        return np.concatenate(
+            [np.asarray(x, np.float32).ravel() for x in leaves])
+
+    def _unflatten(self, flat):
+        import jax
+
+        out, off = [], 0
+        for shape, size in zip(self._shapes, self._sizes):
+            out.append(flat[off:off + size].reshape(shape))
+            off += size
+        return jax.tree_util.tree_unflatten(self._tree_def, out)
+
+    def update(self, batch: dict) -> dict:
+        """One synchronized step on this learner's shard of the batch:
+        local grads -> ring allreduce(mean) -> identical apply."""
+        from ray_tpu.util import collective
+
+        grads, loss, aux = self._grad(self.params, batch)
+        flat = self._flatten(grads)
+        if self.world > 1:
+            flat = np.asarray(
+                collective.allreduce(flat, group_name=self.group),
+                np.float32) / self.world
+        self.params, self.opt_state = self._apply(
+            self.params, self.opt_state, self._unflatten(flat))
+        return {"loss": float(loss),
+                **{k: float(v) for k, v in aux.items()}}
+
+    def get_params(self):
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def params_fingerprint(self) -> str:
+        """SHA1 over every parameter byte — the gang-sync check."""
+        import jax
+
+        h = hashlib.sha1()
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        return h.hexdigest()
+
+    def get_state(self) -> bytes:
+        import jax
+
+        return pickle.dumps({
+            "params": jax.tree_util.tree_map(np.asarray, self.params),
+            "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
+        })
+
+    def set_state(self, blob: bytes) -> bool:
+        st = pickle.loads(blob)
+        self.params = st["params"]
+        self.opt_state = st["opt_state"]
+        return True
+
+
+class LearnerGroup:
+    """Owns the gang (reference: LearnerGroup — spawn, rendezvous,
+    sharded update fan-out, checkpoint)."""
+
+    _seq = 0
+
+    def __init__(self, *, num_learners: int, model: str, obs_size,
+                 num_actions: int, hidden: int, lr: float,
+                 clip_param: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.0, seed: int = 0):
+        LearnerGroup._seq += 1
+        self.group_name = f"learner-gang-{LearnerGroup._seq}"
+        self.num_learners = num_learners
+        self.learners = [
+            LearnerActor.remote(rank, num_learners, self.group_name, model,
+                                obs_size, num_actions, hidden, lr,
+                                clip_param, vf_coeff, entropy_coeff, seed)
+            for rank in range(num_learners)]
+        # Rendezvous: every member joins the ring before the first update.
+        ray_tpu.get([a.join_group.remote() for a in self.learners],
+                    timeout=120)
+
+    def update(self, batch: dict) -> dict:
+        """One synchronized SGD step over the whole batch: each learner
+        takes its 1/N shard; gradients allreduce inside the actors."""
+        n = self.num_learners
+        shards = [
+            {k: np.array_split(v, n)[i] for k, v in batch.items()}
+            for i in range(n)]
+        metrics = ray_tpu.get(
+            [a.update.remote(s) for a, s in zip(self.learners, shards)],
+            timeout=600)
+        # Means across learners (each reports its local loss).
+        return {k: float(np.mean([m[k] for m in metrics]))
+                for k in metrics[0]}
+
+    def get_params(self):
+        return ray_tpu.get(self.learners[0].get_params.remote(), timeout=120)
+
+    def fingerprints(self) -> list[str]:
+        return ray_tpu.get(
+            [a.params_fingerprint.remote() for a in self.learners],
+            timeout=120)
+
+    def save_state(self) -> bytes:
+        """Checkpoint (params + optimizer state) from rank 0 — state is
+        bit-identical across the gang by construction."""
+        return ray_tpu.get(self.learners[0].get_state.remote(), timeout=120)
+
+    def restore_state(self, blob: bytes) -> None:
+        ray_tpu.get([a.set_state.remote(blob) for a in self.learners],
+                    timeout=120)
+
+    def shutdown(self) -> None:
+        from ray_tpu.util import collective
+
+        for a in self.learners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self.learners = []

@@ -242,6 +242,10 @@ class PPOConfig:
     seed: int = 0
     # Catalog model name ("mlp", "resmlp", "atari_cnn" for pixel envs).
     model: str = "mlp"
+    # >1: updates run on a LearnerGroup of remote learner actors with
+    # ring-allreduced gradients (reference: learner_group.py remote
+    # learners + DDP sync); 1 = in-process jitted update.
+    num_learners: int = 1
     # Multi-agent (parity: reference .multi_agent(policies=...,
     # policy_mapping_fn=...)): policy_id -> None; mapping agent_id ->
     # policy_id. None = single-agent.
@@ -271,6 +275,35 @@ class PPOConfig:
 
     def build(self) -> "PPO":
         return PPO(self)
+
+
+def make_ppo_loss(forward, clip_param: float, vf_coeff: float,
+                  entropy_coeff: float):
+    """The PPO clipped-surrogate loss as a free function so the
+    in-process learner and the distributed LearnerGroup's learner
+    actors jit the SAME math (reference: Learner.compute_loss,
+    rllib/core/learner/learner.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, batch):
+        logits, value = forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        clipped = jnp.clip(ratio, 1 - clip_param, 1 + clip_param)
+        pi_loss = -jnp.minimum(ratio * adv, clipped * adv).mean()
+        vf_loss = ((value - batch["returns"]) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    return loss_fn
 
 
 class PPO:
@@ -314,13 +347,22 @@ class PPO:
                 a: config.policy_mapping_fn(a)
                 for a in probe_env.agent_ids}
         self._update = None
+        self._learner_group = None
+        if config.num_learners > 1 and not config.policies:
+            from ray_tpu.rllib.learner_group import LearnerGroup
+
+            self._learner_group = LearnerGroup(
+                num_learners=config.num_learners, model=config.model,
+                obs_size=obs_in, num_actions=self.num_actions,
+                hidden=hidden, lr=config.lr, clip_param=config.clip_param,
+                vf_coeff=config.vf_coeff,
+                entropy_coeff=config.entropy_coeff, seed=config.seed)
         self.iteration = 0
 
     # ---- learner (jit) ----
 
     def _build_update(self):
         import jax
-        import jax.numpy as jnp
         import optax
 
         cfg = self.config
@@ -332,24 +374,8 @@ class PPO:
         else:
             self._opt_state = opt.init(self.params)
 
-        forward = self._spec.jax_forward
-
-        def loss_fn(params, batch):
-            logits, value = forward(params, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None].astype(jnp.int32), axis=1
-            )[:, 0]
-            ratio = jnp.exp(logp - batch["logp"])
-            adv = batch["advantages"]
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
-            clipped = jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param)
-            pi_loss = -jnp.minimum(ratio * adv, clipped * adv).mean()
-            vf_loss = ((value - batch["returns"]) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pi_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
-            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
-                           "entropy": entropy}
+        loss_fn = make_ppo_loss(self._spec.jax_forward, cfg.clip_param,
+                                cfg.vf_coeff, cfg.entropy_coeff)
 
         def update(params, opt_state, batch):
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -365,7 +391,7 @@ class PPO:
         import jax
         import numpy as np
 
-        if self._update is None:
+        if self._update is None and self._learner_group is None:
             self._build_update()
         cfg = self.config
         t0 = time.time()
@@ -373,6 +399,9 @@ class PPO:
                          cfg.train_batch_size // max(1, len(self.workers)))
         if self.policy_params is not None:
             return self._train_multi_agent(per_worker, t0)
+        if self._learner_group is not None:
+            # Rollouts sample against the gang's (synchronized) params.
+            self.params = self._learner_group.get_params()
         host_params = jax.tree_util.tree_map(np.asarray, self.params)
         batches = ray_tpu.get(
             [w.sample.remote(host_params, per_worker) for w in self.workers],
@@ -391,9 +420,14 @@ class PPO:
             for s in range(0, n, cfg.sgd_minibatch_size):
                 idx = perm[s: s + cfg.sgd_minibatch_size]
                 mb = {k: v[idx] for k, v in batch.items()}
-                self.params, self._opt_state, loss, aux = self._update(
-                    self.params, self._opt_state, mb)
-                last_aux = aux
+                if self._learner_group is not None:
+                    last_aux = self._learner_group.update(mb)
+                else:
+                    self.params, self._opt_state, loss, aux = self._update(
+                        self.params, self._opt_state, mb)
+                    last_aux = aux
+        if self._learner_group is not None:
+            self.params = self._learner_group.get_params()
         learn_time = time.time() - t1
         self.iteration += 1
         return {
@@ -463,6 +497,8 @@ class PPO:
                 ray_tpu.kill(w)
             except Exception:
                 pass
+        if self._learner_group is not None:
+            self._learner_group.shutdown()
 
     def get_policy_params(self, policy_id: str | None = None):
         import jax

@@ -1,0 +1,81 @@
+"""Distributed learner gang (parity: rllib/core/learner/learner_group.py
+remote learners with DDP-synchronized updates; here the gradient plane
+is the collective ring and params stay bit-identical by identical
+reduced-gradient application)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import Config
+
+
+@pytest.fixture
+def gang_cluster():
+    cfg = Config()
+    cfg.health_check_period_s = 0.5
+    ray_tpu.init(num_cpus=10, config=cfg)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_learner_group_gang_sync(gang_cluster):
+    """8 learner actors, ring-allreduced gradients: after every
+    synchronized step the parameter fingerprints are BIT-IDENTICAL
+    across the gang, updates actually move the params, and
+    checkpoint/restore round-trips optimizer state (reference:
+    learner_group.py remote learners; torch_learner.py:368 DDP sync)."""
+    import numpy as np
+
+    from ray_tpu.rllib.learner_group import LearnerGroup
+
+    group = LearnerGroup(num_learners=8, model="mlp", obs_size=4,
+                         num_actions=2, hidden=16, lr=1e-2, seed=3)
+    try:
+        fps = group.fingerprints()
+        assert len(set(fps)) == 1, f"initial replicas differ: {fps}"
+        rng = np.random.default_rng(0)
+        batch = {
+            "obs": rng.standard_normal((64, 4)).astype(np.float32),
+            "actions": rng.integers(0, 2, 64).astype(np.int32),
+            "logp": np.full(64, -0.69, np.float32),
+            "advantages": rng.standard_normal(64).astype(np.float32),
+            "returns": rng.standard_normal(64).astype(np.float32),
+        }
+        before = group.fingerprints()[0]
+        m1 = group.update(batch)
+        fps1 = group.fingerprints()
+        assert len(set(fps1)) == 1, f"gang diverged after step 1: {fps1}"
+        assert fps1[0] != before, "update did not change the params"
+        ckpt = group.save_state()
+        m2 = group.update(batch)
+        fps2 = group.fingerprints()
+        assert len(set(fps2)) == 1, f"gang diverged after step 2: {fps2}"
+        assert np.isfinite(m1["loss"]) and np.isfinite(m2["loss"])
+        # restore -> replaying the same minibatch reproduces the same
+        # fingerprint (optimizer state checkpoint is exact)
+        group.restore_state(ckpt)
+        assert group.fingerprints()[0] == fps1[0]
+        group.update(batch)
+        assert group.fingerprints()[0] == fps2[0], \
+            "restored optimizer state did not reproduce the step"
+    finally:
+        group.shutdown()
+
+
+def test_ppo_with_learner_group(gang_cluster):
+    """PPO wired to num_learners=2: a training iteration runs end to end
+    through the gang and both learners finish bit-identical."""
+    from ray_tpu.rllib.ppo import PPOConfig
+
+    algo = (PPOConfig().environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(train_batch_size=256, sgd_minibatch_size=128,
+                      num_sgd_iter=2, num_learners=2)
+            .build())
+    try:
+        result = algo.train()
+        assert result["timesteps_this_iter"] >= 256
+        fps = algo._learner_group.fingerprints()
+        assert len(set(fps)) == 1, f"learners diverged: {fps}"
+    finally:
+        algo.stop()
