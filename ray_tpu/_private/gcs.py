@@ -592,6 +592,9 @@ class GcsServer:
                 "total_resources": n.total_resources,
                 "labels": n.labels,
                 "transfer_port": n.transfer_port,
+                # Same-host peers pull arena-to-arena through shm (one
+                # memcpy, no sockets) — see raylet._native_pull.
+                "store_path": n.store_path,
             }
             for nid, n in self.nodes.items()
             if n.alive

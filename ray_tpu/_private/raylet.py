@@ -345,6 +345,7 @@ class Raylet:
             "MakeRoom": self.handle_make_room,
             "EnsureRuntimeEnv": self.handle_ensure_runtime_env,
             "GetNodeInfo": self.handle_get_node_info,
+            "NodeStoreInfo": self.handle_node_store_info,
             "ReportWorkerDeath": self.handle_report_worker_death,
             "WorkerBlocked": self.handle_worker_blocked,
             "WorkerUnblocked": self.handle_worker_unblocked,
@@ -1164,6 +1165,26 @@ class Raylet:
                 return {"spillback": self._debit_spill(spill, resources)}
             return {"error": "node draining"}
 
+        if strategy and strategy[0] == "node_affinity" \
+                and strategy[1] != self.node_id:
+            # Route the lease to the TARGET node's raylet (reference:
+            # NodeAffinitySchedulingStrategy — the lease must be granted
+            # by the named node; lease_policy.cc picks the target raylet).
+            target, soft = strategy[1], strategy[2]
+            info = self.cluster_view.get(target)
+            if info is not None:
+                return {"spillback": {"node_id": target,
+                                      "host": info["host"],
+                                      "port": info["raylet_port"]}}
+            if soft:
+                pass  # target unknown/dead: soft affinity runs anywhere
+            else:
+                # Hard affinity to a node not (yet) in view: the caller
+                # backs off and retries — a just-added node appears at
+                # the next heartbeat exchange.
+                return {"error": f"node_affinity target {target[:8]} is "
+                                 "not in the cluster view",
+                        "infeasible": True}
         allow_spill = not (strategy and strategy[0] == "node_affinity") and not pg_id
         hops = payload.get("hops", 0)
         is_spread = bool(strategy and strategy[0] == "spread") and hops == 0
@@ -1655,6 +1676,18 @@ class Raylet:
         """Pull via peers' C++ transfer servers (bulk bytes stream
         shm-to-shm without touching Python; chunks stripe across peers).
         False = use the RPC path."""
+        # Same-HOST peer: both arenas are local shm files — attach the
+        # peer's arena and copy object bytes directly (ONE memcpy, no
+        # sockets). This is plasma's same-node shared-memory property
+        # extended across co-hosted raylets (fake multi-node clusters,
+        # multi-raylet hosts); cross-host peers take the TCP stripes.
+        for info in infos:
+            if info.get("host") == self.host and info.get("store_path"):
+                try:
+                    if await self._local_peer_copy(info["store_path"], oid):
+                        return True
+                except Exception:
+                    logger.exception("local peer copy failed; using TCP")
         peers = [(info["host"], info["transfer_port"]) for info in infos]
         if not peers:
             return False
@@ -1679,6 +1712,42 @@ class Raylet:
                 None, native_transfer.fetch_multi, self.store_path, peers,
                 oid.binary())
         return rc == 0
+
+    async def _local_peer_copy(self, peer_store_path: str,
+                               oid: ObjectID) -> bool:
+        """Copy one sealed object from a co-hosted peer's arena into
+        ours (zero-copy read + one memcpy write, off the IO loop)."""
+        if peer_store_path == self.store_path:
+            return self.store.contains(oid)
+        cache = getattr(self, "_peer_store_clients", None)
+        if cache is None:
+            cache = self._peer_store_clients = {}
+        client = cache.get(peer_store_path)
+        if client is None:
+            if not os.path.exists(peer_store_path):
+                return False
+            client = ObjectStoreClient(peer_store_path)
+            cache[peer_store_path] = client
+        got = client.get_buffer(oid)
+        if got is None:
+            return False
+        try:
+            meta, data = got
+            total = len(meta) + len(data)
+            buf = await self._create_with_room(oid, total, len(meta))
+            if buf is None:  # concurrent writer already has it
+                return self.store.contains(oid)
+
+            def copy_and_seal():
+                if meta:
+                    buf[:len(meta)] = meta
+                buf[len(meta):] = data
+                self.store.seal(oid)
+
+            await asyncio.to_thread(copy_and_seal)
+            return True
+        finally:
+            client.release(oid)
 
     async def _pull_from(self, peer: rpc.Connection, oid: ObjectID) -> bool:
         chunk_size = self.config.object_transfer_chunk_size
@@ -1725,6 +1794,20 @@ class Raylet:
                     except OSError:
                         pass
         return {"ok": True}
+
+    async def handle_node_store_info(self, conn, payload):
+        """(host, store_path) of a peer node — workers use it to map
+        same-host arenas for zero-copy reads (one host = one shm
+        domain; see worker._try_same_host_read)."""
+        nid = payload["node_id"]
+        if nid == self.node_id:
+            return {"found": True, "host": self.host,
+                    "store_path": self.store_path}
+        info = self.cluster_view.get(nid)
+        if info is None:
+            return {"found": False}
+        return {"found": True, "host": info.get("host"),
+                "store_path": info.get("store_path", "")}
 
     async def handle_get_node_info(self, conn, payload):
         return {"node_id": self.node_id, "store_path": self.store_path,

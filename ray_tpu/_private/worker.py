@@ -357,6 +357,7 @@ class CoreWorker:
             "ActorSeqSkip": self._handle_actor_seq_skip,
             "AssignActor": self._handle_assign_actor,
             "GetObjectStatus": self._handle_get_object_status,
+            "AddObjectLocation": self._handle_add_object_location,
             "BorrowRef": self._handle_borrow_ref,
             "WaitForRefRemoved": self._handle_wait_for_ref_removed,
             "CancelTask": self._handle_cancel_task,
@@ -670,9 +671,11 @@ class CoreWorker:
             # Drop shm pins this call acquired but will not hand out —
             # every fetch from `upto` on, plus any consumed-but-unpinned
             # earlier ones are already handled. A retried get re-pins.
+            # Pins are (store_client, oid): a same-host zero-copy read
+            # pins the PEER node's arena, not ours.
             for (oid, _), f in zip(refs[upto:], fetched[upto:]):
                 if not isinstance(f, BaseException) and f[2] is not None:
-                    self.store.release(oid)
+                    f[2][0].release(oid)
 
         first_err = next((f for f in fetched if isinstance(f, BaseException)),
                          None)
@@ -697,7 +700,7 @@ class CoreWorker:
                     # last numpy view, so spilling/eviction can reclaim
                     # the slot; round 1 pinned for process lifetime,
                     # which deadlocks restores in a small arena).
-                    shm_owner = _ShmPin(data, self.store, oid)
+                    shm_owner = _ShmPin(data, pin[0], oid)
                     pin = None
                     with deser_context(prereg) as dsink:
                         kind, value = serialization.deserialize(
@@ -706,7 +709,7 @@ class CoreWorker:
                     with deser_context(prereg) as dsink:
                         kind, value = serialization.deserialize(meta, data)
                     if pin is not None:
-                        self.store.release(oid)
+                        pin[0].release(oid)
                         pin = None
                 self._register_new_borrows(dsink)
                 if kind == serialization.KIND_EXCEPTION:
@@ -719,7 +722,7 @@ class CoreWorker:
                     raise exc.TaskError(cause, tb)
             except BaseException:
                 if pin is not None:
-                    self.store.release(oid)
+                    pin[0].release(oid)
                 release_unconsumed(i + 1)
                 raise
             out.append(value)
@@ -745,9 +748,14 @@ class CoreWorker:
                     and (owner is None or owner.worker_id == self.worker_id)):
                 got = self.store.get_buffer(oid)
                 if got is not None:
-                    return got[0], got[1], oid_hex
+                    return got[0], got[1], (self.store, oid)
             if o is not None and o.state == OBJ_READY and o.locations:
-                ok = await self._pull_to_local(oid_hex, list(o.locations))
+                same_host = await self._try_same_host_read(
+                    oid, list(o.locations))
+                if same_host is not None:
+                    return same_host
+                ok = await self._pull_to_local(oid_hex, list(o.locations),
+                                               owner)
                 if ok:
                     continue
                 # All copies lost → lineage reconstruction
@@ -759,8 +767,7 @@ class CoreWorker:
                 if owner is not None and owner.worker_id != self.worker_id:
                     status = await self._poll_owner(oid, owner)
                     if status is not None:
-                        meta, data = status
-                        return meta, data, None
+                        return status
                     # else: became available in store / keep looping
                 else:
                     # We own it and it is pending: wait for task completion.
@@ -785,8 +792,9 @@ class CoreWorker:
             poll = min(poll * 2, 0.02)
 
     async def _poll_owner(self, oid: ObjectID, owner: Address):
-        """Long-poll the owner for object status. Returns (meta, data) for
-        inline values, or None if we should retry via the store."""
+        """Long-poll the owner for object status. Returns a full fetch
+        triple (meta, data, pin|None) when the value resolved, or None
+        if we should retry via the store."""
         try:
             conn = await self._owner_conn(owner)
             resp = await conn.call("GetObjectStatus",
@@ -803,12 +811,17 @@ class CoreWorker:
             self._fetched_prereg[oid.hex()] = {n[0] for n in resp["nested"]}
         status = resp["status"]
         if status == "inline":
-            return bytes(resp["meta"]), bytes(resp["data"])
+            return bytes(resp["meta"]), bytes(resp["data"]), None
         if status == "stored":
-            ok = await self._pull_to_local(oid.hex(), resp["locations"])
+            same_host = await self._try_same_host_read(
+                oid, resp["locations"])
+            if same_host is not None:
+                return same_host
+            ok = await self._pull_to_local(oid.hex(), resp["locations"],
+                                           owner)
             return None
         if status == "failed":
-            return bytes(resp["meta"]), bytes(resp["data"])
+            return bytes(resp["meta"]), bytes(resp["data"]), None
         if status == "unknown":
             raise exc.ObjectLostError(oid.hex(),
                                       f"owner does not know object {oid.hex()}")
@@ -837,11 +850,78 @@ class CoreWorker:
             self._owner_conns, owner.key(), owner.host, owner.port,
             name=f"w{self.worker_id[:6]}->owner", kind="owner")
 
-    async def _pull_to_local(self, oid_hex: str, locations: list[str]) -> bool:
+    async def _try_same_host_read(self, oid: ObjectID, locations: list):
+        """Zero-copy read from a co-hosted node's arena.
+
+        One host is ONE shared-memory domain: when an object's holder
+        runs on this host (fake multi-node clusters, multi-raylet
+        hosts), the consumer maps the holder's arena and reads in place
+        — no bytes move, exactly plasma's same-node property extended
+        across raylets (reference: plasma zero-copy mmap reads; the
+        cross-HOST path still chunks over the transfer plane). Returns
+        a fetch triple with the pin against the PEER store, or None."""
+        if self.raylet is None:
+            return None
+        cache = getattr(self, "_peer_store_cache", None)
+        if cache is None:
+            cache = self._peer_store_cache = {}
+        for nid in locations:
+            if nid == self.node_id:
+                continue  # local store probe already ran
+            entry = cache.get(nid, ...)
+            if entry is ...:
+                try:
+                    resp = await self.raylet.call(
+                        "NodeStoreInfo", {"node_id": nid},
+                        timeout=self.config.rpc_call_timeout_s)
+                except Exception:
+                    return None
+                entry = None
+                if resp.get("found") and resp.get("store_path") \
+                        and resp.get("host") in (self.raylet_host,
+                                                 "127.0.0.1"):
+                    try:
+                        if os.path.exists(resp["store_path"]):
+                            entry = ObjectStoreClient(resp["store_path"])
+                    except Exception:
+                        entry = None
+                cache[nid] = entry
+            if entry is None:
+                continue
+            try:
+                got = entry.get_buffer(oid)
+            except Exception:
+                cache.pop(nid, None)
+                continue
+            if got is not None:
+                return got[0], got[1], (entry, oid)
+        return None
+
+    async def _pull_to_local(self, oid_hex: str, locations: list[str],
+                             owner: "Address | None" = None) -> bool:
         resp = await self.raylet.call("PullObject", {
             "object_id": oid_hex, "locations": locations},
             timeout=self.config.rpc_call_timeout_s)
-        return bool(resp.get("ok"))
+        ok = bool(resp.get("ok"))
+        if ok and owner is not None and owner.worker_id != self.worker_id \
+                and self.node_id not in locations:
+            # Register this node as a NEW copy with the owner's location
+            # directory: later pullers stripe across every node that has
+            # the object, turning a broadcast from a star fan-out into a
+            # chain (reference: ownership_based_object_directory tracks
+            # every copy; push_manager chunked pushes + location-aware
+            # pulls).
+            self._spawn(self._report_copy(owner, oid_hex))
+        return ok
+
+    async def _report_copy(self, owner: Address, oid_hex: str) -> None:
+        try:
+            conn = await self._owner_conn(owner)
+            await conn.notify("AddObjectLocation",
+                              {"object_id": oid_hex,
+                               "node_id": self.node_id})
+        except Exception:
+            pass  # best-effort: the hint only widens future pulls
 
     async def _try_reconstruct(self, oid_hex: str) -> bool:
         """Lineage reconstruction (reference: object_recovery_manager.h:96
@@ -2103,6 +2183,14 @@ class CoreWorker:
             self.borrow_decr(oid_hex)
 
     # ---------- owner-side status service ----------
+
+    async def _handle_add_object_location(self, conn, payload):
+        """A node finished pulling a copy: record it so later pullers
+        stripe across all holders (reference: object directory location
+        updates, ownership_based_object_directory.h)."""
+        o = self.objects.get(payload["object_id"])
+        if o is not None and o.state == OBJ_READY:
+            o.locations.add(payload["node_id"])
 
     async def _handle_get_object_status(self, conn, payload):
         oid_hex = payload["object_id"]

@@ -218,6 +218,18 @@ def object_broadcast(mb: int, num_nodes: int) -> dict:
         def consume(x):
             return float(x[-1]), int(x.nbytes)
 
+        @ray_tpu.remote(num_cpus=1)
+        def warm():
+            return 1
+
+        # Warm a worker + lease on every target node OUTSIDE the timed
+        # window: the envelope row measures object TRANSFER, and a cold
+        # interpreter spawn per node would otherwise dominate small
+        # payloads (same warm-burst discipline as many_tasks).
+        ray_tpu.get([warm.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=n.node_id)).remote() for n in others], timeout=600)
+
         t0 = time.perf_counter()
         outs = ray_tpu.get(
             [consume.options(
