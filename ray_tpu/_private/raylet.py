@@ -108,6 +108,11 @@ class WorkerHandle:
         self.conn: rpc.Connection | None = None   # worker -> raylet channel
         self.address: tuple[str, int] | None = None  # worker's own rpc server
         self.fp_port = 0  # native fastpath listener (0 = asyncio only)
+        # Spawned on behalf of a specific in-flight grant: must NOT enter
+        # the idle pool at registration, or a concurrent grant pops it
+        # and the same process gets assigned twice (double AssignActor =
+        # the second actor's calls stall in its ordered queues).
+        self.reserved = False
         self.registered = asyncio.Event()
         self.leased = False
         self.lease_id: str | None = None
@@ -902,7 +907,7 @@ class Raylet:
         conn.on_close(lambda: None if w.dead else asyncio.ensure_future(
             self._on_worker_death(w, "worker connection lost")))
         w.registered.set()
-        if not w.leased and w.actor_id is None:
+        if not w.leased and w.actor_id is None and not w.reserved:
             w.idle_since = time.monotonic()
             self.idle_workers.append(w)
         self._pump_pending_leases()
@@ -915,12 +920,18 @@ class Raylet:
             if not w.dead and w.proc.poll() is None:
                 return w
         w = self._spawn_worker()
+        # Reserve BEFORE the await: registration lands on this same loop,
+        # and an unreserved fresh worker would enter the idle pool where
+        # a concurrent grant pops it — handing one process to two grants.
+        w.reserved = True
         try:
             await asyncio.wait_for(w.registered.wait(),
                                    self.config.worker_startup_timeout_s)
         except asyncio.TimeoutError:
             self._kill_worker(w)
             return None
+        finally:
+            w.reserved = False
         if w in self.idle_workers:
             self.idle_workers.remove(w)
         return w

@@ -514,11 +514,26 @@ class CoreWorker:
         self._task_events.append(
             (task_id, name, state, time.time(), extra or None))
 
+    _TASK_EVENT_FLUSH_MAX = 5000
+
     async def _flush_task_events_loop(self):
+        dropped = 0
         while True:
             await asyncio.sleep(1.0)
             if self._task_events and self.gcs and not self.gcs.closed:
                 batch, self._task_events = self._task_events, []
+                if len(batch) > self._TASK_EVENT_FLUSH_MAX:
+                    # Pressure valve (reference: task_event_buffer.h caps
+                    # buffered events and counts drops): at 10k+ tasks/s
+                    # shipping 3 events/task would make the GCS steal the
+                    # core the tasks need. Keep the newest window.
+                    first_drop = dropped == 0
+                    dropped += len(batch) - self._TASK_EVENT_FLUSH_MAX
+                    batch = batch[-self._TASK_EVENT_FLUSH_MAX:]
+                    if first_drop:
+                        logger.info("task events exceed flush budget; "
+                                    "dropping oldest (state API sees a "
+                                    "sampled view under burst load)")
                 events = []
                 for task_id, name, state, ts, extra in batch:
                     ev = {"task_id": task_id, "name": name, "state": state,

