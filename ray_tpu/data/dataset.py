@@ -344,8 +344,32 @@ class Dataset:
 
     def limit(self, n: int) -> "Dataset":
         """First n rows (parity: dataset.py Dataset.limit)."""
+        ds = self
+        if not self._stages and len(self._source) > 1:
+            # Limit pushdown (reference: the logical optimizer's limit
+            # rule): when source row counts are known without reading
+            # (materialized blocks, ReadTasks with num_rows metadata —
+            # e.g. sql shards), trailing sources past the limit are
+            # dropped BEFORE any read executes.
+            counts: list = []
+            for s in self._source:
+                if isinstance(s, ReadTask):
+                    counts.append(s.num_rows)
+                elif isinstance(s, list):
+                    counts.append(len(s))
+                else:
+                    counts.append(None)
+            if all(c is not None for c in counts):
+                acc, keep = 0, []
+                for s, c in zip(self._source, counts):
+                    keep.append(s)
+                    acc += c
+                    if acc >= n:
+                        break
+                if len(keep) < len(self._source):
+                    ds = Dataset(keep, [])
         rows = []
-        for r in self.iter_rows():
+        for r in ds.iter_rows():
             rows.append(r)
             if len(rows) >= n:
                 break

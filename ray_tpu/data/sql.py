@@ -1,0 +1,127 @@
+"""SQL + webdataset connectors.
+
+Parity: reference read_sql (python/ray/data/read_api.py — any DBAPI2
+connection factory; partitioned by sharding the query) and the
+webdataset datasource (tar shards of samples grouped by key, decoded by
+extension). Both are dependency-free: DBAPI2 is a protocol (sqlite3 in
+the stdlib satisfies it; any installed driver works), and tar shards
+read with the stdlib tarfile module.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+
+
+def read_sql(sql: str, connection_factory, *,
+             override_num_blocks: int | None = None):
+    """Dataset from a SQL query via a DBAPI2 connection factory.
+
+    `connection_factory` is a zero-arg callable returning a DBAPI2
+    connection — it must be picklable (reads run as cluster tasks), so
+    pass a module-level function or functools.partial, not a live
+    connection. Parallelism: with override_num_blocks=N>1 the query is
+    sharded as `SELECT * FROM (<sql>) LIMIT ... OFFSET ...` per block
+    (the reference shards identically); N=1/None runs it whole.
+    """
+    from ray_tpu.data.dataset import Dataset, ReadTask
+
+    def fetch(query: str, params=()):
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(query, params)
+            cols = [d[0] for d in cur.description]
+            return [dict(zip(cols, row)) for row in cur.fetchall()]
+        finally:
+            conn.close()
+
+    n = override_num_blocks or 1
+    if n <= 1:
+        return Dataset([ReadTask(fn=lambda: fetch(sql),
+                                 meta={"kind": "sql", "sql": sql})])
+
+    def count():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(f"SELECT COUNT(*) FROM ({sql})")
+            return int(cur.fetchone()[0])
+        finally:
+            conn.close()
+
+    total = count()
+    per = max(1, -(-total // n))
+    tasks = []
+    for i in range(n):
+        off = i * per
+        if off >= total:
+            break
+        shard_sql = f"SELECT * FROM ({sql}) LIMIT {per} OFFSET {off}"
+        tasks.append(ReadTask(
+            fn=(lambda q=shard_sql: fetch(q)),
+            num_rows=min(per, total - off),
+            meta={"kind": "sql", "sql": shard_sql}))
+    return Dataset(tasks)
+
+
+# extension -> decoder for webdataset samples (reference default_decoder)
+def _decode_member(ext: str, data: bytes):
+    ext = ext.lower()
+    if ext in ("txt", "text"):
+        return data.decode("utf-8", errors="replace")
+    if ext == "json":
+        return json.loads(data)
+    if ext in ("cls", "cls2", "index", "id"):
+        try:
+            return int(data.decode().strip())
+        except ValueError:
+            return data.decode(errors="replace").strip()
+    if ext in ("jpg", "jpeg", "png", "ppm", "bmp"):
+        try:
+            import numpy as np
+            from PIL import Image
+
+            return np.asarray(Image.open(io.BytesIO(data)))
+        except ImportError:
+            return data
+    if ext in ("npy",):
+        import numpy as np
+
+        return np.load(io.BytesIO(data), allow_pickle=False)
+    return data  # unknown extension: raw bytes
+
+
+def read_webdataset(paths, *, override_num_blocks: int | None = None,
+                    decode: bool = True):
+    """Dataset over webdataset-style tar shards.
+
+    Each tar member `key.ext` contributes field `ext` to the sample
+    `key` (reference: webdataset_datasource — samples are consecutive
+    members sharing a basename); one block per shard. `decode=False`
+    yields raw bytes per field.
+    """
+    from ray_tpu.data import _expand, _lazy_read
+
+    def read_one(path):
+        samples: dict[str, dict] = {}
+        order: list[str] = []
+        with tarfile.open(path) as tf:
+            for m in tf:
+                if not m.isfile():
+                    continue
+                name = m.name
+                key, _, ext = name.rpartition(".")
+                if not key:
+                    key, ext = name, ""
+                data = tf.extractfile(m).read()
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = (_decode_member(ext, data)
+                                     if decode else data)
+        return [samples[k] for k in order]
+
+    return _lazy_read(_expand(paths), read_one, override_num_blocks)
