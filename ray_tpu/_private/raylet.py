@@ -366,6 +366,7 @@ class Raylet:
             "Drain": self.handle_drain,
             "GetState": self.handle_get_state,
             "NodeStacks": self.handle_node_stacks,
+            "NodeProfile": self.handle_node_profile,
             "ListLogs": self.handle_list_logs,
             "TailLog": self.handle_tail_log,
             "WorkerStats": self.handle_worker_stats,
@@ -997,6 +998,27 @@ class Raylet:
         dumps = list(await asyncio.gather(*(dump_one(w) for w in live)))
         return {"node_id": self.node_id, "workers": dumps,
                 "skipped": skipped}
+
+    async def handle_node_profile(self, conn, payload):
+        """Live CPU profiles from every worker on this node (reference:
+        dashboard reporter module's py-spy profiling hooks — here each
+        worker samples its own frames; see worker._handle_profile)."""
+        duration = min(float(payload.get("duration_s", 2.0)), 30.0)
+        live = [w for w in self.workers.values()
+                if not w.dead and w.conn is not None and not w.conn.closed]
+
+        async def profile_one(w):
+            try:
+                return await w.conn.call(
+                    "Profile", {"duration_s": duration},
+                    timeout=duration + 10)
+            except Exception as e:
+                return {"worker_id": w.worker_id,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        out = list(await asyncio.gather(*(profile_one(w) for w in live)))
+        return {"node_id": self.node_id, "duration_s": duration,
+                "workers": out}
 
     # ---- observability: log files + per-worker profiling stats ----
     # (reference: dashboard/modules/log — per-node log index/tail — and

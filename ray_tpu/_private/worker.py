@@ -364,6 +364,7 @@ class CoreWorker:
             "Exit": self._handle_exit,
             "Ping": lambda conn, p: {"ok": True},
             "DumpStack": self._handle_dump_stack,
+            "Profile": self._handle_profile,
         }, name=f"worker-{self.worker_id[:8]}")
         host, port = await self.server.start("127.0.0.1", 0)
         self.address = Address(host, port, self.worker_id, self.node_id)
@@ -2292,6 +2293,43 @@ class CoreWorker:
     async def _handle_exit(self, conn, payload):
         self.loop.call_soon(lambda: os._exit(0))
         return {"ok": True}
+
+    async def _handle_profile(self, conn, payload):
+        """Statistical CPU profile of THIS worker for `duration_s`
+        (reference: the dashboard reporter module's per-worker py-spy/
+        memray hooks — no external profiler exists in this image, so
+        the worker samples its own frames). Returns aggregated
+        (function, samples) hot spots per thread."""
+        import sys as _sys
+
+        duration = min(float(payload.get("duration_s", 2.0)), 30.0)
+        interval = max(float(payload.get("interval_s", 0.005)), 0.001)
+        depth = int(payload.get("depth", 3))
+        counts: dict[str, int] = {}
+        total = 0
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + duration
+        me = threading.get_ident()
+        while loop.time() < deadline:
+            for tid, frame in _sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < depth:
+                    code = f.f_code
+                    stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                                 f"{f.f_lineno}:{code.co_name}")
+                    f = f.f_back
+                key = " < ".join(stack)
+                counts[key] = counts.get(key, 0) + 1
+                total += 1
+            await asyncio.sleep(interval)
+        top = sorted(counts.items(), key=lambda kv: -kv[1])[:50]
+        return {"pid": os.getpid(), "worker_id": self.worker_id,
+                "actor_id": self._actor_id, "duration_s": duration,
+                "samples": total,
+                "hot": [{"stack": k, "count": v} for k, v in top]}
 
     async def _handle_dump_stack(self, conn, payload):
         """All-thread stack dump (reference: `ray stack` py-spies every

@@ -178,6 +178,14 @@ class _Handler(BaseHTTPRequestHandler):
                 # All-worker stack dumps per node (reference:
                 # dashboard/modules/reporter profiling views / ray stack).
                 data = state.dump_stacks()
+            elif path == "/api/profile":
+                # Live statistical CPU profile of every worker
+                # (?duration=seconds; reference: the reporter module's
+                # py-spy profiling endpoint — workers self-sample here).
+                q = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query)
+                dur = float((q.get("duration") or ["2"])[0])
+                data = state.profile_workers(duration_s=min(dur, 30.0))
             elif path == "/api/grafana/dashboard":
                 # Generated Grafana dashboard JSON (reference:
                 # dashboard/modules/metrics grafana_dashboard_factory).

@@ -48,6 +48,15 @@ def dump_stacks() -> list[dict]:
     return _per_node_call("NodeStacks", timeout=30)
 
 
+def profile_workers(duration_s: float = 2.0) -> list[dict]:
+    """Live statistical CPU profile of every worker on every node
+    (reference: dashboard reporter py-spy profiling hooks): each worker
+    samples its own frames for duration_s; results aggregate hot stacks
+    per worker."""
+    return _per_node_call("NodeProfile", payload={"duration_s": duration_s},
+                          timeout=duration_s + 30)
+
+
 def node_stats() -> list[dict]:
     """Per-raylet core stats (workers, leases, store, spilling) pulled
     concurrently from every alive node — the data source for the

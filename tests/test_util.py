@@ -324,3 +324,33 @@ def test_experimental_shuffle_and_raysort(ray_start_regular):
     stats = raysort(40_000, num_maps=3, num_reduces=3)
     assert stats["items_sorted"] == (40_000 // 3) * 3
     assert stats["items_per_s"] > 0
+
+
+def test_profile_workers_live(ray_start_regular):
+    """Live worker CPU profiling (reference: dashboard reporter py-spy
+    hooks): a busy worker's hot loop shows up in its sampled stacks."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def spin(sec):
+        t0 = time.perf_counter()
+        x = 0
+        while time.perf_counter() - t0 < sec:
+            x += 1  # hot loop the sampler must catch
+        return x
+
+    assert ray_tpu.get(spin.remote(0.01), timeout=120) > 0  # warm pool
+    ref = spin.remote(6.0)
+    time.sleep(0.5)  # let it start
+    nodes = state.profile_workers(duration_s=1.5)
+    assert nodes and nodes[0].get("workers") is not None
+    hot_stacks = []
+    for node in nodes:
+        for w in node["workers"]:
+            for h in w.get("hot", []):
+                hot_stacks.append(h["stack"])
+    assert any("spin" in s for s in hot_stacks), hot_stacks[:5]
+    assert ray_tpu.get(ref, timeout=60) > 0
