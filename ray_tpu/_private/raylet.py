@@ -1039,9 +1039,7 @@ class Raylet:
             pass
         return {"node_id": self.node_id, "logs": out}
 
-    async def handle_tail_log(self, conn, payload):
-        name = payload.get("name", "")
-        max_bytes = min(int(payload.get("max_bytes", 64 << 10)), 4 << 20)
+    def _tail_one_log(self, name: str, max_bytes: int) -> dict:
         logs_dir = os.path.realpath(os.path.join(self.session_dir, "logs"))
         path = os.path.realpath(os.path.join(logs_dir, name))
         # Traversal guard: only files directly inside the logs dir.
@@ -1057,6 +1055,17 @@ class Raylet:
             return {"error": str(e)}
         return {"node_id": self.node_id, "name": name, "size": size,
                 "data": data.decode("utf-8", "replace")}
+
+    async def handle_tail_log(self, conn, payload):
+        max_bytes = min(int(payload.get("max_bytes", 64 << 10)), 4 << 20)
+        if "names" in payload:
+            # Batched form: one RPC tails several files (the dashboard's
+            # event merge uses this — one connection per node instead of
+            # one per file).
+            return {"node_id": self.node_id,
+                    "files": {n: self._tail_one_log(n, max_bytes)
+                              for n in payload["names"]}}
+        return self._tail_one_log(payload.get("name", ""), max_bytes)
 
     @staticmethod
     def _proc_stats(pid: int) -> dict:

@@ -1,73 +1,88 @@
 """Dashboard: HTTP observability endpoint for the cluster.
 
 Parity: reference dashboard/ (aiohttp head server + React SPA, modules:
-node, actor, job, state, metrics — dashboard/head.py). Here a stdlib
-threading HTTP server exposes the same data as JSON under /api/* plus a
-single self-contained HTML page; it runs inside any connected process
-(`ray_tpu.dashboard.start()`, or `ray_tpu dashboard` from the CLI).
+node, actor, job, state, metrics — dashboard/head.py +
+dashboard/client/src). Here a stdlib threading HTTP server exposes the
+same data as JSON under /api/* and serves a dependency-free hash-routed
+SPA from `dashboard_static/` (overview cards, per-entity drill-down,
+sortable/filterable tables, log tailing, live profiling); it runs
+inside any connected process (`ray_tpu.dashboard.start()`, or
+`ray_tpu dashboard` from the CLI).
 
-Endpoints: /api/version /api/nodes /api/actors /api/jobs /api/tasks
-/api/summary /api/cluster_status /api/submission_jobs /api/logs
+Endpoints: /api/version /api/nodes /api/node_stats /api/actors
+/api/jobs /api/tasks /api/summary[/actors|/objects] /api/cluster_status
+/api/submission_jobs[/logs?id=] /api/logs /api/events
 /api/grafana/dashboard (generated Grafana JSON, metrics-module parity)
-/logs/view?node=&name= /api/stacks /api/worker_stats (the last four are
-the reference's log + reporter module data views: per-node log browser
-with tail, all-worker stack dumps, per-worker cpu/rss).
+/logs/view?node=&name= /api/stacks /api/profile /api/worker_stats (the
+reference's log + reporter module data views: per-node log browser with
+tail, all-worker stack dumps, live CPU sampling, per-worker cpu/rss).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-_PAGE = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>
-body { font-family: system-ui, sans-serif; margin: 2rem; background: #fafafa; }
-h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
-table { border-collapse: collapse; margin-top: .5rem; }
-td, th { border: 1px solid #ccc; padding: .25rem .6rem; font-size: .85rem; }
-th { background: #eee; text-align: left; }
-#err { color: #b00; }
-</style></head><body>
-<h1>ray_tpu dashboard</h1><div id="err"></div>
-<div id="sections"></div>
-<script>
-const SECTIONS = [
-  ["Cluster", "/api/cluster_status"], ["Nodes", "/api/nodes"],
-  ["Actors", "/api/actors"], ["Jobs", "/api/jobs"],
-  ["Submission jobs", "/api/submission_jobs"],
-  ["Placement groups", "/api/placement_groups"],
-  ["Serve deployments", "/api/serve"],
-  ["Workflows", "/api/workflows"],
-  ["Task summary", "/api/summary"],
-  ["Worker stats (cpu/rss)", "/api/worker_stats"],
-  ["Logs", "/api/logs"]];
-function table(rows) {
-  if (!Array.isArray(rows)) rows = [rows];
-  if (!rows.length) return "<i>none</i>";
-  const keys = Object.keys(rows[0]);
-  let h = "<table><tr>" + keys.map(k => `<th>${k}</th>`).join("") + "</tr>";
-  for (const r of rows) h += "<tr>" + keys.map(k => {
-    const v = r[k];
-    if (k === "view" && typeof v === "string")
-      return `<td><a href="${v}" target="_blank">view</a></td>`;
-    return `<td>${JSON.stringify(v)}</td>`;
-  }).join("") + "</tr>";
-  return h + "</table>";
-}
-async function refresh() {
-  let html = "";
-  for (const [name, url] of SECTIONS) {
-    try {
-      const data = await (await fetch(url)).json();
-      html += `<h2>${name}</h2>` + table(data);
-    } catch (e) { document.getElementById("err").textContent = String(e); }
-  }
-  document.getElementById("sections").innerHTML = html;
-}
-refresh(); setInterval(refresh, 3000);
-</script></body></html>"""
+_STATIC_DIR = os.path.join(os.path.dirname(__file__), "dashboard_static")
+_STATIC_TYPES = {".html": "text/html", ".js": "text/javascript",
+                 ".css": "text/css", ".json": "application/json"}
+
+# (monotonic timestamp, merged events) — see _merged_events().
+_events_cache: tuple[float, list] = (float("-inf"), [])
+_events_lock = threading.Lock()
+
+
+def _merged_events(state) -> list:
+    """Merged structured cluster events (reference: dashboard event
+    module over src/ray/util/event.h emitters). The events-*.jsonl files
+    live in each raylet's own session log dir, so they're read through
+    the raylets' log endpoints — THIS cluster's events regardless of
+    temp_dir overrides or other clusters on the box.
+
+    The SPA polls every 3 s; the TTL (longer than the poll period, or it
+    would never hit) plus a single-flight lock keeps a passive Events
+    tab from becoming continuous cluster-wide I/O. Event file names
+    embed the emitter (events-gcs / events-raylet-<id8>), so raylets
+    sharing one session dir list the same files — each unique file is
+    tailed once, batched into one RPC per node."""
+    import time as _time
+
+    global _events_cache
+    ts, cached = _events_cache
+    if _time.monotonic() - ts < 10.0:
+        return cached
+    if not _events_lock.acquire(blocking=False):
+        return cached  # another request is already rebuilding
+    try:
+        per_node: dict[str, list[str]] = {}
+        seen: set[str] = set()
+        for node in state.list_logs():
+            nid = node.get("node_id", "")
+            for f in node.get("logs", []):
+                name = f["name"]
+                if (name in seen or not name.startswith("events-")
+                        or not name.endswith(".jsonl")):
+                    continue
+                seen.add(name)
+                per_node.setdefault(nid, []).append(name)
+        data = []
+        for nid, names in per_node.items():
+            for out in state.tail_logs(nid, names,
+                                       max_bytes=256 << 10).values():
+                for line in (out.get("data") or "").splitlines():
+                    try:
+                        data.append(json.loads(line))
+                    except ValueError:
+                        continue
+        data.sort(key=lambda e: e.get("ts", 0))
+        data = data[-500:]
+        _events_cache = (_time.monotonic(), data)
+        return data
+    finally:
+        _events_lock.release()
 
 
 def _json_default(o):
@@ -92,9 +107,19 @@ class _Handler(BaseHTTPRequestHandler):
         from ray_tpu.util import state
 
         path = self.path.split("?")[0].rstrip("/") or "/"
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
         try:
-            if path == "/":
-                return self._send(200, _PAGE.encode(), "text/html")
+            if path == "/" or path.startswith("/static/"):
+                # SPA shell + assets. Names are restricted to a flat
+                # basename inside dashboard_static (no traversal).
+                name = ("index.html" if path == "/"
+                        else os.path.basename(path[len("/static/"):]))
+                full = os.path.join(_STATIC_DIR, name)
+                ctype = _STATIC_TYPES.get(os.path.splitext(name)[1])
+                if ctype is None or not os.path.isfile(full):
+                    return self._send(404, b"not found", "text/plain")
+                with open(full, "rb") as f:
+                    return self._send(200, f.read(), ctype)
             if path == "/metrics":
                 from ray_tpu.util.metrics import (core_prometheus_text,
                                                   prometheus_text)
@@ -116,12 +141,30 @@ class _Handler(BaseHTTPRequestHandler):
                 data = state.list_tasks()
             elif path == "/api/summary":
                 data = state.summarize_tasks()
+            elif path == "/api/summary/actors":
+                data = state.summarize_actors()
+            elif path == "/api/summary/objects":
+                data = state.summarize_objects()
+            elif path == "/api/node_stats":
+                data = state.node_stats(
+                    node_id=(q.get("node") or [None])[0])
+            elif path == "/api/events":
+                data = _merged_events(state)
             elif path == "/api/cluster_status":
                 data = state.cluster_status()
             elif path == "/api/submission_jobs":
                 from ray_tpu.job_submission import JobSubmissionClient
 
                 data = [j.__dict__ for j in JobSubmissionClient().list_jobs()]
+            elif path == "/api/submission_jobs/logs":
+                from ray_tpu.job_submission import JobSubmissionClient
+
+                sid = (q.get("id") or [""])[0]
+                try:
+                    out = JobSubmissionClient().get_job_logs(sid)
+                except ValueError as e:  # unknown submission id
+                    return self._send(404, str(e).encode(), "text/plain")
+                return self._send(200, (out or "").encode(), "text/plain")
             elif path == "/api/placement_groups":
                 data = state.list_placement_groups()
             elif path == "/api/objects":
@@ -148,27 +191,24 @@ class _Handler(BaseHTTPRequestHandler):
                 data = [{"workflow_id": w, "status": workflow.get_status(w)}
                         for w in workflow.list_workflows()]
             elif path == "/api/logs":
-                import urllib.parse
-
                 # Log index with view links (reference: dashboard log
                 # module's per-node file browser). Names are URL-quoted —
                 # '&'/'#'/'\"'/spaces in a filename must not break the
-                # query string or the href attribute.
+                # query string or the href attribute. ?node= narrows the
+                # fan-out to one raylet (the SPA's node-detail view
+                # refreshes every 3 s — it must not ping every node).
+                only = (q.get("node") or [None])[0]
                 data = []
-                for node in state.list_logs():
+                for node in state.list_logs(node_id=only):
+                    nid = node.get("node_id", "")
                     for f in node.get("logs", []):
                         data.append({
-                            "node": node.get("node_id", "?")[:8],
+                            "node": (nid or "?")[:8], "node_id": nid,
                             "file": f["name"], "size": f["size"],
-                            "view": (f"/logs/view?node="
-                                     f"{node.get('node_id', '')}&name="
+                            "view": (f"/logs/view?node={nid}&name="
                                      + urllib.parse.quote(f["name"],
                                                           safe=""))})
             elif path == "/logs/view":
-                import urllib.parse
-
-                q = urllib.parse.parse_qs(
-                    urllib.parse.urlparse(self.path).query)
                 node = (q.get("node") or [""])[0]
                 name = (q.get("name") or [""])[0]
                 out = state.tail_log(node, name)
@@ -182,8 +222,6 @@ class _Handler(BaseHTTPRequestHandler):
                 # Live statistical CPU profile of every worker
                 # (?duration=seconds; reference: the reporter module's
                 # py-spy profiling endpoint — workers self-sample here).
-                q = urllib.parse.parse_qs(
-                    urllib.parse.urlparse(self.path).query)
                 dur = float((q.get("duration") or ["2"])[0])
                 data = state.profile_workers(duration_s=min(dur, 30.0))
             elif path == "/api/grafana/dashboard":
@@ -193,19 +231,27 @@ class _Handler(BaseHTTPRequestHandler):
 
                 data = generate_dashboard()
             elif path == "/api/worker_stats":
+                # Flat per-worker rows; node_id is the FULL id (the SPA's
+                # node-detail view filters on it), "node" the display
+                # prefix. ?node= narrows the fan-out to one raylet.
                 data = []
-                for node in state.worker_stats():
-                    nid = node.get("node_id", "?")[:8]
-                    data.append({"node": nid, "worker_id": "(raylet)",
+                for node in state.worker_stats(
+                        node_id=(q.get("node") or [None])[0]):
+                    nid = node.get("node_id", "")
+                    data.append({"node": (nid or "?")[:8], "node_id": nid,
+                                 "worker_id": "(raylet)",
                                  "pid": node.get("pid"),
                                  "cpu_s": node.get("cpu_s"),
                                  "rss_mb": round(
                                      node.get("rss_bytes", 0) / 2**20, 1)})
                     for w in node.get("workers", []):
                         data.append({
-                            "node": nid,
+                            "node": (nid or "?")[:8], "node_id": nid,
                             "worker_id": w["worker_id"][:8],
                             "pid": w.get("pid"),
+                            "actor": w.get("actor_id", "")[:8],
+                            "leased": w.get("leased"),
+                            "blocked": w.get("blocked"),
                             "cpu_s": w.get("cpu_s"),
                             "rss_mb": round(
                                 w.get("rss_bytes", 0) / 2**20, 1)})
@@ -236,7 +282,9 @@ def start(host: str = "127.0.0.1", port: int = 8265) -> int:
 
 
 def stop() -> None:
-    global _server
+    global _server, _events_cache
     if _server is not None:
         _server.shutdown()
         _server = None
+    # Drop cached events: the next start() may serve a different cluster.
+    _events_cache = (float("-inf"), [])

@@ -1,0 +1,513 @@
+/* ray_tpu dashboard SPA (parity: reference dashboard/client/src React
+   app — node/actor/job/task/serve/log/metrics/profiling views). A
+   dependency-free hash router over the head server's /api/* JSON.
+
+   Conventions: every list view gets a client-side text filter and
+   click-to-sort headers; entity ids link to detail routes; state-ish
+   columns render as colored pills. Data auto-refreshes every 3 s
+   (toggle in the sidebar) for the current view only. */
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+
+function esc(v) {
+  if (v === null || v === undefined) return "";
+  return String(v).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+    .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+}
+
+async function getJSON(url) {
+  const r = await fetch(url);
+  const data = await r.json();
+  if (!r.ok) throw new Error(data.error || r.status + " " + url);
+  return data;
+}
+
+async function getText(url) {
+  const r = await fetch(url);
+  return await r.text();
+}
+
+function fmtBytes(n) {
+  if (n === null || n === undefined || isNaN(n)) return "";
+  const u = ["B", "KiB", "MiB", "GiB", "TiB"];
+  let i = 0;
+  while (n >= 1024 && i < u.length - 1) { n /= 1024; i++; }
+  return n.toFixed(i ? 1 : 0) + " " + u[i];
+}
+
+function fmtDur(s) {
+  if (s === null || s === undefined) return "";
+  s = Math.floor(s);
+  const h = Math.floor(s / 3600), m = Math.floor((s % 3600) / 60);
+  return (h ? h + "h " : "") + (m ? m + "m " : "") + (s % 60) + "s";
+}
+
+function pill(v) {
+  return `<span class="pill ${esc(v)}">${esc(v)}</span>`;
+}
+
+// Resource accounting is float-based; round for display so fractional
+// CPUs don't render as 0.30000000000000004.
+function fmtNum(v) { return Math.round(v * 100) / 100; }
+
+function bar(used, total) {
+  const frac = total > 0 ? used / total : 0;
+  const hot = frac > 0.85 ? " hot" : "";
+  return `<span class="bar-outer"><span class="bar-inner${hot}" ` +
+    `style="width:${Math.round(frac * 120)}px"></span></span> ` +
+    `${fmtNum(used)}/${fmtNum(total)}`;
+}
+
+function card(k, v, cls) {
+  return `<div class="card"><div class="k">${esc(k)}</div>` +
+    `<div class="v ${cls || ""}">${v}</div></div>`;
+}
+
+// ---- sortable/filterable table ------------------------------------------
+// Table state (sort key/dir, filter text) persists per route across the
+// 3 s refreshes so the view doesn't snap back while you read it.
+const tableState = {};
+
+function renderTable(rows, opts = {}) {
+  const id = opts.id || location.hash;
+  const st = tableState[id] || (tableState[id] = { sort: null, dir: 1, q: "" });
+  if (!Array.isArray(rows)) rows = rows ? [rows] : [];
+  let cols = opts.cols;
+  if (!cols && rows.length) cols = Object.keys(rows[0]);
+  if (!cols) cols = [];
+  let filtered = rows;
+  if (st.q) {
+    const q = st.q.toLowerCase();
+    filtered = rows.filter((r) =>
+      cols.some((c) => String(r[c] ?? "").toLowerCase().includes(q)));
+  }
+  if (st.sort) {
+    filtered = filtered.slice().sort((a, b) => {
+      const x = a[st.sort], y = b[st.sort];
+      if (typeof x === "number" && typeof y === "number")
+        return (x - y) * st.dir;
+      return String(x ?? "").localeCompare(String(y ?? "")) * st.dir;
+    });
+  }
+  const ths = cols.map((c) =>
+    `<th data-col="${esc(c)}" data-table="${esc(id)}">${esc(c)}` +
+    (st.sort === c ? ` <span class="arrow">${st.dir > 0 ? "▲" : "▼"}</span>`
+                   : "") + `</th>`).join("");
+  const fmt = opts.fmt || {};
+  const trs = filtered.map((r) => "<tr>" + cols.map((c) => {
+    let v = r[c];
+    v = fmt[c] ? fmt[c](v, r) : esc(typeof v === "object" && v !== null
+                                    ? JSON.stringify(v) : v);
+    return `<td title="${esc(typeof r[c] === "object" ? JSON.stringify(r[c])
+                                                      : r[c])}">${v}</td>`;
+  }).join("") + "</tr>").join("");
+  const filterBox = opts.noFilter ? "" :
+    `<input class="filter" placeholder="filter…" data-table="${esc(id)}" ` +
+    `value="${esc(st.q)}">`;
+  return filterBox +
+    `<table><thead><tr>${ths}</tr></thead><tbody>` +
+    (trs || `<tr><td colspan="${cols.length || 1}"><i>none</i></td></tr>`) +
+    `</tbody></table>`;
+}
+
+document.addEventListener("click", (e) => {
+  const th = e.target.closest("th[data-col]");
+  if (!th) return;
+  const st = tableState[th.dataset.table] ||
+    (tableState[th.dataset.table] = { sort: null, dir: 1, q: "" });
+  if (st.sort === th.dataset.col) st.dir = -st.dir;
+  else { st.sort = th.dataset.col; st.dir = 1; }
+  render();
+});
+
+document.addEventListener("input", (e) => {
+  const inp = e.target.closest("input.filter");
+  if (!inp) return;
+  const st = tableState[inp.dataset.table] ||
+    (tableState[inp.dataset.table] = { sort: null, dir: 1, q: "" });
+  st.q = inp.value;
+  // Re-render but keep focus + caret in the filter box.
+  const pos = inp.selectionStart;
+  render().then(() => {
+    const again = document.querySelector(
+      `input.filter[data-table="${CSS.escape(inp.dataset.table)}"]`);
+    if (again) { again.focus(); again.setSelectionRange(pos, pos); }
+  });
+});
+
+// ---- views ---------------------------------------------------------------
+
+const idLink = (route) => (v) =>
+  `<a href="#/${route}/${esc(v)}">${esc(String(v).slice(0, 10))}</a>`;
+
+const VIEWS = {
+  async overview() {
+    const [cs, ver, tasks, actors, objects] = await Promise.all([
+      getJSON("/api/cluster_status"), getJSON("/api/version"),
+      getJSON("/api/summary"), getJSON("/api/summary/actors"),
+      getJSON("/api/summary/objects")]);
+    const alive = cs.nodes.filter((n) => n.alive);
+    let cpuT = 0, cpuA = 0;
+    for (const n of alive) {
+      cpuT += n.total_resources.CPU || 0;
+      cpuA += n.available_resources.CPU || 0;
+    }
+    let h = "<h1>Cluster overview</h1><div class='cards'>" +
+      card("version", esc(ver.version)) +
+      card("nodes alive", `${alive.length}/${cs.nodes.length}`,
+           alive.length === cs.nodes.length ? "ok" : "bad") +
+      card("CPUs in use", `${fmtNum(cpuT - cpuA)}/${fmtNum(cpuT)}`) +
+      card("actors", cs.actors) +
+      card("placement groups", cs.placement_groups) +
+      card("pending demand", cs.pending_demand.length,
+           cs.pending_demand.length ? "bad" : "ok") +
+      card("uptime", fmtDur(cs.uptime_s)) + "</div>";
+    h += "<h2>Per-node utilization</h2>" + renderTable(alive.map((n) => ({
+      node_id: n.node_id, host: n.host, head: n.is_head,
+      cpu: (n.total_resources.CPU || 0) - (n.available_resources.CPU || 0),
+      cpu_total: n.total_resources.CPU || 0,
+    })), {
+      id: "ov-nodes", noFilter: true,
+      cols: ["node_id", "host", "head", "cpu"],
+      fmt: { node_id: idLink("nodes"),
+             cpu: (v, r) => bar(v, r.cpu_total) },
+    });
+    const stateRows = Object.entries(tasks.by_state || {})
+      .map(([k, v]) => ({ state: k, tasks: v }));
+    for (const [k, v] of Object.entries(actors.by_state || {})) {
+      stateRows.push({ state: k, actors: v });
+    }
+    h += "<h2>Task / actor states</h2>" +
+      renderTable(stateRows, { id: "ov-states", noFilter: true,
+        cols: ["state", "tasks", "actors"], fmt: { state: (v) => pill(v) } });
+    h += "<h2>Objects (driver-owned)</h2><div class='cards'>" +
+      card("count", Object.values(objects.by_state || {})
+        .reduce((a, b) => a + b, 0)) +
+      card("bytes", fmtBytes(objects.total_bytes)) + "</div>";
+    return h;
+  },
+
+  async nodes(id) {
+    if (id) return VIEWS._nodeDetail(id);
+    const [nodes, stats] = await Promise.all([
+      getJSON("/api/nodes"), getJSON("/api/node_stats")]);
+    const byId = Object.fromEntries(stats.map((s) => [s.node_id, s]));
+    const rows = nodes.map((n) => {
+      const s = byId[n.node_id] || {};
+      return {
+        node_id: n.node_id, host: n.host, state: n.alive ? "ALIVE" : "DEAD",
+        head: n.is_head, cpu_used:
+          (n.total_resources.CPU || 0) - (n.available_resources.CPU || 0),
+        cpu_total: n.total_resources.CPU || 0,
+        workers: s.num_workers, pending: s.pending_leases,
+        store_bytes: (s.store || {}).bytes_in_use,
+        spilled: s.spilled_bytes, draining: s.draining,
+      };
+    });
+    return "<h1>Nodes</h1>" + renderTable(rows, {
+      fmt: { node_id: idLink("nodes"), state: (v) => pill(v),
+             cpu_used: (v, r) => bar(v, r.cpu_total),
+             store_bytes: (v) => fmtBytes(v), spilled: (v) => fmtBytes(v) },
+    });
+  },
+
+  async _nodeDetail(id) {
+    // Every fetch here is narrowed to this node — an open detail tab
+    // refreshing every 3 s must not fan out to the whole cluster.
+    const nid = encodeURIComponent(id);
+    const [nodes, stats, workers, logs] = await Promise.all([
+      getJSON("/api/nodes"), getJSON("/api/node_stats?node=" + nid),
+      getJSON("/api/worker_stats?node=" + nid),
+      getJSON("/api/logs?node=" + nid)]);
+    const node = nodes.find((n) => n.node_id === id);
+    if (!node) return `<h1>Node ${esc(id)}</h1>not found`;
+    const stat = stats.find((s) => s.node_id === id) || {};
+    let h = `<h1>Node ${esc(id.slice(0, 12))}…</h1>` +
+      `<pre class="json">${esc(JSON.stringify({ ...node, ...stat },
+                                              null, 2))}</pre>`;
+    const rows = workers.filter((w) => w.node_id === id);
+    if (rows.length) {
+      h += "<h2>Workers</h2>" + renderTable(rows, {
+        id: "node-workers",
+        cols: ["worker_id", "pid", "actor", "leased", "blocked", "cpu_s",
+               "rss_mb"],
+      });
+    }
+    h += "<h2>Log files</h2>" + renderTable(logs, {
+      id: "node-logs", cols: ["file", "size", "view"],
+      fmt: { size: (v) => fmtBytes(v), view: (v, r) =>
+        `<a href="#/logs/${esc(id)}/${encodeURIComponent(r.file)}">tail</a>` },
+    });
+    return h;
+  },
+
+  async actors(id) {
+    const actors = await getJSON("/api/actors");
+    if (id) {
+      const a = actors.find((x) => x.actor_id === id);
+      return `<h1>Actor ${esc(id.slice(0, 12))}…</h1>` +
+        (a ? `<pre class="json">${esc(JSON.stringify(a, null, 2))}</pre>`
+           : "not found");
+    }
+    return "<h1>Actors</h1>" + renderTable(actors, {
+      cols: ["actor_id", "class_name", "name", "namespace", "state",
+             "node_id", "restarts", "job_id"],
+      fmt: { actor_id: idLink("actors"), state: (v) => pill(v),
+             node_id: idLink("nodes") },
+    });
+  },
+
+  async tasks() {
+    const [summary, tasks] = await Promise.all([
+      getJSON("/api/summary"), getJSON("/api/tasks")]);
+    let h = "<h1>Tasks</h1><div class='cards'>";
+    for (const [k, v] of Object.entries(summary.by_state || {}))
+      h += card(k, v, k === "FAILED" ? "bad" : "");
+    h += "</div><h2>By function</h2>" + renderTable(
+      Object.entries(summary.by_name || {}).map(([k, v]) =>
+        ({ name: k, count: v })), { id: "task-names", noFilter: true });
+    h += "<h2>Recent task events</h2>" + renderTable(
+      tasks.slice().reverse(), {
+        cols: ["task_id", "name", "state", "node_id", "worker_id", "job_id"],
+        fmt: { state: (v) => pill(v), node_id: idLink("nodes"),
+               task_id: (v) => esc(String(v).slice(0, 12)),
+               worker_id: (v) => esc(String(v).slice(0, 10)) },
+      });
+    return h;
+  },
+
+  async objects() {
+    const [objects, summary] = await Promise.all([
+      getJSON("/api/objects"), getJSON("/api/summary/objects")]);
+    let h = "<h1>Objects (owned by the dashboard's driver)</h1>" +
+      "<div class='cards'>" +
+      card("total bytes", fmtBytes(summary.total_bytes));
+    for (const [k, v] of Object.entries(summary.by_state || {}))
+      h += card(k, v);
+    h += "</div>" + renderTable(objects, {
+      fmt: { size: (v) => fmtBytes(v) } });
+    return h;
+  },
+
+  async pgs() {
+    return "<h1>Placement groups</h1>" + renderTable(
+      await getJSON("/api/placement_groups"),
+      { fmt: { state: (v) => pill(v) } });
+  },
+
+  async jobs() {
+    const [jobs, sjobs] = await Promise.all([
+      getJSON("/api/jobs"), getJSON("/api/submission_jobs")]);
+    let h = "<h1>Driver jobs</h1>" + renderTable(jobs.map((j) => ({
+      job_id: j.job_id, status: j.status, entrypoint: j.entrypoint,
+      runtime: fmtDur((j.end_time || Date.now() / 1000) - j.start_time),
+    })), { id: "jobs", fmt: { status: (v) => pill(v) } });
+    h += "<h2>Submitted jobs</h2>" + renderTable(sjobs, {
+      id: "sjobs",
+      cols: ["submission_id", "status", "entrypoint", "message", "logs"],
+      fmt: { status: (v) => pill(v),
+             logs: (v, r) =>
+               `<a href="#/jobs/logs/${esc(r.submission_id)}">logs</a>` },
+    });
+    return h;
+  },
+
+  async "jobs/logs"(sid) {
+    const text = await getText(
+      "/api/submission_jobs/logs?id=" + encodeURIComponent(sid));
+    return `<h1>Job logs: ${esc(sid)}</h1>` +
+      `<pre class="logview">${esc(text) || "(empty)"}</pre>`;
+  },
+
+  async serve() {
+    const data = await getJSON("/api/serve");
+    const rows = Object.entries(data).map(([name, d]) =>
+      typeof d === "object" ? { deployment: name, ...d } : { deployment: name,
+        info: d });
+    return "<h1>Serve deployments</h1>" + renderTable(rows,
+      { fmt: { status: (v) => pill(v) } });
+  },
+
+  async workflows() {
+    return "<h1>Workflows</h1>" + renderTable(
+      await getJSON("/api/workflows"), { fmt: { status: (v) => pill(v) } });
+  },
+
+  async logs(node, name) {
+    if (node && name) {
+      // route() already URI-decoded the args; re-encode for the query
+      // string but never decode again (a literal '%' in a filename
+      // would throw).
+      const text = await getText(`/logs/view?node=${esc(node)}&name=` +
+                                 encodeURIComponent(name));
+      return `<h1>${esc(name)}</h1>` +
+        `<div class="note">node ${esc(node.slice(0, 12))}… · last 64 KiB · ` +
+        `auto-refreshes</div><pre class="logview">${esc(text)}</pre>`;
+    }
+    const logs = await getJSON("/api/logs");
+    return "<h1>Logs</h1>" + renderTable(logs, {
+      cols: ["node", "file", "size", "view"],
+      fmt: {
+        size: (v) => fmtBytes(v),
+        view: (v, r) => `<a href="#/logs/${esc(r.node_id)}/` +
+          `${encodeURIComponent(r.file)}">tail</a>`,
+      },
+    });
+  },
+
+  async events() {
+    const events = await getJSON("/api/events");
+    return "<h1>Cluster events</h1>" + renderTable(
+      events.slice().reverse().map((e) => ({
+        time: new Date(e.ts * 1000).toISOString().slice(11, 19),
+        severity: e.severity, source: e.source, message: e.message,
+        fields: e.fields,
+      })), { fmt: { severity: (v) => pill(v) } });
+  },
+
+  async metrics() {
+    const text = await getText("/metrics");
+    const rows = [];
+    for (const line of text.split("\n")) {
+      if (!line || line.startsWith("#")) continue;
+      const sp = line.lastIndexOf(" ");
+      rows.push({ metric: line.slice(0, sp), value: line.slice(sp + 1) });
+    }
+    return "<h1>Metrics (Prometheus)</h1>" +
+      "<div class='note'><a href='/api/grafana/dashboard' target='_blank'>" +
+      "generated Grafana dashboard JSON</a> · raw at <a href='/metrics' " +
+      "target='_blank'>/metrics</a></div>" + renderTable(rows);
+  },
+
+  async stacks() {
+    const stacks = await getJSON("/api/stacks");
+    let h = "<h1>Worker stacks</h1>";
+    for (const node of stacks) {
+      for (const w of node.workers || []) {
+        h += `<h2>worker ${esc((w.worker_id || "?").slice(0, 10))} ` +
+          `(pid ${esc(w.pid)})</h2>`;
+        if (w.error) h += `<pre class="logview">${esc(w.error)}</pre>`;
+        for (const t of w.threads || []) {
+          h += `<div class="note">${esc(t.thread)}` +
+            (t.daemon ? " (daemon)" : "") + "</div>" +
+            `<pre class="logview">${esc(t.stack)}</pre>`;
+        }
+      }
+    }
+    return h;
+  },
+
+  // Profiling is trigger-only (it samples live workers for N seconds);
+  // auto-refresh must not re-trigger it, so the view renders a button.
+  async profile() {
+    return "<h1>CPU profile</h1>" +
+      "<div class='note'>Statistical sampling of every live worker " +
+      "(reference: dashboard reporter module's py-spy endpoint).</div>" +
+      "<button id='profile-btn' data-dur='2'>profile 2 s</button> " +
+      "<button id='profile-btn5' data-dur='5'>profile 5 s</button>" +
+      "<div id='profile-out'></div>";
+  },
+};
+
+async function runProfile(dur) {
+  const out = $("profile-out");
+  out.innerHTML = "<div class='note'>sampling…</div>";
+  try {
+    const nodes = await getJSON("/api/profile?duration=" + dur);
+    let h = "";
+    for (const node of nodes) {
+      for (const w of node.workers || []) {
+        const rows = (w.hot || []).map((t) => ({
+          samples: t.count, frac: t.count && w.samples
+            ? (100 * t.count / w.samples).toFixed(1) + "%" : "",
+          stack: t.stack,
+        }));
+        h += `<h2>worker ${esc((w.worker_id || "?").slice(0, 10))} ` +
+          `(pid ${esc(w.pid)}, ${esc(w.samples)} samples)</h2>` +
+          renderTable(rows, { id: "prof-" + w.pid, noFilter: true });
+      }
+    }
+    out.innerHTML = h || "<i>no samples</i>";
+  } catch (e) {
+    out.innerHTML = `<span style="color:var(--bad)">${esc(e)}</span>`;
+  }
+}
+
+document.addEventListener("click", (e) => {
+  const btn = e.target.closest("button[data-dur]");
+  if (btn) runProfile(btn.dataset.dur);
+});
+
+// ---- router --------------------------------------------------------------
+
+const NAV = [
+  ["overview", "Overview"], ["nodes", "Nodes"], ["actors", "Actors"],
+  ["tasks", "Tasks"], ["objects", "Objects"], ["pgs", "Placement groups"],
+  ["jobs", "Jobs"], ["serve", "Serve"], ["workflows", "Workflows"],
+  ["logs", "Logs"], ["events", "Events"], ["metrics", "Metrics"],
+  ["stacks", "Stacks"], ["profile", "Profile"],
+];
+
+// Total (never throws): a malformed percent-escape in a hand-edited
+// hash must not wedge the router — fall back to the raw segment.
+function safeDecode(s) {
+  try { return decodeURIComponent(s); } catch (e) { return s; }
+}
+
+function route() {
+  const hash = location.hash.replace(/^#\//, "") || "overview";
+  const parts = hash.split("/");
+  // Longest-prefix match so "jobs/logs/<id>" resolves before "jobs".
+  for (let n = parts.length; n > 0; n--) {
+    const name = parts.slice(0, n).join("/");
+    if (VIEWS[name]) return { name, args: parts.slice(n).map(safeDecode) };
+  }
+  return { name: "overview", args: [] };
+}
+
+let rendering = false;
+let renderWaiters = null;
+async function render() {
+  // Coalesce, never drop: a nav/sort/filter event during an in-flight
+  // refresh re-renders as soon as the current one finishes, and the
+  // returned promise resolves only after THAT final render (callers
+  // like the filter-box focus restore depend on it).
+  if (rendering) {
+    if (!renderWaiters) renderWaiters = [];
+    return new Promise((res) => renderWaiters.push(res));
+  }
+  rendering = true;
+  const { name, args } = route();
+  for (const a of document.querySelectorAll("#nav-links a")) {
+    a.classList.toggle("active", a.dataset.route === name.split("/")[0]);
+  }
+  $("crumbs").innerHTML = `<a href="#/overview">cluster</a> / ` +
+    esc(name) + (args.length ? " / " + esc(args.join(" / ")) : "");
+  try {
+    const html = await VIEWS[name](...args);
+    $("view").innerHTML = html;
+    $("err").textContent = "";
+    $("last-refresh").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    $("err").textContent = String(e);
+  } finally {
+    rendering = false;
+    if (renderWaiters) {
+      const waiters = renderWaiters;
+      renderWaiters = null;
+      render().then(() => waiters.forEach((res) => res()));
+    }
+  }
+}
+
+$("nav-links").innerHTML = NAV.map(([r, label]) =>
+  `<a href="#/${r}" data-route="${r}">${label}</a>`).join("");
+
+window.addEventListener("hashchange", render);
+render();
+setInterval(() => {
+  // Don't wipe profile output (trigger-only view) on the timer.
+  if ($("auto-refresh").checked && route().name !== "profile") render();
+}, 3000);

@@ -57,12 +57,13 @@ def profile_workers(duration_s: float = 2.0) -> list[dict]:
                           timeout=duration_s + 30)
 
 
-def node_stats() -> list[dict]:
+def node_stats(node_id: str | None = None) -> list[dict]:
     """Per-raylet core stats (workers, leases, store, spilling) pulled
     concurrently from every alive node — the data source for the
     dashboard's core metrics (parity: reference per-node stats via the
-    dashboard reporter agent)."""
-    return _per_node_call("GetState", timeout=10)
+    dashboard reporter agent); `node_id` narrows the fan-out to one
+    raylet."""
+    return _per_node_call("GetState", node_id=node_id, timeout=10)
 
 
 def _per_node_call(method: str, payload: dict | None = None,
@@ -110,10 +111,21 @@ def tail_log(node_id: str, name: str, max_bytes: int = 64 << 10) -> dict:
     return out[0] if out else {"error": f"node {node_id} not found"}
 
 
-def worker_stats() -> list[dict]:
+def tail_logs(node_id: str, names: list[str],
+              max_bytes: int = 64 << 10) -> dict[str, dict]:
+    """Tail several log files on one node with a single RPC (returns
+    {name: tail-result}); the dashboard's event merge depends on this
+    not paying a connection per file."""
+    out = _per_node_call("TailLog", {"names": names, "max_bytes": max_bytes},
+                         node_id=node_id)
+    return out[0].get("files", {}) if out else {}
+
+
+def worker_stats(node_id: str | None = None) -> list[dict]:
     """Per-worker CPU/RSS across the cluster (reference:
-    dashboard/modules/reporter per-node stats)."""
-    return _per_node_call("WorkerStats")
+    dashboard/modules/reporter per-node stats); `node_id` narrows the
+    fan-out to one raylet."""
+    return _per_node_call("WorkerStats", node_id=node_id)
 
 
 def list_objects() -> list[dict]:

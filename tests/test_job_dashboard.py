@@ -85,6 +85,85 @@ def test_dashboard_endpoints(ray_start_regular):
         dashboard.stop()
 
 
+def test_dashboard_spa_contract(ray_start_regular):
+    """The SPA (dashboard_static/app.js) and the server must agree:
+    every endpoint the client fetches answers 200 with the right
+    content type, the static assets serve, and path traversal 404s
+    (parity model: reference dashboard/client against head.py routes —
+    there the contract is typed via API clients; here it's enforced by
+    extracting every fetch target from the shipped app.js)."""
+    import re
+    import urllib.error
+
+    from ray_tpu import dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(2)])
+    port = dashboard.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+
+        def fetch(p):
+            with urllib.request.urlopen(base + p, timeout=60) as r:
+                return r.status, r.headers.get("Content-Type", ""), r.read()
+
+        # SPA shell + assets (the reference serves its built React app the
+        # same way: GET / -> SPA, which then talks JSON).
+        st, ctype, body = fetch("/")
+        assert st == 200 and "text/html" in ctype
+        assert b"app.js" in body
+        st, ctype, js = fetch("/static/app.js")
+        assert st == 200 and "javascript" in ctype
+        st, ctype, _ = fetch("/static/app.css")
+        assert st == 200 and "css" in ctype
+
+        # Traversal attempts and unknown assets must 404.
+        for bad in ["/static/../dashboard.py", "/static/nope.js"]:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                fetch(bad)
+            assert e.value.code == 404
+
+        # Every URL the client code fetches must answer. /api/profile is
+        # excluded: it samples live workers for N seconds (covered by
+        # test_dashboard_log_and_reporter_views) and would stall this test.
+        src = js.decode()
+        # Both quote styles: getJSON("/api/x") and getText(`/logs/view?...`)
+        # — a template-literal fetch must not escape the sweep.
+        urls = set(re.findall(r'get(?:JSON|Text)\((["`])(/[^"`?$]+)', src))
+        urls = {u for _, u in urls}
+        urls.discard("/api/profile")
+        urls.discard("/api/submission_jobs/logs")  # needs ?id=, below
+        assert "/api/cluster_status" in urls and "/api/events" in urls
+        for u in sorted(urls):
+            st, ctype, body = fetch(u)
+            assert st == 200, (u, st)
+            if "json" in ctype:
+                json.loads(body)
+
+        # Endpoints with query params that app.js builds dynamically:
+        # unknown submission ids are a clean 404, not a 500.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            fetch("/api/submission_jobs/logs?id=nope")
+        assert e.value.code == 404
+
+        # Shape contracts the SPA's drill-down views rely on (a 200 with
+        # the wrong fields renders an empty page, so pin them): node
+        # detail filters worker_stats/logs rows by FULL node_id and
+        # narrows the log fan-out with ?node=.
+        nid = json.loads(fetch("/api/nodes")[2])[0]["node_id"]
+        ws = json.loads(fetch("/api/worker_stats")[2])
+        assert ws and all(r["node_id"] == nid for r in ws)
+        assert any(r["worker_id"] != "(raylet)" for r in ws)
+        logs = json.loads(fetch("/api/logs?node=" + nid)[2])
+        assert logs and all(r["node_id"] == nid for r in logs)
+        assert json.loads(fetch("/api/logs?node=ffffffffff")[2]) == []
+    finally:
+        dashboard.stop()
+
+
 def test_timeline_dump(ray_start_regular, tmp_path):
     from ray_tpu.util.timeline import build_trace_events, dump_timeline
 
