@@ -301,6 +301,11 @@ def _open(path: str, mode: str = "r", endpoint_url: str | None = None):
     return open(path, mode)
 
 
+from ray_tpu.data.mongo import read_mongo, write_mongo  # noqa: E402
+from ray_tpu.data.optimizer import (  # noqa: E402
+    Rule,
+    register_optimizer_rule,
+)
 from ray_tpu.data.sql import read_sql, read_webdataset  # noqa: E402
 
 __all__ = [
@@ -309,7 +314,8 @@ __all__ = [
     "range_tensor", "from_numpy", "from_pandas", "from_arrow", "read_text",
     "read_json", "read_csv", "read_numpy", "read_parquet",
     "read_binary_files", "read_images", "read_tfrecords", "from_huggingface",
-    "read_sql", "read_webdataset",
+    "read_sql", "read_webdataset", "read_mongo", "write_mongo",
+    "Rule", "register_optimizer_rule",
 ]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu

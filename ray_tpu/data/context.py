@@ -26,6 +26,11 @@ class DataContext:
     default_block_count: int = 8
     # Per-block remote task timeout (seconds) in the streaming loop.
     block_task_timeout_s: float = 300.0
+    # Logical-optimizer catalog override: None = the built-in rules from
+    # ray_tpu/data/optimizer.py (plus any register_optimizer_rule()
+    # additions, reference: _user_provided_optimizer_rules.py). Set to a
+    # list of Rule instances to replace the catalog wholesale.
+    optimizer_rules: list | None = None
 
     _lock: ClassVar[threading.Lock] = threading.Lock()
     _current: ClassVar["DataContext | None"] = None

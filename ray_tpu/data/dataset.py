@@ -45,6 +45,9 @@ class _Stage:
     pushdown_projection: list | None = None
     pushdown_filter: tuple | None = None
     all_to_all: bool = False  # needs every input block materialized first
+    # Order-only barrier (randomize_block_order): all_to_all_fn permutes
+    # the list of block REFS — blocks are never fetched or touched.
+    reorder: bool = False
     all_to_all_fn: Callable | None = None  # blocks(list of refs) -> list[blocks]
     num_cpus: float = 1.0
     # >0: run on a pool of stateful actors instead of tasks (parity:
@@ -112,49 +115,12 @@ class ReadTask:
 
 
 def _pushdown_rewrite(source: list, stages: list) -> tuple[list, list]:
-    """Fold leading projection/predicate stages into parquet ReadTasks
-    (reference: the logical optimizer's pushdown rules run before
-    physical planning; here the plan IS the stage list)."""
-    if not source or not all(
-            isinstance(s, ReadTask) and s.meta
-            and s.meta.get("kind") == "parquet" for s in source):
-        return source, stages
-    metas = [dict(s.meta) for s in source]
-    i = 0
-    for st in stages:
-        # Fold only when transparent: a projection/predicate referencing
-        # a column OUTSIDE the current projection must keep its stage
-        # (which raises KeyError at runtime) — folding it into pyarrow
-        # would silently succeed, diverging from the non-parquet path.
-        current_cols = metas[0].get("columns")
-        if st.pushdown_projection is not None:
-            cols = st.pushdown_projection
-            if current_cols is not None and \
-                    not set(cols) <= set(current_cols):
-                break
-            for m in metas:
-                m["columns"] = list(cols)
-        elif st.pushdown_filter is not None:
-            col, _op, _lit = st.pushdown_filter
-            if current_cols is not None and col not in current_cols:
-                break
-            for m in metas:
-                m["filters"] = (m.get("filters") or []) + \
-                    [tuple(st.pushdown_filter)]
-        else:
-            break
-        i += 1
-    if i == 0:
-        return source, stages
-    from ray_tpu.data import _read_parquet_group  # late: avoid cycle
-    import functools
+    """Back-compat shim over the optimizer's ParquetReadPushdown rule
+    (the full catalog lives in ray_tpu/data/optimizer.py)."""
+    from ray_tpu.data.optimizer import LogicalPlan, ParquetReadPushdown
 
-    new_source = [
-        ReadTask(fn=functools.partial(
-            _read_parquet_group, m["group"], m.get("columns"),
-            m.get("filters"), m.get("endpoint_url")), meta=m)
-        for m in metas]
-    return new_source, stages[i:]
+    plan = ParquetReadPushdown().apply(LogicalPlan(source, stages))
+    return plan.source, plan.stages
 
 
 @ray_tpu.remote
@@ -304,6 +270,23 @@ class Dataset:
             return out
 
         return self._with(_Stage("flat_map", stage_fn))
+
+    def randomize_block_order(self, seed: int | None = None) -> "Dataset":
+        """Shuffle BLOCK order without touching rows (parity:
+        dataset.py randomize_block_order) — an order-only barrier that
+        permutes block refs, zero data movement. The optimizer pushes it
+        past map stages and deletes it when a random_shuffle follows
+        (optimizer.py ReorderRandomizeBlocks / DropRedundantRandomize,
+        reference: logical/rules/randomize_blocks.py)."""
+        def reorder_fn(blocks, seed=seed):
+            rng = _random.Random(seed)
+            out = list(blocks)
+            rng.shuffle(out)
+            return out
+
+        return self._with(_Stage(name="randomize_block_order", fn=None,
+                                 all_to_all=True, all_to_all_fn=reorder_fn,
+                                 reorder=True))
 
     def random_shuffle(self, seed: int | None = None) -> "Dataset":
         """Distributed push-based shuffle: each map task scatters its rows
@@ -528,6 +511,34 @@ class Dataset:
                 "stages": [st.name for st in self._stages],
             }
 
+    def explain(self) -> str:
+        """Logical plan before and after the optimizer rule catalog
+        (reference: the DAG repr Dataset.__repr__ prints + the logical
+        optimizer in _internal/logical/optimizers.py). Shows which
+        stages were pushed into reads, fused, reordered, or dropped."""
+        from ray_tpu.data.optimizer import LogicalPlan, optimize
+
+        def describe(source, stages):
+            if source and isinstance(source[0], ReadTask):
+                kind = (source[0].meta or {}).get("kind", "read")
+                cols = (source[0].meta or {}).get("columns")
+                filt = (source[0].meta or {}).get("filters")
+                src = f"{kind}[{len(source)} tasks"
+                if cols:
+                    src += f", columns={list(cols)}"
+                if filt:
+                    src += f", filters={list(filt)}"
+                src += "]"
+            else:
+                src = f"blocks[{len(source)}]"
+            return " -> ".join([src] + [st.name for st in stages])
+
+        before = describe(self._source, self._stages)
+        plan = optimize(LogicalPlan(list(self._source),
+                                    list(self._stages)))
+        after = describe(plan.source, plan.stages)
+        return f"logical : {before}\noptimized: {after}"
+
     def stats(self) -> str:
         """Execution summary of the last run (reference: Dataset.stats() —
         data/_internal/stats.py; per-stage timing there, end-to-end here)."""
@@ -546,8 +557,11 @@ class Dataset:
 
         task_timeout = DataContext.get_current().block_task_timeout_s
 
-        source, stages = _pushdown_rewrite(list(self._source),
-                                           list(self._stages))
+        from ray_tpu.data.optimizer import LogicalPlan, optimize
+
+        plan = optimize(LogicalPlan(list(self._source),
+                                    list(self._stages)))
+        source, stages = plan.source, plan.stages
 
         def resolve_sources() -> Iterator:
             """Launch deferred reads as remote tasks; their ObjectRefs feed
@@ -677,6 +691,9 @@ class Dataset:
                 continue
             if barrier.shuffle_map_fn is not None:
                 blocks = run_shuffle(blocks, barrier)
+            elif barrier.reorder:
+                # Order-only barrier: permute the REFS, never fetch.
+                blocks = iter(barrier.all_to_all_fn(list(blocks)))
             else:
                 materialized = [b if not isinstance(b, ray_tpu.ObjectRef)
                                 else ray_tpu.get(b) for b in blocks]
@@ -889,6 +906,15 @@ class Dataset:
             batch = block_to_batch(block)
             if column in batch:
                 np.save(os.path.join(path, f"part-{i:05d}.npy"), batch[column])
+
+    def write_mongo(self, uri: str, database: str, collection: str, *,
+                    client_factory=None) -> int:
+        """Insert every row into a MongoDB collection (reference:
+        Dataset.write_mongo; connector in data/mongo.py)."""
+        from ray_tpu.data.mongo import write_mongo
+
+        return write_mongo(self, uri, database, collection,
+                           client_factory=client_factory)
 
     def write_tfrecords(self, path: str) -> None:
         """One TFRecord file of tf.train.Example protos per output block

@@ -1,0 +1,131 @@
+"""MongoDB connector.
+
+Parity: reference read_mongo / Dataset.write_mongo
+(python/ray/data/read_api.py read_mongo, datasource/mongo_datasource.py
+— partitioned reads via an aggregation pipeline, writes via
+insert_many). The driver dependency is injectable: `client_factory` is
+any zero-arg picklable callable returning a pymongo-compatible client
+(client[db][coll].aggregate / .count_documents / .insert_many), so the
+connector works with pymongo when installed and with hermetic fakes in
+tests — the image ships no mongo server or driver.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def _default_client(uri: str):
+    try:
+        import pymongo
+    except ImportError as e:  # pragma: no cover - driver not in image
+        raise ImportError(
+            "read_mongo/write_mongo need pymongo (not installed) or an "
+            "explicit client_factory") from e
+    return pymongo.MongoClient(uri)
+
+
+def _fetch(factory, database, collection, pipeline, skip, limit):
+    client = factory()
+    coll = client[database][collection]
+    stages = list(pipeline or [])
+    # $skip/$limit append AFTER the user pipeline so filters/projections
+    # inside it see the whole collection; deterministic shard boundaries
+    # need a stable order, so sort by _id first when sharding.
+    if skip is not None:
+        stages = [{"$sort": {"_id": 1}}] + stages + \
+            [{"$skip": skip}, {"$limit": limit}]
+    return [dict(d) for d in coll.aggregate(stages)]
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline: list | None = None,
+               override_num_blocks: int | None = None,
+               client_factory=None):
+    """Dataset over a MongoDB collection, optionally through an
+    aggregation `pipeline`. With override_num_blocks=N>1 the (sorted by
+    _id) result is sharded into N skip/limit ranges read as independent
+    cluster tasks (the reference partitions the same collection scan
+    across read tasks)."""
+    from ray_tpu.data.dataset import Dataset, ReadTask
+
+    factory = client_factory or functools.partial(_default_client, uri)
+    n = override_num_blocks or 1
+    # Sharding slices a stable _id order with $skip/$limit, which is
+    # only correct when the user pipeline maps documents independently —
+    # stages like $group/$sort/$unwind emit results in their own
+    # (possibly nondeterministic) order, so the N independent aggregate
+    # calls would slice N DIFFERENT orderings and duplicate/drop rows.
+    _ORDER_PRESERVING = {"$match", "$project", "$addFields", "$set",
+                         "$unset", "$redact"}
+    if n > 1 and pipeline and any(
+            next(iter(st)) not in _ORDER_PRESERVING for st in pipeline):
+        n = 1
+    if n > 1:
+        client = factory()
+        coll = client[database][collection]
+        if pipeline:
+            counted = list(coll.aggregate(list(pipeline)
+                                          + [{"$count": "n"}]))
+            total = counted[0]["n"] if counted else 0
+        else:
+            total = coll.count_documents({})
+        per = -(-total // n) if total else 0
+        tasks = []
+        for i in range(n):
+            skip = i * per
+            # per=0 (empty source) or skip>=total would send MongoDB a
+            # rejected {$limit: 0} / read nothing: stop emitting tasks.
+            if per <= 0 or skip >= total:
+                break
+            tasks.append(ReadTask(
+                fn=functools.partial(_fetch, factory, database,
+                                     collection, pipeline, skip, per),
+                num_rows=min(per, total - skip),
+                meta={"kind": "mongo", "database": database,
+                      "collection": collection, "skip": skip,
+                      "limit": per}))
+        if tasks:
+            return Dataset(tasks)
+    return Dataset([ReadTask(
+        fn=functools.partial(_fetch, factory, database, collection,
+                             pipeline, None, None),
+        meta={"kind": "mongo", "database": database,
+              "collection": collection})])
+
+
+def _write_block(factory, database, collection, rows):
+    if rows:
+        client = factory()
+        client[database][collection].insert_many(list(rows))
+    return len(rows)
+
+
+def write_mongo(ds, uri: str, database: str, collection: str, *,
+                client_factory=None) -> int:
+    """Insert every row of `ds` into the collection (one insert_many per
+    block, run as cluster tasks); returns rows written."""
+    import ray_tpu
+    from ray_tpu.data.block import block_to_rows
+
+    from ray_tpu.data.context import DataContext
+
+    factory = client_factory or functools.partial(_default_client, uri)
+
+    @ray_tpu.remote
+    def write_one(block):
+        return _write_block(factory, database, collection,
+                            block_to_rows(block))
+
+    # Windowed submission (like the executor's run_segment): bounded
+    # driver memory and bounded concurrent bulk inserts on the server.
+    window_size = DataContext.get_current().max_in_flight_blocks
+    total = 0
+    window: list = []
+    for block in ds._iter_output_blocks():
+        window.append(write_one.remote(block))
+        if len(window) >= window_size:
+            total += ray_tpu.get(window.pop(0))
+    for ref in window:
+        total += ray_tpu.get(ref)
+    return total
