@@ -24,10 +24,16 @@ from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.offline import (BC, MARWIL, BCConfig, JsonReader,
                                    MARWILConfig, write_offline_json)
+from ray_tpu.rllib.alphazero import (AlphaZero, AlphaZeroConfig, MCTS,
+                                     TicTacToe)
+from ray_tpu.rllib.maddpg import MADDPG, CoopNav, MADDPGConfig
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.r2d2 import R2D2, R2D2Config, SequenceReplay
+from ray_tpu.rllib.rainbow import Rainbow, RainbowConfig
 from ray_tpu.rllib.replay import PrioritizedReplayBuffer
 from ray_tpu.rllib.sac import SAC, SACConfig
+from ray_tpu.rllib.slateq import SlateDocEnv, SlateQ, SlateQConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "Impala", "ImpalaConfig", "APPO", "APPOConfig", "A2C", "A2CConfig",
@@ -43,7 +49,11 @@ __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "SimpleQ", "SimpleQConfig", "ApexDQN", "ApexDQNConfig",
            "Bandit", "BanditConfig", "LinearDiscreteBandit",
            "CRR", "CRRConfig", "RandomAgent", "RandomAgentConfig",
-           "DT", "DTConfig", "QMIX", "QMIXConfig", "CoopSwitch"]
+           "DT", "DTConfig", "QMIX", "QMIXConfig", "CoopSwitch",
+           "Rainbow", "RainbowConfig", "R2D2", "R2D2Config",
+           "SequenceReplay", "MADDPG", "MADDPGConfig", "CoopNav",
+           "AlphaZero", "AlphaZeroConfig", "MCTS", "TicTacToe",
+           "SlateQ", "SlateQConfig", "SlateDocEnv"]
 
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu('rllib')

@@ -1,0 +1,345 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Parity: reference rllib/algorithms/maddpg/ (per-agent deterministic
+actor over its OWN observation; per-agent critic over ALL observations
+and ALL actions — centralized training, decentralized execution; target
+networks with polyak averaging; shared replay of joint transitions).
+JAX-native: all agents' actor+critic updates run in one jitted program.
+
+Ships CoopNav, the cooperative continuous testbed (two agents on a
+line steering to their targets, shared reward) standing in for the
+reference's MPE simple_spread.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.utils import tree_copy as _copy_tree
+from ray_tpu.rllib.utils import tree_numpy as _to_numpy
+
+
+def resolve_ma_env(spec):
+    """Environment spec -> instance: "CoopNav" (built-in), a class, or a
+    zero-arg factory. The env must expose the CoopNav contract
+    (n_agents/observation_size/action_size, list-per-agent obs, shared
+    scalar reward)."""
+    if spec == "CoopNav" or spec is None:
+        return CoopNav()
+    if callable(spec):
+        return spec()
+    raise ValueError(f"unsupported multi-agent env spec {spec!r}; pass "
+                     "'CoopNav' or an env class/factory")
+
+
+class CoopNav:
+    """Two agents on [-1, 1] each steering to its own target; shared
+    reward -(|p0-t0| + |p1-t1|). Obs_i = [own pos, own target, other
+    pos, other target]; action_i = velocity in [-1, 1]."""
+
+    n_agents = 2
+    observation_size = 4
+    action_size = 1
+    horizon = 25
+
+    def __init__(self):
+        self.rng = np.random.default_rng(0)
+
+    def reset(self, seed: int | None = None) -> list[np.ndarray]:
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.pos = self.rng.uniform(-1, 1, 2).astype(np.float32)
+        self.targets = self.rng.uniform(-1, 1, 2).astype(np.float32)
+        self.t = 0
+        return self._obs()
+
+    def _obs(self) -> list[np.ndarray]:
+        out = []
+        for i in range(2):
+            j = 1 - i
+            out.append(np.array([self.pos[i], self.targets[i],
+                                 self.pos[j], self.targets[j]],
+                                np.float32))
+        return out
+
+    def step(self, actions: list[float]):
+        self.pos = np.clip(
+            self.pos + 0.1 * np.clip(np.asarray(actions, np.float32)
+                                     .reshape(2), -1, 1), -1, 1)
+        self.t += 1
+        reward = -float(np.abs(self.pos - self.targets).sum())
+        done = self.t >= self.horizon
+        return self._obs(), reward, done, {}
+
+
+def init_maddpg_params(n_agents: int, obs_size: int, act_size: int,
+                       hidden: int = 64, seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def dense(i, o):
+        return {"w": (rng.standard_normal((i, o))
+                      / np.sqrt(i)).astype(np.float32),
+                "b": np.zeros(o, np.float32)}
+
+    joint = n_agents * (obs_size + act_size)
+    agents = []
+    for _ in range(n_agents):
+        agents.append({
+            "actor": {"h": dense(obs_size, hidden),
+                      "out": dense(hidden, act_size)},
+            "critic": {"h1": dense(joint, hidden),
+                       "h2": dense(hidden, hidden),
+                       "out": dense(hidden, 1)},
+        })
+    return {"agents": agents}
+
+
+def numpy_actor(actor: dict, obs: np.ndarray) -> np.ndarray:
+    h = np.tanh(obs @ actor["h"]["w"] + actor["h"]["b"])
+    return np.tanh(h @ actor["out"]["w"] + actor["out"]["b"])
+
+
+@ray_tpu.remote
+class MADDPGRolloutWorker:
+    """CPU sampler: decentralized execution — each agent acts from its
+    own actor + exploration noise (parity: rollout_worker.py)."""
+
+    def __init__(self, env_spec, worker_index: int):
+        self.env = resolve_ma_env(env_spec)
+        self.rng = np.random.default_rng(5000 + worker_index)
+        self.obs = self.env.reset(seed=worker_index)
+        self.ep_ret = 0.0
+
+    def sample(self, params: dict, num_steps: int, noise: float) -> dict:
+        n = self.env.n_agents
+        buf = {"obs": [], "actions": [], "rewards": [], "next_obs": [],
+               "dones": []}
+        episode_returns = []
+        for _ in range(num_steps):
+            acts = []
+            for i in range(n):
+                a = numpy_actor(params["agents"][i]["actor"],
+                                self.obs[i][None, :])[0]
+                a = np.clip(a + noise * self.rng.standard_normal(a.shape),
+                            -1, 1)
+                acts.append(a.astype(np.float32))
+            next_obs, reward, done, _ = self.env.step(
+                [float(a[0]) for a in acts])
+            buf["obs"].append(np.stack(self.obs))
+            buf["actions"].append(np.stack(acts))
+            buf["rewards"].append(reward)
+            buf["next_obs"].append(np.stack(next_obs))
+            buf["dones"].append(float(done))
+            self.ep_ret += reward
+            if done:
+                episode_returns.append(self.ep_ret)
+                self.ep_ret = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        return {k: np.asarray(v, np.float32) for k, v in buf.items()} | {
+            "episode_returns": episode_returns}
+
+
+@dataclass
+class MADDPGConfig:
+    """Parity: rllib MADDPGConfig."""
+
+    env: Any = "CoopNav"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 200
+    buffer_capacity: int = 50_000
+    train_batch_size: int = 128
+    num_sgd_iter: int = 16
+    gamma: float = 0.95
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    tau: float = 0.02  # polyak
+    hidden_size: int = 64
+    exploration_noise: float = 0.3
+    noise_decay_iters: int = 20
+    seed: int = 0
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: int | None = None, **kw):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown MADDPG option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "MADDPG":
+        return MADDPG(self)
+
+
+class MADDPG:
+    """Algorithm driver (parity: Algorithm.step / MADDPG training_step)."""
+
+    def __init__(self, config: MADDPGConfig):
+        self.config = config
+        env = resolve_ma_env(config.env)
+        self.n_agents = env.n_agents
+        self.obs_size = env.observation_size
+        self.act_size = env.action_size
+        self.params = init_maddpg_params(
+            self.n_agents, self.obs_size, self.act_size,
+            config.hidden_size, config.seed)
+        self.target_params = _copy_tree(self.params)
+        cap = config.buffer_capacity
+        self.buf = {
+            "obs": np.zeros((cap, self.n_agents, self.obs_size),
+                            np.float32),
+            "actions": np.zeros((cap, self.n_agents, self.act_size),
+                                np.float32),
+            "rewards": np.zeros(cap, np.float32),
+            "next_obs": np.zeros((cap, self.n_agents, self.obs_size),
+                                 np.float32),
+            "dones": np.zeros(cap, np.float32),
+        }
+        self.buf_pos = 0
+        self.buf_size = 0
+        self.rng = np.random.default_rng(config.seed)
+        self.workers = [MADDPGRolloutWorker.remote(config.env, i)
+                        for i in range(config.num_rollout_workers)]
+        self._update = None
+        self.iteration = 0
+        self.total_steps = 0
+
+    def _add(self, batch: dict) -> None:
+        n = len(batch["obs"])
+        cap = self.config.buffer_capacity
+        idx = (self.buf_pos + np.arange(n)) % cap
+        for k in self.buf:
+            self.buf[k][idx] = batch[k]
+        self.buf_pos = int((self.buf_pos + n) % cap)
+        self.buf_size = int(min(self.buf_size + n, cap))
+
+    def _sample(self, batch_size: int) -> dict:
+        idx = self.rng.integers(0, self.buf_size, batch_size)
+        return {k: v[idx] for k, v in self.buf.items()}
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        n = self.n_agents
+        opt_a = optax.adam(cfg.actor_lr)
+        opt_c = optax.adam(cfg.critic_lr)
+        self._opt_a, self._opt_c = opt_a, opt_c
+        self._opt_a_state = opt_a.init(self.params)
+        self._opt_c_state = opt_c.init(self.params)
+
+        def actor(p, obs):
+            h = jnp.tanh(obs @ p["h"]["w"] + p["h"]["b"])
+            return jnp.tanh(h @ p["out"]["w"] + p["out"]["b"])
+
+        def critic(p, joint):
+            h = jnp.tanh(joint @ p["h1"]["w"] + p["h1"]["b"])
+            h = jnp.tanh(h @ p["h2"]["w"] + p["h2"]["b"])
+            return (h @ p["out"]["w"] + p["out"]["b"])[:, 0]
+
+        def joint_in(obs, acts):
+            B = obs.shape[0]
+            return jnp.concatenate(
+                [obs.reshape(B, -1), acts.reshape(B, -1)], axis=1)
+
+        def critic_loss(params, target_params, batch):
+            # Centralized TD target: all target actors act on next obs.
+            next_acts = jnp.stack(
+                [actor(target_params["agents"][i]["actor"],
+                       batch["next_obs"][:, i]) for i in range(n)], axis=1)
+            total = 0.0
+            for i in range(n):
+                q_next = critic(target_params["agents"][i]["critic"],
+                                joint_in(batch["next_obs"], next_acts))
+                target = batch["rewards"] + cfg.gamma * \
+                    (1.0 - batch["dones"]) * jax.lax.stop_gradient(q_next)
+                q = critic(params["agents"][i]["critic"],
+                           joint_in(batch["obs"], batch["actions"]))
+                total = total + jnp.mean((q - target) ** 2)
+            return total
+
+        def actor_loss(params, batch):
+            # Each agent maximizes ITS centralized critic with its own
+            # action re-derived from its actor, others' from replay.
+            total = 0.0
+            for i in range(n):
+                my_act = actor(params["agents"][i]["actor"],
+                               batch["obs"][:, i])
+                acts = batch["actions"].at[:, i].set(my_act)
+                q = critic(jax.lax.stop_gradient(
+                    params["agents"][i]["critic"]),
+                    joint_in(batch["obs"], acts))
+                total = total - jnp.mean(q)
+            return total
+
+        def polyak(target, online):
+            return jax.tree_util.tree_map(
+                lambda t, o: (1.0 - cfg.tau) * t + cfg.tau * o,
+                target, online)
+
+        @jax.jit
+        def update(params, target_params, oa, oc, batch):
+            closs, cgrads = jax.value_and_grad(critic_loss)(
+                params, target_params, batch)
+            cupd, oc = opt_c.update(cgrads, oc, params)
+            params = optax.apply_updates(params, cupd)
+            aloss, agrads = jax.value_and_grad(actor_loss)(params, batch)
+            aupd, oa = opt_a.update(agrads, oa, params)
+            params = optax.apply_updates(params, aupd)
+            target_params = polyak(target_params, params)
+            return params, target_params, oa, oc, closs, aloss
+
+        self._update = update
+
+    def train(self) -> dict:
+        cfg = self.config
+        if self._update is None:
+            self._build_update()
+        frac = min(1.0, self.iteration / max(1, cfg.noise_decay_iters))
+        noise = cfg.exploration_noise * (1.0 - 0.9 * frac)
+        rollout_params = _to_numpy(self.params)
+        outs = ray_tpu.get([
+            w.sample.remote(rollout_params, cfg.rollout_fragment_length,
+                            noise) for w in self.workers])
+        returns = []
+        for out in outs:
+            self._add(out)
+            returns += out["episode_returns"]
+            self.total_steps += len(out["obs"])
+        closses = []
+        if self.buf_size >= cfg.train_batch_size:
+            for _ in range(cfg.num_sgd_iter):
+                batch = {k: v for k, v in
+                         self._sample(cfg.train_batch_size).items()}
+                import jax.numpy as jnp
+
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                (self.params, self.target_params, self._opt_a_state,
+                 self._opt_c_state, closs, _aloss) = self._update(
+                    self.params, self.target_params, self._opt_a_state,
+                    self._opt_c_state, batch)
+                closses.append(float(closs))
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "episode_reward_mean":
+                    float(np.mean(returns)) if returns else float("nan"),
+                "num_env_steps_sampled": self.total_steps,
+                "critic_loss":
+                    float(np.mean(closses)) if closses else None}
+
+

@@ -1,0 +1,343 @@
+"""R2D2: recurrent replay distributed DQN.
+
+Parity: reference rllib/algorithms/r2d2/ (recurrent Q-network trained
+on stored SEQUENCES with burn-in: the first `burn_in` steps of each
+replayed sequence only warm the hidden state — no gradient — so the
+recurrent state the network trains from is close to the state it acted
+from; double-Q targets; target network). JAX-native: the GRU unroll is
+a lax.scan inside one jitted update, so the whole
+burn-in + train-segment pipeline compiles to a single program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+
+
+def init_r2d2_params(obs_size: int, num_actions: int, hidden: int = 32,
+                     seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+
+    def mat(i, o):
+        return (rng.standard_normal((i, o)) / np.sqrt(i)).astype(np.float32)
+
+    return {
+        # GRU: update gate z, reset gate r, candidate n (torch layout).
+        "wx": mat(obs_size, 3 * hidden), "wh": mat(hidden, 3 * hidden),
+        "b": np.zeros(3 * hidden, np.float32),
+        "q_w": mat(hidden, num_actions),
+        "q_b": np.zeros(num_actions, np.float32),
+    }
+
+
+def _gru_step_np(params, h, x):
+    g = x @ params["wx"] + h @ params["wh"] + params["b"]
+    H = h.shape[-1]
+    z = 1.0 / (1.0 + np.exp(-g[..., :H]))
+    r = 1.0 / (1.0 + np.exp(-g[..., H:2 * H]))
+    n = np.tanh(x @ params["wx"][:, 2 * H:]
+                + r * (h @ params["wh"][:, 2 * H:])
+                + params["b"][2 * H:])
+    return (1.0 - z) * n + z * h
+
+
+def numpy_r2d2_q(params: dict, h: np.ndarray, obs: np.ndarray):
+    """One recurrent step on CPU: returns (q_values, next_hidden)."""
+    h2 = _gru_step_np(params, h, obs)
+    return h2 @ params["q_w"] + params["q_b"], h2
+
+
+class SequenceReplay:
+    """Ring buffer of fixed-length sequences with their initial hidden
+    state (reference: r2d2's sequence storage — replay_sequence_length
+    = burn_in + train length, zero/stored initial states)."""
+
+    def __init__(self, capacity: int, seq_len: int, obs_size: int,
+                 hidden: int, seed: int = 0):
+        self.capacity, self.seq_len = capacity, seq_len
+        self.obs = np.zeros((capacity, seq_len, obs_size), np.float32)
+        self.next_obs = np.zeros((capacity, seq_len, obs_size), np.float32)
+        self.actions = np.zeros((capacity, seq_len), np.int32)
+        self.rewards = np.zeros((capacity, seq_len), np.float32)
+        self.dones = np.zeros((capacity, seq_len), np.float32)
+        self.resets = np.zeros((capacity, seq_len), np.float32)
+        self.h0 = np.zeros((capacity, hidden), np.float32)
+        self.pos = 0
+        self.size = 0
+        self.rng = np.random.default_rng(seed)
+
+    def add_sequences(self, seqs: list[dict]) -> None:
+        for s in seqs:
+            i = self.pos
+            self.obs[i] = s["obs"]
+            self.next_obs[i] = s["next_obs"]
+            self.actions[i] = s["actions"]
+            self.rewards[i] = s["rewards"]
+            self.dones[i] = s["dones"]
+            self.resets[i] = s.get("resets", s["dones"])
+            self.h0[i] = s["h0"]
+            self.pos = (self.pos + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self.rng.integers(0, self.size, batch_size)
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx], "rewards": self.rewards[idx],
+                "dones": self.dones[idx], "resets": self.resets[idx],
+                "h0": self.h0[idx]}
+
+
+@ray_tpu.remote
+class R2D2RolloutWorker:
+    """CPU sampler carrying the recurrent state across fragments; emits
+    fixed-length sequences stamped with the hidden state they started
+    from (parity: rollout_worker.py + R2D2's state-in-replay)."""
+
+    def __init__(self, env_spec, worker_index: int, hidden: int,
+                 seq_len: int):
+        self.env = make_env(env_spec)
+        self.hidden = hidden
+        self.seq_len = seq_len
+        self.rng = np.random.default_rng(4000 + worker_index)
+        self.obs = self.env.reset(seed=worker_index)
+        self.h = np.zeros(hidden, np.float32)
+        self.ep_ret = 0.0
+
+    def sample(self, params: dict, num_seqs: int, epsilon: float) -> dict:
+        seqs = []
+        episode_returns = []
+        for _ in range(num_seqs):
+            seq = {k: [] for k in ("obs", "actions", "rewards",
+                                   "next_obs", "dones", "resets")}
+            h0 = self.h.copy()
+            for _ in range(self.seq_len):
+                q, self.h = numpy_r2d2_q(params, self.h[None, :],
+                                         self.obs[None, :])
+                self.h = self.h[0]
+                if self.rng.random() < epsilon:
+                    action = int(self.rng.integers(self.env.num_actions))
+                else:
+                    action = int(np.argmax(q[0]))
+                next_obs, reward, done, info = self.env.step(action)
+                seq["obs"].append(self.obs)
+                seq["actions"].append(action)
+                seq["rewards"].append(reward)
+                seq["next_obs"].append(next_obs)
+                # dones = bootstrap mask (time-limit cuts bootstrap
+                # through, env.py convention); resets = where the
+                # episode ended and the actor zeroed its hidden state —
+                # the training unroll must do the same.
+                seq["dones"].append(float(bool(done)
+                                    and not info.get("truncated",
+                                                     False)))
+                seq["resets"].append(float(done))
+                self.ep_ret += reward
+                if done:
+                    episode_returns.append(self.ep_ret)
+                    self.ep_ret = 0.0
+                    self.obs = self.env.reset()
+                    self.h = np.zeros(self.hidden, np.float32)
+                else:
+                    self.obs = next_obs
+            seqs.append({"obs": np.asarray(seq["obs"], np.float32),
+                         "actions": np.asarray(seq["actions"], np.int32),
+                         "rewards": np.asarray(seq["rewards"], np.float32),
+                         "next_obs": np.asarray(seq["next_obs"],
+                                                np.float32),
+                         "dones": np.asarray(seq["dones"], np.float32),
+                         "resets": np.asarray(seq["resets"], np.float32),
+                         "h0": h0})
+        return {"sequences": seqs, "episode_returns": episode_returns,
+                "steps": num_seqs * self.seq_len}
+
+
+@dataclass
+class R2D2Config:
+    """Parity: rllib R2D2Config (replay_sequence_length = burn_in +
+    train segment, zero_init_states=False — states come from the actor)."""
+
+    env: Any = "CartPole-v1"
+    num_rollout_workers: int = 2
+    sequences_per_rollout: int = 8
+    burn_in: int = 4
+    train_length: int = 12
+    buffer_capacity: int = 4_000
+    train_batch_size: int = 32
+    num_sgd_iter: int = 16
+    gamma: float = 0.99
+    lr: float = 1e-3
+    hidden_size: int = 32
+    target_network_update_freq: int = 4
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_iters: int = 15
+    seed: int = 0
+
+    @property
+    def seq_len(self) -> int:
+        return self.burn_in + self.train_length
+
+    def environment(self, env):
+        self.env = env
+        return self
+
+    def rollouts(self, num_rollout_workers: int | None = None, **kw):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown R2D2 option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build(self) -> "R2D2":
+        return R2D2(self)
+
+
+class R2D2:
+    """Algorithm driver (parity: Algorithm.step / R2D2 training_step)."""
+
+    def __init__(self, config: R2D2Config):
+        self.config = config
+        probe = make_env(config.env)
+        self.obs_size = probe.observation_size
+        self.num_actions = probe.num_actions
+        self.params = init_r2d2_params(self.obs_size, self.num_actions,
+                                       config.hidden_size, config.seed)
+        self.target_params = {k: v.copy() for k, v in self.params.items()}
+        self.buffer = SequenceReplay(config.buffer_capacity,
+                                     config.seq_len, self.obs_size,
+                                     config.hidden_size, config.seed)
+        self.workers = [
+            R2D2RolloutWorker.remote(config.env, i, config.hidden_size,
+                                     config.seq_len)
+            for i in range(config.num_rollout_workers)]
+        self._update = None
+        self.iteration = 0
+        self.total_steps = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        H = cfg.hidden_size
+        opt = optax.adam(cfg.lr)
+        self._opt = opt
+        self._opt_state = opt.init(self.params)
+
+        def gru_step(params, h, x):
+            g = x @ params["wx"] + h @ params["wh"] + params["b"]
+            z = jax.nn.sigmoid(g[..., :H])
+            r = jax.nn.sigmoid(g[..., H:2 * H])
+            n = jnp.tanh(x @ params["wx"][:, 2 * H:]
+                         + r * (h @ params["wh"][:, 2 * H:])
+                         + params["b"][2 * H:])
+            return (1.0 - z) * n + z * h
+
+        def unroll_q(params, h0, obs_seq, resets):
+            """obs_seq [B, T, obs] -> q [B, T, A] via lax.scan over T.
+            `resets` [B, T] zeroes the carried state AFTER a step where
+            the episode ended — matching the actor, which starts the
+            next episode from h = 0 (sequences may span resets)."""
+            def step(h, xs):
+                x_t, r_t = xs
+                h2 = gru_step(params, h, x_t)
+                h_next = h2 * (1.0 - r_t)[:, None]
+                return h_next, h2 @ params["q_w"] + params["q_b"]
+
+            hT, qs = jax.lax.scan(
+                step, h0, (jnp.swapaxes(obs_seq, 0, 1),
+                           jnp.swapaxes(resets, 0, 1)))
+            return jnp.swapaxes(qs, 0, 1), hT
+
+        def loss_fn(params, target_params, batch):
+            B = batch["obs"].shape[0]
+            # Burn-in: warm the hidden state on the replayed prefix with
+            # NO gradient (R2D2's stored-state + burn-in strategy).
+            burn_obs = batch["obs"][:, :cfg.burn_in]
+            burn_resets = batch["resets"][:, :cfg.burn_in]
+            _, h_warm = unroll_q(jax.lax.stop_gradient(params),
+                                 batch["h0"], burn_obs, burn_resets)
+            h_warm = jax.lax.stop_gradient(h_warm)
+            train = slice(cfg.burn_in, cfg.seq_len)
+            train_resets = batch["resets"][:, train]
+            q_seq, _ = unroll_q(params, h_warm, batch["obs"][:, train],
+                                train_resets)
+            # Targets: unroll the TARGET net one step shifted (its own
+            # burn-in includes the first train step), double-Q action
+            # selection from the online unroll over next_obs.
+            q_next_online, _ = unroll_q(params, h_warm,
+                                        batch["next_obs"][:, train],
+                                        train_resets)
+            q_next_target, _ = unroll_q(target_params, h_warm,
+                                        batch["next_obs"][:, train],
+                                        train_resets)
+            a_star = jnp.argmax(q_next_online, axis=-1)
+            q_boot = jnp.take_along_axis(
+                q_next_target, a_star[..., None], axis=-1)[..., 0]
+            r = batch["rewards"][:, train]
+            d = batch["dones"][:, train]
+            target = r + cfg.gamma * (1.0 - d) * \
+                jax.lax.stop_gradient(q_boot)
+            q_taken = jnp.take_along_axis(
+                q_seq, batch["actions"][:, train, None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            return jnp.mean((q_taken - target) ** 2)
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, batch)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = update
+
+    def train(self) -> dict:
+        cfg = self.config
+        if self._update is None:
+            self._build_update()
+        eps = self._epsilon()
+        rollout_params = {k: np.asarray(v) for k, v in self.params.items()}
+        outs = ray_tpu.get([
+            w.sample.remote(rollout_params, cfg.sequences_per_rollout, eps)
+            for w in self.workers])
+        returns = []
+        for out in outs:
+            self.buffer.add_sequences(out["sequences"])
+            returns += out["episode_returns"]
+            self.total_steps += out["steps"]
+        losses = []
+        if self.buffer.size >= cfg.train_batch_size:
+            for _ in range(cfg.num_sgd_iter):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                self.params, self._opt_state, loss = self._update(
+                    self.params, self.target_params, self._opt_state,
+                    batch)
+                losses.append(float(loss))
+        self.iteration += 1
+        if self.iteration % cfg.target_network_update_freq == 0:
+            self.target_params = {k: np.asarray(v).copy()
+                                  for k, v in self.params.items()}
+        return {"training_iteration": self.iteration,
+                "episode_reward_mean":
+                    float(np.mean(returns)) if returns else float("nan"),
+                "num_env_steps_sampled": self.total_steps,
+                "loss": float(np.mean(losses)) if losses else None}

@@ -1,0 +1,31 @@
+"""Small shared helpers for RLlib algorithm modules.
+
+Parity: reference rllib/utils/ (tree utilities over nested param
+structures — the reference uses torch/tf nest; here plain
+dict/list-of-ndarray trees shared by every JAX algorithm driver)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def tree_copy(t):
+    """Deep copy of a nested dict/list/tuple tree of arrays (device
+    arrays become fresh host ndarrays)."""
+    if isinstance(t, dict):
+        return {k: tree_copy(v) for k, v in t.items()}
+    if isinstance(t, (list, tuple)):
+        out = [tree_copy(v) for v in t]
+        return type(t)(out) if isinstance(t, tuple) else out
+    return np.array(t).copy()
+
+
+def tree_numpy(t):
+    """Nested tree with every leaf viewed as a host ndarray (no copy
+    when already numpy) — the form CPU rollout workers consume."""
+    if isinstance(t, dict):
+        return {k: tree_numpy(v) for k, v in t.items()}
+    if isinstance(t, (list, tuple)):
+        out = [tree_numpy(v) for v in t]
+        return type(t)(out) if isinstance(t, tuple) else out
+    return np.asarray(t)

@@ -1,0 +1,142 @@
+"""Round-4 RLlib families: Rainbow, R2D2, MADDPG, AlphaZero, SlateQ.
+
+Parity model: reference rllib/algorithms/<algo>/tests/test_<algo>.py —
+each family gets a mechanics unit test plus a learning smoke showing
+the policy beats its naive baseline on the family's testbed."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    AlphaZeroConfig,
+    CoopNav,
+    MADDPGConfig,
+    R2D2Config,
+    RainbowConfig,
+    SlateDocEnv,
+    SlateQConfig,
+    TicTacToe,
+)
+
+
+# ---- mechanics -----------------------------------------------------------
+
+
+def test_tictactoe_rules():
+    b = TicTacToe.initial()
+    assert TicTacToe.outcome(b) is None
+    # X plays 0,1,2 across the top; O responds 3,4 — X wins.
+    for a in [0, 3, 1, 4, 2]:
+        assert TicTacToe.outcome(b) is None
+        b = TicTacToe.play(b, a)
+    # The winner just moved, so the player now to move has lost.
+    assert TicTacToe.outcome(b) == -1.0
+    # Draw line: fill without three-in-a-row.
+    b = TicTacToe.initial()
+    for a in [0, 4, 8, 1, 7, 6, 2, 5, 3]:
+        b = TicTacToe.play(b, a)
+    assert TicTacToe.outcome(b) == 0.0
+
+
+def test_slate_env_choice_model():
+    env = SlateDocEnv(0)
+    env.reset(seed=1)
+    slate = np.array([0, 1, 2])
+    probs = env.choice_probs(slate)
+    assert len(probs) == len(slate) + 1  # + no-click
+    assert abs(probs.sum() - 1.0) < 1e-6
+    obs, reward, done, info = env.step(slate)
+    assert obs.shape == (env.dim,)
+    assert reward >= 0.0 and not done
+
+
+def test_coopnav_shared_reward():
+    env = CoopNav()
+    obs = env.reset(seed=3)
+    assert len(obs) == 2 and obs[0].shape == (4,)
+    # Perfect actions (move straight at targets) beat frozen agents.
+    def run(policy):
+        env.reset(seed=3)
+        total = 0.0
+        done = False
+        while not done:
+            acts = policy(env)
+            _, r, done, _ = env.step(acts)
+            total += r
+        return total
+
+    frozen = run(lambda e: [0.0, 0.0])
+    greedy = run(lambda e: list(np.clip(
+        10 * (e.targets - e.pos), -1, 1)))
+    assert greedy > frozen
+
+
+def test_r2d2_sequence_replay_roundtrip():
+    from ray_tpu.rllib import SequenceReplay
+
+    buf = SequenceReplay(capacity=8, seq_len=5, obs_size=3, hidden=7)
+    seqs = [{"obs": np.full((5, 3), i, np.float32),
+             "next_obs": np.zeros((5, 3), np.float32),
+             "actions": np.zeros(5, np.int32),
+             "rewards": np.arange(5, dtype=np.float32),
+             "dones": np.zeros(5, np.float32),
+             "h0": np.full(7, i, np.float32)} for i in range(3)]
+    buf.add_sequences(seqs)
+    batch = buf.sample(4)
+    assert batch["obs"].shape == (4, 5, 3)
+    assert batch["h0"].shape == (4, 7)
+    # The stored initial hidden state matches its sequence.
+    for row in range(4):
+        assert batch["h0"][row][0] == batch["obs"][row][0][0]
+
+
+# ---- learning smokes -----------------------------------------------------
+
+
+def test_rainbow_learns_cartpole(ray_start_regular):
+    algo = RainbowConfig().environment("CartPole-v1") \
+        .rollouts(num_rollout_workers=2) \
+        .training(num_sgd_iter=8, rollout_fragment_length=200).build()
+    rewards = [algo.train()["episode_reward_mean"] for _ in range(7)]
+    assert np.nanmean(rewards[-2:]) > 35, rewards
+
+
+def test_r2d2_learns_cartpole(ray_start_regular):
+    algo = R2D2Config().rollouts(num_rollout_workers=2).training(
+        num_sgd_iter=16, sequences_per_rollout=10,
+        epsilon_decay_iters=10).build()
+    rewards = [algo.train()["episode_reward_mean"] for _ in range(40)]
+    early = np.nanmean(rewards[:5])
+    late = np.nanmean(rewards[-5:])
+    assert late > 30 and late > early, (early, late)
+
+
+def test_maddpg_learns_coopnav(ray_start_regular):
+    algo = MADDPGConfig().rollouts(num_rollout_workers=2).training(
+        num_sgd_iter=24, noise_decay_iters=12).build()
+    rewards = [algo.train()["episode_reward_mean"] for _ in range(32)]
+    late = np.nanmean(rewards[-5:])
+    # Random slates/velocities average ~-33 on CoopNav; centralized
+    # critics must beat that clearly.
+    assert late > -28, rewards[-8:]
+
+
+def test_alphazero_beats_random(ray_start_regular):
+    algo = AlphaZeroConfig().rollouts(num_rollout_workers=2).training(
+        games_per_iteration=8, num_simulations=32,
+        num_sgd_iter=24).build()
+    for _ in range(10):
+        algo.train()
+    score = algo.eval_vs_random(num_games=24, num_simulations=32)
+    # win=1 / draw=0.5 per game; an untrained net with search alone
+    # scores ~0.7 — self-play training must push clearly past it.
+    assert score >= 0.8, score
+
+
+def test_slateq_beats_random_slates(ray_start_regular):
+    algo = SlateQConfig().rollouts(num_rollout_workers=2).build()
+    rewards = [algo.train()["episode_reward_mean"] for _ in range(18)]
+    late = np.nanmean(rewards[-3:])
+    # Random slates average ~8.2 engagement per episode on this catalog.
+    assert late > 9.5, rewards[-6:]
