@@ -143,7 +143,7 @@ _PRIMITIVE_TYPES = frozenset(
 class _PendingTask:
     __slots__ = ("spec", "retries_left", "constructor_like", "futures",
                  "pushed_to", "nested_args", "seq", "return_hexes",
-                 "stream_q", "next_yield_index")
+                 "stream_q", "next_yield_index", "reconstructing")
 
     def __init__(self, spec: TaskSpec, retries_left: int,
                  nested_args: list | None = None):
@@ -164,6 +164,10 @@ class _PendingTask:
         # reference: generator task retries replay only unconsumed
         # returns, task_manager.cc HandleReportGeneratorItemReturns).
         self.next_yield_index = 0
+        # Lineage-reconstruction re-execution of a completed STREAMING
+        # task: yields only refresh their owned objects — nothing is
+        # delivered to a consumer (the original generator is long gone).
+        self.reconstructing = False
         # Refs serialized INSIDE value args (not top-level): list of
         # (oid_hex, owner_wire|None); refcounted like top-level args and
         # released at completion per the borrower protocol.
@@ -223,6 +227,12 @@ class CoreWorker:
         self.pending_tasks: dict[str, _PendingTask] = {}
         self.lineage: dict[str, TaskSpec] = {}
         self._lineage_bytes = 0
+        self._lineage_est: dict[str, int] = {}  # exact add, exact subtract
+        # Live owned objects per lineage task: the spec is only dropped
+        # when the LAST object created by that task is freed (a streamed
+        # generator's yields share one spec — freeing the first consumed
+        # yield must not strand the others without reconstruction).
+        self._lineage_live: dict[str, int] = {}
         self.actor_handles_state: dict[str, dict] = {}  # actor_id -> conn/seq/queue
         self._fn_cache: dict[str, object] = {}
         self._put_counter = itertools.count(1)
@@ -584,7 +594,7 @@ class CoreWorker:
         else:
             await self._write_to_store(oid, sobj)
             o.locations.add(self.node_id)
-        o.lineage_task = lineage_task
+        self._set_lineage_task(o, lineage_task)
         o.state = OBJ_READY
         if o.ready_event:
             o.ready_event.set()
@@ -951,9 +961,22 @@ class CoreWorker:
         logger.warning("reconstructing %s via task %s", oid_hex[:12], spec.name)
         o.state = OBJ_PENDING
         o.locations.clear()
-        pt = _PendingTask(spec, retries_left=1)
-        self.pending_tasks[spec.task_id] = pt
-        self._enqueue_task(pt)
+        if spec.task_id not in self.pending_tasks:
+            # In-flight guard: concurrent gets on two lost yields of the
+            # same generator must share ONE re-execution — a second
+            # submission would overwrite the pending entry and strand
+            # the first execution's remaining yields.
+            pt = _PendingTask(spec, retries_left=1)
+            if spec.num_returns == STREAMING_RETURNS:
+                # Re-run the GENERATOR: every live yield re-registers
+                # through the reconstructing path (no consumer
+                # delivery) — the lost yield refreshes along the way.
+                # Reference: generator lineage re-execution,
+                # task_manager.cc.
+                pt.stream_q = _queue.Queue()
+                pt.reconstructing = True
+            self.pending_tasks[spec.task_id] = pt
+            self._enqueue_task(pt)
         # Wait for re-execution.
         if o.ready_event is None:
             o.ready_event = asyncio.Event()
@@ -1349,9 +1372,16 @@ class CoreWorker:
         if o.locations:
             self._spawn(self.raylet.call("FreeObjects", {"object_ids": [oid_hex]}))
         if o.lineage_task:
-            spec = self.lineage.pop(o.lineage_task, None)
-            if spec is not None:
-                self._lineage_bytes -= len(str(spec.args))
+            live = self._lineage_live.get(o.lineage_task, 0) - 1
+            if live > 0:
+                self._lineage_live[o.lineage_task] = live
+            else:
+                self._lineage_live.pop(o.lineage_task, None)
+                if self.lineage.pop(o.lineage_task, None) is not None:
+                    # Subtract exactly what was added (the counter must
+                    # not drift, or the cap stops meaning anything).
+                    self._lineage_bytes -= self._lineage_est.pop(
+                        o.lineage_task, 0)
         # Refs embedded in this container's payload lose their hold.
         self._release_container(oid_hex)
 
@@ -1497,7 +1527,7 @@ class CoreWorker:
         pt.return_hexes = [oid.hex() for oid in returns]
         for oid_hex in pt.return_hexes:
             o = self.objects.setdefault(oid_hex, _OwnedObject())
-            o.lineage_task = spec.task_id
+            self._set_lineage_task(o, spec.task_id)
         self.pending_tasks[spec.task_id] = pt
         self._record_task_event(spec.task_id, spec.name, "PENDING")
         return pt, returns
@@ -1984,11 +2014,25 @@ class CoreWorker:
                 for i in range(pt.spec.num_returns)]
         return pt.return_hexes
 
+    def _fail_reconstruction(self, pt: _PendingTask, err_meta: bytes,
+                             err_data: bytes) -> None:
+        """A reconstructing re-execution failed: the queue has no
+        consumer, so the waiting get()s are unblocked by failing every
+        still-PENDING object of this lineage directly."""
+        for o in self.objects.values():
+            if o.lineage_task == pt.spec.task_id and o.state == OBJ_PENDING:
+                o.state = OBJ_FAILED
+                o.error = (err_meta, err_data)
+                if o.ready_event:
+                    o.ready_event.set()
+
     def _complete_task_error(self, pt: _PendingTask, err):
         self.pending_tasks.pop(pt.spec.task_id, None)
         self._abandoned_streams.discard(pt.spec.task_id)
         self._record_task_event(pt.spec.task_id, pt.spec.name, "FAILED")
-        if pt.stream_q is not None:
+        if pt.reconstructing:
+            self._fail_reconstruction(pt, err.meta, err.to_bytes())
+        elif pt.stream_q is not None:
             pt.stream_q.put(("error", err.meta, err.to_bytes()))
         else:
             for oid_hex in self._return_hexes(pt):
@@ -2029,7 +2073,10 @@ class CoreWorker:
         if resp.get("status") == "error":
             self._record_task_event(spec.task_id, spec.name, "FAILED")
             err_meta, err_data = resp["error"]
-            if pt.stream_q is not None:
+            if pt.reconstructing:
+                self._fail_reconstruction(pt, bytes(err_meta),
+                                          bytes(err_data))
+            elif pt.stream_q is not None:
                 # Items already yielded stay valid (they were produced);
                 # the generator raises at the failure point.
                 pt.stream_q.put(("error", bytes(err_meta),
@@ -2046,23 +2093,23 @@ class CoreWorker:
             # Keep lineage for reconstruction (bounded). Size estimate is
             # structural, not str(args) — str() of wire args costs more
             # than the rest of completion at trivial-task rates.
-            # Streaming tasks record NO lineage: re-running a generator
-            # could not re-deliver yields through the consumed generator,
-            # so lost streamed objects raise ObjectLostError instead of
-            # reconstructing (documented streaming limitation).
-            if pt.stream_q is None and \
+            # Streaming tasks keep lineage too (r4): a yield object lost
+            # AFTER completion reconstructs by re-running the generator
+            # in reconstructing mode (yields re-register, no delivery).
+            if spec.task_id not in self.lineage and \
                     self._lineage_bytes < self.config.max_lineage_bytes:
                 self.lineage[spec.task_id] = spec
                 est = 64
                 for a in spec.args:
                     est += len(a[2]) + 16 if a[0] == "v" else 80
                 self._lineage_bytes += est
+                self._lineage_est[spec.task_id] = est
             for i, result in enumerate(resp["results"]):
                 oid_hex = hexes[i] if i < len(hexes) else \
                     ObjectID.for_task_return(
                         TaskID.from_hex(spec.task_id), i + 1).hex()
                 self._register_return(spec.task_id, oid_hex, result)
-            if pt.stream_q is not None:
+            if pt.stream_q is not None and not pt.reconstructing:
                 pt.stream_q.put(("end",))
         # Borrower handoff BEFORE releasing our own holds: args the worker
         # still references are registered with their owners first, on the
@@ -2078,12 +2125,31 @@ class CoreWorker:
         else:
             self._release_submitted_refs(pt)
 
+    def _set_lineage_task(self, o, task_id_hex: "str | None") -> None:
+        """Assign an owned object's creating task, keeping the per-task
+        live-object count exact (spec retention is per TASK; see
+        _free_object)."""
+        old = o.lineage_task
+        if old == task_id_hex:
+            return
+        if old:
+            live = self._lineage_live.get(old, 0) - 1
+            if live > 0:
+                self._lineage_live[old] = live
+            else:
+                self._lineage_live.pop(old, None)
+        if task_id_hex:
+            self._lineage_live[task_id_hex] = \
+                self._lineage_live.get(task_id_hex, 0) + 1
+        o.lineage_task = task_id_hex
+
     def _register_return(self, task_id_hex: str, oid_hex: str, result,
                          lineage: bool = True):
         """Record one arrived return/yield entry as an owned READY
         object (shared by TaskDone results and TaskYield streams —
-        streamed yields pass lineage=False: generators do not
-        reconstruct)."""
+        streamed yields carry lineage too: a lost yield reconstructs by
+        re-running the generator, which replays every yield through the
+        reconstructing path)."""
         o = self.objects.setdefault(oid_hex, _OwnedObject())
         if result[0] == "v":
             o.inline = (bytes(result[1]), bytes(result[2]))
@@ -2092,7 +2158,7 @@ class CoreWorker:
             o.locations.add(result[1])
             o.size = result[2]
         o.state = OBJ_READY
-        o.lineage_task = task_id_hex if lineage else None
+        self._set_lineage_task(o, task_id_hex if lineage else None)
         # Refs embedded in the returned payload: the executing worker
         # pre-registered us with their owners; hold them for as long as
         # this return object lives.
@@ -2112,6 +2178,17 @@ class CoreWorker:
         index = payload["index"]
         oid_hex = ObjectID.for_task_return(
             TaskID.from_hex(pt.spec.task_id), index + 1).hex()
+        if pt.reconstructing:
+            # Lineage re-execution of a completed generator: a replayed
+            # yield refreshes its owned object ONLY if someone still
+            # holds a ref (the entry exists) — resurrecting a freed
+            # yield would leak an unowned store copy and re-pin the
+            # lineage spec. Unclaimed replayed copies on the executing
+            # node are unreferenced and fall to LRU eviction.
+            if oid_hex in self.objects:
+                self._register_return(pt.spec.task_id, oid_hex,
+                                      payload["result"])
+            return
         # Fast-forward: a retried generator replays from index 0; items
         # below next_yield_index were already delivered (the re-computed
         # value re-registers, refreshing any lost copy, but no duplicate
@@ -2123,8 +2200,7 @@ class CoreWorker:
         # No ref added here: the ObjectRef the generator constructs on
         # iteration registers the local ref (owned objects are not
         # collected before any ref transition occurs).
-        self._register_return(pt.spec.task_id, oid_hex, payload["result"],
-                              lineage=False)
+        self._register_return(pt.spec.task_id, oid_hex, payload["result"])
         if replay:
             return
         if payload["task_id"] in self._abandoned_streams:

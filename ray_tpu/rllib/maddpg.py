@@ -73,7 +73,9 @@ class CoopNav:
         self.t += 1
         reward = -float(np.abs(self.pos - self.targets).sum())
         done = self.t >= self.horizon
-        return self._obs(), reward, done, {}
+        # Episodes end ONLY by time limit — flag it so off-policy
+        # targets bootstrap through the cut (env.py convention).
+        return self._obs(), reward, done, {"truncated": done}
 
 
 def init_maddpg_params(n_agents: int, obs_size: int, act_size: int,
@@ -127,13 +129,15 @@ class MADDPGRolloutWorker:
                 a = np.clip(a + noise * self.rng.standard_normal(a.shape),
                             -1, 1)
                 acts.append(a.astype(np.float32))
-            next_obs, reward, done, _ = self.env.step(
+            next_obs, reward, done, info = self.env.step(
                 [float(a[0]) for a in acts])
             buf["obs"].append(np.stack(self.obs))
             buf["actions"].append(np.stack(acts))
             buf["rewards"].append(reward)
             buf["next_obs"].append(np.stack(next_obs))
-            buf["dones"].append(float(done))
+            # Time-limit cuts bootstrap through (env.py convention).
+            buf["dones"].append(float(bool(done)
+                                and not info.get("truncated", False)))
             self.ep_ret += reward
             if done:
                 episode_returns.append(self.ep_ret)
