@@ -23,6 +23,11 @@ __all__ = [
     "state_specs_from_rules",
 ]
 
+# TorchTrainer / AccelerateTrainer / HF callbacks import torch lazily —
+# reach them via their submodules (ray_tpu.train.torch,
+# ray_tpu.train.accelerate, ray_tpu.train.huggingface) so `import
+# ray_tpu.train` stays torch-free for pure-JAX users.
+
 from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu('train')
 del _rlu

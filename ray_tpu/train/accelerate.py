@@ -1,0 +1,91 @@
+"""AccelerateTrainer: HF accelerate train loops on the worker gang.
+
+Parity: reference python/ray/train/huggingface/accelerate/
+accelerate_trainer.py — AccelerateTrainer IS a TorchTrainer whose
+backend additionally materializes the user's accelerate configuration
+on every worker before the loop runs: the torch process group comes up
+first (gloo rendezvous, torch.py), then the env contract `accelerate
+launch` would export is set (in-process `Accelerator()` reads
+ACCELERATE_* env vars, not config files — verified against accelerate
+1.14), and the user loop instantiates `accelerate.Accelerator()`
+unchanged. CPU/gloo here — the accelerator path in this framework is
+JAX/TPU (JaxTrainer); this exists for HF-ecosystem user code, the same
+role the reference's CPU/DeepSpeed-less path plays.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ray_tpu.train import session
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.torch import TorchTrainer
+from ray_tpu.train.trainer import Result
+
+
+def _run_with_accelerate_env(user_loop: Callable, config: dict):
+    """Runs inside each train worker AFTER the torch process group is
+    up: export the env contract `accelerate launch` provides (restored
+    afterwards — worker processes are reused across fits and a stale
+    ACCELERATE_* value would leak into the next job), then the user
+    loop."""
+    import os
+
+    rank = session.get_world_rank()
+    world = session.get_world_size()
+    acc_cfg = config.pop("_accelerate_config", None) or {}
+    env = {
+        # PartialState reads these when deciding it is distributed;
+        # MASTER_ADDR/PORT are already set by the torch backend's
+        # rendezvous when world > 1. Set unconditionally: reused
+        # workers must not keep a previous gang's values.
+        "RANK": str(rank),
+        "WORLD_SIZE": str(world),
+        "LOCAL_RANK": str(session.get_context().get_local_rank()),
+        "ACCELERATE_USE_CPU": "true",
+    }
+    for k, v in acc_cfg.items():
+        # `accelerate launch` exports each config entry as
+        # ACCELERATE_<KEY>; pass pre-namespaced keys through verbatim.
+        name = k if k.startswith("ACCELERATE_") else \
+            "ACCELERATE_" + k.upper()
+        env[name] = str(v).lower() if isinstance(v, bool) else str(v)
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        user_loop(config)
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
+class AccelerateTrainer(TorchTrainer):
+    """Parity: ray.train.huggingface.AccelerateTrainer — same
+    fit()/Result surface; `accelerate_config` (a dict of accelerate
+    settings, e.g. {"mixed_precision": "bf16",
+    "gradient_accumulation_steps": 4}, or None for defaults) reaches
+    every worker as the ACCELERATE_* env vars `accelerate launch` would
+    set. The user loop builds `Accelerator()` and uses
+    prepare()/backward()/gather() unchanged."""
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, accelerate_config: dict | None = None,
+                 train_loop_config: dict | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None):
+        cfg = dict(train_loop_config or {})
+        if accelerate_config is not None:
+            cfg["_accelerate_config"] = dict(accelerate_config)
+
+        def wrapped(config, _loop=train_loop_per_worker):
+            _run_with_accelerate_env(_loop, config)
+
+        super().__init__(wrapped, train_loop_config=cfg,
+                         scaling_config=scaling_config,
+                         run_config=run_config)
+
+
+__all__ = ["AccelerateTrainer", "Result"]

@@ -124,6 +124,48 @@ def test_torch_trainer_ddp(ray_start_regular):
     assert "loss" in result.metrics
 
 
+def test_accelerate_trainer(ray_start_regular):
+    """AccelerateTrainer parity (reference: train/huggingface/accelerate
+    AccelerateTrainer): the user loop builds accelerate.Accelerator()
+    over the gang's pre-initialized gloo group; prepare()/backward()/
+    gather() work, and DDP-averaged params end identical across ranks."""
+    from ray_tpu.train.accelerate import AccelerateTrainer
+
+    def loop(config):
+        import torch
+        from accelerate import Accelerator
+        from ray_tpu import train as rt
+
+        accelerator = Accelerator(cpu=True)
+        assert accelerator.num_processes == 2
+        # The accelerate_config dict must actually reach Accelerator()
+        # (exported as the ACCELERATE_* env contract).
+        assert accelerator.gradient_accumulation_steps == 2
+        torch.manual_seed(rt.session.get_world_rank())
+        model = torch.nn.Linear(4, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        model, opt = accelerator.prepare(model, opt)
+        x = torch.ones(8, 4) * (rt.session.get_world_rank() + 1)
+        y = torch.zeros(8, 1)
+        for _ in range(3):
+            opt.zero_grad()
+            loss = ((model(x) - y) ** 2).mean()
+            accelerator.backward(loss)
+            opt.step()
+        flat = torch.cat([p.detach().flatten()
+                          for p in model.parameters()])
+        gathered = accelerator.gather(flat.unsqueeze(0))
+        same = bool(torch.allclose(gathered[0], gathered[1]))
+        rt.report({"loss": float(loss.item()), "params_synced": same,
+                   "world": accelerator.num_processes})
+
+    result = AccelerateTrainer(
+        loop, accelerate_config={"gradient_accumulation_steps": 2},
+        scaling_config=ScalingConfig(num_workers=2)).fit()
+    assert result.metrics["params_synced"] is True
+    assert result.metrics["world"] == 2
+
+
 def test_elastic_restart_restores_checkpoint(ray_start_regular, tmp_path):
     """A worker crash mid-fit retries the whole gang; the retry resumes
     from the last reported checkpoint via session.get_checkpoint()
