@@ -366,6 +366,7 @@ class Raylet:
             "Drain": self.handle_drain,
             "GetState": self.handle_get_state,
             "NodeStacks": self.handle_node_stacks,
+            "NodeDebugTasks": self.handle_node_debug_tasks,
             "NodeProfile": self.handle_node_profile,
             "ListLogs": self.handle_list_logs,
             "TailLog": self.handle_tail_log,
@@ -973,6 +974,27 @@ class Raylet:
     # blocked in ray.get and frees their resources so nested tasks can
     # run — the fix for fan-out/nested-get worker starvation) ----
 
+    async def handle_node_debug_tasks(self, conn, payload):
+        """Per-worker submission-state dump (owned pending tasks + lease
+        slots) plus the raylet's lease table — the debug_state.txt
+        analog (reference: node_manager.cc DumpDebugState); the tool
+        that diagnosed the nested-fanout wedge (PARITY Known gaps)."""
+        live = [w for w in self.workers.values()
+                if not w.dead and w.conn is not None and not w.conn.closed]
+
+        async def dump_one(w):
+            # Concurrent: N wedged workers must cost ~one timeout, not N.
+            try:
+                return await w.conn.call("DebugTasks", {}, timeout=10)
+            except Exception as e:
+                return {"worker_id": w.worker_id, "error": str(e)}
+
+        outs = list(await asyncio.gather(*(dump_one(w) for w in live)))
+        leases = [{"worker": w.worker_id[:8], "leased": w.leased,
+                   "reserved": w.reserved, "actor": bool(w.actor_id)}
+                  for w in self.workers.values()]
+        return {"node_id": self.node_id, "workers": outs, "leases": leases}
+
     async def handle_node_stacks(self, conn, payload):
         """Stack dumps from every live worker on this node (reference:
         `ray stack` — scripts.py:2453 py-spies all workers)."""
@@ -1232,10 +1254,18 @@ class Raylet:
         is_spread = bool(strategy and strategy[0] == "spread") and hops == 0
         locally_feasible = pg_id or resources_fit(self.total_resources, resources)
         if not allow_spill or not is_spread:
-            lease_id = self._acquire(resources, pg_id, bundle_index)
-            if lease_id:
-                return await self._grant_lease(lease_id, resources, pg_id,
-                                               bundle_index)
+            # FIFO fairness: a fresh request must not acquire ahead of
+            # already-queued leases — a returner's immediate re-request
+            # would otherwise grab its own freed credit every cycle and
+            # starve the queue forever (observed as a grant/return
+            # carousel wedging nested fan-outs). PG bundle leases are
+            # exempt: they draw from their own reserved pool, which no
+            # queued non-PG lease can consume.
+            if pg_id or not self.pending_leases:
+                lease_id = self._acquire(resources, pg_id, bundle_index)
+                if lease_id:
+                    return await self._grant_lease(lease_id, resources,
+                                                   pg_id, bundle_index)
         if allow_spill:
             # Prefer a peer with capacity available right now; for SPREAD,
             # prefer spilling even when we could run locally (one hop max,
@@ -1245,11 +1275,14 @@ class Raylet:
                     is_spread or not resources_fit(self.available, resources)):
                 return {"spillback": self._debit_spill(spill, resources)}
             if is_spread:
-                # No better peer: run locally if possible.
-                lease_id = self._acquire(resources, pg_id, bundle_index)
-                if lease_id:
-                    return await self._grant_lease(lease_id, resources, pg_id,
-                                                   bundle_index)
+                # No better peer: run locally if possible (same FIFO
+                # fairness gate as the non-spread path — a spread
+                # returner must not lap the queue either).
+                if pg_id or not self.pending_leases:
+                    lease_id = self._acquire(resources, pg_id, bundle_index)
+                    if lease_id:
+                        return await self._grant_lease(
+                            lease_id, resources, pg_id, bundle_index)
             if not locally_feasible:
                 # This node can never run it; hand off to any peer whose
                 # TOTAL capacity fits (it will queue there), else error.

@@ -39,6 +39,7 @@ job-lifetime pin left is for refs pickled outside any runtime context.
 from __future__ import annotations
 
 import asyncio
+import collections as _collections
 import functools
 import hashlib
 import itertools
@@ -374,6 +375,7 @@ class CoreWorker:
             "Exit": self._handle_exit,
             "Ping": lambda conn, p: {"ok": True},
             "DumpStack": self._handle_dump_stack,
+            "DebugTasks": self._handle_debug_tasks,
             "Profile": self._handle_profile,
         }, name=f"worker-{self.worker_id[:8]}")
         host, port = await self.server.start("127.0.0.1", 0)
@@ -650,29 +652,23 @@ class CoreWorker:
         fp_flush = getattr(self._exec_tls, "fp_flush", None)
         if fp_flush is not None:
             fp_flush()
+        # About to (possibly) block on the exec thread: hand the
+        # unstarted rest of the current push batch back to its owner —
+        # a blocked task must not starve batch-mates (their subtrees
+        # may be exactly what this get() waits on; nested fan-outs
+        # deadlock otherwise). Cheap local-readiness probe avoids the
+        # return when this get() resolves immediately.
+        batch_return = getattr(self._exec_tls, "batch_return", None)
+        if batch_return is not None and not self._refs_ready_local(refs):
+            batch_return()
         async def fetch_all():
             # A worker blocked here still holds its lease's CPU — release
             # it for the duration so nested/fan-out tasks can run on this
             # node (reference: raylet blocked-worker accounting; without
             # this, width > num_cpus nested gets deadlock the pool).
-            def all_ready_here():
-                for oid, _owner in refs:
-                    o = self.objects.get(oid.hex())
-                    if o is not None and o.state == OBJ_READY:
-                        continue
-                    try:
-                        # Borrowed refs whose data is already sealed in the
-                        # local shm store also resolve without blocking.
-                        if self.store.contains(oid):
-                            continue
-                    except Exception:
-                        pass
-                    return False
-                return True
-
             notify_blocked = (not self.is_driver and self.raylet is not None
                               and self._current_task_id is not None
-                              and not all_ready_here())
+                              and not self._refs_ready_local(refs))
             if notify_blocked:
                 try:
                     await self.raylet.notify("WorkerBlocked",
@@ -1702,6 +1698,8 @@ class CoreWorker:
                                      resp["worker_id"], resp["node_id"]])
                     conn.handlers["TaskDone"] = functools.partial(
                         self._handle_task_done, slot, shape)
+                    conn.handlers["TasksReturned"] = functools.partial(
+                        self._handle_tasks_returned, slot, shape)
                     conn.handlers["TaskYield"] = self._handle_task_yield
                     conn.on_close(functools.partial(
                         self._on_slot_conn_closed, slot, shape))
@@ -1817,6 +1815,11 @@ class CoreWorker:
                             entry = self._fp_slots.get(cid)
                             if entry is not None:
                                 await self._handle_task_done(
+                                    entry[0], entry[1], None, pl)
+                        elif method == "TasksReturned":
+                            entry = self._fp_slots.get(cid)
+                            if entry is not None:
+                                await self._handle_tasks_returned(
                                     entry[0], entry[1], None, pl)
                         elif method == "TaskYield":
                             await self._handle_task_yield(None, pl)
@@ -1965,6 +1968,18 @@ class CoreWorker:
                 self._leases[shape].remove(slot)
             for pt in pts:
                 await self._handle_worker_failure(pt, shape, str(e))
+
+    async def _handle_tasks_returned(self, slot: _LeaseSlot, shape: str,
+                                     conn, payload):
+        """The worker's running task blocked and handed back the
+        UNSTARTED rest of its batch: re-enqueue them for fresh placement
+        (no retry consumed — they never ran). The blocked task stays
+        outstanding on the slot."""
+        for task_id in payload["task_ids"]:
+            pt = slot.outstanding.pop(task_id, None)
+            if pt is not None:
+                pt.pushed_to = None
+                self._enqueue_task(pt)
 
     async def _handle_task_done(self, slot: _LeaseSlot, shape: str,
                                 conn, payload):
@@ -2124,6 +2139,24 @@ class CoreWorker:
                 pt, borrows, borrower_id, borrower_addr))
         else:
             self._release_submitted_refs(pt)
+
+    def _refs_ready_local(self, refs) -> bool:
+        """Every ref resolvable without blocking — owned READY entries,
+        or borrowed refs whose data is already sealed in the local shm
+        store. Drives both the blocked-credit notification and the
+        batch-return decision (thread-safe enough from the exec thread:
+        plain dict/store reads under the GIL, best-effort by design)."""
+        for oid, _owner in refs:
+            o = self.objects.get(oid.hex())
+            if o is not None and o.state == OBJ_READY:
+                continue
+            try:
+                if self.store.contains(oid):
+                    continue
+            except Exception:
+                pass
+            return False
+        return True
 
     def _set_lineage_task(self, o, task_id_hex: "str | None") -> None:
         """Assign an owned object's creating task, keeping the per-task
@@ -2407,6 +2440,29 @@ class CoreWorker:
                 "samples": total,
                 "hot": [{"stack": k, "count": v} for k, v in top]}
 
+    async def _handle_debug_tasks(self, conn, payload):
+        """Submission-side state dump: this worker's owned pending tasks
+        and lease slots (reference: the debug_state.txt task/lease
+        sections node_manager.cc dumps). Served per-node via the
+        raylet's NodeDebugTasks — the tool that found the nested-fanout
+        wedge (see PARITY Known gaps)."""
+        out = {"worker_id": self.worker_id, "pid": os.getpid(),
+               "pending": [], "slots": []}
+        for tid, pt in self.pending_tasks.items():
+            out["pending"].append({
+                "task": pt.spec.name, "task_id": tid[:12],
+                "pushed_to": pt.pushed_to and pt.pushed_to[:8],
+                "retries_left": pt.retries_left})
+        for shape, slots in self._leases.items():
+            for s in slots:
+                out["slots"].append({
+                    "worker": s.worker_id[:8], "busy": s.busy,
+                    "outstanding": [p.spec.name for p in
+                                    s.outstanding.values()],
+                    "fp": s.fp_id is not None,
+                    "conn_closed": s.conn.closed})
+        return out
+
     async def _handle_dump_stack(self, conn, payload):
         """All-thread stack dump (reference: `ray stack` py-spies every
         worker, scripts.py:2453 — here the worker reports its own frames,
@@ -2440,9 +2496,26 @@ class CoreWorker:
                         {"task_id": task_id, "index": index,
                          "result": entry})))
 
-            for s in spec:
-                self._queue_task_done(sink, s.task_id,
-                                      self._execute_task(s, emit))
+            remaining = _collections.deque(spec)
+
+            def return_unstarted(conn=sink, remaining=remaining):
+                # See the fastpath twin in _fp_exec_frame: a blocking
+                # task hands its unstarted batch-mates back.
+                ids = [s.task_id for s in remaining]
+                remaining.clear()
+                if ids:
+                    self.loop.call_soon_threadsafe(
+                        lambda: asyncio.ensure_future(conn.notify(
+                            "TasksReturned", {"task_ids": ids})))
+
+            self._exec_tls.batch_return = return_unstarted
+            try:
+                while remaining:
+                    s = remaining.popleft()
+                    self._queue_task_done(sink, s.task_id,
+                                          self._execute_task(s, emit))
+            finally:
+                self._exec_tls.batch_return = None
         else:  # single item: sink is a future; item[2] (if present) is
             # the caller conn for streaming actor-method yields
             emit = None
@@ -2546,14 +2619,38 @@ class CoreWorker:
                   "result": entry}]))
 
         self._exec_tls.fp_flush = flush
+        # Remaining-specs deque: if the RUNNING task blocks in get(),
+        # the unstarted rest of this batch is handed BACK to the owner
+        # (get() calls batch_return) — a blocked task must not serialize
+        # its batch-mates behind it (nested fan-outs deadlock otherwise:
+        # the mate's subtree is what the blocked task waits for, at
+        # sufficient depth).
+        remaining = _collections.deque(pl["specs"])
+
+        def return_unstarted(pump=pump, cid=cid, remaining=remaining,
+                             flush=flush):
+            ids = []
+            while remaining:
+                # task_id is wire element 0 (TaskSpec.to_wire) — no need
+                # to materialize the full spec on this latency-critical
+                # about-to-block path.
+                ids.append(remaining.popleft()[0])
+            if ids:
+                flush()  # completions of earlier batch-mates go first
+                pump.send(cid, rpc.pack(
+                    [rpc.MSG_NOTIFY, 0, "TasksReturned",
+                     {"task_ids": ids}]))
+
+        self._exec_tls.batch_return = return_unstarted
         try:
-            for w in pl["specs"]:
-                s = TaskSpec.from_wire(w)
+            while remaining:
+                s = TaskSpec.from_wire(remaining.popleft())
                 buffered.append(
                     [s.task_id, self._execute_task(s, emit)])
                 if len(buffered) >= 64:
                     flush()
         finally:
+            self._exec_tls.batch_return = None
             self._exec_tls.fp_flush = None
             flush()
 
