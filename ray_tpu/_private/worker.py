@@ -1521,9 +1521,17 @@ class CoreWorker:
             pt.stream_q = _queue.Queue()
             self._stream_queues[spec.task_id] = pt.stream_q
         pt.return_hexes = [oid.hex() for oid in returns]
+        if n_returns:
+            # One live-count store per TASK (submission hot path), not a
+            # read-modify-write per return object. Safe to bypass
+            # _set_lineage_task here: a return ObjectID embeds THIS
+            # task's id, so a pre-existing entry (early borrow, retry)
+            # can only carry this same task or None — never a different
+            # task whose count would need decrementing.
+            self._lineage_live[spec.task_id] = n_returns
         for oid_hex in pt.return_hexes:
             o = self.objects.setdefault(oid_hex, _OwnedObject())
-            self._set_lineage_task(o, spec.task_id)
+            o.lineage_task = spec.task_id
         self.pending_tasks[spec.task_id] = pt
         self._record_task_event(spec.task_id, spec.name, "PENDING")
         return pt, returns
