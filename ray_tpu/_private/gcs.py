@@ -93,7 +93,13 @@ class GcsServer:
         self.task_events: deque = deque(maxlen=self.config.task_events_max_buffer)
         self.pending_demand: dict[str, list] = {}
         self.subscribers: dict[str, set[rpc.Connection]] = defaultdict(set)
-        self._server = rpc.RpcServer(self._handlers(), name="gcs")
+        # Native-pump server when available (src/fastpath.cc): accept,
+        # framing, and sends ride the C++ epoll thread; table mutations
+        # stay Python above the loop (reference: gcs_server.h:79 runs on
+        # a C++ asio loop end-to-end).
+        from ray_tpu._private.fast_rpc import make_server
+
+        self._server = make_server(self._handlers(), name="gcs")
         self._health_task: asyncio.Task | None = None
         self._actor_seq = 0
         self.start_time = time.time()

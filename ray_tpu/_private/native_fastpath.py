@@ -44,7 +44,8 @@ def _load():
         lib = ctypes.CDLL(path)
         lib.fpump_create.restype = ctypes.c_void_p
         lib.fpump_destroy.argtypes = [ctypes.c_void_p]
-        lib.fpump_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.fpump_listen.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
         lib.fpump_listen.restype = ctypes.c_int
         lib.fpump_connect.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_int]
@@ -97,11 +98,11 @@ class FastPump:
 
     # ---- endpoints ----
 
-    def listen(self, host: str = "127.0.0.1") -> int:
-        port = self._lib.fpump_listen(self._h, host.encode())
-        if port < 0:
-            raise OSError("fpump_listen failed")
-        return port
+    def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        got = self._lib.fpump_listen(self._h, host.encode(), port)
+        if got < 0:
+            raise OSError(f"fpump_listen failed (host={host} port={port})")
+        return got
 
     def connect(self, host: str, port: int) -> int:
         cid = self._lib.fpump_connect(self._h, host.encode(), port)

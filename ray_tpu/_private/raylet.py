@@ -310,7 +310,14 @@ class Raylet:
         self.pending_leases: deque = deque()
         self.cluster_view: dict = {}
         self.gcs_conn: rpc.Connection | None = None
-        self.server = rpc.RpcServer(self._handlers(), name=f"raylet-{self.node_id[:8]}")
+        # Native-pump server when available (src/fastpath.cc): the
+        # lease/return/pin cycle's accept/framing/writev all ride the C++
+        # epoll thread (reference: node_manager.cc:1778 handles leases on
+        # a C++ asio loop); Python keeps only the protocol logic.
+        from ray_tpu._private.fast_rpc import make_server
+
+        self.server = make_server(self._handlers(),
+                                  name=f"raylet-{self.node_id[:8]}")
         self.host = "127.0.0.1"
         self.port: int | None = None
         self.draining = False
@@ -319,6 +326,7 @@ class Raylet:
         self._tasks: list[asyncio.Task] = []
         self._lease_seq = 0
         self._num_leases_granted = 0
+        self._last_spawn_failure = "worker startup failed"
         # Recently-rejected infeasible demands, kept ~10s for the autoscaler.
         self._infeasible_demand: list[tuple[float, dict]] = []
         # Actor deaths observed while the GCS was unreachable; replayed
@@ -927,11 +935,27 @@ class Raylet:
         # a concurrent grant pops it — handing one process to two grants.
         w.reserved = True
         try:
-            await asyncio.wait_for(w.registered.wait(),
-                                   self.config.worker_startup_timeout_s)
-        except asyncio.TimeoutError:
-            self._kill_worker(w)
-            return None
+            deadline = time.monotonic() + self.config.worker_startup_timeout_s
+            while not w.registered.is_set():
+                try:
+                    await asyncio.wait_for(w.registered.wait(), 0.5)
+                except asyncio.TimeoutError:
+                    # A process that DIED before registering is a broken
+                    # worker environment, not load — fail in seconds with
+                    # a cause, instead of burning the full startup budget
+                    # (owners budget these retries; see _request_lease).
+                    if w.proc.poll() is not None:
+                        self._kill_worker(w)
+                        self._last_spawn_failure = (
+                            "worker process exited during startup "
+                            "(see worker logs)")
+                        return None
+                    if time.monotonic() > deadline:
+                        self._kill_worker(w)
+                        self._last_spawn_failure = (
+                            f"worker registration timed out after "
+                            f"{self.config.worker_startup_timeout_s:.0f}s")
+                        return None
         finally:
             w.reserved = False
         if w in self.idle_workers:
@@ -1319,9 +1343,16 @@ class Raylet:
         """Attach an already-acquired lease (see _acquire) to a worker."""
         w = await self._get_ready_worker()
         if w is None:
-            # Couldn't start a worker: give the acquisition back.
+            # Couldn't start a worker: give the acquisition back. Often
+            # load-dependent (spawn timeout under process pressure), so
+            # the owner retries — but it is marked spawn_failure so the
+            # owner can BUDGET those retries and surface a persistent
+            # cause (broken worker env) instead of hanging forever.
             self.rcore.release(lease_id)
-            return {"error": "worker startup failed"}
+            reason = getattr(self, "_last_spawn_failure",
+                             "worker startup failed")
+            return {"error": f"worker startup failed: {reason}",
+                    "retry": True, "spawn_failure": True}
         self._num_leases_granted += 1
         w.leased = True
         w.leased_at = time.monotonic()

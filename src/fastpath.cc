@@ -354,14 +354,15 @@ void fpump_destroy(FPump* p) {
 // Bind+listen; returns the bound port or -1.  Call once, before any
 // connects land (loop thread registration is done here, which is safe
 // because the listen fd is added via epoll_ctl from this thread).
-int fpump_listen(FPump* p, const char* host) {
+int fpump_listen(FPump* p, const char* host, int port) {
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (fd < 0) return -1;
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_port = 0;
+  addr.sin_port = htons((uint16_t)port);  // 0 = ephemeral; fixed for
+                                          // GCS restart-on-same-port
   inet_pton(AF_INET, host, &addr.sin_addr);
   if (bind(fd, (sockaddr*)&addr, sizeof(addr)) < 0 || listen(fd, 512) < 0) {
     close(fd);

@@ -15,7 +15,7 @@ extern "C" {
 struct FPump;
 FPump* fpump_create();
 void fpump_destroy(FPump*);
-int fpump_listen(FPump*, const char* host);
+int fpump_listen(FPump*, const char* host, int port);
 int64_t fpump_connect(FPump*, const char* host, int port);
 void fpump_close_conn(FPump*, int64_t);
 int fpump_send(FPump*, int64_t, const void*, uint32_t);
@@ -55,7 +55,7 @@ bool next_ev(FPump* p, Ev* ev, int timeout_ms = 2000) {
 void test_roundtrip() {
   FPump* a = fpump_create();
   FPump* b = fpump_create();
-  int port = fpump_listen(a, "127.0.0.1");
+  int port = fpump_listen(a, "127.0.0.1", 0);
   assert(port > 0);
   int64_t cb = fpump_connect(b, "127.0.0.1", port);
   assert(cb > 0);
@@ -84,7 +84,7 @@ void test_roundtrip() {
 void test_many_frames_and_drain() {
   FPump* a = fpump_create();
   FPump* b = fpump_create();
-  int port = fpump_listen(a, "127.0.0.1");
+  int port = fpump_listen(a, "127.0.0.1", 0);
   int64_t cb = fpump_connect(b, "127.0.0.1", port);
   const int N = 20000;
   std::thread sender([&] {
@@ -156,7 +156,7 @@ void test_destroy_wakes_blocked_consumer() {
 void test_send_to_dead_conn() {
   FPump* a = fpump_create();
   FPump* b = fpump_create();
-  int port = fpump_listen(a, "127.0.0.1");
+  int port = fpump_listen(a, "127.0.0.1", 0);
   int64_t cb = fpump_connect(b, "127.0.0.1", port);
   fpump_close_conn(b, cb);
   Ev ev;
