@@ -14,6 +14,34 @@ from typing import Any
 
 RESOURCE_EPS = 1e-9
 
+
+def _maybe_attach_daemon_profiler(name: str) -> None:
+    """Env-gated daemon CPU profiler: RAY_TPU_DAEMON_PROFILE=<dir> starts
+    cProfile at boot; SIGUSR2 dumps `<dir>/<name>-<pid>.pstats` (daemons
+    die by SIGKILL, so atexit can't be the dump trigger). Reference
+    analog: RAY_PROFILING + py-spy hooks in the dashboard reporter."""
+    import os
+
+    out_dir = os.environ.get("RAY_TPU_DAEMON_PROFILE")
+    if not out_dir:
+        return
+    import cProfile
+    import signal
+
+    prof = cProfile.Profile()
+    prof.enable()
+
+    def dump(signum, frame):
+        prof.disable()
+        path = os.path.join(out_dir, f"{name}-{os.getpid()}.pstats")
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            prof.dump_stats(path)
+        finally:
+            prof.enable()
+
+    signal.signal(signal.SIGUSR2, dump)
+
 # Well-known resource names. TPU is first-class: a node exposes `TPU` chips
 # and slice-topology labels so gang placement can target ICI-connected hosts
 # (reference only knows TPU via autodetect: python/ray/_private/accelerator.py:155).

@@ -24,6 +24,12 @@ class Config:
     # (reference: src/ray/common/ray_config_def.h object_store_memory).
     object_store_memory: int = 256 * 1024 * 1024
     object_store_table_capacity: int = 65536
+    # Same-host zero-copy arena reads between co-hosted nodes (one host =
+    # one shm domain). Disabling forces every cross-node fetch through
+    # the chunked transfer plane (src/transfer.cc) — how real cross-HOST
+    # traffic always moves; the object_broadcast_chunked release gate
+    # holds a floor on that path.
+    same_host_zero_copy: bool = True
     # Objects <= this many bytes are inlined in task replies instead of
     # going through shm (reference: ray_config_def.h
     # max_direct_call_object_size = 100KB).

@@ -30,7 +30,8 @@ import time
 from collections import defaultdict, deque
 
 from ray_tpu._private import rpc
-from ray_tpu._private.common import (
+from ray_tpu._private.common import (  # noqa: F401
+    _maybe_attach_daemon_profiler,
     NodeInfo,
     add_resources,
     normalize_resources,
@@ -1138,6 +1139,9 @@ class GcsServer:
                     pass
         pg["state"] = PG_REMOVED
         self._touch("placement_groups", payload["pg_id"])
+        # Waiters on ready() promises fail instead of hanging forever.
+        await self.publish("PG", {"pg_id": payload["pg_id"],
+                                  "state": PG_REMOVED})
         return {"ok": True}
 
     async def handle_get_pg(self, conn, payload):
@@ -1202,8 +1206,17 @@ def main():
 
     logging.basicConfig(level=logging.INFO,
                         format="[gcs] %(asctime)s %(levelname)s %(message)s")
+    import faulthandler
+
+    faulthandler.enable()  # segfault/abort tracebacks land in gcs.log
+    _maybe_attach_daemon_profiler("gcs")
 
     async def run():
+        # Eager tasks (3.12): an RPC dispatch that completes without
+        # blocking never round-trips through the scheduler — one fewer
+        # loop hop per table mutation on the daemon hot path.
+        asyncio.get_running_loop().set_task_factory(
+            asyncio.eager_task_factory)
         config = Config.from_json(args.config) if args.config else Config()
         server = GcsServer(config, persistence_path=args.persist or None)
         host, port = await server.start(args.host, args.port)

@@ -34,7 +34,8 @@ import time
 from collections import defaultdict, deque
 
 from ray_tpu._private import rpc
-from ray_tpu._private.common import normalize_resources, resources_fit
+from ray_tpu._private.common import (_maybe_attach_daemon_profiler,
+                                     normalize_resources, resources_fit)
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullError
@@ -169,8 +170,13 @@ class _PidProc:
 
 class _ZygoteClient:
     """Client side of the fork-server worker factory
-    (_private/worker_zygote.py). All methods are synchronous and bounded;
-    the raylet calls them via asyncio.to_thread under a lock."""
+    (_private/worker_zygote.py), pure asyncio and PIPELINED: spawn
+    requests go out immediately and the newline-framed replies resolve
+    FIFO futures from one reader task. The old sync client held a lock
+    across each ~10-25ms fork round-trip, serializing every worker
+    bring-up behind it (the r4 many_actors ceiling); responses are
+    strictly ordered on the one socket, so pipelining needs no request
+    ids."""
 
     def __init__(self, session_dir: str, node_id: str):
         self.sock_path = os.path.join(session_dir,
@@ -186,40 +192,66 @@ class _ZygoteClient:
                 [sys.executable, "-m", "ray_tpu._private.worker_zygote"],
                 env=env, stdout=log_file, stderr=subprocess.STDOUT,
                 start_new_session=True)
-        self._sock: socket.socket | None = None
-        self._file = None
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: deque[asyncio.Future] = deque()
+        self._reader_task: asyncio.Task | None = None
+        self._connect_lock = asyncio.Lock()
 
-    def connect(self, timeout: float = 0.2) -> bool:
-        """True once the zygote accepted our control connection."""
-        if self._sock is not None:
+    async def connect(self, timeout: float = 0.2) -> bool:
+        """True once the zygote accepted our control connection. Guarded:
+        concurrent callers after a dropped conn would otherwise open
+        parallel sockets and stack two read loops on one reader."""
+        if self._writer is not None:
             return True
         if self.proc.poll() is not None:
             return False
-        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        s.settimeout(timeout)
-        try:
-            s.connect(self.sock_path)
-        except OSError:
-            s.close()
-            return False
-        self._sock = s
-        self._file = s.makefile("rwb")
-        return True
+        async with self._connect_lock:
+            if self._writer is not None:
+                return True
+            try:
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_unix_connection(self.sock_path), timeout)
+            except (OSError, asyncio.TimeoutError):
+                return False
+            self._reader_task = asyncio.ensure_future(self._read_loop())
+            return True
 
-    def spawn(self, env: dict, log_path: str,
-              timeout: float = 10.0) -> int | None:
-        """Fork a worker; returns its pid, or None (caller cold-spawns)."""
+    async def _read_loop(self):
         try:
-            if not self.connect(min(timeout, 0.5)):
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                fut = self._pending.popleft() if self._pending else None
+                if fut is not None and not fut.done():
+                    fut.set_result(json.loads(line))
+        except (OSError, ValueError, asyncio.CancelledError):
+            pass
+        finally:
+            self._fail_pending()
+
+    def _fail_pending(self):
+        while self._pending:
+            fut = self._pending.popleft()
+            if not fut.done():
+                fut.set_result(None)
+
+    async def spawn(self, env: dict, log_path: str,
+                    timeout: float = 10.0) -> int | None:
+        """Fork a worker; returns its pid, or None (caller cold-spawns).
+        Concurrent callers pipeline on the socket instead of queueing."""
+        try:
+            if not await self.connect(min(timeout, 0.5)):
                 return None
-            self._sock.settimeout(timeout)
-            self._file.write((json.dumps(
+            fut = asyncio.get_running_loop().create_future()
+            self._pending.append(fut)
+            self._writer.write((json.dumps(
                 {"env": env, "log_path": log_path}) + "\n").encode())
-            self._file.flush()
-            line = self._file.readline()
-            if not line:
+            await self._writer.drain()
+            resp = await asyncio.wait_for(fut, timeout)
+            if resp is None:
                 raise OSError("zygote hung up")
-            resp = json.loads(line)
             if "pid" not in resp:
                 # Per-request failure (e.g. fork EAGAIN): the template
                 # itself is fine, keep the connection.
@@ -227,22 +259,32 @@ class _ZygoteClient:
                                resp.get("error"))
                 return None
             return resp["pid"]
-        except (OSError, ValueError, KeyError) as e:
+        except (OSError, ValueError, KeyError, asyncio.TimeoutError) as e:
             logger.warning("zygote spawn failed (%s); cold-spawning", e)
-            self._drop_conn()
+            await self._drop_conn()
             return None
 
-    def _drop_conn(self):
-        if self._sock is not None:
+    async def _drop_conn(self):
+        if self._reader_task is not None:
+            self._reader_task.cancel()
             try:
-                self._sock.close()
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reader_task = None
+        if self._writer is not None:
+            try:
+                self._writer.close()
             except OSError:
                 pass
-            self._sock = None
-            self._file = None
+            self._reader = self._writer = None
+        self._fail_pending()
+
+    async def aclose(self):
+        await self._drop_conn()
+        await asyncio.to_thread(self.close)  # proc.wait can block 2s
 
     def close(self):
-        self._drop_conn()
         # SIGTERM first: the zygote's handler kills its forked workers
         # (they setsid'd, so killing the zygote alone leaks them), then a
         # hard kill as backstop.
@@ -306,6 +348,7 @@ class Raylet:
                                        f"ray_tpu-store-{self.node_id[:12]}")
         self.store: ObjectStoreClient | None = None
         self.workers: dict[str, WorkerHandle] = {}
+        self._log_tails: dict[str, Raylet._LogTail] = {}
         self.idle_workers: deque[WorkerHandle] = deque()
         self.pending_leases: deque = deque()
         self.cluster_view: dict = {}
@@ -336,6 +379,15 @@ class Raylet:
         self._zygote: _ZygoteClient | None = None
         self._zygote_lock = asyncio.Lock()
         self._zygote_strikes = 0
+        # Startup concurrency bound (reference: worker_pool.cc
+        # maximum_startup_concurrency_ = num CPUs): zygote spawns are
+        # pipelined, so without a bound a 400-worker burst forks 400
+        # children that ALL initialize at once — every registration then
+        # completes at the END of the convoy and creation RPC timeouts
+        # fire. Hold a slot from fork until the worker registers (or
+        # dies) so a bounded cohort initializes at a time.
+        self._spawn_slots = asyncio.Semaphore(
+            max(4, int(self.total_resources.get("CPU", 4))))
         # Native C++ scheduling core mirrors the GCS-fed cluster view for
         # spillback decisions (src/scheduler.cc; Python policy is fallback).
         self._native_sched = None
@@ -479,6 +531,7 @@ class Raylet:
             kv_get=_kv_get)
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         self._tasks.append(asyncio.create_task(self._reap_loop()))
+        self._tasks.append(asyncio.create_task(self._log_tail_loop()))
         if self.config.memory_usage_threshold > 0:
             self._tasks.append(asyncio.create_task(self._memory_monitor_loop()))
         # Prestart (reference: worker_pool.cc PrestartWorkers): warm the
@@ -502,7 +555,7 @@ class Raylet:
             self._kill_worker(w)
         if self._zygote is not None:
             zygote, self._zygote = self._zygote, None
-            await asyncio.to_thread(zygote.close)  # proc.wait can block 2s
+            await zygote.aclose()
         if getattr(self, "transfer_server", None) is not None:
             await asyncio.to_thread(self.transfer_server.stop)
         await self.server.stop()
@@ -739,59 +792,88 @@ class Raylet:
 
     # ---------- worker pool ----------
 
-    async def _tail_worker_log(self, w: WorkerHandle, log_path: str):
-        """Tail a worker's log file, publishing appended lines to the GCS
-        LOGS channel — the driver prints them (reference: log_monitor.py
-        tails per-pid worker logs and publishes via GCS pubsub)."""
-        pos = 0
-        carry = b""  # partial trailing line from the previous chunk
+    class _LogTail:
+        __slots__ = ("w", "path", "pos", "carry", "next_poll", "interval")
 
-        async def drain_once():
-            nonlocal pos, carry
-            try:
-                size = os.path.getsize(log_path)
-            except OSError:
-                return
-            while pos < size:
-                with open(log_path, "rb") as f:
-                    f.seek(pos)
-                    chunk = f.read(min(size - pos, 256 * 1024))
+        def __init__(self, w, path):
+            self.w = w
+            self.path = path
+            self.pos = 0
+            self.carry = b""  # partial trailing line from the last chunk
+            self.next_poll = 0.0
+            self.interval = 0.3
+
+    async def _log_tail_loop(self):
+        """ONE tail loop for every worker log, publishing appended lines
+        to the GCS LOGS channel (reference: log_monitor.py tails per-pid
+        worker logs and publishes via GCS pubsub). Per-worker tail TASKS
+        (r4) cost 400 timers + 1.3k stat()s/s during a 400-actor burst —
+        a third of the raylet loop; here quiet logs back off to 2s polls
+        and the whole pool shares one timer."""
+        while True:
+            await asyncio.sleep(0.3)
+            now = time.monotonic()
+            for wid, t in list(self._log_tails.items()):
+                if t.next_poll > now:
+                    continue
+                grew = await self._drain_log_tail(t)
+                if t.w.dead:
+                    # Final drain happened above (worker exit flushes its
+                    # last buffered output); emit any unterminated line.
+                    if t.carry and self.gcs_conn \
+                            and not self.gcs_conn.closed:
+                        try:
+                            await self.gcs_conn.call("Publish", {
+                                "channel": "LOGS",
+                                "message": {
+                                    "worker_id": t.w.worker_id,
+                                    "node_id": self.node_id,
+                                    "pid": t.w.proc.pid,
+                                    "lines": [t.carry.decode("utf-8",
+                                                             "replace")]}})
+                        except Exception:
+                            pass
+                    del self._log_tails[wid]
+                    continue
+                # Chatty logs poll fast; quiet ones back off (most
+                # workers log nothing at all).
+                t.interval = 0.3 if grew else min(2.0, t.interval * 1.7)
+                t.next_poll = now + t.interval
+
+    async def _drain_log_tail(self, t: "_LogTail") -> bool:
+        try:
+            size = os.path.getsize(t.path)
+        except OSError:
+            return False
+        grew = False
+        try:
+            while t.pos < size:
+                grew = True
+                with open(t.path, "rb") as f:
+                    f.seek(t.pos)
+                    chunk = f.read(min(size - t.pos, 256 * 1024))
                 if not chunk:
-                    return
-                pos += len(chunk)
-                data = carry + chunk
+                    break
+                t.pos += len(chunk)
+                data = t.carry + chunk
                 # Keep an unterminated final line for the next read.
                 nl = data.rfind(b"\n")
                 if nl < 0:
-                    carry = data
+                    t.carry = data
                     continue
-                carry = data[nl + 1:]
+                t.carry = data[nl + 1:]
                 lines = data[:nl].decode("utf-8", "replace").splitlines()
                 for s in range(0, len(lines), 200):
                     if self.gcs_conn and not self.gcs_conn.closed:
                         await self.gcs_conn.call("Publish", {
                             "channel": "LOGS",
-                            "message": {"worker_id": w.worker_id,
+                            "message": {"worker_id": t.w.worker_id,
                                         "node_id": self.node_id,
-                                        "pid": w.proc.pid,
+                                        "pid": t.w.proc.pid,
                                         "lines": lines[s:s + 200]}})
-
-        try:
-            while not w.dead:
-                await asyncio.sleep(0.3)
-                await drain_once()
-            # Final drain: exit flushes the worker's last buffered output.
-            await drain_once()
-            if carry and self.gcs_conn and not self.gcs_conn.closed:
-                await self.gcs_conn.call("Publish", {
-                    "channel": "LOGS",
-                    "message": {"worker_id": w.worker_id,
-                                "node_id": self.node_id, "pid": w.proc.pid,
-                                "lines": [carry.decode("utf-8", "replace")]}})
-        except asyncio.CancelledError:
-            raise
         except Exception:
             pass
+        return grew
 
     def _idle_soft_limit(self) -> int:
         """Idle-pool cap shared by the reap loop and prestart (keeping the
@@ -814,6 +896,11 @@ class Raylet:
             "RAY_TPU_GCS_PORT": str(self.gcs_port),
             "RAY_TPU_STORE_PATH": self.store_path,
             "RAY_TPU_SESSION_DIR": self.session_dir,
+            # The CLUSTER config, not defaults: a worker's own fetches,
+            # lease retries, and store sizing must honor what the driver
+            # configured (pool workers previously default-constructed
+            # Config and silently ignored e.g. same_host_zero_copy).
+            "RAY_TPU_CONFIG_JSON": self.config.to_json(),
             # Logs stream to the driver via the tail loop; block-buffered
             # stdout would hold lines back for ~8KB.
             "PYTHONUNBUFFERED": "1",
@@ -822,8 +909,7 @@ class Raylet:
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         w = WorkerHandle(_PendingProc(), worker_id)
         self.workers[worker_id] = w
-        self._tasks.append(
-            asyncio.ensure_future(self._tail_worker_log(w, log_path)))
+        self._log_tails[worker_id] = self._LogTail(w, log_path)
         self._tasks.append(
             asyncio.ensure_future(
                 self._materialize_worker(w, worker_env, log_path)))
@@ -832,7 +918,11 @@ class Raylet:
     async def _materialize_worker(self, w: WorkerHandle, worker_env: dict,
                                   log_path: str):
         """Back the handle with a real process: fork from the zygote when
-        it is (or comes) warm, else cold-spawn an interpreter."""
+        it is (or comes) warm, else cold-spawn an interpreter. Holds one
+        startup-concurrency slot from fork until registration."""
+        await self._spawn_slots.acquire()
+        self._tasks.append(
+            asyncio.ensure_future(self._release_spawn_slot(w)))
         proc = None
         if self._zygote is not None:
             # Waiting for zygote warm-up beats cold-spawning in parallel
@@ -843,20 +933,23 @@ class Raylet:
             # zygote starves every spawn: cap it well below that budget.
             deadline = time.monotonic() + min(
                 20.0, self.config.worker_startup_timeout_s / 2)
+            # The lock covers only the warm-up wait (one waiter polls;
+            # the rest queue behind it briefly at boot) — spawns
+            # themselves PIPELINE on the zygote socket, so a burst of
+            # worker bring-ups no longer serializes behind one ~10-25ms
+            # fork round-trip at a time (r4 many_actors ceiling).
             async with self._zygote_lock:
                 zygote = self._zygote
                 while (zygote is not None
-                       and not await asyncio.to_thread(zygote.connect)
+                       and not await zygote.connect()
                        and time.monotonic() < deadline
                        and zygote.proc.poll() is None
                        and not w.dead):
                     await asyncio.sleep(0.1)
-                pid = None
-                if zygote is not None \
-                        and await asyncio.to_thread(zygote.connect, 0.05):
+                connected = zygote is not None \
+                    and await zygote.connect(0.05)
+                if connected:
                     self._zygote_strikes = 0
-                    pid = await asyncio.to_thread(
-                        zygote.spawn, worker_env, log_path)
                 elif zygote is not None:
                     # Never-connected template: three strikes and it is
                     # retired so later spawns stop paying the wait.
@@ -866,7 +959,10 @@ class Raylet:
                             "worker zygote never became ready; disabling "
                             "fork-server (workers will cold-spawn)")
                         self._zygote = None
-                        await asyncio.to_thread(zygote.close)
+                        await zygote.aclose()
+            pid = None
+            if connected:
+                pid = await zygote.spawn(worker_env, log_path)
             if pid is not None:
                 proc = _PidProc(pid)
         if proc is None:
@@ -895,6 +991,23 @@ class Raylet:
         w.proc = proc
         if w.dead or kill_requested:
             proc.kill()
+
+    async def _release_spawn_slot(self, w: WorkerHandle):
+        """Free the startup slot when the worker registers, dies, or the
+        startup budget lapses — whichever comes first."""
+        deadline = time.monotonic() + self.config.worker_startup_timeout_s
+        try:
+            while not w.registered.is_set() and not w.dead \
+                    and time.monotonic() < deadline:
+                try:
+                    # 1s liveness poll: at 0.25s a 400-worker burst spent
+                    # 1.6k os.kill probes/s on this alone.
+                    await asyncio.wait_for(w.registered.wait(), 1.0)
+                except asyncio.TimeoutError:
+                    if w.proc.poll() is not None:
+                        break
+        finally:
+            self._spawn_slots.release()
 
     def _kill_worker(self, w: WorkerHandle):
         w.dead = True
@@ -1972,8 +2085,16 @@ def main():
 
     logging.basicConfig(level=logging.INFO,
                         format="[raylet] %(asctime)s %(levelname)s %(message)s")
+    import faulthandler
+
+    faulthandler.enable()  # segfault/abort tracebacks land in the log
+    _maybe_attach_daemon_profiler("raylet")
 
     async def run():
+        # Eager tasks (3.12): lease/return dispatches that complete
+        # without blocking skip the scheduler round-trip (see gcs.main).
+        asyncio.get_running_loop().set_task_factory(
+            asyncio.eager_task_factory)
         raylet = Raylet(
             args.gcs_host, args.gcs_port,
             resources=json.loads(args.resources) or None,

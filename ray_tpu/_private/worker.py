@@ -49,7 +49,7 @@ import queue as _queue
 import threading
 import time
 import traceback
-from collections import defaultdict
+from collections import defaultdict, deque
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc, serialization
@@ -293,7 +293,16 @@ class CoreWorker:
         self._leases: dict[str, list[_LeaseSlot]] = defaultdict(list)
         self._lease_requests_in_flight: dict[str, int] = defaultdict(int)
         self._lease_retry_logged = 0.0  # rate-limits lease-retry warnings
-        self._queues: dict[str, list] = defaultdict(list)  # shape -> [task_id]
+        # pg_id -> [promise oid_hex] armed by pg_ready_promise.
+        self._pg_ready_waiters: dict[str, list[str]] = {}
+        # Strong refs to fire-and-forget loop tasks (the loop keeps
+        # tasks weakly; a GC'd pending task never runs its cleanup).
+        self._bg_tasks: set = set()
+        # shape -> deque[task_id]: popleft is O(1) — a LIST's pop(0)
+        # memmoves the whole queue per task, which at 200k queued depth
+        # turned the drain phase into ~GBs of shifting (r4's 5.8k/s
+        # drain ceiling vs 12k/s submit).
+        self._queues: dict[str, deque] = defaultdict(deque)
         # Shapes submitted with SPREAD: dispatch ONE task per push so
         # work disperses across the cluster's width instead of batching
         # onto early leases (reference: spread_scheduling_policy.cc
@@ -336,6 +345,24 @@ class CoreWorker:
         self._task_events: list = []
         self._tqdm_renderer = None  # lazy; driver-side progress bars
         self._run(self._async_init())
+        # GC tuning for task-burst workloads: default thresholds run a
+        # collection every ~700 allocations, and with 100k+ pending
+        # tasks/objects live each pass rescans them all — measured ~15%
+        # of drain throughput on a 200k-task queue. Freeze the warm
+        # startup heap out of scanning everywhere (startup objects are
+        # permanent); raise the young-gen threshold only in DRIVERS,
+        # whose allocation churn is dominated by ray_tpu bookkeeping —
+        # pool workers run arbitrary user code whose cyclic garbage must
+        # keep collecting at the default cadence. RAY_TPU_GC_GEN0
+        # overrides (0 = leave thresholds alone).
+        import gc
+
+        gc.collect()
+        gc.freeze()
+        gen0 = int(os.environ.get("RAY_TPU_GC_GEN0",
+                                  "50000" if is_driver else "0"))
+        if gen0 > 0:
+            gc.set_threshold(gen0, 20, 20)
 
     # ---------- plumbing ----------
 
@@ -386,11 +413,16 @@ class CoreWorker:
             handlers={"Publish": self._on_gcs_publish},
             name=f"w{self.worker_id[:8]}->gcs",
             timeout=self.config.rpc_connect_timeout_s)
-        channels = ["ACTOR"]
+        # Drivers subscribe eagerly (they hold actor handles from the
+        # start); pool workers subscribe lazily on their first handle —
+        # see _actor_state (an eager per-worker ACTOR subscription made
+        # actor-creation bursts O(N^2) in publish fan-out).
+        channels = ["ACTOR"] if self.is_driver else []
         if self.is_driver and self.config.log_to_driver:
             channels.append("LOGS")
         self._gcs_channels = channels
-        await self.gcs.call("Subscribe", {"channels": channels})
+        if channels:
+            await self.gcs.call("Subscribe", {"channels": channels})
         # Survive GCS restarts: reconnect + resubscribe (reference: workers
         # retry through gcs_client across GCS failover).
         self.gcs.on_close(lambda: (not self._shutdown)
@@ -613,6 +645,62 @@ class CoreWorker:
             self._post(self._track_container, oid.hex(), list(sink))
         self._run(self._store_owned(oid, sobj))
         return oid, self.address
+
+    # ---- promise refs (owned pending objects with no producing task) ----
+
+    def pg_ready_promise(self, pg_id_hex: str):
+        """ObjectRef that resolves when the placement group reaches
+        CREATED, driven by the GCS PG pubsub channel — NO probe task, no
+        worker lease (the reference's ready() schedules
+        bundle_reservation_check_func into the PG; here CREATED is only
+        published after every bundle's 2PC commit, so the control-plane
+        future validates the same thing at zero worker cost — the r4
+        gate burned one worker SPAWN per PG on it)."""
+        from ray_tpu._private.api_internal import ObjectRef
+
+        oid = ObjectID.for_put(self._current_task_id,
+                               next(self._put_counter))
+
+        async def arm_and_check():
+            self.objects.setdefault(oid.hex(), _OwnedObject())
+            if "PG" not in self._gcs_channels:
+                self._gcs_channels.append("PG")
+                await self.gcs.call("Subscribe", {"channels": ["PG"]})
+            self._pg_ready_waiters.setdefault(pg_id_hex,
+                                              []).append(oid.hex())
+            # The subscription may postdate the CREATED publish: check
+            # current state once AFTER arming (never misses: either the
+            # publish arrives after the arm, or this read sees CREATED).
+            resp = await self.gcs.call("GetPlacementGroup",
+                                       {"pg_id": pg_id_hex})
+            if resp.get("found") and resp.get("state") in ("CREATED",
+                                                           "REMOVED"):
+                self._settle_pg_waiters(pg_id_hex, resp["state"])
+
+        self._run(arm_and_check())
+        return ObjectRef(oid, self.address)
+
+    def _settle_pg_waiters(self, pg_id_hex: str, state: str) -> None:
+        """Resolve (CREATED) or fail (REMOVED) all ready()-promises of
+        one placement group. Loop-side; idempotent."""
+        for oid_hex in self._pg_ready_waiters.pop(pg_id_hex, []):
+            o = self.objects.get(oid_hex)
+            if o is None or o.state != OBJ_PENDING:
+                continue
+            if state == "CREATED":
+                sobj = serialization.serialize(True)
+                o.inline = (sobj.meta, sobj.to_bytes())
+                o.size = len(o.inline[1])
+                o.state = OBJ_READY
+            else:
+                err = serialization.serialize_exception(
+                    exc.RayTpuError(
+                        f"placement group {pg_id_hex[:8]} was removed "
+                        "before it was scheduled"))
+                o.error = (err.meta, err.to_bytes())
+                o.state = OBJ_FAILED
+            if o.ready_event:
+                o.ready_event.set()
 
     async def _store_owned(self, oid: ObjectID, sobj: serialization.SerializedObject,
                            lineage_task: str | None = None):
@@ -909,7 +997,7 @@ class CoreWorker:
         across raylets (reference: plasma zero-copy mmap reads; the
         cross-HOST path still chunks over the transfer plane). Returns
         a fetch triple with the pin against the PEER store, or None."""
-        if self.raylet is None:
+        if self.raylet is None or not self.config.same_host_zero_copy:
             return None
         cache = getattr(self, "_peer_store_cache", None)
         if cache is None:
@@ -1084,6 +1172,19 @@ class CoreWorker:
                     conn.on_close(lambda: (not self._shutdown)
                                   and self._spawn(self._reconnect_gcs()))
                     logger.info("reconnected to GCS")
+                    # PG-ready promises have no polling fallback (unlike
+                    # the actor path): a CREATED/REMOVED published while
+                    # we were down is gone, so re-query every armed
+                    # waiter's state now.
+                    for pg_id in list(self._pg_ready_waiters):
+                        try:
+                            resp = await conn.call(
+                                "GetPlacementGroup", {"pg_id": pg_id})
+                        except Exception:
+                            continue
+                        if resp.get("found") and resp.get("state") in (
+                                "CREATED", "REMOVED"):
+                            self._settle_pg_waiters(pg_id, resp["state"])
                     return
                 except Exception:
                     if conn is not None:
@@ -1656,7 +1757,7 @@ class CoreWorker:
             take = min(self._PUSH_BATCH_MAX, max(1, -(-len(q) // n_workers)))
         pts = []
         while q and len(pts) < take:
-            pt = self.pending_tasks.get(q.pop(0))
+            pt = self.pending_tasks.get(q.popleft())
             if pt is not None:
                 pts.append(pt)
         return pts
@@ -1833,7 +1934,7 @@ class CoreWorker:
     def _fail_queued_infeasible(self, shape: str, reason: str):
         q = self._queues[shape]
         while q:
-            task_id = q.pop(0)
+            task_id = q.popleft()
             pt = self.pending_tasks.pop(task_id, None)
             if pt is not None:
                 err = serialization.serialize_exception(
@@ -3098,6 +3199,11 @@ class CoreWorker:
                         continue
                 print(f"{prefix} {line}", flush=True)
             return
+        if payload.get("channel") == "PG":
+            msg = payload["message"]
+            if msg.get("state") in ("CREATED", "REMOVED"):
+                self._settle_pg_waiters(msg["pg_id"], msg["state"])
+            return
         if payload.get("channel") != "ACTOR":
             return
         msg = payload["message"]
@@ -3107,13 +3213,13 @@ class CoreWorker:
         if msg["state"] == "ALIVE":
             self._note_actor_incarnation(st, msg.get("restarts", 0))
             st["address"] = msg["address"]
-            st["conn"] = None
+            self._drop_actor_conn(st)
             ev = st.get("alive_event")
             if ev:
                 ev.set()
         elif msg["state"] in ("DEAD", "RESTARTING"):
             st["address"] = None
-            st["conn"] = None
+            self._drop_actor_conn(st)
             if msg["state"] == "DEAD":
                 st["dead"] = True
                 st["death_reason"] = msg.get("reason", "")
@@ -3121,11 +3227,44 @@ class CoreWorker:
                 if ev:
                     ev.set()
 
+    def _drop_actor_conn(self, st) -> None:
+        """Retire a handle's cached conn on an actor state change. Just
+        nulling the slot leaked the conn's PENDING recv task as a
+        garbage cycle — 'Task was destroyed but it is pending!' when the
+        state publish beat the socket EOF (the r4 ES-test teardown
+        flake); close() cancels and awaits it. The close task itself is
+        strongly held (the loop keeps tasks weakly)."""
+        old = st.get("conn")
+        st["conn"] = None
+        if old is not None and not old.closed:
+            task = asyncio.ensure_future(old.close())
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
+
     def _actor_state(self, actor_id: str):
-        return self.actor_handles_state.setdefault(
-            actor_id, {"address": None, "conn": None, "seq": 0, "dead": False,
-                       "death_reason": "", "alive_event": None,
-                       "incarnation": 0, "inflight": []})
+        st = self.actor_handles_state.get(actor_id)
+        if st is None:
+            st = self.actor_handles_state[actor_id] = {
+                "address": None, "conn": None, "seq": 0, "dead": False,
+                "death_reason": "", "alive_event": None,
+                "incarnation": 0, "inflight": []}
+            # Pool workers subscribe to ACTOR state lazily, on their
+            # FIRST actor handle: an eager per-worker subscription made
+            # every ActorReady publish fan out to all ~N already-started
+            # workers — O(N^2) notifies during an actor-creation burst
+            # (the r4 many_actors ceiling had 160k of them at N=400).
+            if "ACTOR" not in self._gcs_channels:
+                self._gcs_channels.append("ACTOR")
+                self._spawn(self._subscribe_channel("ACTOR"))
+        return st
+
+    async def _subscribe_channel(self, channel: str):
+        try:
+            await self.gcs.call("Subscribe", {"channels": [channel]})
+        except Exception:
+            # Reconnect resubscribes _gcs_channels; a failure here means
+            # the GCS conn is already cycling.
+            pass
 
     @staticmethod
     def _note_actor_incarnation(st, restarts: int):
@@ -3340,12 +3479,19 @@ def main():
         from ray_tpu._private import accelerator
 
         accelerator.install_worker_jax_isolation()
+    config = None
+    if env.get("RAY_TPU_CONFIG_JSON"):
+        try:
+            config = Config.from_json(env["RAY_TPU_CONFIG_JSON"])
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "bad RAY_TPU_CONFIG_JSON; using defaults", exc_info=True)
     cw = CoreWorker(
         gcs_host=env["RAY_TPU_GCS_HOST"], gcs_port=int(env["RAY_TPU_GCS_PORT"]),
         raylet_host=env["RAY_TPU_RAYLET_HOST"],
         raylet_port=int(env["RAY_TPU_RAYLET_PORT"]),
         store_path=env["RAY_TPU_STORE_PATH"], node_id=env["RAY_TPU_NODE_ID"],
-        is_driver=False, worker_id=env["RAY_TPU_WORKER_ID"])
+        is_driver=False, worker_id=env["RAY_TPU_WORKER_ID"], config=config)
     # Make the worker's core worker available to executing user code
     # (ray_tpu.get/put/remote work inside tasks).
     from ray_tpu._private import api_internal

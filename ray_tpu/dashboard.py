@@ -85,6 +85,74 @@ def _merged_events(state) -> list:
         _events_lock.release()
 
 
+class _MetricsHistory:
+    """In-memory time-series ring (reference: dashboard/modules/metrics/
+    — there Prometheus+Grafana render history; here the head samples its
+    own cluster view so the SPA can chart without external infra).
+    One sampler thread per dashboard server; 600 samples @2s = 20 min."""
+
+    def __init__(self, interval_s: float = 2.0, maxlen: int = 600):
+        from collections import deque
+
+        self.interval_s = interval_s
+        self.samples: "deque[dict]" = deque(maxlen=maxlen)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_leases: dict[str, float] = {}
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ray_tpu-metrics-history")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _loop(self):
+        import time as _time
+
+        from ray_tpu.util import state
+        while not self._stop.wait(self.interval_s):
+            try:
+                nodes = {}
+                lease_rate = 0.0
+                for n in state.node_stats():
+                    nid = n.get("node_id", "?")
+                    total = n.get("total", {})
+                    avail = n.get("available", {})
+                    granted = float(n.get("leases_granted", 0))
+                    prev = self._last_leases.get(nid)
+                    if prev is not None:
+                        lease_rate += max(0.0, granted - prev) \
+                            / self.interval_s
+                    self._last_leases[nid] = granted
+                    nodes[nid[:8]] = {
+                        "cpu_used": round(total.get("CPU", 0)
+                                          - avail.get("CPU", 0), 2),
+                        "cpu_total": total.get("CPU", 0),
+                        "workers": n.get("num_workers", 0),
+                        "store_mb": round(n.get("store", {}).get(
+                            "bytes_in_use", 0) / 2**20, 1),
+                        "pending_leases": n.get("pending_leases", 0),
+                    }
+                self.samples.append({
+                    "ts": _time.time(),
+                    "nodes": nodes,
+                    "task_rate_per_s": round(lease_rate, 1),
+                })
+            except Exception:
+                continue  # cluster mid-teardown; keep sampling
+
+    def snapshot(self) -> dict:
+        return {"interval_s": self.interval_s,
+                "samples": list(self.samples)}
+
+
+_metrics_history: _MetricsHistory | None = None
+
+
 def _json_default(o):
     try:
         return o.item()  # numpy scalars
@@ -230,6 +298,10 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.util.grafana import generate_dashboard
 
                 data = generate_dashboard()
+            elif path == "/api/metrics/history":
+                data = (_metrics_history.snapshot()
+                        if _metrics_history is not None
+                        else {"interval_s": 0, "samples": []})
             elif path == "/api/worker_stats":
                 # Flat per-worker rows; node_id is the FULL id (the SPA's
                 # node-detail view filters on it), "node" the display
@@ -271,18 +343,23 @@ _server: ThreadingHTTPServer | None = None
 def start(host: str = "127.0.0.1", port: int = 8265) -> int:
     """Start the dashboard server; returns the bound port (the reference's
     default dashboard port is also 8265)."""
-    global _server
+    global _server, _metrics_history
     if _server is not None:
         return _server.server_address[1]
     _server = ThreadingHTTPServer((host, port), _Handler)
     t = threading.Thread(target=_server.serve_forever, daemon=True,
                          name="ray_tpu-dashboard")
     t.start()
+    _metrics_history = _MetricsHistory()
+    _metrics_history.start()
     return _server.server_address[1]
 
 
 def stop() -> None:
-    global _server, _events_cache
+    global _server, _events_cache, _metrics_history
+    if _metrics_history is not None:
+        _metrics_history.stop()
+        _metrics_history = None
     if _server is not None:
         _server.shutdown()
         _server = None

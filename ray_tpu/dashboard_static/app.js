@@ -367,14 +367,36 @@ const VIEWS = {
   },
 
   async metrics() {
-    const text = await getText("/metrics");
+    const [hist, text] = await Promise.all([
+      getJSON("/api/metrics/history"), getText("/metrics")]);
+    const samples = hist.samples || [];
+    const series = (pick) => samples.map((s) => ({ t: s.ts, v: pick(s) }));
+    const sumNodes = (s, k) =>
+      Object.values(s.nodes || {}).reduce((a, n) => a + (n[k] || 0), 0);
+    let charts = "";
+    if (samples.length >= 2) {
+      charts = "<div class='chart-grid'>" +
+        lineChart("CPU in use", "cores",
+                  series((s) => sumNodes(s, "cpu_used"))) +
+        lineChart("Task throughput", "leases/s",
+                  series((s) => s.task_rate_per_s || 0)) +
+        lineChart("Object store", "MB",
+                  series((s) => sumNodes(s, "store_mb"))) +
+        lineChart("Workers", "",
+                  series((s) => sumNodes(s, "workers"))) +
+        "</div>";
+    } else {
+      charts = "<div class='note'>collecting history… " +
+        "(first samples in a few seconds)</div>";
+    }
     const rows = [];
     for (const line of text.split("\n")) {
       if (!line || line.startsWith("#")) continue;
       const sp = line.lastIndexOf(" ");
       rows.push({ metric: line.slice(0, sp), value: line.slice(sp + 1) });
     }
-    return "<h1>Metrics (Prometheus)</h1>" +
+    return "<h1>Metrics</h1>" + charts +
+      "<h2>Prometheus snapshot</h2>" +
       "<div class='note'><a href='/api/grafana/dashboard' target='_blank'>" +
       "generated Grafana dashboard JSON</a> · raw at <a href='/metrics' " +
       "target='_blank'>/metrics</a></div>" + renderTable(rows);
@@ -409,6 +431,94 @@ const VIEWS = {
       "<div id='profile-out'></div>";
   },
 };
+
+// ---- time-series charts (vanilla SVG; single series per panel, so the
+// accent hue carries no identity — the title names the series; hover
+// crosshair shows the value at the nearest sample) ---------------------
+
+let _chartSeq = 0;
+const _chartData = {};
+
+function lineChart(title, unit, pts, w = 380, h = 120) {
+  const id = "ch" + (++_chartSeq);
+  _chartData[id] = { pts, unit };
+  const padL = 44, padR = 10, padT = 8, padB = 18;
+  const xs = pts.map((p) => p.t), ys = pts.map((p) => p.v);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  let y0 = 0, y1 = Math.max(...ys);
+  if (y1 <= y0) y1 = y0 + 1;
+  y1 *= 1.08; // headroom so the line never kisses the frame
+  const X = (t) => padL + (t - x0) / (x1 - x0 || 1) * (w - padL - padR);
+  const Y = (v) => padT + (1 - (v - y0) / (y1 - y0)) * (h - padT - padB);
+  const path = pts.map((p, i) =>
+    (i ? "L" : "M") + X(p.t).toFixed(1) + " " + Y(p.v).toFixed(1)).join("");
+  const last = pts[pts.length - 1];
+  const fmt = (v) => v >= 100 ? Math.round(v) : +v.toFixed(1);
+  // Two recessive gridlines at 1/3 and 2/3 of the scale.
+  let g = "";
+  for (const f of [1 / 3, 2 / 3]) {
+    const yv = padT + (1 - f) * (h - padT - padB);
+    g += `<line x1="${padL}" y1="${yv.toFixed(1)}" x2="${w - padR}" ` +
+      `y2="${yv.toFixed(1)}" class="chart-grid-line"/>`;
+  }
+  const span = Math.round((x1 - x0) / 60);
+  return `<div class="chart" data-chart="${id}">` +
+    `<div class="chart-title">${esc(title)}` +
+    `<span class="chart-last">${fmt(last.v)}${unit ? " " + esc(unit) : ""}` +
+    `</span></div>` +
+    `<svg viewBox="0 0 ${w} ${h}" data-w="${w}" data-h="${h}" ` +
+    `data-padl="${padL}" data-padr="${padR}">` + g +
+    `<line x1="${padL}" y1="${h - padB}" x2="${w - padR}" y2="${h - padB}" ` +
+    `class="chart-axis"/>` +
+    `<text x="${padL - 6}" y="${padT + 8}" class="chart-tick" ` +
+    `text-anchor="end">${fmt(y1 / 1.08)}</text>` +
+    `<text x="${padL - 6}" y="${h - padB}" class="chart-tick" ` +
+    `text-anchor="end">0</text>` +
+    `<text x="${padL}" y="${h - 4}" class="chart-tick">` +
+    `${span ? "last " + span + " min" : "now"}</text>` +
+    `<path d="${path}" class="chart-line"/>` +
+    `<circle class="chart-dot" r="3.5" style="display:none"/>` +
+    `<rect x="${padL}" y="0" width="${w - padL - padR}" height="${h}" ` +
+    `fill="transparent" class="chart-hit"/>` +
+    `</svg><div class="chart-tip" style="display:none"></div></div>`;
+}
+
+document.addEventListener("mousemove", (e) => {
+  const hit = e.target.closest(".chart-hit");
+  if (!hit) {
+    for (const d of document.querySelectorAll(".chart-dot"))
+      d.style.display = "none";
+    for (const t of document.querySelectorAll(".chart-tip"))
+      t.style.display = "none";
+    return;
+  }
+  const box = hit.closest(".chart");
+  const data = _chartData[box.dataset.chart];
+  if (!data || !data.pts.length) return;
+  const svg = box.querySelector("svg");
+  const r = svg.getBoundingClientRect();
+  const w = +svg.dataset.w, padL = +svg.dataset.padl,
+    padR = +svg.dataset.padr;
+  const fx = (e.clientX - r.left) / r.width * w;
+  const pts = data.pts;
+  const x0 = pts[0].t, x1 = pts[pts.length - 1].t;
+  const t = x0 + (fx - padL) / (w - padL - padR) * (x1 - x0);
+  let best = pts[0];
+  for (const p of pts) if (Math.abs(p.t - t) < Math.abs(best.t - t)) best = p;
+  const h = +svg.dataset.h;
+  const X = padL + (best.t - x0) / (x1 - x0 || 1) * (w - padL - padR);
+  const ys = pts.map((p) => p.v);
+  const y1v = Math.max(...ys, 1) * 1.08;
+  const Y = 8 + (1 - best.v / y1v) * (h - 8 - 18);
+  const dot = box.querySelector(".chart-dot");
+  dot.setAttribute("cx", X); dot.setAttribute("cy", Y);
+  dot.style.display = "";
+  const tip = box.querySelector(".chart-tip");
+  tip.textContent = (+best.v.toFixed(2)) + (data.unit ? " " + data.unit : "") +
+    " · " + new Date(best.t * 1000).toLocaleTimeString();
+  tip.style.display = "";
+  tip.style.left = Math.min(X / w * 100, 70) + "%";
+});
 
 async function runProfile(dur) {
   const out = $("profile-out");

@@ -19,6 +19,7 @@ from ray_tpu.rllib.connectors import (ClipActions, Connector,
 from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.ddpg import DDPG, TD3, DDPGConfig, TD3Config
 from ray_tpu.rllib.dqn import DQN, DQNConfig, ReplayBuffer
+from ray_tpu.rllib.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, Env, Pendulum, make_env
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import Impala, ImpalaConfig
@@ -51,6 +52,7 @@ __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
            "CRR", "CRRConfig", "RandomAgent", "RandomAgentConfig",
            "DT", "DTConfig", "QMIX", "QMIXConfig", "CoopSwitch",
            "Rainbow", "RainbowConfig", "R2D2", "R2D2Config",
+           "DreamerV3", "DreamerV3Config",
            "SequenceReplay", "MADDPG", "MADDPGConfig", "CoopNav",
            "AlphaZero", "AlphaZeroConfig", "MCTS", "TicTacToe",
            "SlateQ", "SlateQConfig", "SlateDocEnv"]

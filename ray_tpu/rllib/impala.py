@@ -81,6 +81,10 @@ class ImpalaConfig:
     lr: float = 5e-4
     hidden_size: int = 64
     seed: int = 0
+    # >1: updates run on a LearnerGroup of remote learner actors, one
+    # whole trajectory fragment per learner per step, ring-allreduced
+    # gradients (reference: impala's LearnerGroup fan-out).
+    num_learners: int = 1
 
     def environment(self, env):
         self.env = env
@@ -102,6 +106,64 @@ class ImpalaConfig:
         return Impala(self)
 
 
+def make_impala_loss(*, gamma: float, vf_coeff: float, entropy_coeff: float,
+                     clip_rho: float, clip_c: float):
+    """The V-trace actor-critic loss as a free function, shared by the
+    in-process learner and the distributed LearnerGroup's learner actors
+    (same factoring as make_ppo_loss; reference: impala/impala_learner
+    builds one loss both local and remote learners jit)."""
+    import jax
+    import jax.numpy as jnp
+
+    def forward(params, obs):
+        h = jnp.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
+        h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
+        logits = h @ params["pi"]["w"] + params["pi"]["b"]
+        value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+        return logits, value
+
+    def vtrace(values, boot_v, rewards, dones, rhos):
+        """V-trace targets (Espeholt et al. 2018, eq. 1): backward scan
+        building vs_t = V(x_t) + Σ γ^k c_[t..] δ_k V."""
+        clipped_rho = jnp.minimum(clip_rho, rhos)
+        clipped_c = jnp.minimum(clip_c, rhos)
+        next_values = jnp.concatenate([values[1:], boot_v[None]])
+        next_values = next_values * (1 - dones)  # terminal: V=0
+        deltas = clipped_rho * (rewards + gamma * next_values - values)
+
+        def body(acc, xs):
+            delta, c, done = xs
+            acc = delta + gamma * (1 - done) * c * acc
+            return acc, acc
+
+        _, advs = jax.lax.scan(body, jnp.zeros(()),
+                               (deltas, clipped_c, dones), reverse=True)
+        vs = values + advs
+        next_vs = jnp.concatenate([vs[1:], boot_v[None]]) * (1 - dones)
+        pg_adv = clipped_rho * (rewards + gamma * next_vs - values)
+        return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+    def loss_fn(params, batch):
+        logits, values = forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        _, boot_v = forward(params, batch["bootstrap_obs"][None, :])
+        rhos = jnp.exp(logp - batch["behavior_logp"])
+        vs, pg_adv = vtrace(values, boot_v[0], batch["rewards"],
+                            batch["dones"], rhos)
+        pi_loss = -(logp * pg_adv).mean()
+        vf_loss = ((values - vs) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_rho": rhos.mean()}
+
+    return loss_fn
+
+
 class Impala:
     """Algorithm driver. Sampling stays in flight across train() calls —
     the learner never waits for ALL workers, only for the next ready
@@ -118,64 +180,43 @@ class Impala:
                         for i in range(config.num_rollout_workers)]
         self._inflight: dict = {}   # ref -> worker
         self._update = None
+        self._learner_group = None
+        if config.num_learners > 1:
+            if config.num_fragments_per_iter % config.num_learners:
+                # A partial cohort would be silently discarded at the end
+                # of every train() — with num_learners > fragments the
+                # params would NEVER update.
+                raise ValueError(
+                    f"num_fragments_per_iter={config.num_fragments_per_iter}"
+                    f" must be a multiple of num_learners="
+                    f"{config.num_learners}")
+            from ray_tpu.rllib.learner_group import LearnerGroup
+
+            self._learner_group = LearnerGroup(
+                num_learners=config.num_learners, model="mlp",
+                obs_size=self.obs_size, num_actions=self.num_actions,
+                hidden=config.hidden_size, lr=config.lr,
+                vf_coeff=config.vf_coeff,
+                entropy_coeff=config.entropy_coeff, seed=config.seed,
+                algo="impala",
+                algo_kwargs={"gamma": config.gamma,
+                             "clip_rho": config.vtrace_clip_rho,
+                             "clip_c": config.vtrace_clip_c})
         self.iteration = 0
         self.total_steps = 0
 
     def _build_update(self):
         import jax
-        import jax.numpy as jnp
         import optax
 
         cfg = self.config
         opt = optax.adam(cfg.lr)
         self._opt = opt
         self._opt_state = opt.init(self.params)
-
-        def forward(params, obs):
-            h = jnp.tanh(obs @ params["h1"]["w"] + params["h1"]["b"])
-            h = jnp.tanh(h @ params["h2"]["w"] + params["h2"]["b"])
-            logits = h @ params["pi"]["w"] + params["pi"]["b"]
-            value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
-            return logits, value
-
-        def vtrace(values, boot_v, rewards, dones, rhos):
-            """V-trace targets (Espeholt et al. 2018, eq. 1): backward scan
-            building vs_t = V(x_t) + Σ γ^k c_[t..] δ_k V."""
-            clipped_rho = jnp.minimum(cfg.vtrace_clip_rho, rhos)
-            clipped_c = jnp.minimum(cfg.vtrace_clip_c, rhos)
-            next_values = jnp.concatenate([values[1:], boot_v[None]])
-            next_values = next_values * (1 - dones)  # terminal: V=0
-            deltas = clipped_rho * (rewards + cfg.gamma * next_values - values)
-
-            def body(acc, xs):
-                delta, c, done = xs
-                acc = delta + cfg.gamma * (1 - done) * c * acc
-                return acc, acc
-
-            _, advs = jax.lax.scan(body, jnp.zeros(()),
-                                   (deltas, clipped_c, dones), reverse=True)
-            vs = values + advs
-            next_vs = jnp.concatenate([vs[1:], boot_v[None]]) * (1 - dones)
-            pg_adv = clipped_rho * (rewards + cfg.gamma * next_vs - values)
-            return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
-
-        def loss_fn(params, batch):
-            logits, values = forward(params, batch["obs"])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch["actions"][:, None].astype(jnp.int32),
-                axis=1)[:, 0]
-            _, boot_v = forward(params, batch["bootstrap_obs"][None, :])
-            rhos = jnp.exp(logp - batch["behavior_logp"])
-            vs, pg_adv = vtrace(values, boot_v[0], batch["rewards"],
-                                batch["dones"], rhos)
-            pi_loss = -(logp * pg_adv).mean()
-            vf_loss = ((values - vs) ** 2).mean()
-            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
-            total = pi_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
-            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
-                           "entropy": entropy,
-                           "mean_rho": rhos.mean()}
+        loss_fn = make_impala_loss(
+            gamma=cfg.gamma, vf_coeff=cfg.vf_coeff,
+            entropy_coeff=cfg.entropy_coeff,
+            clip_rho=cfg.vtrace_clip_rho, clip_c=cfg.vtrace_clip_c)
 
         def update(params, opt_state, batch):
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -197,16 +238,19 @@ class Impala:
         self._inflight[ref] = worker
 
     def train(self) -> dict:
-        if self._update is None:
+        if self._update is None and self._learner_group is None:
             self._build_update()
         cfg = self.config
         t0 = time.time()
+        if self._learner_group is not None:
+            self.params = self._learner_group.get_params()
         # Keep every worker busy; collect only the fragments that are ready
         # (workers that aren't done keep running — async by construction).
         for w in self.workers:
             if w not in self._inflight.values():
                 self._launch(w)
         episode_returns, last_aux, consumed = [], {}, 0
+        gang_batches: list = []
         while consumed < cfg.num_fragments_per_iter:
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
                                     timeout=600)
@@ -215,8 +259,18 @@ class Impala:
             batch = ray_tpu.get(ref)
             self._launch(worker)  # immediately resample with fresh params
             episode_returns += batch.pop("episode_returns")
-            self.params, self._opt_state, loss, last_aux = self._update(
-                self.params, self._opt_state, batch)
+            if self._learner_group is not None:
+                # Whole fragments accumulate until every learner has one,
+                # then ONE synchronized allreduced step consumes them
+                # (V-trace sequences cannot be row-split across learners).
+                gang_batches.append(batch)
+                if len(gang_batches) == self._learner_group.num_learners:
+                    last_aux = self._learner_group.update_shards(gang_batches)
+                    gang_batches = []
+                    self.params = self._learner_group.get_params()
+            else:
+                self.params, self._opt_state, loss, last_aux = self._update(
+                    self.params, self._opt_state, batch)
             consumed += 1
             self.total_steps += cfg.rollout_fragment_length
         self.iteration += 1
@@ -238,6 +292,8 @@ class Impala:
             except Exception:
                 pass
         self._inflight.clear()
+        if self._learner_group is not None:
+            self._learner_group.shutdown()
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

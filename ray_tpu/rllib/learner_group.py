@@ -38,12 +38,12 @@ class LearnerActor:
     def __init__(self, rank: int, world: int, group_name: str, model: str,
                  obs_size, num_actions: int, hidden: int, lr: float,
                  clip_param: float, vf_coeff: float, entropy_coeff: float,
-                 seed: int):
+                 seed: int, algo: str = "ppo",
+                 algo_kwargs: dict | None = None):
         import jax
         import optax
 
         from ray_tpu.rllib.catalog import get_model
-        from ray_tpu.rllib.ppo import make_ppo_loss
 
         self.rank, self.world, self.group = rank, world, group_name
         spec = get_model(model)
@@ -53,8 +53,24 @@ class LearnerActor:
         self.params = spec.init_params(obs_size, num_actions, hidden, seed)
         opt = optax.adam(lr)
         self.opt_state = opt.init(self.params)
-        loss_fn = make_ppo_loss(spec.jax_forward, clip_param, vf_coeff,
-                                entropy_coeff)
+        # Pluggable loss: sync algos shard one batch row-wise (PPO);
+        # async algos feed whole trajectory fragments per learner
+        # (IMPALA/APPO — V-trace needs intact sequences). Reference:
+        # rllib/core/learner builds per-algo Learner classes over one
+        # LearnerGroup.
+        if algo == "ppo":
+            from ray_tpu.rllib.ppo import make_ppo_loss
+
+            loss_fn = make_ppo_loss(spec.jax_forward, clip_param, vf_coeff,
+                                    entropy_coeff)
+        elif algo == "impala":
+            from ray_tpu.rllib.impala import make_impala_loss
+
+            loss_fn = make_impala_loss(
+                vf_coeff=vf_coeff, entropy_coeff=entropy_coeff,
+                **(algo_kwargs or {}))
+        else:
+            raise ValueError(f"unknown learner algo {algo!r}")
 
         def grad_fn(params, batch):
             (loss, aux), grads = jax.value_and_grad(
@@ -152,14 +168,16 @@ class LearnerGroup:
     def __init__(self, *, num_learners: int, model: str, obs_size,
                  num_actions: int, hidden: int, lr: float,
                  clip_param: float = 0.2, vf_coeff: float = 0.5,
-                 entropy_coeff: float = 0.0, seed: int = 0):
+                 entropy_coeff: float = 0.0, seed: int = 0,
+                 algo: str = "ppo", algo_kwargs: dict | None = None):
         LearnerGroup._seq += 1
         self.group_name = f"learner-gang-{LearnerGroup._seq}"
         self.num_learners = num_learners
         self.learners = [
             LearnerActor.remote(rank, num_learners, self.group_name, model,
                                 obs_size, num_actions, hidden, lr,
-                                clip_param, vf_coeff, entropy_coeff, seed)
+                                clip_param, vf_coeff, entropy_coeff, seed,
+                                algo, algo_kwargs)
             for rank in range(num_learners)]
         # Rendezvous: every member joins the ring before the first update.
         ray_tpu.get([a.join_group.remote() for a in self.learners],
@@ -172,6 +190,13 @@ class LearnerGroup:
         shards = [
             {k: np.array_split(v, n)[i] for k, v in batch.items()}
             for i in range(n)]
+        return self.update_shards(shards)
+
+    def update_shards(self, shards: list[dict]) -> dict:
+        """One synchronized step with an EXPLICIT batch per learner —
+        the async-algo path (IMPALA/APPO hand each learner a whole
+        trajectory fragment; V-trace sequences cannot be row-split)."""
+        assert len(shards) == self.num_learners
         metrics = ray_tpu.get(
             [a.update.remote(s) for a, s in zip(self.learners, shards)],
             timeout=600)

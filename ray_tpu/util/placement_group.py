@@ -29,18 +29,15 @@ class PlacementGroup:
         return self.bundles
 
     def ready(self):
-        """Returns an ObjectRef that resolves once the PG is scheduled, by
-        running a zero-CPU probe task inside bundle 0 (reference:
-        python/ray/util/placement_group.py ready() submits
-        bundle_reservation_check_func the same way)."""
-        import ray_tpu
+        """Returns an ObjectRef that resolves once the PG is scheduled.
 
-        @ray_tpu.remote(num_cpus=0, placement_group=self,
-                        placement_group_bundle_index=0)
-        def _bundle_reservation_check():
-            return True
-
-        return _bundle_reservation_check.remote()
+        The reference submits a probe task (bundle_reservation_check_func)
+        into bundle 0 (python/ray/util/placement_group.py ready()); here
+        the promise is settled straight off the GCS PG pubsub channel —
+        CREATED is only published after every bundle's 2PC commit, so it
+        validates the same thing without leasing (and on a fresh cluster,
+        SPAWNING) one worker per placement group."""
+        return get_core_worker().pg_ready_promise(self.id.hex())
 
     def wait(self, timeout: float | None = None) -> bool:
         """Block until the PG is scheduled (reference:

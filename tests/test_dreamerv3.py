@@ -1,0 +1,66 @@
+"""DreamerV3 world-model + imagination actor-critic.
+
+Parity: reference rllib/algorithms/dreamerv3/ (the one model-based family
+with current relevance — VERDICT r4 missing #4). Learning regression on
+the CPU backend with XS-scale nets."""
+
+import numpy as np
+
+
+def test_dreamerv3_world_model_shapes():
+    from ray_tpu.rllib import DreamerV3Config
+
+    algo = (DreamerV3Config().environment("CartPole-v1")
+            .training(deter=32, hidden=32, stoch_groups=4, stoch_classes=4,
+                      env_steps_per_iter=64, updates_per_iter=1,
+                      warmup_steps=32, batch_size=4, batch_length=8,
+                      imag_horizon=5)
+            .build())
+    r = algo.train()
+    assert r["timesteps_total"] == 64
+    assert r["num_updates"] == 1
+    assert np.isfinite(r["wm_loss"])
+    assert np.isfinite(r["actor_loss"])
+    assert np.isfinite(r["critic_loss"])
+    # KL with free bits can never drop below the floor.
+    assert r["kl_dyn"] >= algo.config.free_bits - 1e-5
+    a = algo.compute_single_action(np.zeros(4, np.float32))
+    assert a in (0, 1)
+
+
+def test_dreamerv3_replay_sequences_respect_episode_starts():
+    from ray_tpu.rllib.dreamerv3 import _SeqReplay
+
+    rep = _SeqReplay(100, 4, 2)
+    for ep in range(5):
+        for t in range(10):
+            rep.add(np.full(4, ep, np.float32), 0, 1.0, 1.0,
+                    1.0 if t == 0 else 0.0)
+    batch = rep.sample(np.random.default_rng(0), 8, 6)
+    assert batch["obs"].shape == (8, 6, 4)
+    assert batch["is_first"].shape == (8, 6)
+    # Episode boundaries appear in sampled windows as is_first flags.
+    assert batch["is_first"].sum() >= 1
+
+
+def test_dreamerv3_improves_cartpole():
+    from ray_tpu.rllib import DreamerV3Config
+
+    algo = (DreamerV3Config().environment("CartPole-v1")
+            .training(deter=64, hidden=64, stoch_groups=4, stoch_classes=8,
+                      env_steps_per_iter=400, updates_per_iter=25,
+                      warmup_steps=400, batch_size=8, batch_length=16,
+                      imag_horizon=10, model_lr=3e-3, actor_lr=1e-3,
+                      critic_lr=1e-3)
+            .build())
+    hist = []
+    for _ in range(10):
+        r = algo.train()
+        if np.isfinite(r.get("episode_reward_mean", float("nan"))):
+            hist.append(r["episode_reward_mean"])
+    assert len(hist) >= 4, f"too few reporting iters: {hist}"
+    early = np.mean(hist[:2])
+    late = np.mean(hist[-2:])
+    assert late > early + 5, \
+        f"DreamerV3 failed to improve: early={early:.1f} late={late:.1f} " \
+        f"({hist})"

@@ -331,3 +331,39 @@ def test_grafana_dashboard_generation():
         write_dashboard(f.name)
         model = _json.load(open(f.name))
     assert model["uid"] == "ray_tpu-autogen"
+
+
+def test_metrics_history_contract(ray_start_regular):
+    """/api/metrics/history feeds the SPA's time-series panels: samples
+    accumulate on a ring, each carrying per-node cpu/store/workers plus
+    a cluster task rate (reference: dashboard/modules/metrics/ renders
+    the same series via Prometheus+Grafana)."""
+    import time
+
+    from ray_tpu import dashboard
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    port = dashboard.start(port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        ray_tpu.get([noop.remote() for _ in range(20)])
+        deadline = time.monotonic() + 30
+        hist = {"samples": []}
+        while time.monotonic() < deadline and len(hist["samples"]) < 2:
+            time.sleep(1.0)
+            hist = json.loads(urllib.request.urlopen(
+                f"{base}/api/metrics/history").read())
+        assert hist["interval_s"] > 0
+        assert len(hist["samples"]) >= 2, hist
+        s = hist["samples"][-1]
+        assert "ts" in s and "task_rate_per_s" in s
+        assert s["nodes"], "per-node series missing"
+        node = next(iter(s["nodes"].values()))
+        for k in ("cpu_used", "cpu_total", "workers", "store_mb",
+                  "pending_leases"):
+            assert k in node, f"missing {k}: {node}"
+    finally:
+        dashboard.stop()

@@ -79,3 +79,31 @@ def test_ppo_with_learner_group(gang_cluster):
         assert len(set(fps)) == 1, f"learners diverged: {fps}"
     finally:
         algo.stop()
+
+
+def test_impala_with_learner_group(gang_cluster):
+    """IMPALA wired to num_learners=2 — the ASYNC-algo gang path: each
+    learner consumes a whole trajectory fragment (V-trace sequences are
+    never row-split), gradients ring-allreduce, and both learners stay
+    bit-identical across the async update stream (VERDICT r4 #8)."""
+    from ray_tpu.rllib import ImpalaConfig
+
+    algo = (ImpalaConfig().environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(rollout_fragment_length=64,
+                      num_fragments_per_iter=4, num_learners=2)
+            .build())
+    try:
+        r1 = algo.train()
+        assert r1["timesteps_total"] == 4 * 64
+        fps = algo._learner_group.fingerprints()
+        assert len(set(fps)) == 1, f"learners diverged: {fps}"
+        r2 = algo.train()
+        assert r2["timesteps_total"] == 8 * 64
+        fps = algo._learner_group.fingerprints()
+        assert len(set(fps)) == 1, f"learners diverged after iter 2: {fps}"
+        import numpy as np
+
+        assert np.isfinite(r2.get("pi_loss", float("nan")))
+    finally:
+        algo.stop()
