@@ -380,14 +380,17 @@ class Raylet:
         self._zygote_lock = asyncio.Lock()
         self._zygote_strikes = 0
         # Startup concurrency bound (reference: worker_pool.cc
-        # maximum_startup_concurrency_ = num CPUs): zygote spawns are
-        # pipelined, so without a bound a 400-worker burst forks 400
-        # children that ALL initialize at once — every registration then
-        # completes at the END of the convoy and creation RPC timeouts
-        # fire. Hold a slot from fork until the worker registers (or
-        # dies) so a bounded cohort initializes at a time.
+        # maximum_startup_concurrency_): zygote spawns are pipelined, so
+        # without a bound a 400-worker burst forks 400 children that ALL
+        # initialize at once — every registration then completes at the
+        # END of the convoy and creation RPC timeouts fire. Hold a slot
+        # from fork until the worker registers (or dies) so a bounded
+        # cohort initializes at a time. Sized 4x CPUs (min 32): worker
+        # init is IO-heavy (connects/registration round trips), so
+        # cohorts several times the core count still converge fast, and
+        # a burst at typical pool sizes (~30) isn't serialized at all.
         self._spawn_slots = asyncio.Semaphore(
-            max(4, int(self.total_resources.get("CPU", 4))))
+            max(32, 4 * int(self.total_resources.get("CPU", 4))))
         # Native C++ scheduling core mirrors the GCS-fed cluster view for
         # spillback decisions (src/scheduler.cc; Python policy is fallback).
         self._native_sched = None
@@ -1900,7 +1903,9 @@ class Raylet:
         # sockets). This is plasma's same-node shared-memory property
         # extended across co-hosted raylets (fake multi-node clusters,
         # multi-raylet hosts); cross-host peers take the TCP stripes.
-        for info in infos:
+        # same_host_zero_copy=False disables the shortcut so the chunked
+        # plane is measurable on one host (object_broadcast_chunked).
+        for info in (infos if self.config.same_host_zero_copy else []):
             if info.get("host") == self.host and info.get("store_path"):
                 try:
                     if await self._local_peer_copy(info["store_path"], oid):

@@ -348,17 +348,22 @@ class CoreWorker:
         # GC tuning for task-burst workloads: default thresholds run a
         # collection every ~700 allocations, and with 100k+ pending
         # tasks/objects live each pass rescans them all — measured ~15%
-        # of drain throughput on a 200k-task queue. Freeze the warm
-        # startup heap out of scanning everywhere (startup objects are
-        # permanent); raise the young-gen threshold only in DRIVERS,
-        # whose allocation churn is dominated by ray_tpu bookkeeping —
-        # pool workers run arbitrary user code whose cyclic garbage must
-        # keep collecting at the default cadence. RAY_TPU_GC_GEN0
-        # overrides (0 = leave thresholds alone).
+        # of drain throughput on a 200k-task queue. DRIVERS freeze the
+        # warm startup heap out of scanning and raise the young-gen
+        # threshold (driver churn is ray_tpu bookkeeping). Pool workers
+        # do NEITHER here: their startup heap is frozen once in the
+        # ZYGOTE template pre-fork (worker_zygote.main — a collect per
+        # spawned worker cost ~70ms on the jax-warm heap and capped
+        # actor bursts), and user code's cyclic garbage must keep
+        # collecting at the default cadence — unless RAY_TPU_GC_GEN0 is
+        # set explicitly (it overrides everywhere; 0 = leave thresholds
+        # alone). COLD-spawned workers (zygote disabled/retired/failed)
+        # have no pre-frozen template, so they freeze here.
         import gc
 
-        gc.collect()
-        gc.freeze()
+        if is_driver or not os.environ.get("RAY_TPU_FORKED_FROM_ZYGOTE"):
+            gc.collect()
+            gc.freeze()
         gen0 = int(os.environ.get("RAY_TPU_GC_GEN0",
                                   "50000" if is_driver else "0"))
         if gen0 > 0:

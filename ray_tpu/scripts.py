@@ -84,6 +84,11 @@ def cmd_stop(args):
 def _connect_from_state(args):
     import ray_tpu
 
+    if ray_tpu.is_initialized():
+        # In-process use (tests, embedding): the session is the
+        # CALLER's; _shutdown_if_owned leaves it alone.
+        ray_tpu._cli_owns_session = False
+        return ray_tpu
     with open(args.state_file) as f:
         info = json.load(f)
     host, port = info["raylet"].rsplit(":", 1)
@@ -91,7 +96,16 @@ def _connect_from_state(args):
                  _head_raylet=(host, int(port)),
                  _store_path=info["store_path"],
                  _node_id=info["node_id"])
+    ray_tpu._cli_owns_session = True
     return ray_tpu
+
+
+def _shutdown_if_owned(ray_tpu):
+    """Tear down only sessions THIS command created — never a live
+    session an embedding caller handed us via an early-initialized
+    runtime."""
+    if getattr(ray_tpu, "_cli_owns_session", True):
+        ray_tpu.shutdown()
 
 
 def cmd_status(args):
@@ -100,7 +114,7 @@ def cmd_status(args):
 
     st = state.cluster_status()
     print(json.dumps(st, indent=2, default=str))
-    ray_tpu.shutdown()
+    _shutdown_if_owned(ray_tpu)
     return 0
 
 
@@ -119,7 +133,7 @@ def cmd_serve(args):
     elif args.serve_cmd == "shutdown":
         serve.shutdown()
         print("serve shut down")
-    ray_tpu.shutdown()
+    _shutdown_if_owned(ray_tpu)
     return 0
 
 
@@ -143,7 +157,7 @@ def cmd_stack(args):
                     print(f"    {line}")
             if "error" in w:
                 print(f"  error: {w['error']}")
-    ray_tpu.shutdown()
+    _shutdown_if_owned(ray_tpu)
     return 0
 
 
@@ -156,7 +170,7 @@ def cmd_list(args):
           "placement-groups": state.list_placement_groups,
           "objects": state.list_objects}[args.entity]
     print(json.dumps(fn(), indent=2, default=str))
-    ray_tpu.shutdown()
+    _shutdown_if_owned(ray_tpu)
     return 0
 
 
@@ -191,7 +205,47 @@ def cmd_summary(args):
     fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors,
           "objects": state.summarize_objects}[args.entity]
     print(json.dumps(fn(), indent=2, default=str))
-    ray_tpu.shutdown()
+    _shutdown_if_owned(ray_tpu)
+    return 0
+
+
+def cmd_memory(args):
+    """`ray_tpu memory` — cluster object-memory report (parity:
+    reference `ray memory` / memory_utils.py: per-node store usage +
+    this driver's owned references with pinned sizes and totals)."""
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util import state
+
+    nodes = state.node_stats()
+    print(f"{'NODE':<10}{'IN USE':>12}{'HEAP':>12}{'OBJECTS':>9}"
+          f"{'EVICTED':>9}{'SPILLED':>12}")
+    tot_use = tot_heap = 0
+    for n in nodes:
+        st = n.get("store", {})
+        tot_use += st.get("bytes_in_use", 0)
+        tot_heap += st.get("heap_size", 0)
+        print(f"{n.get('node_id', '?')[:8]:<10}"
+              f"{st.get('bytes_in_use', 0) / 2**20:>10.1f}MB"
+              f"{st.get('heap_size', 0) / 2**20:>10.1f}MB"
+              f"{st.get('num_objects', 0):>9}"
+              f"{st.get('num_evictions', 0):>9}"
+              f"{n.get('spilled_bytes', 0) / 2**20:>10.1f}MB")
+    print(f"{'TOTAL':<10}{tot_use / 2**20:>10.1f}MB"
+          f"{tot_heap / 2**20:>10.1f}MB\n")
+    objs = state.list_objects()
+    objs.sort(key=lambda o: -(o.get("size") or 0))
+    print(f"owned by this driver: {len(objs)} refs, "
+          f"{sum(o.get('size') or 0 for o in objs) / 2**20:.1f}MB")
+    print(f"{'OBJECT':<14}{'STATE':<9}{'SIZE':>10}{'LREF':>6}{'SREF':>6}"
+          f"  LOCATIONS")
+    for o in objs[:args.limit]:
+        print(f"{o['object_id'][:12]:<14}{o['state']:<9}"
+              f"{(o.get('size') or 0) / 2**10:>8.1f}KB"
+              f"{o['local_refs']:>6}{o['submitted_refs']:>6}"
+              f"  {','.join(n[:8] for n in o.get('locations', [])) or '-'}")
+    if len(objs) > args.limit:
+        print(f"... {len(objs) - args.limit} more (use --limit)")
+    _shutdown_if_owned(ray_tpu)
     return 0
 
 
@@ -213,7 +267,7 @@ def cmd_dashboard(args):
     except KeyboardInterrupt:
         pass
     dashboard.stop()
-    ray_tpu.shutdown()
+    _shutdown_if_owned(ray_tpu)
     return 0
 
 
@@ -241,7 +295,7 @@ def cmd_job(args):
         elif args.job_cmd == "stop":
             print("stopped" if client.stop_job(args.id) else "not running")
     finally:
-        ray_tpu.shutdown()
+        _shutdown_if_owned(ray_tpu)
     return 0
 
 
@@ -252,7 +306,7 @@ def cmd_timeline(args):
     path = dump_timeline(args.output)
     print(f"chrome trace written to {path} (open in chrome://tracing "
           "or https://ui.perfetto.dev)")
-    ray_tpu.shutdown()
+    _shutdown_if_owned(ray_tpu)
     return 0
 
 
@@ -295,6 +349,11 @@ def main():
                                        "(parity: `ray summary`)")
     p.add_argument("entity", choices=["tasks", "actors", "objects"])
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("memory", help="cluster object-memory report "
+                                      "(parity: `ray memory`)")
+    p.add_argument("--limit", type=int, default=20)
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("microbenchmark", help="core-runtime throughput suite")
     p.set_defaults(fn=cmd_microbenchmark)

@@ -458,6 +458,8 @@ def run_test(test: dict, quick: bool) -> dict:
         # drain_per_s alongside the depth metric): every listed metric
         # must clear its floor, not just the headline one.
         extra = test.get("extra_thresholds")
+        if not quick and isinstance(test.get("full_extra_thresholds"), dict):
+            extra = test["full_extra_thresholds"]
         if isinstance(extra, dict):
             record["extra_thresholds"] = extra
             misses = [f"secondary metric {k}={metrics.get(k)} below "

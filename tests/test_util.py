@@ -354,3 +354,24 @@ def test_profile_workers_live(ray_start_regular):
                 hot_stacks.append(h["stack"])
     assert any("spin" in s for s in hot_stacks), hot_stacks[:5]
     assert ray_tpu.get(ref, timeout=60) > 0
+
+
+def test_memory_cli_report(ray_start_regular, capsys):
+    """`ray_tpu memory` (parity: reference `ray memory`): per-node store
+    usage plus the driver's owned refs with sizes and totals."""
+    import numpy as np
+
+    from ray_tpu import scripts
+
+    ref = ray_tpu.put(np.zeros(100_000))
+
+    class _A:
+        limit = 20
+        address = None
+
+    rc = scripts.cmd_memory(_A())
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "NODE" in out and "TOTAL" in out
+    assert "owned by this driver" in out
+    assert ref.hex()[:12] in out
