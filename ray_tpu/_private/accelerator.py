@@ -72,6 +72,12 @@ def tpu_slice_labels() -> dict[str, str]:
     slice_name = os.environ.get(TPU_SLICE_NAME_ENV)
     if slice_name:
         labels["tpu-slice"] = slice_name
+    # Generic provider-node identity (non-TPU clouds: the AWS provider's
+    # user-data bootstrap sets it so the autoscaler can map the GCS node
+    # back to the instance for idle-drain-terminate).
+    node_name = os.environ.get("RAY_TPU_NODE_NAME")
+    if node_name:
+        labels["node-name"] = node_name
     worker_id = os.environ.get(TPU_WORKER_ID_ENV)
     if worker_id is not None:
         labels["tpu-worker-id"] = worker_id

@@ -5,6 +5,7 @@ from ray_tpu.autoscaler.cluster_config import (
     node_types_from_config,
     validate_cluster_config,
 )
+from ray_tpu.autoscaler.aws_ec2 import AWSEC2NodeProvider
 from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
 from ray_tpu.autoscaler.node_provider import (
     FakeNodeProvider,
@@ -13,6 +14,7 @@ from ray_tpu.autoscaler.node_provider import (
 )
 
 __all__ = ["StandardAutoscaler", "NodeProvider", "FakeNodeProvider",
-           "NodeType", "GCPTPUNodeProvider", "load_cluster_config",
+           "NodeType", "GCPTPUNodeProvider", "AWSEC2NodeProvider",
+           "load_cluster_config",
            "validate_cluster_config", "node_types_from_config",
            "make_provider"]

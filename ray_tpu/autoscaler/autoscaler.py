@@ -110,8 +110,8 @@ class StandardAutoscaler:
         # TPU provision would launch a duplicate slice (reference:
         # resource_demand_scheduler counts launching nodes as upcoming).
         registered = {n["node_id"] for n in alive}
-        registered |= {(n.get("labels") or {}).get("tpu-slice")
-                       for n in alive}
+        for key in ("tpu-slice", "node-name"):
+            registered |= {(n.get("labels") or {}).get(key) for n in alive}
         upcoming = []
         upcoming_by_type: dict[str, int] = {}
         for nid in current:
@@ -159,9 +159,13 @@ class StandardAutoscaler:
         by_id = {n["node_id"]: n for n in alive}
         by_slice: dict[str, list[dict]] = {}
         for n in alive:
-            label = (n.get("labels") or {}).get("tpu-slice")
-            if label:
-                by_slice.setdefault(label, []).append(n)
+            labels = n.get("labels") or {}
+            # `tpu-slice` (GCP multi-host slices) or `node-name` (AWS
+            # instances) — either maps GCS nodes to the provider node.
+            for key in ("tpu-slice", "node-name"):
+                if labels.get(key):
+                    by_slice.setdefault(labels[key], []).append(n)
+                    break
         min_by_type: dict[str, int] = {}
         for nid in list(current):
             infos = [by_id[nid]] if nid in by_id else by_slice.get(nid, [])

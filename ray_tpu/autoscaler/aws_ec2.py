@@ -1,0 +1,178 @@
+"""AWS EC2 node provider.
+
+Parity: reference python/ray/autoscaler/_private/aws/node_provider.py
+(AWSNodeProvider over boto3: run_instances/terminate_instances with
+ray-cluster-name tag filtering, config.py:1 bootstrap_aws) — the second
+cloud beside GCP, making the autoscaler genuinely multi-cloud.
+
+Re-design notes: same choice as the GCP provider (gcp_tpu.py) — shell
+out to the `aws` CLI instead of importing boto3, keeping the provider
+dependency-free. Cluster membership rides a Name-tag prefix (the
+reference tags instances with ray-cluster-name and filters on it);
+raylet bootstrap rides EC2 user-data at launch (the reference's
+equivalent of its ssh command runner setup, without needing inbound
+SSH), so a node joins the cluster the moment cloud-init runs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import shutil
+import subprocess
+import uuid
+
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
+
+logger = logging.getLogger(__name__)
+
+
+class AWSEC2NodeProvider(NodeProvider):
+    """Provisions EC2 instances via the `aws` CLI.
+
+    config keys: region, instance_type, ami, optional subnet_id,
+    security_group_ids, key_name, iam_instance_profile, spot,
+    head_address (raylet bootstrap target), cluster_name.
+    """
+
+    NAME_PREFIX = "ray-tpu-"
+
+    def __init__(self, config: dict):
+        super().__init__(config)
+        for key in ("region", "instance_type", "ami"):
+            if key not in config:
+                raise ValueError(f"AWSEC2NodeProvider config needs {key!r}")
+        self.cluster_name = config.get("cluster_name", "default")
+        self._nodes: dict[str, dict] = {}
+
+    # -- aws CLI plumbing (separated so tests can assert the exact argv) --
+
+    def _aws(self) -> str:
+        path = shutil.which("aws")
+        if path is None:
+            raise RuntimeError(
+                "aws CLI not found; AWSEC2NodeProvider requires the AWS "
+                "CLI on the head node")
+        return path
+
+    def _user_data(self, name: str) -> str:
+        """Cloud-init script: starts a raylet pointed at the head on
+        first boot, labeled so the autoscaler can match the GCS node
+        back to this instance for idle-drain-terminate (the GCP
+        provider's TPU_NAME contract, here RAY_TPU_NODE_NAME). Passed
+        RAW: `aws ec2 run-instances --user-data` base64-encodes it
+        itself — pre-encoding would hand cloud-init double-encoded
+        garbage and the raylet would never start."""
+        head = self.config.get("head_address", "")
+        return ("#!/bin/bash\n"
+                f"RAY_TPU_NODE_NAME={name} "
+                f"python3 -m ray_tpu.scripts start --address={head}\n")
+
+    def create_command(self, name: str, node_type: NodeType) -> list[str]:
+        cfg = self.config
+        tags = (f"ResourceType=instance,Tags=["
+                f"{{Key=Name,Value={name}}},"
+                f"{{Key=ray-cluster-name,Value={self.cluster_name}}}]")
+        cmd = [
+            "aws", "ec2", "run-instances",
+            f"--region={cfg['region']}",
+            f"--image-id={cfg['ami']}",
+            f"--instance-type={cfg['instance_type']}",
+            "--count=1",
+            f"--tag-specifications={tags}",
+            f"--user-data={self._user_data(name)}",
+            "--output=json",
+        ]
+        if cfg.get("subnet_id"):
+            cmd.append(f"--subnet-id={cfg['subnet_id']}")
+        if cfg.get("security_group_ids"):
+            # Separate argv tokens: a space-joined value would reach the
+            # API as ONE malformed group id.
+            cmd.append("--security-group-ids")
+            cmd.extend(cfg["security_group_ids"])
+        if cfg.get("key_name"):
+            cmd.append(f"--key-name={cfg['key_name']}")
+        if cfg.get("iam_instance_profile"):
+            cmd.append(
+                f"--iam-instance-profile=Name={cfg['iam_instance_profile']}")
+        if cfg.get("spot"):
+            cmd.append("--instance-market-options=MarketType=spot")
+        return cmd
+
+    def list_command(self) -> list[str]:
+        cfg = self.config
+        return [
+            "aws", "ec2", "describe-instances",
+            f"--region={cfg['region']}",
+            "--filters",
+            f"Name=tag:ray-cluster-name,Values={self.cluster_name}",
+            "Name=instance-state-name,Values=pending,running",
+            "--output=json",
+        ]
+
+    def terminate_command(self, instance_id: str) -> list[str]:
+        cfg = self.config
+        return [
+            "aws", "ec2", "terminate-instances",
+            f"--region={cfg['region']}",
+            f"--instance-ids={instance_id}",
+            "--output=json",
+        ]
+
+    def _run(self, cmd: list[str]) -> str:
+        cmd = [self._aws()] + cmd[1:]
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(f"{' '.join(cmd)} failed: {out.stderr[-500:]}")
+        return out.stdout
+
+    # -- NodeProvider interface --
+
+    def non_terminated_nodes(self) -> list[str]:
+        """Pending/running instances of THIS cluster (tag filter). Keyed
+        by the Name tag (stable across the instance lifecycle and what
+        the GCS node label carries); instance ids live in _nodes."""
+        try:
+            listed = json.loads(self._run(self.list_command()) or "{}")
+        except RuntimeError:
+            return list(self._nodes)
+        names = []
+        for res in listed.get("Reservations", []):
+            for inst in res.get("Instances", []):
+                tags = {t["Key"]: t["Value"] for t in inst.get("Tags", [])}
+                name = tags.get("Name", "")
+                if not name.startswith(self.NAME_PREFIX):
+                    continue
+                names.append(name)
+                self._nodes.setdefault(name, {"type_name": "worker"})[
+                    "instance_id"] = inst.get("InstanceId")
+        return names
+
+    def node_resources(self, node_id: str) -> dict:
+        return dict(self.config.get("resources", {"CPU": 1.0}))
+
+    def node_type(self, node_id: str) -> str:
+        return self._nodes.get(node_id, {}).get("type_name", "worker")
+
+    def create_node(self, node_type: NodeType, count: int = 1) -> list[str]:
+        created = []
+        for _ in range(count):
+            name = f"{self.NAME_PREFIX}{node_type.name}-{uuid.uuid4().hex[:8]}"
+            out = json.loads(self._run(self.create_command(name, node_type))
+                             or "{}")
+            iid = None
+            for inst in out.get("Instances", []):
+                iid = inst.get("InstanceId")
+            self._nodes[name] = {"type_name": node_type.name,
+                                 "instance_id": iid}
+            created.append(name)
+        return created
+
+    def terminate_node(self, node_id: str) -> None:
+        info = self._nodes.get(node_id, {})
+        iid = info.get("instance_id")
+        try:
+            if iid:
+                self._run(self.terminate_command(iid))
+        finally:
+            self._nodes.pop(node_id, None)

@@ -72,4 +72,13 @@ def make_provider(cfg: dict, runtime_node=None) -> NodeProvider:
         from ray_tpu.autoscaler.gcp_tpu import GCPTPUNodeProvider
 
         return GCPTPUNodeProvider(cfg["provider"])
+    if ptype == "aws_ec2":
+        from ray_tpu.autoscaler.aws_ec2 import AWSEC2NodeProvider
+
+        # The YAML's top-level cluster_name IS the tag-isolation key;
+        # without it every cluster would filter as "default" and count
+        # sibling clusters' instances as its own capacity.
+        pcfg = dict(cfg["provider"])
+        pcfg.setdefault("cluster_name", cfg["cluster_name"])
+        return AWSEC2NodeProvider(pcfg)
     raise ValueError(f"unknown provider type {ptype!r}")

@@ -1,0 +1,221 @@
+"""Hermetic end-to-end test of the AWS EC2 provider reconcile loop:
+run-instances (tagged, user-data raylet bootstrap) -> running ->
+registered via the node-name label -> idle -> drain -> terminate —
+against a FAKE aws binary so the whole flow runs without AWS
+(reference model: reference aws node_provider + its fake-provider
+autoscaler tests; sibling of test_autoscaler_gcp_e2e)."""
+
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.aws_ec2 import AWSEC2NodeProvider
+from ray_tpu.autoscaler.node_provider import NodeType
+
+FAKE_AWS = '''#!{python}
+import json, os, sys
+STATE = {state!r}
+LOG = {log!r}
+def load():
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            return json.load(f)
+    return {{"instances": {{}}}}
+def save(s):
+    with open(STATE, "w") as f:
+        json.dump(s, f)
+args = sys.argv[1:]
+with open(LOG, "a") as f:
+    f.write(json.dumps(args) + chr(10))
+s = load()
+op = args[:2]
+if op == ["ec2", "run-instances"]:
+    name = None
+    cluster = None
+    user_data = None
+    for a in args:
+        if a.startswith("--tag-specifications=") and "Key=Name,Value=" in a:
+            name = a.split("Key=Name,Value=")[1].split("}}")[0]
+            if "Key=ray-cluster-name,Value=" in a:
+                cluster = a.split("Key=ray-cluster-name,Value=")[1] \
+                    .split("}}")[0]
+        if a.startswith("--user-data="):
+            user_data = a.split("=", 1)[1]
+    if user_data and not user_data.startswith("#!"):
+        # Model the real CLI contract: run-instances takes RAW user-data
+        # (it base64-encodes internally); a pre-encoded blob would reach
+        # cloud-init as garbage.
+        sys.stderr.write("fake aws: user-data is not a raw script")
+        sys.exit(3)
+    iid = "i-" + format(len(s["instances"]), "017x")
+    s["instances"][iid] = {{"name": name, "state": "pending",
+                            "cluster": cluster, "user_data": user_data}}
+    save(s)
+    print(json.dumps({{"Instances": [{{"InstanceId": iid}}]}})); sys.exit(0)
+if op == ["ec2", "describe-instances"]:
+    # Honor the tag + instance-state filters (the provider's whole
+    # cluster-isolation mechanism rides them).
+    want_cluster = None
+    want_states = None
+    for a in args:
+        if a.startswith("Name=tag:ray-cluster-name,Values="):
+            want_cluster = a.split("=", 2)[2]
+        if a.startswith("Name=instance-state-name,Values="):
+            want_states = a.split("=", 2)[2].split(",")
+    out = []
+    for iid, inst in s["instances"].items():
+        if want_cluster is not None and inst.get("cluster") != want_cluster:
+            continue
+        if want_states is not None and inst["state"] not in want_states:
+            continue
+        out.append({{"InstanceId": iid, "State":
+                     {{"Name": inst["state"]}},
+                     "Tags": [{{"Key": "Name",
+                                "Value": inst["name"]}},
+                              {{"Key": "ray-cluster-name",
+                                "Value": inst.get("cluster") or ""}}]}})
+    print(json.dumps({{"Reservations": [{{"Instances": out}}]}}))
+    sys.exit(0)
+if op == ["ec2", "terminate-instances"]:
+    for a in args:
+        if a.startswith("--instance-ids="):
+            s["instances"].pop(a.split("=", 1)[1], None)
+    save(s)
+    print(json.dumps({{}})); sys.exit(0)
+sys.stderr.write("fake aws: unknown op " + repr(op) + chr(10))
+sys.exit(2)
+'''
+
+
+@pytest.fixture()
+def fake_aws(tmp_path, monkeypatch):
+    state = tmp_path / "aws_state.json"
+    log = tmp_path / "aws_calls.log"
+    exe = tmp_path / "aws"
+    exe.write_text(FAKE_AWS.format(python=sys.executable,
+                                   state=str(state), log=str(log)))
+    exe.chmod(exe.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv("PATH", f"{tmp_path}{os.pathsep}"
+                               f"{os.environ.get('PATH', '')}")
+
+    class Ctl:
+        def calls(self):
+            if not log.exists():
+                return []
+            return [json.loads(line) for line in
+                    log.read_text().splitlines()]
+
+        def state(self):
+            return json.loads(state.read_text())
+
+        def set_state(self, s):
+            state.write_text(json.dumps(s))
+
+    return Ctl()
+
+
+def _provider():
+    return AWSEC2NodeProvider({
+        "region": "us-east-1", "instance_type": "m6i.4xlarge",
+        "ami": "ami-0abc", "cluster_name": "test",
+        "head_address": "10.0.0.1:6379",
+        "resources": {"CPU": 16.0},
+    })
+
+
+def test_provision_register_drain_terminate_cycle(fake_aws):
+    provider = _provider()
+    cpu_type = NodeType("worker", {"CPU": 16.0}, max_workers=4)
+    drained: list = []
+    status = {"nodes": [], "pending_demand": [{"CPU": 16.0}],
+              "pending_placement_groups": []}
+    scaler = StandardAutoscaler(
+        provider, [cpu_type], get_cluster_status=lambda: status,
+        drain_node=drained.append, idle_timeout_s=0.0)
+
+    # Tick 1: unmet CPU demand -> run-instances with Name tag + raylet
+    # bootstrap user-data.
+    scaler.update()
+    st = fake_aws.state()
+    assert len(st["instances"]) == 1
+    (iid,) = st["instances"]
+    name = st["instances"][iid]["name"]
+    assert name.startswith("ray-tpu-")
+    runs = [c for c in fake_aws.calls() if c[:2] == ["ec2", "run-instances"]]
+    ud = next(a for a in runs[0] if a.startswith("--user-data="))
+    script = ud.split("=", 1)[1]
+    assert script.startswith("#!"), "user-data must be the RAW script"
+    assert f"RAY_TPU_NODE_NAME={name}" in script
+    assert "--address=10.0.0.1:6379" in script
+
+    # Tick 2: instance pending, not yet registered -> counts as upcoming
+    # capacity, NO duplicate launch.
+    scaler.update()
+    assert len(fake_aws.state()["instances"]) == 1
+
+    # Boots, registers with the GCS carrying the node-name label; demand
+    # clears -> idle -> drain -> terminate through the instance id.
+    st = fake_aws.state()
+    st["instances"][iid]["state"] = "running"
+    fake_aws.set_state(st)
+    status["pending_demand"] = []
+    status["nodes"] = [
+        {"node_id": "gcsnode0", "alive": True,
+         "available_resources": {"CPU": 16.0},
+         "total_resources": {"CPU": 16.0},
+         "labels": {"node-name": name}}]
+    scaler.update()  # marks idle
+    scaler.update()  # terminates after the (0s) timeout
+    assert drained == ["gcsnode0"]
+    assert fake_aws.state()["instances"] == {}
+    assert provider.non_terminated_nodes() == []
+    terms = [c for c in fake_aws.calls()
+             if c[:2] == ["ec2", "terminate-instances"]]
+    assert len(terms) == 1 and f"--instance-ids={iid}" in terms[0]
+
+
+def test_busy_instance_not_terminated(fake_aws):
+    provider = _provider()
+    cpu_type = NodeType("worker", {"CPU": 16.0}, max_workers=4)
+    status = {"nodes": [], "pending_demand": [{"CPU": 16.0}],
+              "pending_placement_groups": []}
+    scaler = StandardAutoscaler(
+        provider, [cpu_type], get_cluster_status=lambda: status,
+        idle_timeout_s=0.0)
+    scaler.update()
+    st = fake_aws.state()
+    (iid,) = st["instances"]
+    name = st["instances"][iid]["name"]
+    st["instances"][iid]["state"] = "running"
+    fake_aws.set_state(st)
+    # Busy (resources in use): must NOT be terminated with zero demand.
+    status["pending_demand"] = []
+    status["nodes"] = [
+        {"node_id": "a", "alive": True,
+         "available_resources": {"CPU": 0.0},
+         "total_resources": {"CPU": 16.0},
+         "labels": {"node-name": name}}]
+    scaler.update()
+    scaler.update()
+    assert iid in fake_aws.state()["instances"]
+
+
+def test_spot_and_networking_flags():
+    p = AWSEC2NodeProvider({
+        "region": "us-east-1", "instance_type": "m6i.xlarge",
+        "ami": "ami-1", "spot": True, "subnet_id": "subnet-9",
+        "security_group_ids": ["sg-1", "sg-2"], "key_name": "k",
+        "iam_instance_profile": "prof"})
+    cmd = p.create_command("ray-tpu-worker-x", NodeType("worker", {"CPU": 4}))
+    assert "--instance-market-options=MarketType=spot" in cmd
+    assert "--subnet-id=subnet-9" in cmd
+    # Security groups must be SEPARATE argv tokens (a joined value is one
+    # malformed group id to the API).
+    i = cmd.index("--security-group-ids")
+    assert cmd[i + 1:i + 3] == ["sg-1", "sg-2"]
+    assert "--key-name=k" in cmd
+    assert "--iam-instance-profile=Name=prof" in cmd
