@@ -18,8 +18,6 @@ from __future__ import annotations
 
 import json
 import logging
-import shutil
-import subprocess
 import uuid
 
 from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
@@ -39,21 +37,17 @@ class AWSEC2NodeProvider(NodeProvider):
 
     def __init__(self, config: dict):
         super().__init__(config)
-        for key in ("region", "instance_type", "ami"):
+        # head_address is required: without it user-data would run
+        # `start --address=` (rejected by scripts.py) and the instance
+        # would sit forever as phantom "upcoming" capacity absorbing
+        # demand the cluster never serves.
+        for key in ("region", "instance_type", "ami", "head_address"):
             if key not in config:
                 raise ValueError(f"AWSEC2NodeProvider config needs {key!r}")
         self.cluster_name = config.get("cluster_name", "default")
         self._nodes: dict[str, dict] = {}
 
     # -- aws CLI plumbing (separated so tests can assert the exact argv) --
-
-    def _aws(self) -> str:
-        path = shutil.which("aws")
-        if path is None:
-            raise RuntimeError(
-                "aws CLI not found; AWSEC2NodeProvider requires the AWS "
-                "CLI on the head node")
-        return path
 
     def _user_data(self, name: str) -> str:
         """Cloud-init script: starts a raylet pointed at the head on
@@ -120,18 +114,28 @@ class AWSEC2NodeProvider(NodeProvider):
         ]
 
     def _run(self, cmd: list[str]) -> str:
-        cmd = [self._aws()] + cmd[1:]
-        out = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
-        if out.returncode != 0:
-            raise RuntimeError(f"{' '.join(cmd)} failed: {out.stderr[-500:]}")
-        return out.stdout
+        from ray_tpu.autoscaler.node_provider import cli_run
+
+        return cli_run("aws", cmd)
+
+    def _type_from_name(self, name: str) -> str:
+        """Recover the node-type from the Name tag (f"{PREFIX}{type}-
+        {hex8}") — after a head restart _nodes is empty, and a wrong
+        type would exclude the node from upcoming-capacity counting AND
+        idle termination (it would run and bill forever)."""
+        body = name[len(self.NAME_PREFIX):]
+        return body.rsplit("-", 1)[0] if "-" in body else body
 
     # -- NodeProvider interface --
 
     def non_terminated_nodes(self) -> list[str]:
         """Pending/running instances of THIS cluster (tag filter). Keyed
         by the Name tag (stable across the instance lifecycle and what
-        the GCS node label carries); instance ids live in _nodes."""
+        the GCS node label carries); instance ids live in _nodes. The
+        result is the UNION of described and locally-known nodes:
+        describe-instances is eventually consistent, and a just-launched
+        instance missing from one listing must not trigger a duplicate
+        launch."""
         try:
             listed = json.loads(self._run(self.list_command()) or "{}")
         except RuntimeError:
@@ -144,8 +148,23 @@ class AWSEC2NodeProvider(NodeProvider):
                 if not name.startswith(self.NAME_PREFIX):
                     continue
                 names.append(name)
-                self._nodes.setdefault(name, {"type_name": "worker"})[
+                self._nodes.setdefault(
+                    name, {"type_name": self._type_from_name(name)})[
                     "instance_id"] = inst.get("InstanceId")
+        # Locally-known nodes missing from the listing stay for a few
+        # ticks (consistency window) but are evicted after 3 consecutive
+        # misses — a spot reclaim or external terminate must not leave
+        # phantom capacity absorbing demand forever.
+        for name in list(self._nodes):
+            if name in names:
+                self._nodes[name].pop("misses", None)
+                continue
+            misses = self._nodes[name].get("misses", 0) + 1
+            if misses >= 3:
+                self._nodes.pop(name)
+            else:
+                self._nodes[name]["misses"] = misses
+                names.append(name)
         return names
 
     def node_resources(self, node_id: str) -> dict:

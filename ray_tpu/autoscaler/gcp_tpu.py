@@ -17,8 +17,6 @@ from __future__ import annotations
 
 import json
 import logging
-import shutil
-import subprocess
 import uuid
 
 from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
@@ -41,14 +39,6 @@ class GCPTPUNodeProvider(NodeProvider):
         self._nodes: dict[str, dict] = {}
 
     # -- gcloud plumbing (separated so tests can assert the exact argv) --
-
-    def _gcloud(self) -> str:
-        path = shutil.which("gcloud")
-        if path is None:
-            raise RuntimeError(
-                "gcloud CLI not found; GCPTPUNodeProvider requires the "
-                "Google Cloud SDK on the head node")
-        return path
 
     def create_command(self, name: str, node_type: NodeType) -> list[str]:
         cfg = self.config
@@ -121,11 +111,9 @@ class GCPTPUNodeProvider(NodeProvider):
         ]
 
     def _run(self, cmd: list[str]) -> str:
-        cmd = [self._gcloud()] + cmd[1:]
-        out = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
-        if out.returncode != 0:
-            raise RuntimeError(f"{' '.join(cmd)} failed: {out.stderr[-500:]}")
-        return out.stdout
+        from ray_tpu.autoscaler.node_provider import cli_run
+
+        return cli_run("gcloud", cmd)
 
     # -- NodeProvider interface --
 

@@ -31,6 +31,26 @@ class NodeType:
     hosts_per_slice: int = 1
 
 
+def cli_run(binary: str, cmd: list[str], timeout: float = 600) -> str:
+    """Shared cloud-CLI runner for shell-out providers (gcloud, aws):
+    which-lookup, bounded run, stderr-tail error. cmd[0] is replaced
+    with the resolved binary path."""
+    import shutil
+    import subprocess
+
+    path = shutil.which(binary)
+    if path is None:
+        raise RuntimeError(
+            f"{binary} CLI not found; this provider requires it on the "
+            "head node")
+    cmd = [path] + cmd[1:]
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(f"{' '.join(cmd)} failed: {out.stderr[-500:]}")
+    return out.stdout
+
+
 class NodeProvider:
     """Subclass per cloud. All methods are called from the autoscaler loop."""
 

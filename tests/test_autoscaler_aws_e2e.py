@@ -207,7 +207,8 @@ def test_busy_instance_not_terminated(fake_aws):
 def test_spot_and_networking_flags():
     p = AWSEC2NodeProvider({
         "region": "us-east-1", "instance_type": "m6i.xlarge",
-        "ami": "ami-1", "spot": True, "subnet_id": "subnet-9",
+        "ami": "ami-1", "head_address": "10.0.0.1:6379", "spot": True,
+        "subnet_id": "subnet-9",
         "security_group_ids": ["sg-1", "sg-2"], "key_name": "k",
         "iam_instance_profile": "prof"})
     cmd = p.create_command("ray-tpu-worker-x", NodeType("worker", {"CPU": 4}))
