@@ -61,43 +61,7 @@ def ray_dask_get(dsk: dict, keys, **kwargs) -> Any:
     from ray_tpu._private import serialization
 
     refs: dict[Any, Any] = {}
-
-    def key_deps(node, path=(), out=None):
-        """(path, key) pairs for every graph-key reference inside a task
-        spec (dask nests keys arbitrarily deep in args)."""
-        if out is None:
-            out = []
-        if _istask(node):
-            for i, a in enumerate(node[1:], 1):
-                key_deps(a, path + (i,), out)
-        elif isinstance(node, (list, tuple)):
-            for i, a in enumerate(node):
-                key_deps(a, path + (i,), out)
-        else:
-            try:
-                if node in dsk and path:
-                    out.append((path, node))
-            except TypeError:
-                pass  # unhashable literal
-        return out
-
-    def materialize(key):
-        if key in refs:
-            return refs[key]
-        node = dsk[key]
-        if _istask(node):
-            deps = key_deps(node)
-            positions = {path: i for i, (path, _) in enumerate(deps)}
-            dep_refs = [materialize(k) for _, k in deps]
-            # cloudpickle: dask graphs carry closures/lambdas routinely.
-            blob = serialization.dumps_func((node, positions))
-            refs[key] = _dask_task.remote(blob, *dep_refs)
-        elif isinstance(node, (str, bytes, int, float, frozenset, tuple)) \
-                and _hashable(node) and node in dsk and node != key:
-            refs[key] = materialize(node)  # alias: key -> key
-        else:
-            refs[key] = ray_tpu.put(node)  # literal
-        return refs[key]
+    get_timeout = kwargs.get("get_timeout")
 
     def _hashable(x):
         try:
@@ -106,14 +70,96 @@ def ray_dask_get(dsk: dict, keys, **kwargs) -> Any:
         except TypeError:
             return False
 
-    def resolve(keyspec):
-        # dask's get contract: keys may be nested lists mirroring the
-        # desired output structure.
-        if isinstance(keyspec, list):
-            return [resolve(k) for k in keyspec]
-        return ray_tpu.get(materialize(keyspec), timeout=600)
+    def is_key(x) -> bool:
+        # Dask's rule (dask.core ishashable + `in dsk`), checked BEFORE
+        # any recursion: tuple keys like ("x", 0) — the key format of
+        # every dask.array/dataframe/bag graph — are key references,
+        # not literal tuples to recurse into.
+        return _hashable(x) and x in dsk
 
-    return resolve(keys)
+    def key_deps(node, path=(), out=None):
+        """(path, key) pairs for every graph-key reference inside a
+        value — task args, nested containers, or a bare list of keys."""
+        if out is None:
+            out = []
+        if path and is_key(node):
+            out.append((path, node))
+        elif _istask(node):
+            for i, a in enumerate(node[1:], 1):
+                key_deps(a, path + (i,), out)
+        elif isinstance(node, (list, tuple)):
+            for i, a in enumerate(node):
+                key_deps(a, path + (i,), out)
+        return out
+
+    def materialize(root):
+        """Iterative dependency walk (a deep linear chain must not hit
+        the recursion limit) with cycle detection."""
+        if root in refs:
+            return refs[root]
+        stack = [root]
+        onstack = {root}
+        while stack:
+            k = stack[-1]
+            if k in refs:
+                stack.pop()
+                onstack.discard(k)
+                continue
+            node = dsk[k]
+            alias = is_key(node) and node != k
+            dep_keys = [node] if alias else [d for _, d in key_deps(node)]
+            # ONE unresolved dep at a time: the stack then IS the DFS
+            # path, so the onstack check flags true cycles only (pushing
+            # all deps at once made queued SIBLINGS look like ancestors).
+            unresolved = next((d for d in dep_keys if d not in refs), None)
+            if unresolved is not None:
+                if unresolved in onstack:
+                    raise ValueError(
+                        f"cycle in dask graph involving {unresolved!r}")
+                stack.append(unresolved)
+                onstack.add(unresolved)
+                continue
+            if alias:
+                refs[k] = refs[node]
+            else:
+                deps = key_deps(node)
+                if deps or _istask(node):
+                    # Anything with embedded keys (task tuples AND bare
+                    # containers of keys) executes remotely so the
+                    # substitution happens where the values are.
+                    positions = {path: i
+                                 for i, (path, _) in enumerate(deps)}
+                    # cloudpickle: dask graphs carry closures/lambdas.
+                    blob = serialization.dumps_func((node, positions))
+                    refs[k] = _dask_task.remote(
+                        blob, *[refs[d] for _, d in deps])
+                else:
+                    refs[k] = ray_tpu.put(node)  # literal
+            stack.pop()
+            onstack.discard(k)
+        return refs[root]
+
+    # Submit EVERYTHING first, then one batched get: independent
+    # subgraphs must run concurrently, not serialize behind per-key
+    # driver round-trips. `keys` may be nested lists mirroring the
+    # desired output structure (dask's get contract).
+    flat: list = []
+
+    def build(keyspec):
+        if isinstance(keyspec, list):
+            return [build(k) for k in keyspec]
+        flat.append(materialize(keyspec))
+        return len(flat) - 1
+
+    shape = build(keys)
+    values = ray_tpu.get(flat, timeout=get_timeout) if flat else []
+
+    def fill(sh):
+        if isinstance(sh, list):
+            return [fill(x) for x in sh]
+        return values[sh]
+
+    return fill(shape)
 
 
 def enable_dask_on_ray():

@@ -85,3 +85,42 @@ def test_shared_dep_computed_once(ray_start_regular):
     assert ray_dask_get(dsk, "out") == 17
     # The shared node ran ONCE (memoized ref), not once per consumer.
     assert ray_tpu.get(counter.total.remote()) == 1
+
+
+def test_tuple_keys_collection_style(ray_start_regular):
+    """Tuple keys are THE key format of dask.array/dataframe graphs —
+    they are key references, never literal tuples (review-reproduced
+    failure)."""
+    dsk = {
+        ("x", 0): 5,
+        ("x", 1): 7,
+        "sum": (operator.add, ("x", 0), ("x", 1)),
+        "nested": (sum, [("x", 0), ("x", 1), "sum"]),
+    }
+    assert ray_dask_get(dsk, "sum") == 12
+    assert ray_dask_get(dsk, "nested") == 24
+    assert ray_dask_get(dsk, [("x", 0), "sum"]) == [5, 12]
+
+
+def test_list_of_keys_value(ray_start_regular):
+    """A bare list-of-keys VALUE substitutes its keys (dask
+    _execute_task semantics; the common final aggregation node)."""
+    dsk = {"x": 1, "y": 2, "w": ["x", "y"]}
+    assert ray_dask_get(dsk, "w") == [1, 2]
+
+
+def test_deep_chain_no_recursion_limit(ray_start_regular):
+    n = 2000
+    dsk = {"k0": 0}
+    for i in range(1, n):
+        dsk[f"k{i}"] = (operator.add, f"k{i-1}", 1)
+    # Far beyond the default recursion limit if walked recursively.
+    assert ray_dask_get(dsk, f"k{n-1}") == n - 1
+
+
+def test_cycle_detected(ray_start_regular):
+    import pytest
+
+    dsk = {"a": (operator.add, "b", 1), "b": (operator.add, "a", 1)}
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get(dsk, "a")
