@@ -62,11 +62,16 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
 
     # Carries vary over the pipeline axis (ppermute) AND any axes the input
     # varies over (e.g. dp-sharded batch): adding 0·x unions the two sets.
+    def _vary(val):
+        # jax>=0.9 renames pvary to pcast(..., to='varying'); support both.
+        if hasattr(lax, "pcast"):
+            return lax.pcast(val, (axis,), to="varying")
+        return lax.pvary(val, (axis,))
+
     zero_like_x = jnp.zeros(mb_shape, x_microbatches.dtype) + \
         x_microbatches[0] * 0
-    state0 = lax.pvary(zero_like_x, (axis,))
-    outputs0 = lax.pvary(jnp.zeros_like(x_microbatches) + x_microbatches * 0,
-                         (axis,))
+    state0 = _vary(zero_like_x)
+    outputs0 = _vary(jnp.zeros_like(x_microbatches) + x_microbatches * 0)
     _, outputs = lax.fori_loop(0, total_steps, body, (state0, outputs0))
     # Results are only valid on the last stage; broadcast so every stage
     # returns them (psum of a one-hot-masked value = ICI broadcast).
