@@ -149,3 +149,41 @@ def test_write_through_survives_immediate_kill9(ft_cluster):
             time.sleep(0.5)
     assert kv_ok, "acknowledged KVPut lost across immediate kill -9"
     assert actor_ok, "acknowledged actor registration lost across kill -9"
+
+
+def test_pg_ready_promise_survives_gcs_restart(ft_cluster):
+    """pg.ready() is a GCS-pubsub-backed promise (r5): a CREATED that
+    lands while the driver's GCS conn is down must still resolve — the
+    reconnect handshake re-queries every armed waiter (worker.py
+    _reconnect_gcs). Sequence: PG stays PENDING (infeasible), ready()
+    arms, GCS dies and restarts, THEN capacity arrives and the PG
+    creates — the promise must fire, not hang."""
+    import threading
+
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    # Infeasible until a bigger node joins: 16 CPUs on a 4-CPU head.
+    pg = placement_group([{"CPU": 16.0}])
+    ref = pg.ready()
+
+    got = []
+    waiter = threading.Thread(
+        target=lambda: got.append(ray_tpu.get(ref, timeout=120)),
+        daemon=True)
+    waiter.start()
+    time.sleep(1.0)
+    assert not got, "PG resolved before capacity existed"
+
+    node = ft_cluster._node
+    node.kill_gcs()
+    time.sleep(0.5)
+    node.restart_gcs()
+
+    # New capacity arrives AFTER the restart; the PG schedules and the
+    # promise must resolve through the re-subscribed channel (or the
+    # reconnect re-query), not hang forever.
+    ft_cluster.add_node(num_cpus=16)
+    waiter.join(timeout=90)
+    assert got == [True], f"pg.ready() promise lost across GCS restart: {got}"
+    remove_placement_group(pg)
