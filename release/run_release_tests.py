@@ -95,7 +95,12 @@ def many_placement_groups(num_pgs: int) -> dict:
                                               remove_placement_group)
 
     t0 = time.perf_counter()
-    pgs = [placement_group([{"CPU": 0.01}]) for _ in range(num_pgs)]
+    # 0.001-CPU bundles: the row measures PG MACHINERY throughput (2PC
+    # reserve/commit/ready), and all num_pgs bundles must be able to
+    # hold reservations SIMULTANEOUSLY on the 8-CPU harness node (1000
+    # x 0.01 would exceed the pool and the tail would wait forever —
+    # capacity, not machinery).
+    pgs = [placement_group([{"CPU": 0.001}]) for _ in range(num_pgs)]
     ray_tpu.get([pg.ready() for pg in pgs], timeout=600)
     dt = time.perf_counter() - t0
     for pg in pgs:
