@@ -50,3 +50,36 @@ def test_nested_fanout_survives_lease_retry_storm(contended_cluster):
     # leases that time out and must be re-requested indefinitely.
     total = ray_tpu.get(spawn.remote(4, 3), timeout=240)
     assert total == 4 ** 3
+
+
+def test_persistent_spawn_failure_fails_queue_with_cause(monkeypatch):
+    """Worker-spawn failures are BUDGETED (5 consecutive -> fail the
+    queued tasks with the cause) instead of retrying forever: a broken
+    worker environment must surface as an error, not an infinite hang
+    (r5 review finding on the deadlock fix). Forced here by a startup
+    timeout no real spawn can meet."""
+    import pytest
+
+    import ray_tpu.exceptions as exc
+
+    # BEFORE Config(): the driver's config (env-overridden here) is what
+    # the GCS serves to the raylet at boot — every spawn's registration
+    # window then expires instantly and each lease grant reports
+    # spawn_failure.
+    monkeypatch.setenv("RAY_TPU_WORKER_STARTUP_TIMEOUT_S", "0.05")
+    cfg = Config()
+    cfg.health_check_period_s = 0.2
+    cfg.worker_lease_timeout_s = 5.0
+    cfg.use_worker_zygote = False
+    cfg.prestart_workers = 0
+    ray_tpu.init(num_cpus=2, config=cfg)
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        with pytest.raises(exc.RayTpuError, match="unschedulable|startup"):
+            ray_tpu.get(f.remote(), timeout=120)
+    finally:
+        monkeypatch.delenv("RAY_TPU_WORKER_STARTUP_TIMEOUT_S")
+        ray_tpu.shutdown()
