@@ -209,6 +209,21 @@ def cmd_summary(args):
     return 0
 
 
+def cmd_drain(args):
+    """`ray_tpu drain <node_id>` — stop new leases on a node and let
+    running work finish (parity: reference `ray drain-node`; same
+    DrainNode RPC the autoscaler issues before terminating)."""
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu._private.api_internal import get_core_worker
+
+    cw = get_core_worker()
+    resp = cw._run(cw.gcs.call("DrainNode", {"node_id": args.node_id},
+                               timeout=60))
+    print(json.dumps(resp if isinstance(resp, dict) else {"ok": resp}))
+    _shutdown_if_owned(ray_tpu)
+    return 0
+
+
 def cmd_memory(args):
     """`ray_tpu memory` — cluster object-memory report (parity:
     reference `ray memory` / memory_utils.py: per-node store usage +
@@ -349,6 +364,12 @@ def main():
                                        "(parity: `ray summary`)")
     p.add_argument("entity", choices=["tasks", "actors", "objects"])
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("drain", help="drain a node: stop new leases, let "
+                                     "running work finish (parity: "
+                                     "`ray drain-node`)")
+    p.add_argument("node_id")
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("memory", help="cluster object-memory report "
                                       "(parity: `ray memory`)")

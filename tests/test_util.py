@@ -375,3 +375,33 @@ def test_memory_cli_report(ray_start_regular, capsys):
     assert "NODE" in out and "TOTAL" in out
     assert "owned by this driver" in out
     assert ref.hex()[:12] in out
+
+
+def test_drain_cli(ray_start_cluster_head, capsys):
+    """`ray_tpu drain <node>` issues the same DrainNode the autoscaler
+    uses: the node stops taking new leases (parity: `ray drain-node`)."""
+    from ray_tpu import scripts
+    from ray_tpu.util import state
+
+    cluster = ray_start_cluster_head
+    victim = cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(2)
+
+    class _A:
+        node_id = victim.node_id
+        address = None
+
+    rc = scripts.cmd_drain(_A())
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ok" in out or "drain" in out.lower()
+    # The drained node is excluded from new placement: spread tasks all
+    # land on the head.
+    @ray_tpu.remote
+    def where():
+        import ray_tpu as rt
+        from ray_tpu._private.api_internal import get_core_worker
+        return get_core_worker().node_id
+
+    nodes = {ray_tpu.get(where.remote(), timeout=60) for _ in range(4)}
+    assert victim.node_id not in nodes
