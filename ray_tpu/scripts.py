@@ -133,6 +133,28 @@ def cmd_serve(args):
     elif args.serve_cmd == "shutdown":
         serve.shutdown()
         print("serve shut down")
+    elif args.serve_cmd == "run":
+        # `serve run module:attr` (reference: the serve CLI's main dev
+        # entry) — import the deployment (or bound app), deploy, block.
+        import importlib
+
+        mod_name, _, attr = args.target.partition(":")
+        if not attr:
+            print("target must be module:deployment", file=sys.stderr)
+            return 1
+        sys.path.insert(0, os.getcwd())
+        target = getattr(importlib.import_module(mod_name), attr)
+        handle = serve.run(target)
+        st = serve.status()
+        print(json.dumps({"running": sorted(st.get("deployments", st))},
+                         default=str), flush=True)
+        if not getattr(args, "non_blocking", False):
+            try:
+                signal.pause()
+            except KeyboardInterrupt:
+                pass
+            serve.shutdown()
+        del handle
     _shutdown_if_owned(ray_tpu)
     return 0
 
@@ -389,6 +411,11 @@ def main():
     ps.add_argument("config", help="JSON config file (ServeDeploy schema)")
     ssub.add_parser("status")
     ssub.add_parser("shutdown")
+    pr = ssub.add_parser("run", help="import module:deployment, deploy, "
+                                     "block (reference: `serve run`)")
+    pr.add_argument("target")
+    pr.add_argument("--non-blocking", action="store_true",
+                    dest="non_blocking")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("job", help="submit and manage jobs")

@@ -116,3 +116,40 @@ def test_start_proxies_idempotent(ray_start_regular):
         assert first == second  # same actor, same port
     finally:
         serve.shutdown()
+
+
+def test_serve_run_cli(ray_start_regular, tmp_path, capsys):
+    """`ray_tpu serve run module:deployment` — import, deploy, report
+    (reference: the serve CLI's main dev entry), non-blocking mode."""
+    import os
+    import sys
+
+    from ray_tpu import scripts, serve
+
+    (tmp_path / "my_serve_app.py").write_text(
+        "import ray_tpu.serve as serve\n"
+        "@serve.deployment\n"
+        "class Hello:\n"
+        "    def __call__(self, name):\n"
+        "        return f'hi {name}'\n"
+        "app = Hello.bind()\n")
+    old_cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        class _A:
+            serve_cmd = "run"
+            target = "my_serve_app:app"
+            non_blocking = True
+            address = None
+
+        rc = scripts.cmd_serve(_A())
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "running" in out
+        h = serve.get_deployment_handle("Hello")
+        assert h.remote("x").result() == "hi x"
+    finally:
+        os.chdir(old_cwd)
+        sys.path.remove(str(tmp_path)) if str(tmp_path) in sys.path else None
+        sys.modules.pop("my_serve_app", None)
+        serve.shutdown()
