@@ -120,6 +120,13 @@ class FastRpcServer:
         self.connections: set[FastConn] = set()
         self.port: int | None = None
         self.host: str | None = None
+        # Optional in-pump native service (daemon protocol logic in C++,
+        # e.g. the GCS KV/pubsub handlers — src/gcs_service.cc): a
+        # callable(pump) -> service|None installed by the daemon BEFORE
+        # start(); it runs between pump creation and listen() so the
+        # loop thread sees the hook before any frame arrives.
+        self.service_factory = None
+        self.native_service = None
         self._pump = None
         self._conns: dict[int, FastConn] = {}
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -130,6 +137,8 @@ class FastRpcServer:
         from ray_tpu._private import native_fastpath
 
         pump = native_fastpath.FastPump()
+        if self.service_factory is not None:
+            self.native_service = self.service_factory(pump)
         # port=0 picks an ephemeral port; a fixed port (GCS
         # restart-on-same-port) binds with SO_REUSEADDR.
         self.port = pump.listen(host, port)
@@ -272,6 +281,12 @@ class FastRpcServer:
         if self._pump is not None:
             self._pump.close()
             self._pump = None
+        # Destroy the native service only after the pump loop thread is
+        # joined (close() above) — it must never run the frame hook
+        # against a freed service.
+        if self.native_service is not None:
+            self.native_service.close()
+            self.native_service = None
 
 
 def make_server(handlers: dict[str, Callable], name: str = "server",

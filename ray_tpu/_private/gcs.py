@@ -101,6 +101,16 @@ class GcsServer:
         from ray_tpu._private.fast_rpc import make_server
 
         self._server = make_server(self._handlers(), name="gcs")
+        # Native in-pump protocol service (src/gcs_service.cc): when the
+        # daemon runs on the fastpath pump, the KV table and pubsub
+        # handlers execute entirely in C++ on the loop thread (parse →
+        # mutate → WAL write-through → reply) and their frames never
+        # reach Python. Installed by _native_service_factory at server
+        # start; None on the asyncio fallback.
+        self._native_svc = None
+        self._pending_native_kv: list = []   # (key_hex, blob) restore rows
+        self._native_appends_seen = 0
+        self._native_walfails_seen = 0
         self._health_task: asyncio.Task | None = None
         self._actor_seq = 0
         self.start_time = time.time()
@@ -204,6 +214,10 @@ class GcsServer:
 
             events.configure(os.path.dirname(self.persistence_path), "gcs")
             events.record("INFO", "gcs", "control plane started")
+        from ray_tpu._private.fast_rpc import FastRpcServer
+
+        if isinstance(self._server, FastRpcServer):
+            self._server.service_factory = self._native_service_factory
         addr = await self._server.start(host, port)
         self._health_task = asyncio.create_task(self._health_check_loop())
         if self.persistence_path:
@@ -212,11 +226,56 @@ class GcsServer:
         logger.info("GCS listening on %s:%s", *addr)
         return addr
 
+    def _native_service_factory(self, pump):
+        """Install the native KV/pubsub service into the daemon pump
+        (called by FastRpcServer.start between pump creation and
+        listen). Any failure falls back to the Python handlers,
+        re-homing kv rows that _load_state stashed for the native
+        side."""
+        from ray_tpu._private import native_gcs_service
+
+        if native_gcs_service.available():
+            try:
+                svc = native_gcs_service.GcsNativeService(pump, self._store)
+                for key_hex, blob in self._pending_native_kv:
+                    ns, k = rpc.unpack(bytes.fromhex(key_hex))
+                    svc.kv_load(ns, rpc.pack(k), blob)
+                # Hook the pump only once every restored row loaded — a
+                # partially-loaded service must never answer frames.
+                svc.install()
+                self._pending_native_kv = []
+                self._native_svc = svc
+                logger.info(
+                    "native GCS service active (KV + pubsub in-pump)")
+                return svc
+            except Exception:
+                logger.exception("native GCS service failed to install; "
+                                 "Python handles KV/pubsub")
+        # Fallback: re-home any rows _load_state stashed for the native
+        # side into the Python tables.
+        for key_hex, blob in self._pending_native_kv:
+            self._restore_kv_row(key_hex, blob)
+        self._pending_native_kv = []
+        return None
+
+    def _restore_kv_row(self, key_hex: str, blob: bytes) -> None:
+        """Restore one persisted kv row into the Python tables."""
+        ns, k = rpc.unpack(bytes.fromhex(key_hex))
+        k = k if isinstance(k, bytes) else k.encode()
+        self.kv[ns][k] = rpc.unpack(blob)
+        self._row_hashes[("kv", key_hex)] = hash(blob)
+        self._row_sizes[("kv", key_hex)] = len(blob)
+
     async def stop(self):
+        self._native_svc = None  # server stop destroys the service
         if self._health_task:
             self._health_task.cancel()
         if getattr(self, "_persist_task", None):
             self._persist_task.cancel()
+        # Server (and its pump loop thread, which may be running native
+        # KV write-throughs) must be fully stopped BEFORE the store is
+        # flushed and closed.
+        await self._server.stop()
         if self._store is not None:
             # Flush acknowledged mutations from the last <0.5s window,
             # then compact so restart replays a snapshot, not a long WAL.
@@ -230,7 +289,6 @@ class GcsServer:
                 self.mark_dirty(tables)
                 logger.exception("final GCS persistence flush failed")
             self._store.close()
-        await self._server.stop()
 
     # ---------- persistence ----------
     # Tables persist as (namespace, key) -> msgpack'd row in the native
@@ -405,12 +463,16 @@ class GcsServer:
                     "store does not migrate it — starting fresh",
                     self.persistence_path)
             return  # first start of this session
+        native_kv = self._native_kv_planned()
         for key_hex, blob in self._store.scan("kv"):
-            ns, k = rpc.unpack(bytes.fromhex(key_hex))
-            k = k if isinstance(k, bytes) else k.encode()
-            self.kv[ns][k] = rpc.unpack(blob)
-            self._row_hashes[("kv", key_hex)] = hash(blob)
-            self._row_sizes[("kv", key_hex)] = len(blob)
+            if native_kv:
+                # The native service will own these rows (it re-writes
+                # them through the WAL itself); keeping them out of
+                # _row_hashes keeps the Python flush sweep away from
+                # the kv namespace.
+                self._pending_native_kv.append((key_hex, blob))
+            else:
+                self._restore_kv_row(key_hex, blob)
             self._persisted_bytes += len(blob)
         for key_hex, blob in self._store.scan("actors"):
             a = rpc.unpack(blob)
@@ -495,6 +557,19 @@ class GcsServer:
     async def _persist_loop(self):
         while True:
             await asyncio.sleep(0.5)
+            if self._native_svc is not None:
+                # Native KV mutations append to the WAL on the pump
+                # thread; fold them into the same batched-fdatasync
+                # window, and surface disk-full failures.
+                _, appends, fails = self._native_svc.counters()
+                if appends != self._native_appends_seen:
+                    self._native_appends_seen = appends
+                    self._needs_sync = True
+                if fails != self._native_walfails_seen:
+                    self._native_walfails_seen = fails
+                    logger.error(
+                        "native GCS service: %d WAL appends failed "
+                        "(disk full?)", fails)
             if self._needs_sync:
                 # Batched fdatasync: write-through already made every
                 # acknowledged mutation process-crash durable; this
@@ -503,6 +578,13 @@ class GcsServer:
                 self._needs_sync = False
                 await asyncio.to_thread(self._store.sync)
             if not self._dirty:
+                # Compaction must not be gated on Python-side dirtiness:
+                # a kv-churn workload handled entirely by the native
+                # service never dirties a Python table, yet its WAL
+                # appends still need folding into the snapshot.
+                if self._store.wal_bytes() > max(
+                        1 << 20, 4 * self._persisted_bytes):
+                    await asyncio.to_thread(self._store.compact)
                 continue
             tables, self._dirty = self._dirty, set()
             try:
@@ -537,7 +619,24 @@ class GcsServer:
         await self.publish(payload["channel"], payload["message"])
         return {"ok": True}
 
+    def _native_kv_planned(self) -> bool:
+        from ray_tpu._private.fast_rpc import FastRpcServer
+
+        if not isinstance(self._server, FastRpcServer):
+            return False
+        from ray_tpu._private import native_gcs_service
+
+        return native_gcs_service.available()
+
     async def publish(self, channel: str, message):
+        if self._native_svc is not None:
+            # One ctypes call, N native sends — and no packing at all
+            # when nobody subscribed (the common case for LOGS).
+            if self._native_svc.sub_count(channel):
+                self._native_svc.fanout(channel, rpc.pack(
+                    [rpc.MSG_NOTIFY, 0, "Publish",
+                     {"channel": channel, "message": message}]))
+            return
         dead = []
         for conn in list(self.subscribers.get(channel, ())):
             try:

@@ -15,16 +15,19 @@ _build_lock = threading.Lock()
 
 
 def ensure_built(src_name: "str | tuple[str, ...]", lib_name: str,
-                 extra_flags: tuple[str, ...] = ()) -> str:
+                 extra_flags: tuple[str, ...] = (),
+                 dep_names: tuple[str, ...] = ()) -> str:
     """Compile src/<src_name(s)> to _lib/<lib_name> if stale; returns the
-    lib path. Compiles to a private temp file then os.replace()s:
-    concurrent processes (GCS + raylet on a fresh checkout) must never
-    dlopen a half-written .so."""
+    lib path. `dep_names` are non-compiled dependencies (headers) that
+    participate in the staleness check only. Compiles to a private temp
+    file then os.replace()s: concurrent processes (GCS + raylet on a
+    fresh checkout) must never dlopen a half-written .so."""
     names = (src_name,) if isinstance(src_name, str) else tuple(src_name)
     srcs = [os.path.join(SRC_DIR, n) for n in names]
+    deps = srcs + [os.path.join(SRC_DIR, n) for n in dep_names]
     lib_path = os.path.join(LIB_DIR, lib_name)
     with _build_lock:
-        existing = [s for s in srcs if os.path.exists(s)]
+        existing = [s for s in deps if os.path.exists(s)]
         if os.path.exists(lib_path) and (
             not existing
             or os.path.getmtime(lib_path) >= max(os.path.getmtime(s)

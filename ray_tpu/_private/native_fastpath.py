@@ -64,6 +64,8 @@ def _load():
             ctypes.POINTER(ctypes.c_uint32), ctypes.c_int]
         lib.fpump_next.restype = ctypes.c_int
         lib.fpump_arm_eventfd.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.fpump_set_service.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_void_p, ctypes.c_void_p]
         lib.fpump_drain.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint32)]
@@ -115,6 +117,14 @@ class FastPump:
             self._lib.fpump_close_conn(self._h, conn_id)
 
     # ---- IO ----
+
+    def set_service(self, frame_fn_addr: int, close_fn_addr: int,
+                    ctx: int) -> None:
+        """Install an in-pump native service (loop-thread frame handler).
+        Must be called before listen()/connect() — the loop thread reads
+        the hook fields without a lock."""
+        self._lib.fpump_set_service(self._h, frame_fn_addr, close_fn_addr,
+                                    ctx)
 
     def send(self, conn_id: int, payload: bytes) -> bool:
         """Queue one frame body; returns False if the conn is gone."""

@@ -78,12 +78,27 @@ struct Conn {
   bool want_write = false;  // EPOLLOUT currently armed
 };
 
+// In-pump native service: a handler called ON THE LOOP THREAD for every
+// parsed frame before it is queued toward Python.  Returning nonzero
+// consumes the frame (the service answered it natively via fpump_send);
+// zero passes it through unchanged.  This is how daemon protocol logic
+// moves into C++ one method at a time (gcs_service.cc) while Python
+// keeps the rest — the reference's daemons dispatch protobuf handlers on
+// their C++ event loops the same way (gcs_server.h:79 service tables).
+typedef int (*service_frame_fn)(void* ctx, int64_t conn_id,
+                                const char* data, uint32_t len);
+typedef void (*service_close_fn)(void* ctx, int64_t conn_id);
+
 struct FPump {
   int epfd = -1;
   int wake_efd = -1;        // producers -> loop
   int recv_efd = -1;        // loop -> consumers (level-ish via counter)
   int listen_fd = -1;
   int listen_port = 0;
+  // Set before listen() (no lock: writes happen-before any frame).
+  service_frame_fn svc_frame = nullptr;
+  service_close_fn svc_close = nullptr;
+  void* svc_ctx = nullptr;
   std::thread loop_thread;
   std::atomic<bool> stopping{false};
 
@@ -141,6 +156,7 @@ void drop_conn(FPump* p, Conn* c) {
     std::lock_guard<std::mutex> g(p->conn_mu);
     p->conns.erase(c->id);
   }
+  if (p->svc_close) p->svc_close(p->svc_ctx, c->id);
   p->push_event(Event{c->id, EV_CLOSE, {}});
   delete c;
 }
@@ -154,7 +170,10 @@ bool parse_frames(FPump* p, Conn* c) {
                    ((uint8_t)b[off + 2] << 8) | (uint8_t)b[off + 3];
     if (len > kMaxFrame) return false;  // protocol violation: drop conn
     if (b.size() - off - 4 < len) break;
-    p->push_event(Event{c->id, EV_FRAME, b.substr(off + 4, len)});
+    if (p->svc_frame == nullptr ||
+        p->svc_frame(p->svc_ctx, c->id, b.data() + off + 4, len) == 0) {
+      p->push_event(Event{c->id, EV_FRAME, b.substr(off + 4, len)});
+    }
     off += 4 + (size_t)len;
   }
   if (off) c->rbuf.erase(0, off);
@@ -452,6 +471,15 @@ int fpump_send(FPump* p, int64_t conn_id, const void* buf, uint32_t len) {
 void fpump_inject(FPump* p, int64_t token, const void* buf, uint32_t len) {
   p->push_event(Event{token, EV_INJECT,
                       std::string((const char*)buf, buf ? len : 0)});
+}
+
+// Register the in-pump native service.  Must be called BEFORE
+// fpump_listen/fpump_connect so the loop thread's reads of the three
+// fields are ordered by the listen/connect synchronization.
+void fpump_set_service(FPump* p, void* frame_fn, void* close_fn, void* ctx) {
+  p->svc_frame = (service_frame_fn)frame_fn;
+  p->svc_close = (service_close_fn)close_fn;
+  p->svc_ctx = ctx;
 }
 
 int fpump_recv_eventfd(FPump* p) { return p->recv_efd; }

@@ -1,0 +1,301 @@
+"""Native in-pump GCS service (src/gcs_service.cc) e2e tests.
+
+The service executes the GCS's KV and pubsub protocol entirely on the
+fastpath pump's C++ loop thread; these tests drive it through REAL
+rpc.Connection clients against a REAL GcsServer, asserting (a) the
+semantics match the Python handlers exactly, (b) the frames were in
+fact handled natively (service counters), and (c) rows persist across
+restarts in both directions — native-written state restores under the
+Python fallback and vice versa (the row format is byte-compatible by
+construction: hex(msgpack([ns, key])) -> msgpack(value)).
+
+Reference analog: gcs_kv_manager.cc HandleInternalKVPut and
+pubsub_handler.cc dispatched on the gcs_server C++ event loop
+(gcs_server.h:79).
+"""
+
+import asyncio
+
+import pytest
+
+from ray_tpu._private import rpc
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.native_fastpath import available as pump_available
+from ray_tpu._private.native_gcs_service import available as svc_available
+
+pytestmark = pytest.mark.skipif(
+    not (pump_available() and svc_available()),
+    reason="native pump/service unavailable")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _start_gcs(tmp_path=None):
+    gcs = GcsServer(persistence_path=str(tmp_path / "gcs_state")
+                    if tmp_path else None)
+    host, port = await gcs.start()
+    return gcs, host, port
+
+
+def test_kv_semantics_native(tmp_path):
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            assert gcs._native_svc is not None, \
+                "native service should be active under the pump server"
+            conn = await rpc.connect(host, port)
+
+            r = await conn.call("KVPut", {"ns": "fn", "key": b"k1",
+                                          "value": b"v1"})
+            assert r == {"added": True}
+            r = await conn.call("KVPut", {"ns": "fn", "key": b"k1",
+                                          "value": b"zz",
+                                          "overwrite": False})
+            assert r == {"added": False}
+            r = await conn.call("KVGet", {"ns": "fn", "key": b"k1"})
+            assert r == {"value": b"v1"}
+            r = await conn.call("KVGet", {"ns": "fn", "key": b"nope"})
+            assert r == {"value": None}
+            r = await conn.call("KVExists", {"ns": "fn", "key": b"k1"})
+            assert r == {"exists": True}
+            await conn.call("KVPut", {"ns": "fn", "key": b"k2",
+                                      "value": b"v2"})
+            await conn.call("KVPut", {"ns": "other", "key": b"k3",
+                                      "value": b"v3"})
+            r = await conn.call("KVKeys", {"ns": "fn", "prefix": b"k"})
+            assert sorted(r["keys"]) == [b"k1", b"k2"]
+            r = await conn.call("KVKeys", {"ns": "fn", "prefix": b"zzz"})
+            assert r["keys"] == []
+            r = await conn.call("KVDel", {"ns": "fn", "key": b"k1"})
+            assert r == {"deleted": True}
+            r = await conn.call("KVDel", {"ns": "fn", "key": b"k1"})
+            assert r == {"deleted": False}
+            r = await conn.call("KVGet", {"ns": "fn", "key": b"k1"})
+            assert r == {"value": None}
+
+            # All of the above were handled in C++ — Python never saw
+            # the frames, and self.kv stayed empty.
+            handled, appends, fails = gcs._native_svc.counters()
+            assert handled >= 10
+            assert appends >= 4   # 3 puts + 1 effective delete
+            assert fails == 0
+            assert gcs.kv == {}
+            n_ns, n_rows = gcs._native_svc.kv_stats()
+            assert (n_ns, n_rows) == (2, 2)   # fn:k2, other:k3
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_pubsub_native_fanout(tmp_path):
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            sub1 = await rpc.connect(host, port)
+            sub2 = await rpc.connect(host, port)
+            pub = await rpc.connect(host, port)
+            got1, got2 = [], []
+            ev1, ev2 = asyncio.Event(), asyncio.Event()
+
+            def on_pub(sink, ev):
+                def h(conn, payload):
+                    sink.append(payload)
+                    ev.set()
+                return h
+
+            sub1.handlers["Publish"] = on_pub(got1, ev1)
+            sub2.handlers["Publish"] = on_pub(got2, ev2)
+            assert (await sub1.call("Subscribe",
+                                    {"channels": ["X"]}))["ok"]
+            assert (await sub2.call("Subscribe",
+                                    {"channels": ["X", "Y"]}))["ok"]
+            assert gcs._native_svc.sub_count("X") == 2
+            assert gcs._native_svc.sub_count("Y") == 1
+
+            # External publish RPC: native fanout to both.
+            r = await pub.call("Publish", {"channel": "X",
+                                           "message": {"n": 1}})
+            assert r == {"ok": True}
+            await asyncio.wait_for(ev1.wait(), 5)
+            await asyncio.wait_for(ev2.wait(), 5)
+            assert got1 == [{"channel": "X", "message": {"n": 1}}]
+            assert got2 == [{"channel": "X", "message": {"n": 1}}]
+
+            # Internal publish (the path actor/node/PG state changes
+            # use): routed through the native fanout too.
+            ev2.clear()
+            await gcs.publish("Y", {"n": 2})
+            await asyncio.wait_for(ev2.wait(), 5)
+            assert got2[-1] == {"channel": "Y", "message": {"n": 2}}
+            # Python-side subscriber table stayed empty: the
+            # subscriptions live in the native service.
+            assert not any(gcs.subscribers.values())
+
+            # Disconnect cleans native subscriber state.
+            await sub2.close()
+            for _ in range(100):
+                if gcs._native_svc.sub_count("X") == 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert gcs._native_svc.sub_count("X") == 1
+            assert gcs._native_svc.sub_count("Y") == 0
+            await sub1.close()
+            await pub.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_restart_restores_native_rows(tmp_path):
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        conn = await rpc.connect(host, port)
+        await conn.call("KVPut", {"ns": "fn", "key": b"pk",
+                                  "value": b"pv"})
+        await conn.call("KVPut", {"ns": "", "key": b"root",
+                                  "value": b"rv"})
+        await conn.close()
+        await gcs.stop()
+
+        gcs2, host2, port2 = await _start_gcs(tmp_path)
+        try:
+            assert gcs2._native_svc is not None
+            assert gcs2._native_svc.kv_stats()[1] == 2
+            conn2 = await rpc.connect(host2, port2)
+            assert (await conn2.call("KVGet", {"ns": "fn",
+                                               "key": b"pk"}))["value"] \
+                == b"pv"
+            assert (await conn2.call("KVGet",
+                                     {"key": b"root"}))["value"] == b"rv"
+            await conn2.close()
+        finally:
+            await gcs2.stop()
+
+    run(main())
+
+
+def test_cross_compat_python_and_native_rows(tmp_path, monkeypatch):
+    """Rows written by the Python fallback restore under the native
+    service and vice versa — the store format is shared."""
+    async def write_python_side():
+        monkeypatch.setenv("RAY_TPU_NATIVE_GCS_SERVICE", "0")
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            assert gcs._native_svc is None
+            conn = await rpc.connect(host, port)
+            await conn.call("KVPut", {"ns": "compat", "key": b"from-py",
+                                      "value": b"py-val"})
+            await conn.close()
+        finally:
+            await gcs.stop()
+        monkeypatch.delenv("RAY_TPU_NATIVE_GCS_SERVICE")
+
+    async def native_reads_then_writes():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            assert gcs._native_svc is not None
+            conn = await rpc.connect(host, port)
+            assert (await conn.call(
+                "KVGet", {"ns": "compat",
+                          "key": b"from-py"}))["value"] == b"py-val"
+            await conn.call("KVPut", {"ns": "compat", "key": b"from-c",
+                                      "value": b"c-val"})
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    async def python_reads_native_row():
+        monkeypatch.setenv("RAY_TPU_NATIVE_GCS_SERVICE", "0")
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            assert (await conn.call(
+                "KVGet", {"ns": "compat",
+                          "key": b"from-c"}))["value"] == b"c-val"
+            assert (await conn.call(
+                "KVGet", {"ns": "compat",
+                          "key": b"from-py"}))["value"] == b"py-val"
+            await conn.close()
+        finally:
+            await gcs.stop()
+        monkeypatch.delenv("RAY_TPU_NATIVE_GCS_SERVICE")
+
+    run(write_python_side())
+    run(native_reads_then_writes())
+    run(python_reads_native_row())
+
+
+def test_malformed_known_method_errors_not_passthrough(tmp_path):
+    """A malformed payload for a method the native service owns must
+    come back as an RpcError — passing it to Python would answer from
+    the (empty) Python tables and silently diverge."""
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            await conn.call("KVPut", {"ns": "x", "key": b"k",
+                                      "value": b"v"})
+            with pytest.raises(rpc.RpcError, match="malformed"):
+                await conn.call("KVGet", {"ns": "x"})   # no "key"
+            assert gcs._native_svc.proto_errors() == 1
+            # The well-formed path still works afterwards.
+            assert (await conn.call(
+                "KVGet", {"ns": "x", "key": b"k"}))["value"] == b"v"
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_idempotent_reput_skips_wal(tmp_path):
+    """Re-putting an identical value must not append to the WAL
+    (parity with the Python write-through's hash-diff dedup)."""
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            await conn.call("KVPut", {"ns": "x", "key": b"k",
+                                      "value": b"v"})
+            appends_before = gcs._native_svc.counters()[1]
+            for _ in range(5):
+                r = await conn.call("KVPut", {"ns": "x", "key": b"k",
+                                              "value": b"v"})
+                assert r == {"added": True}
+            assert gcs._native_svc.counters()[1] == appends_before
+            # A changed value DOES append.
+            await conn.call("KVPut", {"ns": "x", "key": b"k",
+                                      "value": b"v2"})
+            assert gcs._native_svc.counters()[1] == appends_before + 1
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_str_and_bytes_keys_are_distinct(tmp_path):
+    """Key identity is the raw msgpack encoding: "a" (str) and b"a"
+    (bin) are different keys, matching the Python dict fallback."""
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            await conn.call("KVPut", {"ns": "t", "key": "a",
+                                      "value": b"str-key"})
+            await conn.call("KVPut", {"ns": "t", "key": b"a",
+                                      "value": b"bin-key"})
+            assert (await conn.call(
+                "KVGet", {"ns": "t", "key": "a"}))["value"] == b"str-key"
+            assert (await conn.call(
+                "KVGet", {"ns": "t", "key": b"a"}))["value"] == b"bin-key"
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
