@@ -1,0 +1,184 @@
+"""Bench-artifact health stamping and no-clobber saves.
+
+Round 5's headline failure was observational: the watchdog overwrote
+`BENCH_TPU_LIVE.json` (60.1% MFU) with a capture taken while the device
+tunnel was demonstrably sick (17.8% MFU, step time 3.4x), and nothing in
+the pipeline could tell a degraded environment from a code regression.
+
+This module is the fix's pure-JSON half (bench.py owns the jax-side
+probe): every bench record carries a **health stamp** under
+``extra["health"]``:
+
+    {
+      "verdict": "ok" | "degraded",
+      "reasons": [str, ...],              # empty when ok
+      "probe_gflops_before": float,      # fixed-matmul probe, pre-capture
+      "probe_gflops_after": float,       # same probe, post-capture
+      "probe_gflops_best": float,        # best probe ever recorded here
+      "pump_stats": {...} | None,        # daemon event-loop snapshot
+    }
+
+and `save_artifact` enforces the no-clobber rule: a capture stamped
+`degraded` (or a cpu fallback) never overwrites a healthy accelerator
+artifact — it is written beside it as `<stem>.degraded.json` so the
+evidence is kept without becoming the number of record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# Absolute sanity floor for a non-cpu probe: the weakest supported chip
+# (v5e, 197 TFLOP/s bf16 peak) delivers tens of TFLOP/s on a plain
+# jit'd matmul; a wedged tunnel measures orders of magnitude less.
+PROBE_FLOOR_GFLOPS = 5000.0
+# A probe this far below the best recorded one means the environment,
+# not the code, changed (r5's regression was 3.4x ≈ 0.29).
+DEGRADED_VS_BEST = 0.8
+# The environment sickening DURING the capture (after-probe collapsing
+# vs before-probe) invalidates the window itself.
+DEGRADED_DURING = 0.5
+
+
+def make_stamp(probe_before: float | None, probe_after: float | None,
+               backend: str, best_recorded: float | None = None,
+               pump_stats: dict | None = None) -> dict:
+    """Build the health dict for one capture. GFLOP/s units throughout."""
+    reasons: list[str] = []
+    probes = [p for p in (probe_before, probe_after) if p]
+    best_now = max(probes) if probes else 0.0
+    if backend != "cpu":
+        if not probes:
+            reasons.append("no health probe completed")
+        elif best_now < PROBE_FLOOR_GFLOPS:
+            reasons.append(
+                f"probe {best_now:.0f} GFLOP/s below the "
+                f"{PROBE_FLOOR_GFLOPS:.0f} floor (tunnel sick?)")
+        if best_recorded and probes and \
+                best_now < DEGRADED_VS_BEST * best_recorded:
+            reasons.append(
+                f"probe {best_now:.0f} GFLOP/s is "
+                f"{best_now / best_recorded:.2f}x of the best recorded "
+                f"{best_recorded:.0f} (environment degraded)")
+    if probe_before and probe_after and \
+            probe_after < DEGRADED_DURING * probe_before:
+        reasons.append(
+            f"post-capture probe fell to "
+            f"{probe_after / probe_before:.2f}x of pre-capture "
+            "(environment degraded during the measurement)")
+    best = max([best_recorded or 0.0] + probes)
+    return {
+        "verdict": "degraded" if reasons else "ok",
+        "reasons": reasons,
+        "probe_gflops_before": round(probe_before or 0.0, 1),
+        "probe_gflops_after": round(probe_after or 0.0, 1),
+        "probe_gflops_best": round(best, 1),
+        "pump_stats": pump_stats,
+    }
+
+
+def _load(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def best_recorded_probe(*paths: str) -> float | None:
+    """Best probe GFLOP/s across existing artifacts (the comparison
+    baseline for the next capture's verdict)."""
+    best = 0.0
+    for path in paths:
+        rec = _load(path)
+        if rec:
+            h = (rec.get("extra") or {}).get("health") or {}
+            best = max(best, float(h.get("probe_gflops_best") or 0.0))
+    return best or None
+
+
+def is_degraded(rec: dict) -> bool:
+    h = (rec.get("extra") or {}).get("health") or {}
+    return h.get("verdict") == "degraded"
+
+
+def is_healthy_accelerator(rec: dict) -> bool:
+    """A record worth protecting: a non-cpu capture with a real number
+    that is not stamped degraded (legacy records without a stamp count —
+    they predate the stamp but were captured on a live accelerator)."""
+    extra = rec.get("extra") or {}
+    return (extra.get("backend", "cpu") != "cpu"
+            and bool(rec.get("value")) and not is_degraded(rec))
+
+
+def degraded_sibling(dest: str) -> str:
+    stem, ext = os.path.splitext(dest)
+    return f"{stem}.degraded{ext or '.json'}"
+
+
+def _write_atomic(path: str, rec: dict) -> None:
+    """tmp + os.replace: a save interrupted mid-write must never leave
+    the artifact truncated — a corrupt dest would dodge the healthy-
+    artifact check on the NEXT save and let anything install over it."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, path)
+
+
+def save_artifact(src: str, dest: str) -> int:
+    """Install a bench record at `dest`, refusing to clobber a healthy
+    accelerator artifact with a degraded (or cpu-fallback) capture —
+    the rejected record lands beside it as `<stem>.degraded.json`."""
+    rec = _load(src)
+    if rec is None:
+        print(f"bench-health: cannot read record at {src}",
+              file=sys.stderr)
+        return 1
+    existing = _load(dest)
+    if existing is not None and is_healthy_accelerator(existing):
+        backend = (rec.get("extra") or {}).get("backend", "cpu")
+        reason = None
+        if is_degraded(rec):
+            reason = "capture is stamped degraded"
+        elif backend == "cpu":
+            reason = "capture is a cpu fallback"
+        if reason is not None:
+            side = degraded_sibling(dest)
+            _write_atomic(side, rec)
+            print(f"bench-health: REFUSING to overwrite healthy artifact "
+                  f"{dest} ({reason}); wrote {side} instead",
+                  file=sys.stderr)
+            return 0
+    _write_atomic(dest, rec)
+    print(f"bench-health: installed {dest}", file=sys.stderr)
+    return 0
+
+
+def try_pump_stats() -> dict | None:
+    """Daemon event-loop snapshot when a cluster is connected; None
+    otherwise (the bench usually runs without one)."""
+    try:
+        from ray_tpu._private.api_internal import core_worker_or_none
+
+        if core_worker_or_none() is None:
+            return None
+        from ray_tpu.util import state
+
+        return state.pump_stats()
+    except Exception:
+        return None
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) == 3 and argv[0] == "save":
+        return save_artifact(argv[1], argv[2])
+    print("usage: python -m ray_tpu._private.bench_health "
+          "save <src.json> <dest.json>", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

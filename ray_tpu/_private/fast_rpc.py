@@ -24,10 +24,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 import traceback
 from typing import Awaitable, Callable
 
 from ray_tpu._private import rpc
+from ray_tpu._private.event_stats import EventLoopStats
 from ray_tpu._private.native_fastpath import (EV_ACCEPT, EV_CLOSE, EV_FRAME)
 from ray_tpu._private.rpc import (MSG_ERROR, MSG_NOTIFY, MSG_REQUEST,
                                   MSG_RESPONSE, ConnectionLost, RpcError,
@@ -127,6 +129,9 @@ class FastRpcServer:
         # loop thread sees the hook before any frame arrives.
         self.service_factory = None
         self.native_service = None
+        # Per-handler dispatch latency + drain batch stats (analogue of
+        # the reference's event_stats.h around its asio loop posts).
+        self.stats = EventLoopStats(name)
         self._pump = None
         self._conns: dict[int, FastConn] = {}
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -163,6 +168,8 @@ class FastRpcServer:
         # empty.
         while True:
             events = self._pump.drain(max_events=512)
+            if events:
+                self.stats.record_drain(len(events))
             for ev in events:
                 self._handle_event(ev)
             if len(events) < 512:
@@ -209,30 +216,43 @@ class FastRpcServer:
 
     def _dispatch(self, conn: FastConn, seq, method: str, payload) -> None:
         handler = conn.handlers.get(method)
+        t0 = time.perf_counter()
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
             result = handler(conn, payload)
         except Exception as e:
+            self.stats.record_handler(method, time.perf_counter() - t0,
+                                      error=True)
             self._reply_error(conn, seq, method, e)
             return
         if isinstance(result, Awaitable):
             task = asyncio.ensure_future(self._finish(conn, seq, method,
-                                                      result))
+                                                      result, t0))
             # Keep a strong ref until done (create_task keeps only weak).
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
-        elif seq is not None:
-            self._send(conn._conn_id, [MSG_RESPONSE, seq, method, result])
+            self.stats.set_queue_depth(len(self._inflight))
+        else:
+            self.stats.record_handler(method, time.perf_counter() - t0)
+            if seq is not None:
+                self._send(conn._conn_id,
+                           [MSG_RESPONSE, seq, method, result])
 
-    async def _finish(self, conn: FastConn, seq, method: str, coro) -> None:
+    async def _finish(self, conn: FastConn, seq, method: str, coro,
+                      t0: float) -> None:
         try:
             result = await coro
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            self.stats.record_handler(method, time.perf_counter() - t0,
+                                      error=True)
             self._reply_error(conn, seq, method, e)
             return
+        finally:
+            self.stats.set_queue_depth(max(0, len(self._inflight) - 1))
+        self.stats.record_handler(method, time.perf_counter() - t0)
         if seq is not None:
             self._send(conn._conn_id, [MSG_RESPONSE, seq, method, result])
 

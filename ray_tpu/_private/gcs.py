@@ -201,6 +201,7 @@ class GcsServer:
             "AddTaskEvents": self.handle_add_task_events,
             "ListTaskEvents": self.handle_list_task_events,
             "GetClusterStatus": self.handle_get_cluster_status,
+            "GetEventLoopStats": self.handle_get_event_loop_stats,
             "GetConfig": self.handle_get_config,
         }.items()}
 
@@ -235,6 +236,7 @@ class GcsServer:
         from ray_tpu._private import native_gcs_service
 
         if native_gcs_service.available():
+            svc = None
             try:
                 svc = native_gcs_service.GcsNativeService(pump, self._store)
                 for key_hex, blob in self._pending_native_kv:
@@ -251,6 +253,15 @@ class GcsServer:
             except Exception:
                 logger.exception("native GCS service failed to install; "
                                  "Python handles KV/pubsub")
+                # The pump hook was never installed (install() is the
+                # last step), so the partially-constructed service can be
+                # destroyed safely — without this the gsvc_create'd
+                # native handle leaks on every fallback.
+                if svc is not None:
+                    try:
+                        svc.close()
+                    except Exception:
+                        logger.exception("native GCS service close failed")
         # Fallback: re-home any rows _load_state stashed for the native
         # side into the Python tables.
         for key_hex, blob in self._pending_native_kv:
@@ -259,9 +270,12 @@ class GcsServer:
         return None
 
     def _restore_kv_row(self, key_hex: str, blob: bytes) -> None:
-        """Restore one persisted kv row into the Python tables."""
+        """Restore one persisted kv row into the Python tables. The
+        decoded key type (str vs bytes) is preserved: a str-keyed row
+        written by the native service must answer a str-keyed KVGet
+        after a fallback restart (the live tables keep the two distinct,
+        exactly like the native service's raw-encoding identity)."""
         ns, k = rpc.unpack(bytes.fromhex(key_hex))
-        k = k if isinstance(k, bytes) else k.encode()
         self.kv[ns][k] = rpc.unpack(blob)
         self._row_hashes[("kv", key_hex)] = hash(blob)
         self._row_sizes[("kv", key_hex)] = len(blob)
@@ -838,6 +852,10 @@ class GcsServer:
             "pg_bundle_index": payload.get("pg_bundle_index", -1),
         }
         self._touch("actors", actor_id)
+        self._record_task_event(
+            self._creation_task_id(actor_id, spec), payload.get("class_name", ""),
+            "CREATE_REGISTERED", job_id=payload.get("job_id", ""),
+            actor_id=actor_id)
         asyncio.ensure_future(self._schedule_actor(actor_id))
         return {"ok": True}
 
@@ -919,6 +937,10 @@ class GcsServer:
             self.native_sched.debit_node(node_id, placement_demand)
         a["node_id"] = node_id
         self.mark_dirty(("actors",))
+        self._record_task_event(
+            self._creation_task_id(actor_id, a["spec"]), a["class_name"],
+            "CREATE_SCHEDULED", job_id=a.get("job_id", ""),
+            actor_id=actor_id, target_node=node_id)
         try:
             resp = await self.node_conns[node_id].call(
                 "CreateActor",
@@ -942,6 +964,10 @@ class GcsServer:
         a["state"] = ACTOR_ALIVE
         a["address"] = payload["address"]
         self._touch("actors", payload["actor_id"])
+        self._record_task_event(
+            self._creation_task_id(payload["actor_id"], a["spec"]),
+            a["class_name"], "CREATE_READY", job_id=a.get("job_id", ""),
+            actor_id=payload["actor_id"])
         # restarts doubles as the incarnation number: callers reset their
         # per-actor sequence numbers when it changes (reference: the client
         # queue resend path in direct_actor_task_submitter).
@@ -1262,6 +1288,28 @@ class GcsServer:
 
     # ---------- task events / status ----------
 
+    def _record_task_event(self, task_id: str, name: str, state: str,
+                           **extra) -> None:
+        """GCS-side lifecycle stamp (actor CREATE stages): lands in the
+        same task-event table worker stamps flush into, keyed by the
+        creation task id so the per-actor ladder merges with the
+        executing worker's ARGS_FETCHED/RUNNING/FINISHED stamps."""
+        ev = {"task_id": task_id, "name": name, "state": state,
+              "node_id": "gcs", "worker_id": "gcs",
+              "job_id": extra.pop("job_id", ""), "ts": time.time()}
+        if extra:
+            ev.update(extra)
+        self.task_events.append(ev)
+
+    @staticmethod
+    def _creation_task_id(actor_id: str, spec_wire) -> str:
+        # TaskSpec.to_wire is a list with task_id first; fall back to the
+        # actor id for exotic/legacy spec payloads.
+        if isinstance(spec_wire, (list, tuple)) and spec_wire \
+                and isinstance(spec_wire[0], str):
+            return spec_wire[0]
+        return actor_id
+
     async def handle_add_task_events(self, conn, payload):
         self.task_events.extend(payload["events"])
         return {"ok": True}
@@ -1286,6 +1334,26 @@ class GcsServer:
                                      if p["state"] == PG_CREATED]),
             "uptime_s": time.time() - self.start_time,
         }
+
+    async def handle_get_event_loop_stats(self, conn, payload):
+        """Event-loop/RPC dispatch stats for the GCS pump (analogue of
+        the reference's event_stats.h surface): per-handler call counts
+        and latencies from the server's EventLoopStats, plus the native
+        in-pump service's counters (frames it handled never reach the
+        Python dispatch table, so they are reported separately)."""
+        out = {"server": self._server.stats.snapshot()}
+        if self._native_svc is not None:
+            handled, appends, fails = self._native_svc.counters()
+            n_ns, n_rows = self._native_svc.kv_stats()
+            out["native"] = {
+                "handled": handled, "wal_appends": appends,
+                "wal_failures": fails,
+                "proto_errors": self._native_svc.proto_errors(),
+                "kv_namespaces": n_ns, "kv_rows": n_rows,
+            }
+        else:
+            out["native"] = None
+        return out
 
     async def handle_get_config(self, conn, payload):
         return {"config": self.config.to_json()}
@@ -1313,9 +1381,11 @@ def main():
     async def run():
         # Eager tasks (3.12): an RPC dispatch that completes without
         # blocking never round-trips through the scheduler — one fewer
-        # loop hop per table mutation on the daemon hot path.
-        asyncio.get_running_loop().set_task_factory(
-            asyncio.eager_task_factory)
+        # loop hop per table mutation on the daemon hot path. Absent on
+        # older interpreters; the daemon must still boot there.
+        if hasattr(asyncio, "eager_task_factory"):
+            asyncio.get_running_loop().set_task_factory(
+                asyncio.eager_task_factory)
         config = Config.from_json(args.config) if args.config else Config()
         server = GcsServer(config, persistence_path=args.persist or None)
         host, port = await server.start(args.host, args.port)

@@ -428,6 +428,7 @@ class Raylet:
             "ReturnPGBundle": self.handle_return_pg_bundle,
             "Drain": self.handle_drain,
             "GetState": self.handle_get_state,
+            "GetEventLoopStats": self.handle_get_event_loop_stats,
             "NodeStacks": self.handle_node_stacks,
             "NodeDebugTasks": self.handle_node_debug_tasks,
             "NodeProfile": self.handle_node_profile,
@@ -616,7 +617,7 @@ class Raylet:
                     # Demand signal for the autoscaler (reference: raylets
                     # report resource load via ray_syncer →
                     # gcs_autoscaler_state_manager).
-                    "pending_demand": [r for r, _pg, _idx, _f, _sp in
+                    "pending_demand": [item[0] for item in
                                        list(self.pending_leases)[:100]]
                     + [d for _ts, d in self._infeasible_demand],
                 }, timeout=self.config.health_check_timeout_s)
@@ -1053,8 +1054,20 @@ class Raylet:
         try:
             deadline = time.monotonic() + self.config.worker_startup_timeout_s
             while not w.registered.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._kill_worker(w)
+                    self._last_spawn_failure = (
+                        f"worker registration timed out after "
+                        f"{self.config.worker_startup_timeout_s:g}s")
+                    return None
                 try:
-                    await asyncio.wait_for(w.registered.wait(), 0.5)
+                    # Wait slice bounded by the REMAINING budget: a fixed
+                    # 0.5s slice quantized sub-0.5s startup timeouts away
+                    # entirely (a fast registration landed inside the
+                    # first slice and the deadline was never checked).
+                    await asyncio.wait_for(w.registered.wait(),
+                                           min(0.5, remaining))
                 except asyncio.TimeoutError:
                     # A process that DIED before registering is a broken
                     # worker environment, not load — fail in seconds with
@@ -1065,12 +1078,6 @@ class Raylet:
                         self._last_spawn_failure = (
                             "worker process exited during startup "
                             "(see worker logs)")
-                        return None
-                    if time.monotonic() > deadline:
-                        self._kill_worker(w)
-                        self._last_spawn_failure = (
-                            f"worker registration timed out after "
-                            f"{self.config.worker_startup_timeout_s:.0f}s")
                         return None
         finally:
             w.reserved = False
@@ -1359,6 +1366,7 @@ class Raylet:
     async def handle_request_worker_lease(self, conn, payload):
         """Grant a worker lease, spill back, or queue (reference:
         node_manager.cc:1778 HandleRequestWorkerLease)."""
+        received_at = time.time()
         resources = normalize_resources(payload.get("resources"))
         strategy = payload.get("strategy")
         pg_id = payload.get("placement_group", "")
@@ -1405,7 +1413,8 @@ class Raylet:
                 lease_id = self._acquire(resources, pg_id, bundle_index)
                 if lease_id:
                     return await self._grant_lease(lease_id, resources,
-                                                   pg_id, bundle_index)
+                                                   pg_id, bundle_index,
+                                                   received_at=received_at)
         if allow_spill:
             # Prefer a peer with capacity available right now; for SPREAD,
             # prefer spilling even when we could run locally (one hop max,
@@ -1422,7 +1431,8 @@ class Raylet:
                     lease_id = self._acquire(resources, pg_id, bundle_index)
                     if lease_id:
                         return await self._grant_lease(
-                            lease_id, resources, pg_id, bundle_index)
+                            lease_id, resources, pg_id, bundle_index,
+                            received_at=received_at)
             if not locally_feasible:
                 # This node can never run it; hand off to any peer whose
                 # TOTAL capacity fits (it will queue there), else error.
@@ -1441,7 +1451,8 @@ class Raylet:
                     "infeasible": True}
         # Queue until resources free up.
         fut = asyncio.get_running_loop().create_future()
-        item = (resources, pg_id, bundle_index, fut, allow_spill)
+        item = (resources, pg_id, bundle_index, fut, allow_spill,
+                received_at)
         self.pending_leases.append(item)
         try:
             return await asyncio.wait_for(fut, self.config.worker_lease_timeout_s)
@@ -1455,8 +1466,10 @@ class Raylet:
                 return {"spillback": self._debit_spill(spill, resources)}
             return {"error": "lease timeout: insufficient resources", "retry": True}
 
-    async def _grant_lease(self, lease_id, resources, pg_id, bundle_index):
+    async def _grant_lease(self, lease_id, resources, pg_id, bundle_index,
+                           received_at: float | None = None):
         """Attach an already-acquired lease (see _acquire) to a worker."""
+        acquired_at = time.time()
         w = await self._get_ready_worker()
         if w is None:
             # Couldn't start a worker: give the acquisition back. Often
@@ -1477,11 +1490,26 @@ class Raylet:
         # Observability only (which pool the lease drew from is tracked
         # natively; -1 records the wildcard request as made).
         w.lease_pg = (pg_id, bundle_index) if pg_id else None
+        granted_at = time.time()
         return {"granted": True, "lease_id": lease_id,
                 "worker_id": w.worker_id,
                 "worker_host": w.address[0], "worker_port": w.address[1],
                 "worker_fp_port": getattr(w, "fp_port", 0),
-                "node_id": self.node_id}
+                "node_id": self.node_id,
+                # Raylet-side lifecycle stamps: queue wait (request
+                # arrival → resource acquisition) and worker attach time
+                # — the owner embeds them in the task's LEASE_GRANTED
+                # event so the latency breakdown can split raylet
+                # queueing from RPC transit.
+                "lease_timing": {
+                    "received_at": received_at or acquired_at,
+                    "granted_at": granted_at,
+                    "queue_wait_ms": round(
+                        (acquired_at - (received_at or acquired_at))
+                        * 1000, 3),
+                    "worker_attach_ms": round(
+                        (granted_at - acquired_at) * 1000, 3),
+                }}
 
     async def handle_return_worker(self, conn, payload):
         lease_id = payload["lease_id"]
@@ -1511,7 +1539,7 @@ class Raylet:
         # hottest scheduling path), refreshed after successful acquires.
         avail = None
         for item in list(self.pending_leases):
-            resources, pg_id, bundle_index, fut, spillable = item
+            resources, pg_id, bundle_index, fut, spillable, _received = item
             if fut.done():
                 self.pending_leases.remove(item)
                 continue
@@ -1546,11 +1574,14 @@ class Raylet:
                         peer_avail[k] = peer_avail.get(k, 0) - v
                     self.pending_leases.remove(item)
                     fut.set_result({"spillback": spill})
-        for lease_id, (resources, pg_id, bundle_index, fut, _sp) in granted:
+        for lease_id, (resources, pg_id, bundle_index, fut, _sp,
+                       received_at) in granted:
             async def grant(lease_id=lease_id, resources=resources,
-                            pg_id=pg_id, bundle_index=bundle_index, fut=fut):
+                            pg_id=pg_id, bundle_index=bundle_index, fut=fut,
+                            received_at=received_at):
                 result = await self._grant_lease(lease_id, resources, pg_id,
-                                                 bundle_index)
+                                                 bundle_index,
+                                                 received_at=received_at)
                 if not fut.done():
                     fut.set_result(result)
                 elif result.get("granted"):
@@ -1580,7 +1611,8 @@ class Raylet:
                 # Not spillable: the GCS owns actor placement and reschedules
                 # on failure; the raylet must not redirect actor creations.
                 self.pending_leases.append(
-                    (resources, pg_id, bundle_index, fut, False))
+                    (resources, pg_id, bundle_index, fut, False,
+                     time.time()))
                 try:
                     grant = await asyncio.wait_for(
                         fut, self.config.worker_lease_timeout_s)
@@ -2070,6 +2102,13 @@ class Raylet:
             "draining": self.draining,
         }
 
+    async def handle_get_event_loop_stats(self, conn, payload):
+        """Per-handler dispatch latency + drain stats for this raylet's
+        RPC loop (native pump or asyncio fallback — both expose the same
+        EventLoopStats surface; analogue of event_stats.h)."""
+        return {"node_id": self.node_id,
+                "server": self.server.stats.snapshot()}
+
 
 def main():
     import argparse
@@ -2098,8 +2137,9 @@ def main():
     async def run():
         # Eager tasks (3.12): lease/return dispatches that complete
         # without blocking skip the scheduler round-trip (see gcs.main).
-        asyncio.get_running_loop().set_task_factory(
-            asyncio.eager_task_factory)
+        if hasattr(asyncio, "eager_task_factory"):
+            asyncio.get_running_loop().set_task_factory(
+                asyncio.eager_task_factory)
         raylet = Raylet(
             args.gcs_host, args.gcs_port,
             resources=json.loads(args.resources) or None,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import traceback
 from typing import Awaitable, Callable
 
@@ -50,11 +51,16 @@ class Connection:
     """One bidirectional RPC channel. Both peers may call() and serve handlers."""
 
     def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
-                 handlers: dict[str, Callable] | None = None, name: str = "conn"):
+                 handlers: dict[str, Callable] | None = None, name: str = "conn",
+                 stats=None):
         self.reader = reader
         self.writer = writer
         self.handlers = handlers or {}
         self.name = name
+        # EventLoopStats of the owning RpcServer (None on client conns):
+        # per-handler dispatch latency, same surface as the native pump
+        # server (fast_rpc.FastRpcServer.stats).
+        self._stats = stats
         self._seq = 0
         self._pending: dict[int, asyncio.Future] = {}
         self._closed = False
@@ -152,17 +158,23 @@ class Connection:
 
     async def _dispatch(self, seq, method: str, payload) -> None:
         handler = self.handlers.get(method)
+        t0 = time.perf_counter() if self._stats is not None else 0.0
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
             result = handler(self, payload)
             if isinstance(result, Awaitable):
                 result = await result
+            if self._stats is not None:
+                self._stats.record_handler(method, time.perf_counter() - t0)
             if seq is not None:
                 await self._send([MSG_RESPONSE, seq, method, result])
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            if self._stats is not None:
+                self._stats.record_handler(method, time.perf_counter() - t0,
+                                           error=True)
             if seq is not None:
                 try:
                     await self._send([MSG_ERROR, seq, method,
@@ -209,6 +221,8 @@ class RpcServer:
 
     def __init__(self, handlers: dict[str, Callable], name: str = "server",
                  on_connect: Callable[[Connection], None] | None = None):
+        from ray_tpu._private.event_stats import EventLoopStats
+
         self.handlers = handlers
         self.name = name
         self.on_connect = on_connect
@@ -216,6 +230,9 @@ class RpcServer:
         self.connections: set[Connection] = set()
         self.port: int | None = None
         self.host: str | None = None
+        # Same per-handler dispatch stats surface as FastRpcServer, so
+        # GetEventLoopStats answers on the asyncio fallback too.
+        self.stats = EventLoopStats(name)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._accept, host, port)
@@ -224,7 +241,8 @@ class RpcServer:
         return self.host, self.port
 
     async def _accept(self, reader, writer):
-        conn = Connection(reader, writer, self.handlers, name=f"{self.name}-peer")
+        conn = Connection(reader, writer, self.handlers,
+                          name=f"{self.name}-peer", stats=self.stats)
         self.connections.add(conn)
         conn.on_close(lambda: self.connections.discard(conn))
         conn.start()

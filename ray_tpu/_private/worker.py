@@ -42,6 +42,7 @@ import asyncio
 import collections as _collections
 import functools
 import hashlib
+import inspect
 import itertools
 import logging
 import os
@@ -91,6 +92,23 @@ class _ShmPin:
             self._store.release(self._oid)
         except Exception:
             pass  # store already torn down at interpreter exit
+
+
+def _pep688_supported() -> bool:
+    """Python-class __buffer__ (PEP 688) landed in 3.12; older
+    interpreters must fall back to copying payloads out of shm."""
+    class _Probe:
+        def __buffer__(self, flags):
+            return memoryview(b"")
+
+    try:
+        memoryview(_Probe())
+        return True
+    except TypeError:
+        return False
+
+
+_HAS_PEP688 = _pep688_supported()
 
 
 class _OwnedObject:
@@ -144,12 +162,16 @@ _PRIMITIVE_TYPES = frozenset(
 class _PendingTask:
     __slots__ = ("spec", "retries_left", "constructor_like", "futures",
                  "pushed_to", "nested_args", "seq", "return_hexes",
-                 "stream_q", "next_yield_index", "reconstructing")
+                 "stream_q", "next_yield_index", "reconstructing",
+                 "submitted_ts")
 
     def __init__(self, spec: TaskSpec, retries_left: int,
                  nested_args: list | None = None):
         self.spec = spec
         self.retries_left = retries_left
+        # Wall-clock submission time: the task-lifecycle ladder's origin
+        # (lease timestamps from a warm, pre-existing slot clamp to it).
+        self.submitted_ts = time.time()
         self.futures: list[asyncio.Future] = []
         self.pushed_to: str | None = None
         # Return ObjectID hexes, filled by submit_task so completion does
@@ -188,10 +210,12 @@ class _LeaseSlot:
     fails/retries everything left in it)."""
     __slots__ = ("conn", "lease_id", "worker_id", "node_id", "raylet", "busy",
                  "idle_since", "outstanding", "worker_addr", "fp_id",
-                 "pushed_any")
+                 "pushed_any", "lease_requested_ts", "lease_granted_ts",
+                 "lease_timing")
 
     def __init__(self, conn, lease_id, worker_id, node_id, raylet,
-                 worker_addr=None):
+                 worker_addr=None, lease_requested_ts=None,
+                 lease_granted_ts=None):
         self.conn = conn
         self.lease_id = lease_id
         self.worker_id = worker_id
@@ -203,6 +227,13 @@ class _LeaseSlot:
         self.worker_addr = worker_addr  # Address wire of the worker
         self.fp_id = None  # native fastpath conn id (None = asyncio path)
         self.pushed_any = False  # ever dispatched (spread recycle gate)
+        # Lease negotiation wall-clock stamps for the lifecycle ladder
+        # (per-task LEASE_* events clamp these to the task's own
+        # submission time — a warm lease predates late submissions).
+        now = time.time()
+        self.lease_requested_ts = lease_requested_ts or now
+        self.lease_granted_ts = lease_granted_ts or now
+        self.lease_timing = None  # raylet-side stamps from the grant
 
 
 def _shape_key(resources: dict) -> str:
@@ -585,11 +616,14 @@ class CoreWorker:
 
     # ---------- events ----------
 
-    def _record_task_event(self, task_id: str, name: str, state: str, **extra):
+    def _record_task_event(self, task_id: str, name: str, state: str,
+                           ts: float | None = None, **extra):
         # Hot path (several per task): append a tuple; the flush loop
-        # formats the wire dicts off the critical path.
+        # formats the wire dicts off the critical path. `ts` lets the
+        # lease ladder stamp negotiation times captured earlier.
         self._task_events.append(
-            (task_id, name, state, time.time(), extra or None))
+            (task_id, name, state, time.time() if ts is None else ts,
+             extra or None))
 
     _TASK_EVENT_FLUSH_MAX = 5000
 
@@ -836,17 +870,27 @@ class CoreWorker:
                 prereg = ({n[0] for n in self._container_nested.get(oid_hex, [])}
                           | self._fetched_prereg.pop(oid_hex, set()))
                 if pin is not None and _has_buffers(meta):
-                    # Zero-copy payload: DONATE the store read-ref to a
-                    # _ShmPin that every deserialized view keeps alive
-                    # (plasma-buffer semantics — the pin dies with the
-                    # last numpy view, so spilling/eviction can reclaim
-                    # the slot; round 1 pinned for process lifetime,
-                    # which deadlocks restores in a small arena).
-                    shm_owner = _ShmPin(data, pin[0], oid)
-                    pin = None
+                    if _HAS_PEP688:
+                        # Zero-copy payload: DONATE the store read-ref to
+                        # a _ShmPin that every deserialized view keeps
+                        # alive (plasma-buffer semantics — the pin dies
+                        # with the last numpy view, so spilling/eviction
+                        # can reclaim the slot; round 1 pinned for
+                        # process lifetime, which deadlocks restores in a
+                        # small arena).
+                        shm_owner = _ShmPin(data, pin[0], oid)
+                        pin = None
+                        payload = memoryview(shm_owner)
+                    else:
+                        # No PEP 688 on this interpreter: copy out of shm
+                        # and release the read-ref immediately — correct,
+                        # just not zero-copy.
+                        payload = bytes(data)
+                        pin[0].release(oid)
+                        pin = None
                     with deser_context(prereg) as dsink:
                         kind, value = serialization.deserialize(
-                            meta, memoryview(shm_owner))
+                            meta, payload)
                 else:
                     with deser_context(prereg) as dsink:
                         kind, value = serialization.deserialize(meta, data)
@@ -1666,7 +1710,8 @@ class CoreWorker:
             o = self.objects.setdefault(oid_hex, _OwnedObject())
             o.lineage_task = spec.task_id
         self.pending_tasks[spec.task_id] = pt
-        self._record_task_event(spec.task_id, spec.name, "PENDING")
+        self._record_task_event(spec.task_id, spec.name, "SUBMITTED",
+                                ts=pt.submitted_ts)
         return pt, returns
 
     def _enqueue_prepared(self, pt: _PendingTask) -> None:
@@ -1792,6 +1837,7 @@ class CoreWorker:
             asyncio.ensure_future(self._request_lease(shape, template_spec))
 
     async def _request_lease(self, shape: str, spec: TaskSpec):
+        lease_requested_ts = time.time()
         try:
             raylet_conn = self.raylet
             _hop = 0
@@ -1852,7 +1898,10 @@ class CoreWorker:
                         resp["node_id"], raylet_conn,
                         worker_addr=[resp["worker_host"],
                                      resp["worker_port"],
-                                     resp["worker_id"], resp["node_id"]])
+                                     resp["worker_id"], resp["node_id"]],
+                        lease_requested_ts=lease_requested_ts,
+                        lease_granted_ts=time.time())
+                    slot.lease_timing = resp.get("lease_timing")
                     conn.handlers["TaskDone"] = functools.partial(
                         self._handle_task_done, slot, shape)
                     conn.handlers["TasksReturned"] = functools.partial(
@@ -2107,10 +2156,28 @@ class CoreWorker:
         no execution deadline).
         """
         slot.pushed_any = True
+        now = time.time()
         for pt in pts:
             pt.pushed_to = slot.node_id
             slot.outstanding[pt.spec.task_id] = pt
-            self._record_task_event(pt.spec.task_id, pt.spec.name, "RUNNING",
+            # Lease ladder: negotiation stamps come from the slot, clamped
+            # into [task submission, now] — a warm lease granted before
+            # this task existed contributes ~0 negotiation latency, which
+            # is exactly what the task experienced. The executing worker
+            # stamps ARGS_FETCHED/RUNNING on its side.
+            req = min(max(slot.lease_requested_ts, pt.submitted_ts), now)
+            granted = min(max(slot.lease_granted_ts, req), now)
+            tid, name = pt.spec.task_id, pt.spec.name
+            self._record_task_event(tid, name, "LEASE_REQUESTED", ts=req)
+            if slot.lease_timing:
+                self._record_task_event(
+                    tid, name, "LEASE_GRANTED", ts=granted,
+                    raylet_queue_ms=slot.lease_timing["queue_wait_ms"],
+                    worker_attach_ms=slot.lease_timing["worker_attach_ms"])
+            else:
+                self._record_task_event(tid, name, "LEASE_GRANTED",
+                                        ts=granted)
+            self._record_task_event(tid, name, "DISPATCHED", ts=now,
                                     target_node=slot.node_id)
         if slot.fp_id is not None and self._fp_sub_pump is not None:
             frame = rpc.pack([rpc.MSG_NOTIFY, 0, "PushTaskBatch",
@@ -2953,22 +3020,37 @@ class CoreWorker:
             if spec.actor_creation:
                 cls = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
+                self._record_task_event(spec.task_id, spec.name,
+                                        "ARGS_FETCHED")
                 # Actor envs persist: the process is dedicated to the actor
                 # (reference: runtime-env-keyed workers, worker_pool.cc).
                 with runtime_env_context(spec.runtime_env, persistent=True,
                                          job_id=spec.job_id):
                     with tracing.execute_span(spec.name, spec.task_id,
                                               spec.trace_ctx):
+                        # RUNNING after env activation: the startup
+                        # stage (ARGS_FETCHED → RUNNING) is the
+                        # runtime-env build, not 0 by construction.
+                        self._record_task_event(spec.task_id, spec.name,
+                                                "RUNNING")
                         self._actor_instance = cls(*args, **kwargs)
                 self._start_actor_concurrency(spec.max_concurrency)
                 return {"status": "ok", "results": []}
             if spec.actor_id:
                 fn = getattr(self._actor_instance, spec.name.split(".")[-1])
                 args, kwargs = self._resolve_args(spec)
+                self._record_task_event(spec.task_id, spec.name,
+                                        "ARGS_FETCHED")
+                self._record_task_event(spec.task_id, spec.name, "RUNNING")
                 with tracing.execute_span(spec.name, spec.task_id,
                                           spec.trace_ctx):
                     result = fn(*args, **kwargs)
-                    if asyncio.iscoroutine(result):
+                    # inspect (not asyncio): on Python <= 3.10
+                    # asyncio.iscoroutine also matches plain GENERATORS
+                    # (legacy @asyncio.coroutine support), which would
+                    # misroute streaming actor methods onto the event
+                    # loop ("Task got bad yield").
+                    if inspect.iscoroutine(result):
                         # async actor method: run on the actor's event
                         # loop; concurrent calls (one per exec thread)
                         # interleave at await points (reference: asyncio
@@ -2988,8 +3070,16 @@ class CoreWorker:
                 if fn is None:
                     fn = self._run(self._fetch_function(spec.func_key))
                 args, kwargs = self._resolve_args(spec)
+                self._record_task_event(spec.task_id, spec.name,
+                                        "ARGS_FETCHED")
 
                 def run_fn():
+                    # Stamped here — inside the runtime_env/tracing
+                    # contexts when they apply — so the startup stage
+                    # (ARGS_FETCHED → RUNNING) measures env activation
+                    # instead of being structurally 0.
+                    self._record_task_event(spec.task_id, spec.name,
+                                            "RUNNING")
                     result = fn(*args, **kwargs)
                     if spec.num_returns != STREAMING_RETURNS:
                         return result
@@ -3112,6 +3202,11 @@ class CoreWorker:
         fut = asyncio.get_running_loop().create_future()
         self._exec_enqueue((spec, fut))
         result = await fut
+        # Creation tasks complete here (no owner-side TaskDone), so the
+        # executing worker closes their lifecycle ladder itself.
+        self._record_task_event(
+            spec.task_id, spec.name,
+            "FINISHED" if result["status"] == "ok" else "FAILED")
         if result["status"] != "ok":
             err = result.get("error")
             reason = "actor constructor failed"
@@ -3302,6 +3397,7 @@ class CoreWorker:
         spec.actor_incarnation = st["incarnation"]
         st["seq"] += 1
         st["inflight"].append(spec)
+        self._record_task_event(spec.task_id, spec.name, "SUBMITTED")
         stream_q = None
         if spec.num_returns == STREAMING_RETURNS:
             # Register the pending entry BEFORE the call goes out so
@@ -3380,6 +3476,8 @@ class CoreWorker:
                 conn = None
                 try:
                     conn = await self._actor_conn(actor_id, st)
+                    self._record_task_event(spec.task_id, spec.name,
+                                            "DISPATCHED")
                     resp = await conn.call("ActorCall", {
                         "spec": spec.to_wire(), "caller_id": self.worker_id},
                         timeout=None)

@@ -10,7 +10,8 @@ inside any connected process (`ray_tpu.dashboard.start()`, or
 `ray_tpu dashboard` from the CLI).
 
 Endpoints: /api/version /api/nodes /api/node_stats /api/actors
-/api/jobs /api/tasks /api/summary[/actors|/objects] /api/cluster_status
+/api/jobs /api/tasks /api/summary[/actors|/objects|/task_latency]
+/api/pump_stats /api/cluster_status
 /api/submission_jobs[/logs?id=] /api/logs /api/events
 /api/grafana/dashboard (generated Grafana JSON, metrics-module parity)
 /logs/view?node=&name= /api/stacks /api/profile /api/worker_stats (the
@@ -192,7 +193,13 @@ class _Handler(BaseHTTPRequestHandler):
                 from ray_tpu.util.metrics import (core_prometheus_text,
                                                   prometheus_text)
 
-                body = prometheus_text() + core_prometheus_text()
+                # core first: it sets the pump gauges and synchronously
+                # flushes the registry to the GCS (metrics.
+                # flush_registry_now), so prometheus_text renders THIS
+                # scrape's values — the reverse order (or the throttled
+                # async flush alone) served the previous scrape's.
+                core = core_prometheus_text()
+                body = prometheus_text() + core
                 return self._send(200, body.encode(),
                                   "text/plain; version=0.0.4")
             if path == "/api/version":
@@ -213,6 +220,21 @@ class _Handler(BaseHTTPRequestHandler):
                 data = state.summarize_actors()
             elif path == "/api/summary/objects":
                 data = state.summarize_objects()
+            elif path == "/api/summary/task_latency":
+                # Per-stage lifecycle latency percentiles (SUBMITTED →
+                # LEASE_* → DISPATCHED → ARGS_FETCHED → RUNNING →
+                # FINISHED) from the GCS task-event table. Bounded by
+                # default — the endpoint is polled, and dragging the
+                # full 200k-row table over RPC per request would make
+                # the GCS spend its loop time packing event batches.
+                limit = int((q.get("limit") or ["20000"])[0])
+                data = state.summarize_task_latency(
+                    limit=max(1, min(limit, 500000)))
+            elif path == "/api/pump_stats":
+                # Daemon event-loop stats: per-handler call counts +
+                # latencies for the GCS/raylet pumps (event_stats.h
+                # analogue) and the native in-pump service counters.
+                data = state.pump_stats()
             elif path == "/api/node_stats":
                 data = state.node_stats(
                     node_id=(q.get("node") or [None])[0])

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from ray_tpu.util.collective.ops import axis_size as _axis_size
+
 try:
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -336,7 +338,7 @@ def ring_attention(q, k, v, axis: str = "sp", *, causal: bool = False,
     ring via ppermute; a running online-softmax merge keeps exactness.
     For causal masking, chunk index determines global positions.
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     my_idx = lax.axis_index(axis)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     B, H, S, D = q.shape
@@ -371,8 +373,14 @@ def ring_attention(q, k, v, axis: str = "sp", *, causal: bool = False,
     # Mark the carries as varying over the ring axis so the scan carry
     # types match (shard_map's varying-axis type system). pcast is the
     # current spelling; fall back to pvary on older JAX.
-    _vary = (lambda x: lax.pcast(x, axis, to="varying")) \
-        if hasattr(lax, "pcast") else (lambda x: lax.pvary(x, (axis,)))
+    if hasattr(lax, "pcast"):
+        _vary = lambda x: lax.pcast(x, axis, to="varying")  # noqa: E731
+    elif hasattr(lax, "pvary"):
+        _vary = lambda x: lax.pvary(x, (axis,))  # noqa: E731
+    else:
+        # jax 0.4.x: shard_map has no varying-axis type system yet —
+        # no cast needed.
+        _vary = lambda x: x  # noqa: E731
     acc0 = _vary(jnp.zeros((B, H, S, D), jnp.float32))
     m0 = _vary(jnp.full((B, H, S, 1), _NEG_INF, jnp.float32))
     l0 = _vary(jnp.zeros((B, H, S, 1), jnp.float32))
@@ -400,7 +408,7 @@ def ulysses_attention(q, k, v, axis: str = "sp", *, causal: bool = False,
     The reference has no sequence parallelism at all (SURVEY.md §2.4: SP
     "absent", Ulysses named as the rebuild deliverable).
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     B, H, S, D = q.shape  # S = local shard of the sequence
     if H % n:
         raise ValueError(f"ulysses needs heads ({H}) divisible by axis ({n})")

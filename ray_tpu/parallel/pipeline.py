@@ -16,6 +16,8 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+from ray_tpu.util.collective.ops import axis_size as _axis_size
 import jax.numpy as jnp
 from jax import lax
 
@@ -33,7 +35,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
 
     Total steps = num_micro + num_stages - 1 (fill + drain).
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = _axis_size(axis)
     stage = lax.axis_index(axis)
     num_micro = x_microbatches.shape[0]
     mb_shape = x_microbatches.shape[1:]
@@ -63,10 +65,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
     # Carries vary over the pipeline axis (ppermute) AND any axes the input
     # varies over (e.g. dp-sharded batch): adding 0·x unions the two sets.
     def _vary(val):
-        # jax>=0.9 renames pvary to pcast(..., to='varying'); support both.
+        # jax>=0.9 renames pvary to pcast(..., to='varying'); support
+        # both, and 0.4.x (no varying-axis types) needs no cast at all.
         if hasattr(lax, "pcast"):
             return lax.pcast(val, (axis,), to="varying")
-        return lax.pvary(val, (axis,))
+        if hasattr(lax, "pvary"):
+            return lax.pvary(val, (axis,))
+        return val
 
     zero_like_x = jnp.zeros(mb_shape, x_microbatches.dtype) + \
         x_microbatches[0] * 0

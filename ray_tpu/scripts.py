@@ -231,6 +231,44 @@ def cmd_summary(args):
     return 0
 
 
+def cmd_task_latency(args):
+    """`ray_tpu task-latency` — per-stage lifecycle latency percentiles
+    (SUBMITTED → LEASE_REQUESTED → LEASE_GRANTED → DISPATCHED →
+    ARGS_FETCHED → RUNNING → FINISHED/FAILED) from the GCS task-event
+    table, rendered as one row per stage."""
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util import state
+
+    out = state.summarize_task_latency(limit=args.limit)
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"{out['tasks']} tasks with recorded events")
+        print(f"{'STAGE':<24}{'COUNT':>8}{'P50':>10}{'P95':>10}"
+              f"{'P99':>10}{'MEAN':>10}{'MAX':>10}  (ms)")
+        for name, _, _ in state.LATENCY_STAGES:
+            s = out["stages"].get(name)
+            if s is None:
+                continue
+            print(f"{name:<24}{s['count']:>8}{s['p50_ms']:>10.2f}"
+                  f"{s['p95_ms']:>10.2f}{s['p99_ms']:>10.2f}"
+                  f"{s['mean_ms']:>10.2f}{s['max_ms']:>10.2f}")
+    _shutdown_if_owned(ray_tpu)
+    return 0
+
+
+def cmd_pump_stats(args):
+    """`ray_tpu pump-stats` — daemon event-loop stats: per-handler call
+    counts and latencies for the GCS and every raylet pump (analogue of
+    the reference's event_stats.h debug dump)."""
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.pump_stats(), indent=2, default=str))
+    _shutdown_if_owned(ray_tpu)
+    return 0
+
+
 def cmd_drain(args):
     """`ray_tpu drain <node_id>` — stop new leases on a node and let
     running work finish (parity: reference `ray drain-node`; same
@@ -386,6 +424,16 @@ def main():
                                        "(parity: `ray summary`)")
     p.add_argument("entity", choices=["tasks", "actors", "objects"])
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("task-latency", help="per-stage task lifecycle "
+                                            "latency percentiles")
+    p.add_argument("--limit", type=int, default=200000)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_task_latency)
+
+    p = sub.add_parser("pump-stats", help="daemon event-loop stats "
+                                          "(per-handler counts/latencies)")
+    p.set_defaults(fn=cmd_pump_stats)
 
     p = sub.add_parser("drain", help="drain a node: stop new leases, let "
                                      "running work finish (parity: "

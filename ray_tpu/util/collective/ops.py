@@ -72,12 +72,12 @@ def permute(x, axis: str, perm: list[tuple[int, int]]):
 
 
 def shift_right(x, axis: str):
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
 
 
 def shift_left(x, axis: str):
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
 
 
@@ -85,5 +85,12 @@ def axis_index(axis: str):
     return lax.axis_index(axis)
 
 
-def axis_size(axis: str):
-    return lax.axis_size(axis)
+def axis_size(axis: str) -> int:
+    """Concrete size of a named mesh axis, across jax versions:
+    lax.axis_size where it exists, else jax.core.axis_frame (which
+    returns the int size on the 0.4.x line)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    from jax import core as _core
+
+    return _core.axis_frame(axis)

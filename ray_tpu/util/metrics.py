@@ -65,6 +65,30 @@ class Metric:
                 "values": {json.dumps(k): v for k, v in self._values.items()}}
 
 
+def flush_registry_now() -> bool:
+    """Publish the CURRENT registry snapshot to the GCS synchronously.
+
+    The per-set `_flush_maybe` path is throttled (1/s) and fire-and-
+    forget — fine for user metrics, but a scrape that just updated a
+    batch of gauges (export_pump_stats) must publish the complete batch
+    BEFORE the exposition renders, or it serves the previous scrape's
+    values. Returns False when no cluster is connected."""
+    cw = core_worker_or_none()
+    if cw is None or cw.gcs is None or cw.gcs.closed:
+        return False
+    with _registry_lock:
+        snapshot = {name: m.snapshot() for name, m in _registry.items()}
+    try:
+        cw._run(cw.gcs.call("KVPut", {
+            "ns": "metrics",
+            "key": f"worker:{cw.worker_id}".encode(),
+            "value": json.dumps(snapshot).encode()}, timeout=5))
+        _last_flush[0] = time.monotonic()
+        return True
+    except Exception:
+        return False
+
+
 class Counter(Metric):
     def inc(self, value: float = 1.0, tags: dict | None = None):
         key = self._tag_tuple(tags)
@@ -202,6 +226,91 @@ def prometheus_text() -> str:
     return "\n".join(lines) + "\n"
 
 
+_pump_gauges: dict[str, Metric] | None = None
+# (monotonic ts, snapshot): pump_stats() is a cluster-wide RPC sweep —
+# a fresh connect to every raylet — so scrape paths reuse one snapshot
+# for a few seconds instead of sweeping per scrape.
+_pump_cache: tuple[float, dict | None] = (float("-inf"), None)
+_PUMP_CACHE_TTL_S = 5.0
+
+
+def _pump_stats_cached() -> dict:
+    global _pump_cache
+    from ray_tpu.util import state as _state
+
+    ts, snap = _pump_cache
+    now = time.monotonic()
+    if snap is None or now - ts >= _PUMP_CACHE_TTL_S:
+        snap = _state.pump_stats()
+        _pump_cache = (now, snap)
+    return snap
+
+
+def export_pump_stats() -> dict:
+    """Publish every daemon's event-loop stats as util.metrics gauges
+    (per-handler call count / cumulative latency / max latency, plus
+    loop drain + queue-depth gauges), tagged by daemon and RPC method.
+    Returns the raw state.pump_stats() snapshot the gauges were built
+    from. Parity: the reference exports event_stats.h counters through
+    metric_defs.cc `operation_count`/`operation_run_time_ms`."""
+    global _pump_gauges
+    if _pump_gauges is None:
+        _pump_gauges = {
+            "calls": Gauge("ray_tpu_pump_handler_calls",
+                           "RPC handler invocations per daemon event loop",
+                           ("daemon", "method")),
+            "errors": Gauge("ray_tpu_pump_handler_errors",
+                            "RPC handler invocations that raised",
+                            ("daemon", "method")),
+            "cum_ms": Gauge("ray_tpu_pump_handler_latency_ms_total",
+                            "cumulative handler latency per method (ms)",
+                            ("daemon", "method")),
+            "max_ms": Gauge("ray_tpu_pump_handler_latency_ms_max",
+                            "max single-dispatch handler latency (ms)",
+                            ("daemon", "method")),
+            "drains": Gauge("ray_tpu_pump_drains",
+                            "event-loop drain callbacks (loop wakeups)",
+                            ("daemon",)),
+            "events": Gauge("ray_tpu_pump_events",
+                            "events pulled across all drains", ("daemon",)),
+            "queue_depth": Gauge("ray_tpu_pump_queue_depth",
+                                 "in-flight async dispatches (last seen)",
+                                 ("daemon",)),
+            "native_handled": Gauge(
+                "ray_tpu_pump_native_handled",
+                "frames handled by the in-pump native service",
+                ("daemon",)),
+        }
+    snap = _pump_stats_cached()
+    daemons = [("gcs", snap.get("gcs") or {})]
+    for r in snap.get("raylets") or []:
+        if "server" in r:
+            daemons.append((f"raylet-{str(r.get('node_id', '?'))[:8]}", r))
+    g = _pump_gauges
+    for daemon, stats in daemons:
+        server = stats.get("server") or {}
+        for method, h in (server.get("handlers") or {}).items():
+            tags = {"daemon": daemon, "method": method}
+            g["calls"].set(h["count"], tags=tags)
+            g["errors"].set(h["errors"], tags=tags)
+            g["cum_ms"].set(h["cum_ms"], tags=tags)
+            g["max_ms"].set(h["max_ms"], tags=tags)
+        loop = server.get("loop") or {}
+        g["drains"].set(loop.get("drains", 0), tags={"daemon": daemon})
+        g["events"].set(loop.get("events", 0), tags={"daemon": daemon})
+        g["queue_depth"].set(loop.get("queue_depth", 0),
+                             tags={"daemon": daemon})
+        native = stats.get("native")
+        if native:
+            g["native_handled"].set(native.get("handled", 0),
+                                    tags={"daemon": daemon})
+    # Synchronous publish of the complete batch: the throttled per-set
+    # flush would snapshot mid-update and race the exposition's KV read,
+    # leaving the rendered pump families one scrape behind.
+    flush_registry_now()
+    return snap
+
+
 def core_prometheus_text() -> str:
     """Core-runtime metrics in Prometheus exposition format (parity:
     reference src/ray/stats/metric_defs.cc per-component instrumentation
@@ -253,6 +362,28 @@ def core_prometheus_text() -> str:
         tasks = _state.summarize_tasks()["by_state"]
         gauge("ray_tpu_tasks", "task events by state",
               [({"state": k}, v) for k, v in tasks.items()])
+    except Exception:
+        pass
+    # Event-loop/pump stats per daemon (analogue of the reference's
+    # event_stats.h exported through metric_defs.cc operation_* series).
+    # Published ONLY through the registry gauges (rendered by
+    # prometheus_text) — emitting the same family names here too would
+    # duplicate their TYPE blocks in the concatenated /metrics page,
+    # which expfmt consumers reject wholesale.
+    try:
+        export_pump_stats()
+    except Exception:
+        pass
+    # Per-stage task-lifecycle latency percentiles (families unique to
+    # this exposition; bounded limit — the scrape path must not drag
+    # the full 200k-row event table over RPC every 15s).
+    try:
+        lat = _state.summarize_task_latency(limit=20000)
+        for pct in ("p50_ms", "p95_ms", "p99_ms"):
+            gauge(f"ray_tpu_task_stage_{pct}",
+                  f"task lifecycle stage latency {pct[:-3]} (ms)",
+                  [({"stage": s}, v[pct])
+                   for s, v in lat["stages"].items()])
     except Exception:
         pass
     return "\n".join(lines) + "\n"

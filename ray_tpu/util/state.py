@@ -32,13 +32,28 @@ def list_placement_groups() -> list[dict]:
     return cw._run(cw.gcs.call("ListPlacementGroups", {}))["placement_groups"]
 
 
+# Ordered lifecycle ladder (reference: gcs.proto TaskStatus). Owner-side
+# stamps: SUBMITTED, LEASE_*, DISPATCHED, FINISHED/FAILED; executor-side:
+# ARGS_FETCHED, RUNNING; GCS-side: actor CREATE_* stages.
+LIFECYCLE_STAGES = ("SUBMITTED", "LEASE_REQUESTED", "LEASE_GRANTED",
+                    "DISPATCHED", "ARGS_FETCHED", "RUNNING",
+                    "FINISHED", "FAILED")
+_STAGE_RANK = {s: i for i, s in enumerate(LIFECYCLE_STAGES)}
+
+
 def list_tasks(limit: int = 1000) -> list[dict]:
-    """Latest known state per task, from the GCS task-event buffer."""
+    """Latest known state per task, from the GCS task-event buffer.
+    Events for one task arrive from several processes (owner, executor,
+    GCS), so "latest" is by timestamp with the ladder rank as the
+    tie-break, not by arrival order."""
     cw = get_core_worker()
-    events = cw._run(cw.gcs.call("ListTaskEvents", {"limit": limit * 4}))["events"]
+    events = cw._run(cw.gcs.call("ListTaskEvents", {"limit": limit * 8}))["events"]
     latest: dict[str, dict] = {}
     for e in events:
-        latest[e["task_id"]] = e
+        cur = latest.get(e["task_id"])
+        if cur is None or (e.get("ts", 0.0), _STAGE_RANK.get(e.get("state"), -1)) \
+                >= (cur.get("ts", 0.0), _STAGE_RANK.get(cur.get("state"), -1)):
+            latest[e["task_id"]] = e
     return list(latest.values())[-limit:]
 
 
@@ -176,3 +191,99 @@ def summarize_objects() -> dict:
 def cluster_status() -> dict:
     cw = get_core_worker()
     return cw._run(cw.gcs.call("GetClusterStatus", {}))
+
+
+# ---------- task-lifecycle latency breakdown ----------
+
+# (stage_name, from_state, to_state): duration of each ladder segment.
+# `total` spans submission to terminal state.
+LATENCY_STAGES = (
+    ("queue_to_lease_request", "SUBMITTED", "LEASE_REQUESTED"),
+    ("lease_negotiation", "LEASE_REQUESTED", "LEASE_GRANTED"),
+    ("dispatch", "LEASE_GRANTED", "DISPATCHED"),
+    ("args_fetch", "DISPATCHED", "ARGS_FETCHED"),
+    ("startup", "ARGS_FETCHED", "RUNNING"),
+    ("execution", "RUNNING", None),       # None = FINISHED or FAILED
+    ("total", "SUBMITTED", None),
+)
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+def summarize_task_latency(limit: int = 200000,
+                           events: list[dict] | None = None) -> dict:
+    """Per-stage latency percentiles across the task-event table
+    (reference analog: `ray summary tasks` backed by gcs_task_manager's
+    per-state timestamps). Returns {"tasks": n, "stages": {stage:
+    {count, p50_ms, p95_ms, p99_ms, mean_ms, max_ms}}}; a stage is
+    reported only for tasks that recorded both of its endpoints, so
+    actor tasks (no lease stages) and failed tasks mix freely with the
+    plain-task ladder."""
+    if events is None:
+        cw = get_core_worker()
+        events = cw._run(cw.gcs.call(
+            "ListTaskEvents", {"limit": limit}))["events"]
+    # (min, max) stamp per (task, state): pre-execution stages pair the
+    # FIRST pass's stamps (what the submission experienced); the
+    # execution stage pairs the terminal stamp with the LAST RUNNING at
+    # or before it, so a task that failed once and finished on retry
+    # doesn't book the whole retry gap as user-code execution.
+    per_task: dict[str, dict[str, tuple[float, float]]] = {}
+    for e in events:
+        stamps = per_task.setdefault(e["task_id"], {})
+        state = e.get("state")
+        ts = e.get("ts")
+        if state is None or ts is None:
+            continue
+        cur = stamps.get(state)
+        stamps[state] = (ts, ts) if cur is None else \
+            (min(cur[0], ts), max(cur[1], ts))
+    samples: dict[str, list[float]] = {name: [] for name, _, _ in
+                                       LATENCY_STAGES}
+    for stamps in per_task.values():
+        terminal = stamps.get("FINISHED", stamps.get("FAILED"))
+        terminal = terminal and terminal[0]
+        for name, frm, to in LATENCY_STAGES:
+            span0 = stamps.get(frm)
+            if span0 is None:
+                continue
+            t0 = span0[0]
+            if to is None:
+                t1 = terminal
+                if name == "execution" and t1 is not None \
+                        and span0[1] <= t1:
+                    t0 = span0[1]  # last attempt's RUNNING
+            else:
+                t1 = stamps.get(to) and stamps[to][0]
+            if t1 is not None:
+                samples[name].append(max(0.0, t1 - t0))
+    stages = {}
+    for name, vals in samples.items():
+        if not vals:
+            continue
+        vals.sort()
+        stages[name] = {
+            "count": len(vals),
+            "p50_ms": round(_percentile(vals, 0.50) * 1000, 3),
+            "p95_ms": round(_percentile(vals, 0.95) * 1000, 3),
+            "p99_ms": round(_percentile(vals, 0.99) * 1000, 3),
+            "mean_ms": round(sum(vals) / len(vals) * 1000, 3),
+            "max_ms": round(vals[-1] * 1000, 3),
+        }
+    return {"tasks": len(per_task), "stages": stages}
+
+
+def pump_stats() -> dict:
+    """Event-loop/RPC dispatch stats of every daemon: the GCS pump
+    (per-handler latencies + native in-pump service counters) and each
+    raylet's pump. The Python-side analogue of the reference's
+    event_stats.h surface (`RAY_event_stats=1` debug state dump)."""
+    cw = get_core_worker()
+    gcs = cw._run(cw.gcs.call("GetEventLoopStats", {}, timeout=10))
+    return {"gcs": gcs, "raylets": _per_node_call("GetEventLoopStats")}

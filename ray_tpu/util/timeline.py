@@ -2,9 +2,11 @@
 
 Parity: reference `ray timeline` (scripts.py:2459) which dumps per-worker
 profile events (core_worker/profile_event.cc → task_event_buffer.h) as a
-chrome://tracing JSON. Here the GCS task-event table provides the
-RUNNING→FINISHED/FAILED pairs; rows are (node, worker), one "X" complete
-event per task execution.
+chrome://tracing JSON. Here the GCS task-event table provides the full
+lifecycle ladder: one "X" complete event per task execution on (node,
+worker) rows, plus per-STAGE sub-spans (queue, lease negotiation,
+dispatch, arg fetch) on dedicated "stage:<name>" rows so where a slow
+task spent its pre-execution time is visible at a glance.
 """
 
 from __future__ import annotations
@@ -13,14 +15,48 @@ import json
 
 from ray_tpu._private.api_internal import get_core_worker
 
+# Pre-execution ladder segments rendered as their own rows (everything
+# up to and including RUNNING — one shared definition with the state
+# API); the RUNNING→FINISHED span stays the per-worker execution row.
+from ray_tpu.util.state import LIFECYCLE_STAGES
+
+_STAGE_LADDER = LIFECYCLE_STAGES[:LIFECYCLE_STAGES.index("RUNNING") + 1]
+_STAGE_NAMES = {"LEASE_REQUESTED": "queue", "LEASE_GRANTED": "lease",
+                "DISPATCHED": "dispatch", "ARGS_FETCHED": "args_fetch",
+                "RUNNING": "startup"}
+
+
+def _stage_rows(task_stamps: dict[str, dict[str, dict]]) -> list[dict]:
+    """Per-stage sub-spans: for each task, an 'X' between each pair of
+    consecutive recorded ladder stamps, on a row per stage."""
+    trace = []
+    for tid, stamps in task_stamps.items():
+        present = [s for s in _STAGE_LADDER if s in stamps]
+        for frm, to in zip(present, present[1:]):
+            e0, e1 = stamps[frm], stamps[to]
+            trace.append({
+                "name": e0.get("name", tid),
+                "cat": "stage",
+                "ph": "X",
+                "ts": e0["ts"] * 1e6,
+                "dur": max(0.0, (e1["ts"] - e0["ts"]) * 1e6),
+                "pid": "lifecycle",
+                "tid": f"stage:{_STAGE_NAMES[to]}",
+                "args": {"task_id": tid, "from": frm, "to": to},
+            })
+    return trace
+
 
 def build_trace_events(events: list[dict]) -> list[dict]:
     """Pair per-task state transitions into chrome trace 'X' events."""
     starts: dict[str, dict] = {}
     trace: list[dict] = []
+    task_stamps: dict[str, dict[str, dict]] = {}
     for e in sorted(events, key=lambda e: e.get("ts", 0.0)):
         state = e.get("state")
         tid = e.get("task_id")
+        if state in _STAGE_LADDER:
+            task_stamps.setdefault(tid, {}).setdefault(state, e)
         if state == "RUNNING":
             starts[tid] = e
         elif state in ("FINISHED", "FAILED") and tid in starts:
@@ -42,6 +78,7 @@ def build_trace_events(events: list[dict]) -> list[dict]:
                       "ts": s["ts"] * 1e6, "pid": s.get("node_id", "n")[:8],
                       "tid": s.get("worker_id", "w")[:8], "s": "t",
                       "args": {"task_id": tid, "state": "RUNNING"}})
+    trace.extend(_stage_rows(task_stamps))
     return trace
 
 

@@ -22,7 +22,12 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       else
         echo "[$(date +%T)] TPU BENCH SUCCESS:" >> "$LOG"
         cat .bench_tpu_out.json >> "$LOG"
-        cp .bench_tpu_out.json BENCH_TPU_LIVE.json
+        # Health-gated install: a capture whose embedded health stamp
+        # says "degraded" must NOT clobber a healthy artifact (it lands
+        # beside it as BENCH_TPU_LIVE.degraded.json) — the r5 failure
+        # mode where a sick-tunnel capture became the number of record.
+        python bench.py --save-artifact .bench_tpu_out.json \
+          BENCH_TPU_LIVE.json >> "$LOG" 2>&1
         # Follow-ups while the tunnel answers: the max-fit (~2.7B,
         # remat+adafactor at the HBM edge) scaling datapoint and the
         # on-chip kernel sweep (Mosaic rejects kernels interpret mode
@@ -30,7 +35,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
         if timeout 3600 env RAY_TPU_BENCH_CONFIG=max python bench.py \
             > .bench_tpu_max.json 2>> "$LOG"; then
           if ! grep -q '"backend": "cpu"' .bench_tpu_max.json; then
-            cp .bench_tpu_max.json BENCH_TPU_MAX.json
+            python bench.py --save-artifact .bench_tpu_max.json \
+              BENCH_TPU_MAX.json >> "$LOG" 2>&1
             echo "[$(date +%T)] max-fit capture:" >> "$LOG"
             cat .bench_tpu_max.json >> "$LOG"
           fi

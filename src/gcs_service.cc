@@ -98,8 +98,32 @@ void AppendHex(std::string& out, std::string_view raw) {
   }
 }
 
+// Canonicalize one str/bin key encoding: decode content + type, re-encode
+// in msgpack-python's smallest form. Python's _pack_row re-packs the
+// DECODED key, so any accepted wire encoding of the same logical key must
+// map to the same canonical bytes — otherwise a valid-but-non-canonical
+// client encoding (e.g. bin16 for a 1-byte key) yields a store row the
+// Python fallback can never delete, and the row resurrects on restart.
+// Returns false for non-str/bin keys (kept verbatim by the caller).
+bool CanonicalKey(std::string_view key_raw, std::string* out) {
+  if (key_raw.empty()) return false;
+  View v{(const uint8_t*)key_raw.data(), key_raw.size(), 0};
+  uint8_t tag = (uint8_t)key_raw[0];
+  bool is_str = (tag & 0xe0) == 0xa0 || tag == 0xd9 || tag == 0xda ||
+                tag == 0xdb;
+  std::string_view content;
+  if (!mplite::read_strbin(v, &content) || v.off != key_raw.size())
+    return false;
+  out->clear();
+  if (is_str) mplite::w_str(*out, content);
+  else mplite::w_bin(*out, content);
+  return true;
+}
+
 // Store key for one kv row: hex(msgpack([ns, key])) — must byte-match
 // rpc.pack([ns, k]).hex() in gcs.py _pack_row for the same logical key.
+// `key_raw` is canonical by the time it gets here (gsvc_on_frame
+// canonicalizes the parsed key before any table/WAL use).
 std::string RowKeyHex(std::string_view ns, std::string_view key_raw) {
   std::string packed;
   mplite::w_array(packed, 2);
@@ -175,9 +199,11 @@ struct Fields {
   bool overwrite = true;        // "overwrite"
   std::string_view prefix;      // "prefix" content bytes
   std::string_view channel;     // "channel" (str)
+  bool have_channel = false;
   std::string_view message_raw; // "message" raw encoding
   bool have_message = false;
   std::vector<std::string_view> channels;  // "channels" (list of str)
+  bool have_channels = false;
 };
 
 bool ParsePayload(View& v, Fields* f) {
@@ -201,12 +227,14 @@ bool ParsePayload(View& v, Fields* f) {
       if (!mplite::read_strbin(v, &f->prefix)) return false;
     } else if (k == "channel") {
       if (!mplite::read_str(v, &f->channel)) return false;
+      f->have_channel = true;
     } else if (k == "message") {
       if (!mplite::read_raw(v, &f->message_raw)) return false;
       f->have_message = true;
     } else if (k == "channels") {
       uint32_t cn;
       if (!mplite::read_array(v, &cn)) return false;
+      f->have_channels = true;
       for (uint32_t j = 0; j < cn; j++) {
         std::string_view ch;
         if (!mplite::read_str(v, &ch)) return false;
@@ -362,6 +390,14 @@ int gsvc_on_frame(void* h, int64_t conn_id, const char* data, uint32_t len) {
   if (!ParsePayload(v, &f))
     return Malformed(s, conn_id, msg_type, seq, method);
 
+  // Key identity is the CANONICAL encoding: msgpack-python clients
+  // always send smallest-form, but any accepted non-canonical encoding
+  // of the same logical key must hit the same table slot and the same
+  // store row as the canonical one (and as Python's re-packed row key).
+  std::string canon_key;
+  if (f.have_key && CanonicalKey(f.key_raw, &canon_key))
+    f.key_raw = canon_key;
+
   std::string result;
   std::lock_guard<std::mutex> lock(s->mu);
   switch (op) {
@@ -443,6 +479,10 @@ int gsvc_on_frame(void* h, int64_t conn_id, const char* data, uint32_t len) {
       break;
     }
     case SUB: {
+      // Python parity: handle_subscribe KeyErrors on a missing
+      // "channels" field (an empty list is fine).
+      if (!f.have_channels)
+        return Malformed(s, conn_id, msg_type, seq, method);
       for (auto ch : f.channels) {
         std::string chs(ch);
         if (s->subs[chs].insert(conn_id).second)
@@ -452,7 +492,10 @@ int gsvc_on_frame(void* h, int64_t conn_id, const char* data, uint32_t len) {
       break;
     }
     case PUB: {
-      if (f.channel.empty() && !f.have_message)
+      // Python parity: handle_publish KeyErrors on a missing "channel"
+      // OR "message" — a Publish without a channel must NOT fan out to
+      // channel "" and report ok.
+      if (!f.have_channel || !f.have_message)
         return Malformed(s, conn_id, msg_type, seq, method);
       // Re-wrap as the notify frame every subscriber expects:
       // [MSG_NOTIFY, 0, "Publish", {"channel": ch, "message": raw}].

@@ -1,0 +1,154 @@
+"""Bench artifact health stamp + no-clobber rule (VERDICT r5 weak #1:
+a sick-tunnel capture overwrote the healthy number of record and nothing
+could tell environment degradation from a code regression)."""
+
+import json
+import os
+import subprocess
+import sys
+
+from ray_tpu._private.bench_health import (best_recorded_probe,
+                                           degraded_sibling,
+                                           is_healthy_accelerator,
+                                           make_stamp, save_artifact)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rec(value=16000.0, backend="axon", health=None):
+    extra = {"backend": backend, "mfu": 0.6}
+    if health is not None:
+        extra["health"] = health
+    return {"metric": "llama_train_tokens_per_sec_per_chip",
+            "value": value, "unit": "tokens/s/chip",
+            "vs_baseline": 1.3, "extra": extra}
+
+
+def test_make_stamp_ok():
+    h = make_stamp(90000.0, 88000.0, "axon", best_recorded=95000.0)
+    assert h["verdict"] == "ok" and h["reasons"] == []
+    assert h["probe_gflops_best"] == 95000.0
+
+
+def test_make_stamp_degraded_vs_best():
+    # r5's signature: probe collapses to ~0.3x of the best recorded.
+    h = make_stamp(28000.0, 27000.0, "axon", best_recorded=95000.0)
+    assert h["verdict"] == "degraded"
+    assert any("best recorded" in r for r in h["reasons"])
+
+
+def test_make_stamp_degraded_below_floor():
+    h = make_stamp(300.0, 250.0, "axon")
+    assert h["verdict"] == "degraded"
+    assert any("floor" in r for r in h["reasons"])
+
+
+def test_make_stamp_degraded_during_capture():
+    h = make_stamp(90000.0, 20000.0, "axon", best_recorded=90000.0)
+    assert h["verdict"] == "degraded"
+    assert any("during" in r for r in h["reasons"])
+
+
+def test_make_stamp_cpu_has_no_floor():
+    h = make_stamp(15.0, 14.0, "cpu")
+    assert h["verdict"] == "ok"
+
+
+def test_save_refuses_degraded_over_healthy(tmp_path):
+    dest = str(tmp_path / "BENCH_TPU_LIVE.json")
+    src = str(tmp_path / "new.json")
+    healthy = _rec(health=make_stamp(90000.0, 89000.0, "axon"))
+    with open(dest, "w") as f:
+        json.dump(healthy, f)
+    degraded = _rec(value=4800.0,
+                    health=make_stamp(25000.0, 24000.0, "axon",
+                                      best_recorded=90000.0))
+    with open(src, "w") as f:
+        json.dump(degraded, f)
+    assert save_artifact(src, dest) == 0
+    with open(dest) as f:
+        assert json.load(f)["value"] == 16000.0  # healthy record kept
+    side = degraded_sibling(dest)
+    assert side.endswith("BENCH_TPU_LIVE.degraded.json")
+    with open(side) as f:
+        assert json.load(f)["value"] == 4800.0  # evidence kept beside
+
+
+def test_save_refuses_cpu_over_accelerator(tmp_path):
+    dest = str(tmp_path / "BENCH_TPU_LIVE.json")
+    src = str(tmp_path / "new.json")
+    with open(dest, "w") as f:
+        json.dump(_rec(), f)  # legacy healthy record, no stamp
+    with open(src, "w") as f:
+        json.dump(_rec(value=120.0, backend="cpu",
+                       health=make_stamp(15.0, 15.0, "cpu")), f)
+    assert save_artifact(src, dest) == 0
+    with open(dest) as f:
+        assert json.load(f)["extra"]["backend"] == "axon"
+
+
+def test_save_allows_healthy_over_anything(tmp_path):
+    dest = str(tmp_path / "BENCH_TPU_LIVE.json")
+    src = str(tmp_path / "new.json")
+    with open(dest, "w") as f:
+        json.dump(_rec(value=4800.0,
+                       health=make_stamp(200.0, 200.0, "axon")), f)
+    fresh = _rec(value=17000.0, health=make_stamp(91000.0, 92000.0, "axon"))
+    with open(src, "w") as f:
+        json.dump(fresh, f)
+    assert save_artifact(src, dest) == 0
+    with open(dest) as f:
+        assert json.load(f)["value"] == 17000.0
+
+
+def test_save_first_artifact_always_lands(tmp_path):
+    dest = str(tmp_path / "BENCH_TPU_LIVE.json")
+    src = str(tmp_path / "new.json")
+    with open(src, "w") as f:
+        json.dump(_rec(value=5.0,
+                       health=make_stamp(100.0, 90.0, "axon")), f)
+    assert save_artifact(src, dest) == 0
+    assert os.path.exists(dest)
+
+
+def test_best_recorded_probe_reads_stamp(tmp_path):
+    p = str(tmp_path / "BENCH_TPU_LIVE.json")
+    with open(p, "w") as f:
+        json.dump(_rec(health=make_stamp(90000.0, 85000.0, "axon")), f)
+    assert best_recorded_probe(p) == 90000.0
+    assert best_recorded_probe(str(tmp_path / "missing.json")) is None
+
+
+def test_is_healthy_accelerator():
+    assert is_healthy_accelerator(_rec())                   # legacy
+    assert not is_healthy_accelerator(_rec(backend="cpu"))
+    assert not is_healthy_accelerator(_rec(value=0.0))
+    assert not is_healthy_accelerator(
+        _rec(health=make_stamp(100.0, 90.0, "axon")))       # degraded
+
+
+def test_bench_cli_save_artifact_no_jax(tmp_path):
+    """`python bench.py --save-artifact` must work without touching jax
+    (a wedged tunnel can never block the save path) — exercised as the
+    watchdog invokes it."""
+    src = str(tmp_path / "cap.json")
+    dest = str(tmp_path / "BENCH_TPU_LIVE.json")
+    with open(src, "w") as f:
+        json.dump(_rec(health=make_stamp(90000.0, 90000.0, "axon")), f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--save-artifact", src, dest],
+        capture_output=True, text=True, timeout=60, env=env, cwd=_REPO)
+    assert r.returncode == 0, r.stderr
+    assert "installed" in r.stderr
+    with open(dest) as f:
+        assert json.load(f)["value"] == 16000.0
+    # Malformed arity errors out fast — it must never fall through into
+    # the jax-initializing bench path (wedged-tunnel hazard).
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--save-artifact", src],
+        capture_output=True, text=True, timeout=60, env=env, cwd=_REPO)
+    assert r.returncode == 2 and "usage:" in r.stderr

@@ -81,6 +81,17 @@ def test_dashboard_endpoints(ray_start_regular):
         assert "nodes" in status or status
         ver = json.loads(urllib.request.urlopen(f"{base}/api/version").read())
         assert ver["version"] == ray_tpu.__version__
+        # Observability additions: lifecycle latency breakdown + daemon
+        # event-loop stats.
+        lat = json.loads(urllib.request.urlopen(
+            f"{base}/api/summary/task_latency").read())
+        # Flush cadence is 1s, so counts may still be 0 here — this is
+        # the endpoint contract check; test_task_latency covers content.
+        assert "stages" in lat and "tasks" in lat
+        pump = json.loads(urllib.request.urlopen(
+            f"{base}/api/pump_stats").read())
+        assert sum(h["count"] for h in
+                   pump["gcs"]["server"]["handlers"].values()) > 0
     finally:
         dashboard.stop()
 

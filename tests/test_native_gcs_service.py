@@ -299,3 +299,187 @@ def test_str_and_bytes_keys_are_distinct(tmp_path):
             await gcs.stop()
 
     run(main())
+
+
+def test_publish_missing_channel_malformed(tmp_path):
+    """ADVICE r5: a Publish without "channel" (or without "message")
+    must be rejected as malformed — the Python handler KeyErrors — not
+    fanned out to channel "" with ok:true."""
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            with pytest.raises(rpc.RpcError, match="malformed"):
+                await conn.call("Publish", {"message": {"x": 1}})
+            with pytest.raises(rpc.RpcError, match="malformed"):
+                await conn.call("Publish", {"channel": "X"})
+            # Well-formed publish still works.
+            assert (await conn.call(
+                "Publish", {"channel": "X", "message": {"x": 1}}))["ok"]
+            assert gcs._native_svc.proto_errors() == 2
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_subscribe_missing_channels_malformed(tmp_path):
+    """ADVICE r5: Subscribe without a "channels" list must error like
+    the Python handler (KeyError), not return ok:true."""
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            with pytest.raises(rpc.RpcError, match="malformed"):
+                await conn.call("Subscribe", {})
+            # An EMPTY channels list is well-formed (subscribes to
+            # nothing), matching the Python for-loop semantics.
+            assert (await conn.call("Subscribe", {"channels": []}))["ok"]
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def _raw_kvput_frame(seq: int) -> bytes:
+    """A KVPut request whose key uses a VALID but NON-CANONICAL msgpack
+    encoding (bin16 for a 1-byte key — msgpack-python would emit bin8).
+    Hand-built: pack() always produces canonical forms."""
+    body = bytes([0x94])                    # [msg_type, seq, method, payload]
+    body += bytes([0x00])                   # MSG_REQUEST
+    body += bytes([seq])                    # seq (fixint)
+    body += bytes([0xa5]) + b"KVPut"
+    body += bytes([0x83])                   # map3
+    body += bytes([0xa2]) + b"ns" + bytes([0xa1]) + b"t"
+    body += bytes([0xa3]) + b"key" + bytes([0xc5, 0x00, 0x01]) + b"a"
+    body += bytes([0xa5]) + b"value" + bytes([0xc4, 0x01]) + b"v"
+    return len(body).to_bytes(4, "big") + body
+
+
+def test_noncanonical_key_encoding_canonicalizes(tmp_path, monkeypatch):
+    """ADVICE r5: RowKeyHex must canonicalize the key encoding so native
+    and Python compute identical store row keys for any accepted wire
+    encoding. A bin16-encoded b"a" written natively must (a) be the same
+    logical row as canonical b"a", and (b) stay deleted after a
+    Python-fallback delete + restart (no resurrecting rows)."""
+    import asyncio as aio
+
+    async def native_write_noncanonical():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            reader, writer = await aio.open_connection(host, port)
+            writer.write(_raw_kvput_frame(1))
+            await writer.drain()
+            header = await reader.readexactly(4)
+            resp = rpc.unpack(
+                await reader.readexactly(int.from_bytes(header, "big")))
+            assert resp[0] == rpc.MSG_RESPONSE and resp[3] == {"added": True}
+            writer.close()
+            # Canonical-key reads find the row (identity canonicalized).
+            conn = await rpc.connect(host, port)
+            assert (await conn.call(
+                "KVGet", {"ns": "t", "key": b"a"}))["value"] == b"v"
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    async def python_deletes():
+        monkeypatch.setenv("RAY_TPU_NATIVE_GCS_SERVICE", "0")
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            assert (await conn.call(
+                "KVGet", {"ns": "t", "key": b"a"}))["value"] == b"v"
+            assert (await conn.call(
+                "KVDel", {"ns": "t", "key": b"a"}))["deleted"]
+            await conn.close()
+        finally:
+            await gcs.stop()
+        monkeypatch.delenv("RAY_TPU_NATIVE_GCS_SERVICE")
+
+    async def stays_deleted():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            assert (await conn.call(
+                "KVGet", {"ns": "t", "key": b"a"}))["value"] is None
+            assert gcs._native_svc.kv_stats()[1] == 0
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    run(native_write_noncanonical())
+    run(python_deletes())
+    run(stays_deleted())
+
+
+def test_str_key_restores_under_python_fallback(tmp_path, monkeypatch):
+    """ADVICE r5: _restore_kv_row must preserve the decoded key TYPE —
+    a str-keyed row written natively must answer a str-keyed KVGet
+    after a fallback restart (the old .encode() coercion broke it)."""
+    async def native_writes_str_key():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            conn = await rpc.connect(host, port)
+            await conn.call("KVPut", {"ns": "t", "key": "skey",
+                                      "value": b"sval"})
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    async def python_restores_str_key():
+        monkeypatch.setenv("RAY_TPU_NATIVE_GCS_SERVICE", "0")
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            assert gcs._native_svc is None
+            conn = await rpc.connect(host, port)
+            assert (await conn.call(
+                "KVGet", {"ns": "t", "key": "skey"}))["value"] == b"sval"
+            await conn.close()
+        finally:
+            await gcs.stop()
+        monkeypatch.delenv("RAY_TPU_NATIVE_GCS_SERVICE")
+
+    run(native_writes_str_key())
+    run(python_restores_str_key())
+
+
+def test_native_factory_failure_closes_handle(tmp_path, monkeypatch):
+    """ADVICE r5: if install fails after gsvc_create, the partially
+    constructed native handle must be closed on the Python-fallback
+    path, not leaked."""
+    from ray_tpu._private import native_gcs_service
+
+    closed = []
+    orig_close = native_gcs_service.GcsNativeService.close
+
+    def tracking_close(self):
+        closed.append(True)
+        orig_close(self)
+
+    def broken_install(self):
+        raise RuntimeError("injected install failure")
+
+    monkeypatch.setattr(native_gcs_service.GcsNativeService, "close",
+                        tracking_close)
+    monkeypatch.setattr(native_gcs_service.GcsNativeService, "install",
+                        broken_install)
+
+    async def main():
+        gcs, host, port = await _start_gcs(tmp_path)
+        try:
+            assert gcs._native_svc is None  # fell back to Python
+            assert closed, "leaked native service handle on fallback"
+            # The Python handlers serve KV after the fallback.
+            conn = await rpc.connect(host, port)
+            await conn.call("KVPut", {"ns": "x", "key": b"k",
+                                      "value": b"v"})
+            assert (await conn.call(
+                "KVGet", {"ns": "x", "key": b"k"}))["value"] == b"v"
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    run(main())

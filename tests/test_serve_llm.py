@@ -73,10 +73,13 @@ def test_engine_admission_mid_flight(tiny_model, engine):
 def test_engine_eos_and_overflow(tiny_model, engine):
     cfg, params = tiny_model
     ref = _reference_greedy(cfg, params, [3, 3, 3], 20)
-    eos = ref[5]  # pick a token we know appears at step 5
+    eos = ref[5]  # pick a token we know appears in the reference output
     got = engine.generate([3, 3, 3],
                           SamplingParams(max_new_tokens=20, eos_token=eos))
-    assert got == ref[:6]  # stops at (and includes) the eos token
+    # Stops at (and includes) the FIRST occurrence of the eos token —
+    # which may precede step 5 (token values depend on the tiny random
+    # model's numerics, which shift across jax versions).
+    assert got == ref[:ref.index(eos) + 1]
     with pytest.raises(ValueError, match="exceeds engine max_len"):
         engine.submit(list(range(90)), SamplingParams(max_new_tokens=20))
 

@@ -1,0 +1,264 @@
+"""Task-lifecycle latency breakdown + pump event-loop stats.
+
+The full state ladder (SUBMITTED → LEASE_REQUESTED → LEASE_GRANTED →
+DISPATCHED → ARGS_FETCHED → RUNNING → FINISHED/FAILED, plus actor
+CREATE_* stages) is stamped across three processes — owner, executing
+worker, GCS — and merges in the GCS task-event table keyed by task id.
+`summarize_task_latency` turns it into per-stage percentiles; the
+daemon servers expose per-handler event-loop stats (event_stats.h
+analogue) via GetEventLoopStats.
+
+Parity: reference gcs_task_manager per-state timestamps +
+src/ray/common/asio/event_stats.h.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+FULL_LADDER = ("SUBMITTED", "LEASE_REQUESTED", "LEASE_GRANTED",
+               "DISPATCHED", "ARGS_FETCHED", "RUNNING", "FINISHED")
+
+
+def _events_by_task(deadline_s=15.0, predicate=None):
+    """Poll the GCS task-event table (worker flush cadence is 1s) until
+    `predicate(by_task)` holds; returns {task_id: {state: event}}."""
+    from ray_tpu._private.api_internal import get_core_worker
+
+    cw = get_core_worker()
+    deadline = time.monotonic() + deadline_s
+    by_task = {}
+    while time.monotonic() < deadline:
+        events = cw._run(cw.gcs.call("ListTaskEvents",
+                                     {"limit": 500000}))["events"]
+        by_task = {}
+        for e in events:
+            by_task.setdefault(e["task_id"], {}).setdefault(e["state"], e)
+        if predicate is None or predicate(by_task):
+            return by_task
+        time.sleep(0.25)
+    return by_task
+
+
+def _ladder_complete(stamps: dict) -> bool:
+    return all(s in stamps for s in FULL_LADDER)
+
+
+@pytest.mark.smoke
+def test_lifecycle_ladder_and_pump_stats_smoke(ray_start_regular):
+    """Tier-1 smoke gate (ISSUE 1 satellite): a 50-task job must record
+    every lifecycle stage with timestamps, and the daemon pumps must
+    report nonzero handled calls."""
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    assert ray_tpu.get([work.remote(i) for i in range(50)]) \
+        == [2 * i for i in range(50)]
+
+    by_task = _events_by_task(predicate=lambda bt: sum(
+        1 for st in bt.values() if _ladder_complete(st)) >= 50)
+    complete = [st for st in by_task.values() if _ladder_complete(st)]
+    assert len(complete) >= 50, (
+        f"only {len(complete)} tasks recorded the full ladder; "
+        f"states seen: {sorted({s for st in by_task.values() for s in st})}")
+    # Timestamps are monotone along the ladder for every complete task.
+    for stamps in complete:
+        ts = [stamps[s]["ts"] for s in FULL_LADDER]
+        assert all(isinstance(t, float) for t in ts)
+        assert all(b >= a for a, b in zip(ts, ts[1:])), ts
+        # Owner stamps the pre-dispatch stages; the executing worker
+        # stamps ARGS_FETCHED/RUNNING with its own identity.
+        assert stamps["RUNNING"]["worker_id"] != \
+            stamps["SUBMITTED"]["worker_id"]
+
+    # Per-stage percentiles: >= 5 distinct stages with sane ordering.
+    lat = state.summarize_task_latency()
+    assert lat["tasks"] >= 50
+    stages = lat["stages"]
+    assert len(stages) >= 5, sorted(stages)
+    for name, s in stages.items():
+        assert s["count"] > 0
+        assert 0.0 <= s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"] \
+            <= s["max_ms"], (name, s)
+
+    # Pump stats: the GCS loop handled real calls, per-handler latencies
+    # accumulated, and every raylet answers the same surface.
+    pump = state.pump_stats()
+    gcs_handlers = pump["gcs"]["server"]["handlers"]
+    total_calls = sum(h["count"] for h in gcs_handlers.values())
+    assert total_calls > 0, "pump stats report zero handled calls"
+    assert any(h["cum_ms"] >= 0 and h["max_ms"] >= h.get("mean_ms", 0) / 2
+               for h in gcs_handlers.values())
+    raylets = [r for r in pump["raylets"] if "server" in r]
+    assert raylets, pump["raylets"]
+    assert sum(h["count"] for r in raylets
+               for h in r["server"]["handlers"].values()) > 0
+
+
+def test_actor_ladder_and_create_stages(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    a = Counter.remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+
+    # Actor CREATE stages (GCS-stamped) + executor-side creation stamps.
+    def has_create(bt):
+        return any({"CREATE_REGISTERED", "CREATE_SCHEDULED",
+                    "CREATE_READY", "FINISHED"} <= set(st)
+                   for st in bt.values())
+    by_task = _events_by_task(predicate=has_create)
+    create = [st for st in by_task.values()
+              if "CREATE_REGISTERED" in st]
+    assert create, sorted({s for st in by_task.values() for s in st})
+    st = create[0]
+    for stage in ("CREATE_SCHEDULED", "CREATE_READY", "ARGS_FETCHED",
+                  "RUNNING", "FINISHED"):
+        assert stage in st, (stage, sorted(st))
+    assert st["CREATE_REGISTERED"]["ts"] <= st["CREATE_SCHEDULED"]["ts"] \
+        <= st["CREATE_READY"]["ts"]
+
+    # Actor METHOD ladder: no lease stages, but submit → dispatch →
+    # args → run → finish all stamped.
+    def method_done(bt):
+        return any(st.get("SUBMITTED", {}).get("name") == "Counter.bump"
+                   and "FINISHED" in st and "RUNNING" in st
+                   for st in bt.values())
+    by_task = _events_by_task(predicate=method_done)
+    method = [st for st in by_task.values()
+              if st.get("SUBMITTED", {}).get("name") == "Counter.bump"
+              and "FINISHED" in st and "RUNNING" in st]
+    assert method
+    st = method[0]
+    for stage in ("SUBMITTED", "DISPATCHED", "ARGS_FETCHED", "RUNNING",
+                  "FINISHED"):
+        assert stage in st, (stage, sorted(st))
+
+
+def test_failed_task_ladder(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("intentional")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+    def failed(bt):
+        # Owner-side FAILED and executor-side RUNNING flush from
+        # different processes on a 1s cadence — wait for both.
+        return any(st.get("SUBMITTED", {}).get("name") == "boom"
+                   and "FAILED" in st and "RUNNING" in st
+                   for st in bt.values())
+    by_task = _events_by_task(predicate=failed)
+    st = next(s for s in by_task.values()
+              if s.get("SUBMITTED", {}).get("name") == "boom"
+              and "FAILED" in s)
+    # The task ran (executor stamped it) before it failed (owner stamp).
+    for stage in ("SUBMITTED", "DISPATCHED", "ARGS_FETCHED", "RUNNING",
+                  "FAILED"):
+        assert stage in st, (stage, sorted(st))
+    assert "FINISHED" not in st
+
+    # Failed tasks contribute to the `total`/`execution` stages too.
+    lat = state.summarize_task_latency()
+    assert "total" in lat["stages"] and "execution" in lat["stages"]
+
+
+def test_timeline_stage_rows(ray_start_regular, tmp_path):
+    from ray_tpu.util.timeline import build_trace_events
+
+    @ray_tpu.remote
+    def work(x):
+        return x
+
+    ray_tpu.get([work.remote(i) for i in range(5)])
+    from ray_tpu._private.api_internal import get_core_worker
+
+    cw = get_core_worker()
+
+    def stage_rows_present(bt):
+        return sum(1 for st in bt.values() if _ladder_complete(st)) >= 5
+    _events_by_task(predicate=stage_rows_present)
+    events = cw._run(cw.gcs.call("ListTaskEvents",
+                                 {"limit": 100000}))["events"]
+    trace = build_trace_events(events)
+    stage_tids = {e["tid"] for e in trace if e.get("cat") == "stage"}
+    # queue/lease/dispatch/args_fetch/startup rows all rendered.
+    assert {"stage:queue", "stage:lease", "stage:dispatch",
+            "stage:args_fetch", "stage:startup"} <= stage_tids, stage_tids
+    assert all(e["dur"] >= 0 for e in trace if e.get("cat") == "stage")
+
+
+def test_summarize_task_latency_pure():
+    """Percentile math on a synthetic event set (no cluster)."""
+    events = []
+    for i in range(100):
+        tid = f"t{i}"
+        base = 1000.0 + i
+        for j, st in enumerate(("SUBMITTED", "LEASE_REQUESTED",
+                                "LEASE_GRANTED", "DISPATCHED",
+                                "ARGS_FETCHED", "RUNNING", "FINISHED")):
+            events.append({"task_id": tid, "name": "f", "state": st,
+                           "ts": base + j * 0.010})
+    out = state.summarize_task_latency(events=events)
+    assert out["tasks"] == 100
+    assert len(out["stages"]) == 7
+    ex = out["stages"]["execution"]
+    assert ex["count"] == 100
+    assert 9.0 <= ex["p50_ms"] <= 11.0
+    assert out["stages"]["total"]["p99_ms"] >= out["stages"]["total"]["p50_ms"]
+    # A task with no lease stages (actor path) still contributes to the
+    # stages it has.
+    out2 = state.summarize_task_latency(events=[
+        {"task_id": "a", "name": "m", "state": "SUBMITTED", "ts": 1.0},
+        {"task_id": "a", "name": "m", "state": "RUNNING", "ts": 1.5},
+        {"task_id": "a", "name": "m", "state": "FAILED", "ts": 2.0},
+    ])
+    assert out2["stages"]["execution"]["count"] == 1
+    assert "lease_negotiation" not in out2["stages"]
+    # Retried task: execution pairs the terminal stamp with the LAST
+    # attempt's RUNNING, not the first — the retry gap must not be
+    # booked as user-code execution. `total` stays end-to-end.
+    out3 = state.summarize_task_latency(events=[
+        {"task_id": "r", "name": "f", "state": "SUBMITTED", "ts": 0.0},
+        {"task_id": "r", "name": "f", "state": "RUNNING", "ts": 1.0},
+        {"task_id": "r", "name": "f", "state": "RETRYING", "ts": 2.0},
+        {"task_id": "r", "name": "f", "state": "RUNNING", "ts": 10.0},
+        {"task_id": "r", "name": "f", "state": "FINISHED", "ts": 10.5},
+    ])
+    assert abs(out3["stages"]["execution"]["p50_ms"] - 500.0) < 1.0
+    assert abs(out3["stages"]["total"]["p50_ms"] - 10500.0) < 1.0
+
+
+def test_event_loop_stats_unit():
+    from ray_tpu._private.event_stats import EventLoopStats
+
+    s = EventLoopStats("unit")
+    s.record_handler("Foo", 0.002)
+    s.record_handler("Foo", 0.004)
+    s.record_handler("Bar", 0.001, error=True)
+    s.record_drain(10)
+    s.record_drain(3)
+    s.set_queue_depth(7)
+    s.set_queue_depth(2)
+    snap = s.snapshot()
+    foo = snap["handlers"]["Foo"]
+    assert foo["count"] == 2 and foo["errors"] == 0
+    assert 5.9 <= foo["cum_ms"] <= 6.1
+    assert 3.9 <= foo["max_ms"] <= 4.1
+    assert snap["handlers"]["Bar"]["errors"] == 1
+    assert snap["loop"]["drains"] == 2
+    assert snap["loop"]["events"] == 13
+    assert snap["loop"]["max_batch"] == 10
+    assert snap["loop"]["queue_depth"] == 2
+    assert snap["loop"]["queue_depth_max"] == 7
