@@ -92,7 +92,10 @@ def _reexec_hermetic_cpu() -> int:
     env["RAY_TPU_BENCH_CHILD"] = "1"
     error, child_stdout = None, ""
     try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+        # argv forwarded: --device-handoff (and future modes) must
+        # survive the hermetic re-exec.
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            *sys.argv[1:]],
                            cwd=_REPO_ROOT, env=env, timeout=900,
                            capture_output=True, text=True)
         child_stdout = r.stdout
@@ -177,12 +180,16 @@ def _replay_live_capture() -> int | None:
     return 0
 
 
+_DEVICE_HANDOFF_MODE = "--device-handoff" in sys.argv[1:]
+
 if os.environ.get("RAY_TPU_BENCH_CHILD") == "1":
     import jax  # hermetic CPU child: axon site already stripped
 elif _probe_accelerator() is not None:
     import jax  # accelerator alive: init the real backend in-process
 else:
-    rc = _replay_live_capture()
+    # Training-capture replay only applies to the MFU bench; a handoff
+    # run must produce its own (cpu-backend) capture instead.
+    rc = None if _DEVICE_HANDOFF_MODE else _replay_live_capture()
     if rc is not None:
         sys.exit(rc)
     print("bench: no live accelerator and no live capture to replay; "
@@ -370,5 +377,80 @@ def main():
     }))
 
 
+def device_handoff_main():
+    """Device-handoff microbenchmark: device object plane vs host path
+    for a KV-cache-sized tensor handoff (ISSUE 3 bench satellite).
+
+    device plane  — pin + same-process resolve + unpin (what the serve
+                    prefill→decode handoff pays): zero payload copies.
+    host path     — serialize (device_get → out-of-band buffer) →
+                    payload bytes → deserialize → device_put: what every
+                    cross-task device array paid before the plane.
+
+    Emits ONE JSON line, health-stamped like the training captures.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+    from ray_tpu._private import device_objects, serialization
+    from ray_tpu._private.bench_health import make_stamp
+
+    on_tpu = jax.default_backend() != "cpu"
+    # KV-cache-sized working set: 16 layers x (k, v) on TPU (~512 MiB in
+    # bf16), scaled down on the CPU fake backend.
+    layers = 16 if on_tpu else 4
+    shape = (8, 1024, 128) if on_tpu else (4, 256, 32)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    kv = [(jnp.ones(shape, dtype), jnp.ones(shape, dtype))
+          for _ in range(layers)]
+    total_bytes = sum(int(k.nbytes) + int(v.nbytes) for k, v in kv)
+    jax.block_until_ready(kv[0][0])
+    float(np.asarray(kv[0][0])[0, 0, 0])  # device sync (axon-safe)
+
+    probe_before = _health_probe()
+    iters = 20 if on_tpu else 10
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = device_objects.local_handoff("bench-handoff", kv)
+    assert out[0][0] is kv[0][0], "device plane must hand over live arrays"
+    dt_plane = (time.perf_counter() - t0) / iters
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        restored = []
+        for k, v in kv:
+            sk, sv = serialization.serialize(k), serialization.serialize(v)
+            restored.append(
+                (serialization.deserialize(sk.meta, sk.to_bytes())[1],
+                 serialization.deserialize(sv.meta, sv.to_bytes())[1]))
+        jax.block_until_ready(restored[0][0])
+    float(np.asarray(restored[0][0])[0, 0, 0])
+    dt_host = (time.perf_counter() - t0) / iters
+
+    probe_after = _health_probe()
+    health = make_stamp(probe_before, probe_after, jax.default_backend())
+    gbps_host = total_bytes / dt_host / 2**30
+    stats = device_objects.registry().stats()
+    print(json.dumps({
+        "metric": "device_handoff_speedup_vs_host_path",
+        "value": round(dt_host / dt_plane, 1) if dt_plane > 0 else 0.0,
+        "unit": "x",
+        "vs_baseline": round(dt_host / dt_plane, 1) if dt_plane > 0 else 0.0,
+        "extra": {
+            "health": health,
+            "backend": jax.default_backend(),
+            "payload_bytes": total_bytes,
+            "layers": layers,
+            "device_plane_ms": round(dt_plane * 1000, 4),
+            "host_path_ms": round(dt_host * 1000, 4),
+            "host_path_gib_per_s": round(gbps_host, 3),
+            "plane_counters": stats["counters"],
+        }}))
+    return 0
+
+
 if __name__ == "__main__":
+    if _DEVICE_HANDOFF_MODE:
+        sys.exit(device_handoff_main())
     main()

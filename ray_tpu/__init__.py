@@ -14,7 +14,8 @@ from typing import Any, Iterable, Sequence
 from ray_tpu import exceptions
 from ray_tpu._private import api_internal
 from ray_tpu._private.api_internal import (ActorClass, ActorHandle,
-                                           ObjectRef, ObjectRefGenerator)
+                                           DeviceObjectRef, ObjectRef,
+                                           ObjectRefGenerator)
 from ray_tpu._private.common import Address
 from ray_tpu._private.config import Config
 
@@ -396,5 +397,6 @@ __all__ = [
     "ObjectRefGenerator",
     "kill", "cancel", "get_actor", "nodes", "cluster_resources",
     "available_resources", "get_runtime_context", "method",
-    "ObjectRef", "ActorHandle", "ActorClass", "Config", "exceptions",
+    "ObjectRef", "DeviceObjectRef", "ActorHandle", "ActorClass", "Config",
+    "exceptions",
 ]

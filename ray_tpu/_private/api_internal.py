@@ -137,16 +137,32 @@ class ObjectRef:
             if cw is not None and self.owner is not None \
                     and self.owner.worker_id == cw.worker_id:
                 cw.pin_nested_ref(self.id.hex())
-        return (_rebuild_object_ref, (self.id.binary(), owner_wire))
+        # type(self): a DeviceObjectRef must survive the pickle hop as
+        # one (isinstance routing on the receiver would silently break).
+        return (_rebuild_object_ref,
+                (self.id.binary(), owner_wire, type(self)))
 
     # Allow `await ref` patterns later; for now block via global get.
     def future(self):
         raise NotImplementedError
 
 
-def _rebuild_object_ref(id_bytes, owner_wire):
+class DeviceObjectRef(ObjectRef):
+    """Reference to an HBM-resident object (device object plane,
+    _private/device_objects.py): the payload stays pinned on the
+    producing worker; only a descriptor travels the object path. Flows
+    through task args and ray_tpu.get like any ObjectRef — resolution
+    picks the cheapest transfer route."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return f"DeviceObjectRef({self.id.hex()})"
+
+
+def _rebuild_object_ref(id_bytes, owner_wire, ref_cls=None):
     owner = Address.from_wire(owner_wire) if owner_wire else None
-    ref = ObjectRef(ObjectID(id_bytes), owner, _register=False)
+    ref = (ref_cls or ObjectRef)(ObjectID(id_bytes), owner, _register=False)
     cw = _core_worker
     if cw is None or owner is None:
         return ref
@@ -190,6 +206,7 @@ _OPTION_DEFAULTS = {
     "runtime_env": None,
     "memory": None,
     "accelerator_type": None,
+    "tensor_transport": None,
 }
 
 
@@ -201,6 +218,9 @@ def _validate_options(opts: dict, for_actor: bool) -> dict:
         out[k] = v
     if out["lifetime"] not in (None, "detached", "non_detached"):
         raise ValueError("lifetime must be None, 'detached', or 'non_detached'")
+    if out["tensor_transport"] not in (None, "object_store", "device"):
+        raise ValueError("tensor_transport must be None, 'object_store', "
+                         "or 'device'")
     if not for_actor and out["max_restarts"]:
         raise ValueError("max_restarts is an actor option")
     return out
@@ -314,6 +334,7 @@ class RemoteFunction:
         (strategy, pg_id, bundle_index), resources = cached
         task_id = cw.next_task_id()
         streaming = self._opts["num_returns"] in ("streaming", "dynamic")
+        transport = self._opts["tensor_transport"]
         spec = TaskSpec(
             task_id=task_id.hex(),
             job_id=cw.job_id,
@@ -335,6 +356,7 @@ class RemoteFunction:
             placement_group=pg_id,
             pg_bundle_index=bundle_index,
             runtime_env=_effective_runtime_env(self._opts["runtime_env"]),
+            tensor_transport=transport if transport == "device" else "",
         )
         submit = cw.submit_streaming_task if streaming else cw.submit_task
         if tracing.enabled():
@@ -345,7 +367,8 @@ class RemoteFunction:
             out = submit(spec, nested_args=nested, task_id=task_id)
         if streaming:
             return ObjectRefGenerator(spec.task_id, cw.address, out)
-        refs = [ObjectRef(oid, cw.address) for oid in out]
+        ref_cls = DeviceObjectRef if transport == "device" else ObjectRef
+        refs = [ref_cls(oid, cw.address) for oid in out]
         if self._opts["num_returns"] == 1:
             return refs[0]
         return refs
@@ -447,18 +470,28 @@ class ObjectRefGenerator:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1, tensor_transport: str | None = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._tensor_transport = tensor_transport
 
     def options(self, **opts):
+        bad = set(opts) - {"num_returns", "tensor_transport"}
+        if bad:
+            raise ValueError(f"unknown actor-method options {sorted(bad)}")
         n = opts.get("num_returns", self._num_returns)
-        return ActorMethod(self._handle, self._method_name, n)
+        tt = opts.get("tensor_transport", self._tensor_transport)
+        if tt not in (None, "object_store", "device"):
+            raise ValueError("tensor_transport must be None, "
+                             "'object_store', or 'device'")
+        return ActorMethod(self._handle, self._method_name, n, tt)
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
-            self._method_name, args, kwargs, self._num_returns)
+            self._method_name, args, kwargs, self._num_returns,
+            tensor_transport=self._tensor_transport)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -468,10 +501,14 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str,
-                 max_task_retries: int = 0):
+                 max_task_retries: int = 0,
+                 tensor_transport: str | None = None):
         self._actor_id = actor_id
         self._class_name = class_name
         self._max_task_retries = max_task_retries
+        # Class-level @ray_tpu.remote(tensor_transport=...) default;
+        # per-method .options(tensor_transport=...) overrides.
+        self._tensor_transport = tensor_transport
 
     @property
     def _id_hex(self) -> str:
@@ -480,9 +517,11 @@ class ActorHandle:
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        return ActorMethod(self, name,
+                           tensor_transport=self._tensor_transport)
 
-    def _submit_method(self, method_name: str, args, kwargs, num_returns):
+    def _submit_method(self, method_name: str, args, kwargs, num_returns,
+                       tensor_transport: str | None = None):
         cw = get_core_worker()
         streaming = num_returns in ("streaming", "dynamic")
         wire_args, kwargs_keys, _, nested = cw.serialize_args(args, kwargs)
@@ -499,6 +538,8 @@ class ActorHandle:
             max_retries=0,
             owner=cw.address.to_wire(),
             actor_id=self._actor_id.hex(),
+            tensor_transport=("device" if tensor_transport == "device"
+                              else ""),
         )
         with tracing.submit_span(spec.name, spec.task_id) as trace_ctx:
             spec.trace_ctx = trace_ctx
@@ -507,19 +548,24 @@ class ActorHandle:
                                        nested_args=nested)
         if streaming:
             return ObjectRefGenerator(spec.task_id, cw.address, out)
-        refs = [ObjectRef(oid, cw.address) for oid in out]
+        ref_cls = (DeviceObjectRef if tensor_transport == "device"
+                   else ObjectRef)
+        refs = [ref_cls(oid, cw.address) for oid in out]
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):
         return (_rebuild_actor_handle,
-                (self._actor_id.binary(), self._class_name, self._max_task_retries))
+                (self._actor_id.binary(), self._class_name,
+                 self._max_task_retries, self._tensor_transport))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
 
-def _rebuild_actor_handle(id_bytes, class_name, max_task_retries):
-    return ActorHandle(ActorID(id_bytes), class_name, max_task_retries)
+def _rebuild_actor_handle(id_bytes, class_name, max_task_retries,
+                          tensor_transport=None):
+    return ActorHandle(ActorID(id_bytes), class_name, max_task_retries,
+                       tensor_transport)
 
 
 class ActorClass:
@@ -586,11 +632,13 @@ class ActorClass:
                 get_if_exists=self._opts["get_if_exists"])
         if not resp.get("ok"):
             raise exc.RayTpuError(resp.get("reason", "actor registration failed"))
+        transport = self._opts["tensor_transport"]
         if resp.get("existing"):
             return ActorHandle(ActorID.from_hex(resp["actor_id"]),
-                               self._cls.__name__, self._opts["max_task_retries"])
+                               self._cls.__name__,
+                               self._opts["max_task_retries"], transport)
         return ActorHandle(actor_id, self._cls.__name__,
-                           self._opts["max_task_retries"])
+                           self._opts["max_task_retries"], transport)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -141,6 +141,10 @@ class TaskSpec:
     # Actor creation only: how many tasks may execute concurrently on the
     # actor (reference: max_concurrency / async actors, fiber.h).
     max_concurrency: int = 1
+    # "device": returned jax.Arrays stay pinned in the executing worker's
+    # HBM (device object plane, _private/device_objects.py); only a small
+    # descriptor travels the object path.
+    tensor_transport: str = ""
 
     def to_wire(self):
         return [
@@ -149,7 +153,7 @@ class TaskSpec:
             self.retry_exceptions, self.owner, self.actor_id, self.actor_creation,
             self.actor_seq, self.max_restarts, self.max_task_retries, self.strategy,
             self.placement_group, self.pg_bundle_index, self.runtime_env,
-            self.trace_ctx, self.max_concurrency,
+            self.trace_ctx, self.max_concurrency, self.tensor_transport,
         ]
 
     @classmethod

@@ -435,6 +435,7 @@ class Raylet:
             "ListLogs": self.handle_list_logs,
             "TailLog": self.handle_tail_log,
             "WorkerStats": self.handle_worker_stats,
+            "NodeDeviceObjects": self.handle_node_device_objects,
         }
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
@@ -1252,6 +1253,29 @@ class Raylet:
             }
         except (OSError, IndexError, ValueError):
             return {}
+
+    async def handle_node_device_objects(self, conn, payload):
+        """Device object plane stats from every live worker on this node
+        (pinned-HBM bytes/objects + transfer/fallback counters per
+        registry; see _private/device_objects.py). The per-node surface
+        behind util/state.list_device_objects and the
+        `ray_tpu device-objects` CLI verb."""
+        live = [w for w in self.workers.values()
+                if not w.dead and w.conn is not None and not w.conn.closed]
+
+        async def stats_one(w):
+            try:
+                out = await w.conn.call(
+                    "DeviceObjectStats",
+                    {"entries": bool(payload.get("entries"))}, timeout=10)
+                out.setdefault("worker_id", w.worker_id)
+                return out
+            except Exception as e:
+                return {"worker_id": w.worker_id,
+                        "error": f"{type(e).__name__}: {e}"}
+
+        stats = list(await asyncio.gather(*(stats_one(w) for w in live)))
+        return {"node_id": self.node_id, "workers": stats}
 
     async def handle_worker_stats(self, conn, payload):
         workers = []

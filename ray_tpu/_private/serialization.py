@@ -15,6 +15,7 @@ Layout of a stored object:
 from __future__ import annotations
 
 import pickle
+import sys
 import traceback
 
 import msgpack
@@ -28,8 +29,56 @@ KIND_PYTHON = 0
 KIND_EXCEPTION = 1
 KIND_RAW = 2
 KIND_ACTOR_HANDLE = 3
+# Payload contains DeviceObjectStub placeholders for HBM-pinned arrays
+# (see _private/device_objects.py); get() resolves them after deserialize.
+KIND_DEVICE = 4
 
 _ALIGN = 64
+
+
+def _as_out_of_band(value):
+    """Host-path double-copy fix for device arrays: pickling a jax.Array
+    directly lands INBAND (jax reduces through a plain bytes payload), so
+    the value pays the host gather AND a pickle copy, and deserialization
+    cannot view into shm. Re-rooting through numpy makes the (single)
+    host gather the out-of-band pickle-5 buffer — it lands 64-byte-
+    aligned in the shm payload and reconstructs as a view, ready to feed
+    one jax.device_put. Top-level arrays only (the hot shapes: task
+    returns / puts of one tensor)."""
+    mod = type(value).__module__
+    if not (mod.startswith("jax") or mod.startswith("jaxlib")):
+        return value
+    jax = sys.modules.get("jax")
+    if jax is None or not isinstance(value, jax.Array):
+        return value
+    try:
+        import numpy as np
+
+        return _JaxArrayPayload(np.asarray(value))
+    except Exception:
+        return value  # exotic shardings may refuse a host gather
+
+
+class _JaxArrayPayload:
+    """Pickles as its numpy buffer (out-of-band) and restores as a
+    jax.Array on the consumer (one host→device DMA from the shm view)."""
+
+    __slots__ = ("np_value",)
+
+    def __init__(self, np_value):
+        self.np_value = np_value
+
+    def __reduce__(self):
+        return (_restore_jax_array, (self.np_value,))
+
+
+def _restore_jax_array(np_value):
+    try:
+        import jax
+
+        return jax.device_put(np_value)
+    except Exception:
+        return np_value
 
 
 def _align(n: int) -> int:
@@ -74,6 +123,7 @@ class SerializedObject:
 
 
 def serialize(value, kind: int = KIND_PYTHON) -> SerializedObject:
+    value = _as_out_of_band(value)
     buffers: list[pickle.PickleBuffer] = []
     try:
         inband = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)

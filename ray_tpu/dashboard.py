@@ -10,8 +10,8 @@ inside any connected process (`ray_tpu.dashboard.start()`, or
 `ray_tpu dashboard` from the CLI).
 
 Endpoints: /api/version /api/nodes /api/node_stats /api/actors
-/api/jobs /api/tasks /api/summary[/actors|/objects|/task_latency]
-/api/pump_stats /api/cluster_status
+/api/jobs /api/tasks /api/summary[/actors|/objects|/task_latency|
+/device_objects] /api/device_objects /api/pump_stats /api/cluster_status
 /api/submission_jobs[/logs?id=] /api/logs /api/events
 /api/grafana/dashboard (generated Grafana JSON, metrics-module parity)
 /logs/view?node=&name= /api/stacks /api/profile /api/worker_stats (the
@@ -259,6 +259,14 @@ class _Handler(BaseHTTPRequestHandler):
                 data = state.list_placement_groups()
             elif path == "/api/objects":
                 data = state.list_objects()
+            elif path == "/api/device_objects":
+                # Device object plane: pinned-HBM registries per worker
+                # (raylet fan-out), transfer-route counters, owned
+                # descriptors (_private/device_objects.py).
+                data = state.list_device_objects(
+                    entries=(q.get("entries") or ["1"])[0] != "0")
+            elif path == "/api/summary/device_objects":
+                data = state.summarize_device_objects()
             elif path == "/api/serve":
                 # Serve module (reference: dashboard/modules/serve): the
                 # controller's deployment table. Only "no controller"

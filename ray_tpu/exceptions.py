@@ -58,6 +58,13 @@ class OwnerDiedError(ObjectLostError):
     pass
 
 
+class DeviceObjectLostError(ObjectLostError):
+    """The worker pinning a device-resident object (HBM tensor) died or
+    dropped the pin before a consumer resolved it. Owners recover via
+    lineage reconstruction (the creating task re-executes and re-pins);
+    borrowers observe this error."""
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -324,6 +324,50 @@ def cmd_memory(args):
     return 0
 
 
+def cmd_device_objects(args):
+    """`ray_tpu device-objects` — device object plane report: pinned-HBM
+    bytes/objects per worker (raylet fan-out), transfer/fallback route
+    counters, and this driver's owned device-object descriptors."""
+    ray_tpu = _connect_from_state(args)
+    from ray_tpu.util import state
+
+    out = state.list_device_objects(entries=not args.no_entries)
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        _shutdown_if_owned(ray_tpu)
+        return 0
+    c = out["local"]["counters"]
+    print(f"routes: in_process={c['in_process']} "
+          f"collective={c['collective']} "
+          f"host_fallback={c['host_fallback']} lost={c['lost']} "
+          f"released={c['released']}")
+    print(f"{'NODE':<10}{'WORKER':<10}{'PINNED':>8}{'BYTES':>12}"
+          f"{'IN-PROC':>9}{'COLL':>6}{'HOST':>6}")
+    for node in out["nodes"]:
+        nid = str(node.get("node_id", "?"))[:8]
+        if "error" in node:
+            print(f"{nid:<10}unreachable: {node['error']}")
+            continue
+        for w in node.get("workers", []):
+            wc = w.get("counters", {})
+            print(f"{nid:<10}{str(w.get('worker_id', '?'))[:8]:<10}"
+                  f"{w.get('pinned_objects', 0):>8}"
+                  f"{w.get('pinned_bytes', 0) / 2**20:>10.2f}MB"
+                  f"{wc.get('in_process', 0):>9}"
+                  f"{wc.get('collective', 0):>6}"
+                  f"{wc.get('host_fallback', 0):>6}")
+    if out["owned"]:
+        print(f"\nowned device objects: {len(out['owned'])}")
+        print(f"{'OBJECT':<14}{'STATE':<8}{'LEAVES':>7}{'BYTES':>12}"
+              f"  PIN WORKER")
+        for o in out["owned"]:
+            print(f"{o['object_id'][:12]:<14}{o['state']:<8}"
+                  f"{o['leaves']:>7}{o['pinned_bytes'] / 2**10:>10.1f}KB"
+                  f"  {o['pin_worker']}")
+    _shutdown_if_owned(ray_tpu)
+    return 0
+
+
 def cmd_microbenchmark(args):
     from ray_tpu import microbenchmark
 
@@ -445,6 +489,14 @@ def main():
                                       "(parity: `ray memory`)")
     p.add_argument("--limit", type=int, default=20)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("device-objects",
+                       help="device object plane report (pinned-HBM "
+                            "bytes, transfer routes, descriptors)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--no-entries", action="store_true",
+                   help="skip per-array registry entries")
+    p.set_defaults(fn=cmd_device_objects)
 
     p = sub.add_parser("microbenchmark", help="core-runtime throughput suite")
     p.set_defaults(fn=cmd_microbenchmark)

@@ -84,7 +84,8 @@ class LLMEngine:
     def __init__(self, cfg: LlamaConfig, params, *, max_batch: int = 4,
                  max_len: int = 1024, decode_chunk: int = 8,
                  prefill_chunk: int = 0, rng_seed: int = 0,
-                 page_size: int = 0, kv_pool_tokens: int = 0):
+                 page_size: int = 0, kv_pool_tokens: int = 0,
+                 use_device_plane: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -92,6 +93,14 @@ class LLMEngine:
         self.params = params
         self.max_batch = max_batch
         self.max_len = max_len
+        # Prefill→decode KV handoff rides the device object plane
+        # (_private/device_objects.py): the freshly prefilled per-request
+        # KV is pinned, resolved by decode over the cheapest route
+        # (same-process → zero-copy handover of the live arrays), and
+        # unpinned — pinned-KV bytes and handoff counts are observable
+        # through the plane's gauges. Fails open: any plane error falls
+        # back to the direct in-memory handoff.
+        self.use_device_plane = use_device_plane
         # Paged KV mode (page_size > 0): admission is bounded by POOL
         # pages (resident tokens), not slot count x max_len.
         self.page_size = page_size
@@ -442,6 +451,7 @@ class LLMEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : len(prompt)] = prompt
         logits, kv_one = self._prefill_one(self.params, jnp.asarray(padded))
+        kv_one = self._device_handoff(kv_one)
         # Write the slot row of every layer cache + first sampled token.
         for li, (k_full, v_full) in enumerate(self._kv):
             k_one, v_one = kv_one[li]
@@ -449,6 +459,20 @@ class LLMEngine:
                             v_full.at[slot].set(v_one))
         self._commit_first_token(slot, handle,
                                  logits[len(prompt) - 1], len(prompt))
+
+    def _device_handoff(self, kv):
+        """Hand the prefill KV cache to decode as a device object:
+        same-process resolution returns the SAME live arrays (zero copy)
+        while ticking the plane's pinned-HBM gauge and in_process
+        counter — the serve hot path's first device-plane consumer."""
+        if not self.use_device_plane:
+            return kv
+        try:
+            from ray_tpu._private import device_objects
+
+            return device_objects.local_handoff("llm-prefill-kv", kv)
+        except Exception:
+            return kv
 
     def _commit_first_token(self, slot: int, handle: RequestHandle,
                             first_logits, prompt_len: int):

@@ -85,6 +85,22 @@ class TrainWorker:
         return {"reports": items, "done": self._done, "error": self._error,
                 "result": self._result if self._done and not self._error else None}
 
+    def receive_weights(self, weights) -> dict:
+        """Device-plane weight broadcast sink: `weights` arrives already
+        resolved (the ref's descriptor pulled the tensors straight from
+        the broadcaster's registry — no GCS/plasma round trip). Stored
+        for the train loop (session.get_broadcast_weights)."""
+        self._broadcast_weights = weights
+        from ray_tpu._private.device_objects import tree_map
+
+        leaves: list = []
+        tree_map(weights, leaves.append, lambda v: hasattr(v, "shape"))
+        # nbytes is metadata on jax.Array AND ndarray — no host gather
+        # (np.asarray here would DMA the whole model back to host just
+        # to report a size).
+        return {"rank": self.rank, "leaves": len(leaves),
+                "bytes": int(sum(getattr(x, "nbytes", 0) for x in leaves))}
+
     def node_id(self) -> str:
         return ray_tpu.get_runtime_context().node_id
 
@@ -131,6 +147,24 @@ class WorkerGroup:
     def run_on_all(self, method: str, *args, **kwargs) -> list:
         return ray_tpu.get([getattr(w, method).remote(*args, **kwargs)
                             for w in self.workers], timeout=300)
+
+    def broadcast_weights(self, params) -> list:
+        """Broadcast initial weights to every worker through ONE device
+        object (the train-side device-plane consumer): the driver pins
+        the jax param tree in its own registry, the object path carries
+        only the descriptor, and each worker pulls the tensors directly
+        from the driver — collective route on a shared mesh, host path
+        otherwise; never through the GCS or a pickle round trip. Trees
+        with no jax.Array leaves degrade to a plain put transparently."""
+        from ray_tpu._private import device_objects
+
+        ref = device_objects.device_put(params)
+        try:
+            return ray_tpu.get(
+                [w.receive_weights.remote(ref) for w in self.workers],
+                timeout=300)
+        finally:
+            del ref  # drop the pin once every worker has its copy
 
     def shutdown(self):
         for w in self.workers:

@@ -85,6 +85,13 @@ class _PeerPlane:
             "group": group, "tag": tag, "dtype": str(arr.dtype),
             "shape": list(arr.shape), "data": arr.tobytes()}))
 
+    def discard(self, group: str, tag: str) -> None:
+        """Drop an undelivered mailbox entry (e.g. a device-plane
+        collective transfer that degraded to the host path mid-batch —
+        its already-sent payloads must not strand here forever)."""
+        with self._cond:
+            self._inbox.pop((group, tag), None)
+
     def recv(self, group: str, tag: str, timeout: float = 300.0
              ) -> np.ndarray:
         key = (group, tag)
@@ -97,7 +104,12 @@ class _PeerPlane:
                         f"collective recv timed out waiting for {tag!r}")
                 self._cond.wait(remaining)
             dtype, shape, data = self._inbox.pop(key)
-        return np.frombuffer(bytearray(data), dtype=dtype).reshape(shape)
+        # bf16/fp8 dtype names need ml_dtypes registered with numpy —
+        # a jax-less consumer of a device-plane transfer must not crash.
+        from ray_tpu._private.device_objects import _np_dtype
+
+        return np.frombuffer(bytearray(data),
+                             dtype=_np_dtype(dtype)).reshape(shape)
 
     def close(self):
         for conn in self._conns.values():

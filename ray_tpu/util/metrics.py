@@ -227,23 +227,38 @@ def prometheus_text() -> str:
 
 
 _pump_gauges: dict[str, Metric] | None = None
-# (monotonic ts, snapshot): pump_stats() is a cluster-wide RPC sweep —
-# a fresh connect to every raylet — so scrape paths reuse one snapshot
-# for a few seconds instead of sweeping per scrape.
-_pump_cache: tuple[float, dict | None] = (float("-inf"), None)
+# pump_stats() is a cluster-wide RPC sweep — a fresh connect to every
+# raylet — so scrape paths reuse one snapshot for a few seconds instead
+# of sweeping per scrape (see _ttl_cached).
+_pump_cache: dict = {"ts": float("-inf"), "snap": None}
 _PUMP_CACHE_TTL_S = 5.0
 
 
-def _pump_stats_cached() -> dict:
-    global _pump_cache
+def _ttl_cached(cache: dict, fetch) -> dict:
+    """Shared TTL memo for cluster-sweep snapshots (pump stats, device
+    plane): `cache` is a mutable {"ts", "snap"} cell owned by the call
+    site; one refresh per TTL regardless of scrape rate."""
+    now = time.monotonic()
+    if cache.get("snap") is None or now - cache["ts"] >= _PUMP_CACHE_TTL_S:
+        cache["snap"] = fetch()
+        cache["ts"] = now
+    return cache["snap"]
+
+
+_device_cache: dict = {"ts": float("-inf"), "snap": None}
+_latency_cache: dict = {"ts": float("-inf"), "snap": None}
+
+
+def _device_summary_cached() -> dict:
     from ray_tpu.util import state as _state
 
-    ts, snap = _pump_cache
-    now = time.monotonic()
-    if snap is None or now - ts >= _PUMP_CACHE_TTL_S:
-        snap = _state.pump_stats()
-        _pump_cache = (now, snap)
-    return snap
+    return _ttl_cached(_device_cache, _state.summarize_device_objects)
+
+
+def _pump_stats_cached() -> dict:
+    from ray_tpu.util import state as _state
+
+    return _ttl_cached(_pump_cache, _state.pump_stats)
 
 
 def export_pump_stats() -> dict:
@@ -364,6 +379,35 @@ def core_prometheus_text() -> str:
               [({"state": k}, v) for k, v in tasks.items()])
     except Exception:
         pass
+    # Device object plane: cluster-wide pinned-HBM gauges (the registry
+    # gauges each worker publishes cover its own process; this block
+    # aggregates the raylet fan-out for one-scrape cluster totals).
+    # Cached like pump_stats: the fan-out is a fresh RPC to every raylet
+    # (which fans to every worker) — the scrape path must not pay that
+    # per request.
+    try:
+        dev = _device_summary_cached()
+        gauge("ray_tpu_device_plane_pinned_bytes",
+              "bytes pinned in HBM by the device object plane, per node",
+              [({"node_id": str(n.get("node_id", "?"))[:12]},
+                n.get("pinned_bytes", 0))
+               for n in dev["per_node"] if "error" not in n])
+        gauge("ray_tpu_device_plane_pinned_objects",
+              "arrays pinned by the device object plane, per node",
+              [({"node_id": str(n.get("node_id", "?"))[:12]},
+                n.get("pinned_objects", 0))
+               for n in dev["per_node"] if "error" not in n])
+    except Exception:
+        pass
+    # Keep this process's own device-plane registry gauges current so
+    # prometheus_text renders this scrape's values.
+    try:
+        from ray_tpu._private.device_objects import (
+            export_device_object_gauges)
+
+        export_device_object_gauges()
+    except Exception:
+        pass
     # Event-loop/pump stats per daemon (analogue of the reference's
     # event_stats.h exported through metric_defs.cc operation_* series).
     # Published ONLY through the registry gauges (rendered by
@@ -378,7 +422,11 @@ def core_prometheus_text() -> str:
     # this exposition; bounded limit — the scrape path must not drag
     # the full 200k-row event table over RPC every 15s).
     try:
-        lat = _state.summarize_task_latency(limit=20000)
+        # TTL-cached like the pump/device sweeps: a 20k-row ListTaskEvents
+        # per scrape is GCS loop time the scrape path must not spend.
+        lat = _ttl_cached(
+            _latency_cache,
+            lambda: _state.summarize_task_latency(limit=20000))
         for pct in ("p50_ms", "p95_ms", "p99_ms"):
             gauge(f"ray_tpu_task_stage_{pct}",
                   f"task lifecycle stage latency {pct[:-3]} (ms)",

@@ -193,6 +193,59 @@ def cluster_status() -> dict:
     return cw._run(cw.gcs.call("GetClusterStatus", {}))
 
 
+def list_device_objects(entries: bool = True) -> dict:
+    """Device object plane state (parity target: `ray list objects` for
+    GPU objects): this process's pin registry + transfer counters, the
+    owned device-object descriptors (object id → pin worker / bytes /
+    leaf count), and every node's per-worker registry stats via raylet
+    fan-out."""
+    from ray_tpu._private import device_objects
+
+    cw = get_core_worker()
+    local = device_objects.registry().stats()
+    if entries:
+        local["entries"] = device_objects.registry().entries()
+    owned = []
+    for oid_hex, o in list(cw.objects.items()):
+        d = getattr(o, "device", None)
+        if d:
+            owned.append({
+                "object_id": oid_hex,
+                "state": o.state,
+                "pin_worker": (d[0][2][:12] if d[0] else "(local)"),
+                "pin_node": (d[0][3][:12] if d[0] else ""),
+                "key_prefix": d[1],
+                "pinned_bytes": d[2],
+                "leaves": d[3],
+                "local_refs": o.local_refs,
+                "submitted_refs": o.submitted_refs,
+            })
+    return {"local": local, "owned": owned,
+            "nodes": _per_node_call("NodeDeviceObjects",
+                                    payload={"entries": bool(entries)})}
+
+
+def summarize_device_objects() -> dict:
+    """Cluster-wide pinned-HBM totals per node from the device plane."""
+    out = list_device_objects(entries=False)
+    per_node = []
+    total_bytes = total_objects = 0
+    for node in out["nodes"]:
+        if "error" in node:
+            per_node.append(node)
+            continue
+        nb = sum(w.get("pinned_bytes", 0) for w in node.get("workers", []))
+        no = sum(w.get("pinned_objects", 0) for w in node.get("workers", []))
+        total_bytes += nb
+        total_objects += no
+        per_node.append({"node_id": node.get("node_id"),
+                         "pinned_bytes": nb, "pinned_objects": no})
+    return {"pinned_bytes": total_bytes, "pinned_objects": total_objects,
+            "owned_descriptors": len(out["owned"]),
+            "local_counters": out["local"]["counters"],
+            "per_node": per_node}
+
+
 # ---------- task-lifecycle latency breakdown ----------
 
 # (stage_name, from_state, to_state): duration of each ladder segment.

@@ -41,6 +41,29 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
             cat .bench_tpu_max.json >> "$LOG"
           fi
         fi
+        # Device object plane: capture the device-handoff microbench on
+        # the live TPU (device plane vs host path for a KV-sized array)
+        # and surface the pinned-HBM gauge alongside the pump stats so
+        # the log shows both control-plane AND data-plane health.
+        if timeout 1800 python bench.py --device-handoff \
+            > .bench_device_handoff.json 2>> "$LOG"; then
+          if ! grep -q '"backend": "cpu"' .bench_device_handoff.json; then
+            python bench.py --save-artifact .bench_device_handoff.json \
+              BENCH_DEVICE_HANDOFF.json >> "$LOG" 2>&1
+            echo "[$(date +%T)] device-handoff capture:" >> "$LOG"
+            cat .bench_device_handoff.json >> "$LOG"
+          fi
+          # Surface the run's ACTUAL pinned-HBM/route numbers (from the
+          # bench process's own plane counters — a fresh interpreter's
+          # registry is empty by construction).
+          timeout 60 python - .bench_device_handoff.json >> "$LOG" 2>&1 <<'PYEOF' || true
+import json, sys
+extra = json.load(open(sys.argv[1])).get("extra", {})
+print("device-plane gauge (bench run):",
+      "payload_bytes=", extra.get("payload_bytes"),
+      "counters=", extra.get("plane_counters"))
+PYEOF
+        fi
         timeout 1800 python scripts/tpu_kernel_sweep.py --check-only \
           > KERNEL_SWEEP_TPU.txt 2>&1 || true
         exit 0
