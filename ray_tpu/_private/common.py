@@ -176,6 +176,15 @@ class NodeInfo:
     # Port of the node's native C++ object-transfer server (0 = none;
     # peers then fall back to the RPC chunk path).
     transfer_port: int = 0
+    # Drain ladder (reference: autoscaler.proto DrainNode / rpc
+    # DrainNodeReason):
+    # ALIVE -> DRAINING (evacuation in progress) -> DRAINED (safe to kill)
+    # -> DEAD. A DRAINED node's death is expected and must not trigger
+    # recovery storms.
+    state: str = "ALIVE"
+    drain_reason: str = ""        # preemption | idle | manual
+    drain_deadline_s: float = 0.0
+    drain_stats: dict = field(default_factory=dict)
 
     def to_wire(self):
         return {
@@ -189,4 +198,41 @@ class NodeInfo:
             "store_path": self.store_path,
             "is_head": self.is_head,
             "transfer_port": self.transfer_port,
+            "state": self.state,
+            "drain_reason": self.drain_reason,
+            "drain_deadline_s": self.drain_deadline_s,
+            "drain_stats": self.drain_stats,
         }
+
+
+def wait_for_drained(get_nodes, node_id: str, deadline_s: float, *,
+                     poll_s: float = 0.2, slack_s: float = 10.0):
+    """Poll `get_nodes()` (a callable returning node-table wire dicts)
+    until `node_id` finishes its drain. ONE implementation for every
+    wait-for-DRAINED caller (CLI, autoscaler monitor, cluster_utils) so
+    they cannot disagree about what a finished drain looks like.
+
+    Returns (outcome, node_wire | None) with outcome one of:
+      "DRAINED" — evacuation completed (even if the node has since
+                  died: a self-drained raylet exits right after);
+      "DIED"    — dead before reaching DRAINED (evacuation failed);
+      "GONE"    — node vanished from the table;
+      "TIMEOUT" — still draining past deadline_s + slack_s;
+      "ERROR"   — get_nodes itself failed.
+    """
+    deadline = time.monotonic() + deadline_s + slack_s
+    me = None
+    while time.monotonic() < deadline:
+        try:
+            nodes = get_nodes()
+        except Exception:
+            return "ERROR", me
+        me = next((n for n in nodes if n["node_id"] == node_id), None)
+        if me is None:
+            return "GONE", None
+        if me.get("state") == "DRAINED":
+            return "DRAINED", me
+        if not me.get("alive"):
+            return "DIED", me
+        time.sleep(poll_s)
+    return "TIMEOUT", me

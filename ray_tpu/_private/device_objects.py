@@ -58,6 +58,8 @@ _counters = {
     "host_out": 0,           # host-path pulls served (producer side)
     "lost": 0,               # resolutions that found the pin gone
     "released": 0,           # arrays unpinned by refcount release
+    "evacuated_out": 0,      # arrays shipped off a draining node
+    "evacuated_in": 0,       # arrays re-pinned here by an evacuation
 }
 _handoff_seq = itertools.count(1)
 
@@ -180,6 +182,19 @@ class DeviceRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: dict[str, tuple] = {}  # key -> (array, meta, ts)
+        # prefix -> Address wire of the process that OWNS the ObjectRef
+        # whose payload these pins back. The pin worker needs it exactly
+        # once: a drain evacuation re-homes the arrays to the ref owner
+        # (evacuate()) — without it the pins die with the node.
+        self._ref_owners: dict[str, list | None] = {}
+
+    def note_ref_owner(self, prefix: str, owner_wire) -> None:
+        with self._lock:
+            self._ref_owners[prefix] = owner_wire
+
+    def ref_owner(self, prefix: str):
+        with self._lock:
+            return self._ref_owners.get(prefix)
 
     def pin(self, key: str, array, cw=None) -> DeviceObjectMeta:
         try:
@@ -219,15 +234,18 @@ class DeviceRegistry:
             _count("released")
         return gone is not None
 
-    def release_prefix(self, prefix: str) -> int:
+    def release_prefix(self, prefix: str, *, counted: bool = True) -> int:
         """Unpin every leaf of one device object (keys are
-        '<prefix>#<leaf-index>')."""
+        '<prefix>#<leaf-index>'). counted=False for internal unpins
+        (drain evacuation moves arrays, it does not release them — the
+        'released' gauge must stay a pure refcount-release count)."""
         with self._lock:
             keys = [k for k in self._entries
                     if k == prefix or k.startswith(prefix + "#")]
             for k in keys:
                 del self._entries[k]
-        if keys:
+            self._ref_owners.pop(prefix, None)
+        if keys and counted:
             _count("released", len(keys))
         return len(keys)
 
@@ -295,6 +313,9 @@ def _update_gauges(force: bool = False) -> None:
                               "device objects found lost at resolution"),
                 "released": Gauge("ray_tpu_device_objects_released",
                                   "arrays unpinned by refcount release"),
+                "evacuated": Gauge("ray_tpu_device_objects_evacuated",
+                                   "arrays moved by drain evacuation",
+                                   ("direction",)),
             }
         reg = registry()
         with reg._lock:
@@ -309,6 +330,9 @@ def _update_gauges(force: bool = False) -> None:
             g["transfers"].set(snap.get(route, 0), tags={"route": route})
         g["lost"].set(snap.get("lost", 0))
         g["released"].set(snap.get("released", 0))
+        for direction in ("out", "in"):
+            g["evacuated"].set(snap.get(f"evacuated_{direction}", 0),
+                               tags={"direction": direction})
     except Exception:
         pass
 
@@ -594,6 +618,189 @@ async def handle_stats(cw, payload: dict) -> dict:
     return out
 
 
+# ---------- drain-path evacuation ----------
+
+async def evacuate(cw) -> dict:
+    """Re-home every pinned array whose ObjectRef owner lives off this
+    node — called by the raylet's drain pipeline before the node dies.
+    Leaves are grouped per device object (prefix) and shipped to the
+    ref-owner process, which re-pins them under the SAME keys and
+    refreshes its descriptor (DeviceObjectRepin). Route: the peer-plane
+    collective mailbox when RAY_TPU_DEVICE_COLLECTIVE=1 (raw buffers,
+    no pickle), else the counted host fallback (gather + inline bytes).
+    Pins whose ref owner dies with this node are skipped — there is no
+    surviving reference to preserve them for."""
+    import asyncio
+    import os
+
+    from ray_tpu._private.common import Address
+
+    reg = registry()
+    with reg._lock:
+        snap = list(reg._entries.items())
+        owners = dict(reg._ref_owners)
+    by_prefix: dict[str, list] = {}
+    for key, entry in snap:
+        by_prefix.setdefault(key.split("#", 1)[0], []).append((key, entry))
+    stats = {"evacuated_objects": 0, "evacuated_bytes": 0, "skipped": 0,
+             "routes": {}}
+    loop = asyncio.get_running_loop()
+    want_collective = os.environ.get("RAY_TPU_DEVICE_COLLECTIVE") == "1"
+    for prefix, leaves in by_prefix.items():
+        owner_wire = owners.get(prefix)
+        if not owner_wire:
+            stats["skipped"] += len(leaves)
+            continue
+        addr = Address.from_wire(owner_wire)
+        if addr.worker_id == cw.worker_id or addr.node_id == cw.node_id:
+            # The owner's process dies with this node: its refs (and any
+            # consumer's recovery path) die too — nothing to preserve.
+            stats["skipped"] += len(leaves)
+            continue
+
+        def gather_all(leaves=leaves):
+            out = []
+            for key, (array, meta, _ts) in leaves:
+                np_value = np.asarray(array)
+                out.append((key, str(np_value.dtype),
+                            list(np_value.shape), np_value.tobytes(),
+                            meta.nbytes))
+            return out
+
+        try:
+            gathered = await loop.run_in_executor(None, gather_all)
+            conn = await cw._owner_conn(addr)
+            resp = None
+            route = "host"
+            delivered_tags: list = []
+            if want_collective:
+                # Three steps, because the receiver's mailbox must exist
+                # BEFORE any raw-buffer send (an unknown-handler notify
+                # is silently dropped): prepare (owner arms its
+                # _PeerPlane, or refuses and we go host with no stall) →
+                # deliver the buffers → commit (owner recvs + pins, and
+                # discards every tag on failure so nothing strands).
+                # Exceptions anywhere degrade to the host route too —
+                # the host Repin then carries the delivered tags as
+                # stale so the owner sweeps its mailbox.
+                route = "collective"
+                tags = [key for key, *_ in gathered]
+                try:
+                    resp = await conn.call(
+                        "DeviceObjectRepin",
+                        {"prefix": prefix, "route": "collective",
+                         "phase": "prepare", "tags": tags}, timeout=15)
+                    if resp.get("ok"):
+                        for key, dtype, shape, data, _nb in gathered:
+                            await conn.notify("CollectiveDeliver", {
+                                "group": COLLECTIVE_GROUP, "tag": key,
+                                "dtype": dtype, "shape": shape,
+                                "data": data})
+                            delivered_tags.append(key)
+                        resp = await conn.call(
+                            "DeviceObjectRepin",
+                            {"prefix": prefix, "route": "collective",
+                             "phase": "commit", "tags": tags},
+                            timeout=60)
+                except Exception:
+                    resp = {}
+                if not resp.get("ok"):
+                    resp = None
+                    route = "host"
+            if resp is None:
+                resp = await conn.call("DeviceObjectRepin", {
+                    "prefix": prefix, "route": "host",
+                    "stale_tags": delivered_tags,
+                    "items": [{"key": key, "dtype": dtype,
+                               "shape": shape, "data": data}
+                              for key, dtype, shape, data, _nb
+                              in gathered]}, timeout=60)
+            if not resp.get("ok"):
+                stats["skipped"] += len(leaves)
+                continue
+        except Exception:
+            stats["skipped"] += len(leaves)
+            continue
+        reg.release_prefix(prefix, counted=False)
+        nbytes = sum(nb for *_rest, nb in gathered)
+        stats["evacuated_objects"] += len(leaves)
+        stats["evacuated_bytes"] += nbytes
+        stats["routes"][route] = stats["routes"].get(route, 0) + len(leaves)
+        _count("evacuated_out", len(leaves))
+    return stats
+
+
+async def handle_repin(cw, payload: dict) -> dict:
+    """Ref-owner side of a drain evacuation: accept the arrays a dying
+    node shipped over, pin them in THIS process under their original
+    keys, and repoint the owned object's descriptor here — consumers
+    (and our own gets) then resolve against a live pin instead of
+    falling into lineage reconstruction."""
+    import asyncio
+
+    prefix = payload["prefix"]
+    arrays: dict[str, np.ndarray] = {}
+    if payload.get("route") == "collective":
+        try:
+            from ray_tpu.util.collective.collective import _get_peer_plane
+
+            plane = _get_peer_plane()
+        except Exception as e:
+            return {"ok": False, "error": f"no peer plane: {e}"}
+        if payload.get("phase") == "prepare":
+            # Mailbox armed; the sender may deliver now. Nothing was
+            # sent yet, so a refusal above costs the drain nothing.
+            return {"ok": True}
+        loop = asyncio.get_running_loop()
+        try:
+            for tag in payload["tags"]:
+                arrays[tag] = await loop.run_in_executor(
+                    None, lambda t=tag: plane.recv(COLLECTIVE_GROUP, t,
+                                                   timeout=10.0))
+        except Exception as e:
+            # Partial failure: raw tensor buffers already delivered for
+            # the remaining tags must not strand in the mailbox for the
+            # process lifetime (the sender retries via the host route).
+            for tag in payload["tags"]:
+                if tag not in arrays:
+                    try:
+                        plane.discard(COLLECTIVE_GROUP, tag)
+                    except Exception:
+                        pass
+            return {"ok": False, "error": f"collective recv failed: {e}"}
+    else:
+        # Host route after a degraded collective attempt: buffers the
+        # sender already delivered into our mailbox are stale (the host
+        # payload is authoritative) — sweep them, but only from an
+        # ALREADY-EXISTING plane (no plane = the notifies were dropped
+        # at dispatch; arming one just to sweep would be waste).
+        if payload.get("stale_tags"):
+            from ray_tpu.util.collective import collective as _coll
+
+            plane = _coll._peer_plane
+            if plane is not None:
+                for tag in payload["stale_tags"]:
+                    try:
+                        plane.discard(COLLECTIVE_GROUP, tag)
+                    except Exception:
+                        pass
+        for item in payload["items"]:
+            arrays[item["key"]] = np.frombuffer(
+                bytearray(item["data"]),
+                dtype=_np_dtype(item["dtype"])).reshape(item["shape"])
+    reg = registry()
+    n = total = 0
+    for key, np_value in arrays.items():
+        meta = reg.pin(key, _to_device(np_value), cw)
+        total += meta.nbytes
+        n += 1
+    own_wire = cw.address.to_wire() if cw.address else None
+    reg.note_ref_owner(prefix, own_wire)
+    _count("evacuated_in", n)
+    cw._post(cw._repoint_device_pin, prefix, own_wire)
+    return {"ok": True, "repinned": n, "bytes": total}
+
+
 def note_lost() -> None:
     _count("lost")
 
@@ -619,6 +826,9 @@ def device_put(value):
     stubbed, total, n = extract_arrays(value, prefix, cw)
     if n == 0:
         return ray_tpu.put(value)
+    # Self-owned pin: evacuation has nothing to move (the ref dies with
+    # this process), but the owner record keeps the table uniform.
+    registry().note_ref_owner(prefix, cw.address.to_wire())
     # Refs embedded beside the arrays live as long as the put container
     # (the same container tracking put() applies).
     with collect_nested_refs() as sink:

@@ -52,6 +52,17 @@ PG_PENDING = "PENDING"
 PG_CREATED = "CREATED"
 PG_REMOVED = "REMOVED"
 
+# Node drain ladder (reference: autoscaler.proto DrainNode +
+# node_manager.cc HandleDrainRaylet). ALIVE nodes schedule normally;
+# DRAINING nodes take no new placements while they evacuate; DRAINED
+# nodes are safe to terminate and their death is a non-event.
+NODE_ALIVE = "ALIVE"
+NODE_DRAINING = "DRAINING"
+NODE_DRAINED = "DRAINED"
+NODE_DEAD = "DEAD"
+
+DRAIN_REASONS = ("preemption", "idle", "manual")
+
 
 class GcsServer:
     def __init__(self, config: Config | None = None,
@@ -93,6 +104,14 @@ class GcsServer:
         self.placement_groups: dict[str, dict] = {}
         self.task_events: deque = deque(maxlen=self.config.task_events_max_buffer)
         self.pending_demand: dict[str, list] = {}
+        # Forwarding directory for objects evacuated off drained nodes:
+        # oid_hex -> node_id of the copy's new home. Owners consult it
+        # (GetObjectRelocations) before falling back to lineage
+        # reconstruction when every known location is gone. Bounded:
+        # entries beyond the cap age out FIFO.
+        self.object_relocations: "dict[str, str]" = {}
+        self._relocation_order: deque = deque()
+        self._relocation_cap = 65536
         self.subscribers: dict[str, set[rpc.Connection]] = defaultdict(set)
         # Native-pump server when available (src/fastpath.cc): accept,
         # framing, and sends ride the C++ epoll thread; table mutations
@@ -137,6 +156,8 @@ class GcsServer:
     _MUTATING = {
         "RegisterNode": ("nodes",),
         "NotifyNodeDead": ("nodes",),
+        "DrainNode": ("nodes",),
+        "DrainComplete": ("nodes", "actors"),
         "KVPut": ("kv",),
         "KVDel": ("kv",),
         "RegisterActor": ("actors", "named_actors"),
@@ -176,6 +197,8 @@ class GcsServer:
             "Heartbeat": self.handle_heartbeat,
             "GetAllNodes": self.handle_get_all_nodes,
             "DrainNode": self.handle_drain_node,
+            "DrainComplete": self.handle_drain_complete,
+            "GetObjectRelocations": self.handle_get_object_relocations,
             "NotifyNodeDead": self.handle_notify_node_dead,
             "KVPut": self.handle_kv_put,
             "KVGet": self.handle_kv_get,
@@ -526,7 +549,11 @@ class GcsServer:
                 available_resources=w["available_resources"],
                 labels=w.get("labels") or {}, store_path=w.get("store_path", ""),
                 is_head=w.get("is_head", False),
-                transfer_port=w.get("transfer_port", 0))
+                transfer_port=w.get("transfer_port", 0),
+                state=w.get("state", "ALIVE"),
+                drain_reason=w.get("drain_reason", ""),
+                drain_deadline_s=w.get("drain_deadline_s", 0.0),
+                drain_stats=w.get("drain_stats") or {})
             # Nodes come back when their raylet re-registers; stale-alive
             # entries would mislead placement.
             info.alive = False
@@ -695,8 +722,11 @@ class GcsServer:
         node.last_heartbeat = time.monotonic()
         node.available_resources = payload.get("available_resources", node.available_resources)
         if self.native_sched is not None:
+            # A draining node keeps heartbeating but must stay dead in
+            # the placement mirror (update_node defaults alive=True).
             self.native_sched.update_node(
-                node.node_id, available=node.available_resources)
+                node.node_id, available=node.available_resources,
+                alive=node.state == NODE_ALIVE)
         self.pending_demand[node.node_id] = payload.get("pending_demand", [])
         # Reply piggy-backs the cluster resource view so raylets can make
         # spillback decisions (replaces the reference's ray_syncer gossip,
@@ -715,6 +745,9 @@ class GcsServer:
                 # Same-host peers pull arena-to-arena through shm (one
                 # memcpy, no sockets) — see raylet._native_pull.
                 "store_path": n.store_path,
+                # Raylets must not spill leases onto a DRAINING peer
+                # (its object plane stays reachable for pulls).
+                "state": n.state,
             }
             for nid, n in self.nodes.items()
             if n.alive
@@ -724,14 +757,182 @@ class GcsServer:
         return {"nodes": [n.to_wire() for n in self.nodes.values()]}
 
     async def handle_drain_node(self, conn, payload):
+        """Start a graceful drain: DRAINING in the node table, Drain RPC
+        to the raylet (reason + deadline), proactive actor migration.
+        Failures PROPAGATE — a caller about to terminate the VM must
+        know the node was never told to evacuate (the old handler
+        swallowed every error and answered ok)."""
         node_id = payload["node_id"]
+        reason = payload.get("reason") or "manual"
+        if reason not in DRAIN_REASONS:
+            return {"ok": False, "error": f"unknown drain reason {reason!r} "
+                                          f"(expected one of {DRAIN_REASONS})"}
+        deadline_s = float(payload.get("deadline_s") or 30.0)
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"ok": False, "error": f"unknown node {node_id[:12]}"}
+        if node.state == NODE_DRAINED:
+            # Already evacuated (possibly self-drained on SIGTERM and
+            # exited): idempotent success, even if the node is dead —
+            # checked BEFORE aliveness or a clean self-drain would read
+            # as a failed drain to the autoscaler/CLI.
+            return {"ok": True, "state": NODE_DRAINED}
+        if not node.alive:
+            return {"ok": False, "error": f"node {node_id[:12]} is not alive"}
         nconn = self.node_conns.get(node_id)
-        if nconn is not None:
-            try:
-                await nconn.call("Drain", {}, timeout=self.config.rpc_call_timeout_s)
-            except Exception:
-                pass
-        return {"ok": True}
+        if nconn is None or nconn.closed:
+            return {"ok": False,
+                    "error": f"no raylet connection to node {node_id[:12]}"}
+        already_draining = node.state == NODE_DRAINING
+        node.state = NODE_DRAINING
+        node.drain_reason = reason
+        node.drain_deadline_s = deadline_s
+        node.drain_stats.setdefault("started_at", time.time())
+        self._touch("nodes", node_id)
+        # Placement mirror: stop picking the node for new actors/PGs
+        # (the data plane keeps treating it as alive — objects are still
+        # being pulled off it).
+        if self.native_sched is not None:
+            self.native_sched.update_node(node_id, available={}, alive=False)
+        def rollback():
+            # The raylet never accepted the drain: a node left DRAINING
+            # here would be wedged out of placement forever (no
+            # _run_drain is running, so DrainComplete never comes).
+            if already_draining:
+                return
+            node.state = NODE_ALIVE
+            node.drain_reason = ""
+            self._touch("nodes", node_id)
+            if self.native_sched is not None:
+                self.native_sched.update_node(
+                    node_id, available=node.available_resources,
+                    alive=True)
+
+        try:
+            resp = await nconn.call(
+                "Drain", {"reason": reason, "deadline_s": deadline_s},
+                timeout=self.config.rpc_call_timeout_s)
+        except Exception as e:
+            rollback()
+            return {"ok": False,
+                    "error": f"drain rpc to raylet {node_id[:12]} failed: {e}"}
+        if not resp.get("ok"):
+            rollback()
+            return {"ok": False,
+                    "error": resp.get("error", "raylet refused drain")}
+        from ray_tpu.util import events
+
+        events.record("INFO", "gcs", f"node draining ({reason}, "
+                      f"deadline {deadline_s:g}s)", node_id=node_id)
+        await self.publish("NODE", {"event": "draining", "node_id": node_id,
+                                    "reason": reason,
+                                    "deadline_s": deadline_s})
+        # Proactively restart restartable/named actors elsewhere while
+        # the node is still up — callers observe a RESTARTING window,
+        # never a dead-actor error. Once per drain: a repeated DrainNode
+        # must not race a second migration pass into double-scheduling
+        # the same actor (two CreateActors = a forked actor).
+        if not already_draining:
+            asyncio.ensure_future(self._migrate_actors_off(node_id, reason))
+        return {"ok": True, "state": NODE_DRAINING}
+
+    async def _migrate_actors_off(self, node_id: str, reason: str):
+        """Move every restartable (or detached/named) ALIVE actor off a
+        draining node before it dies (reference: gcs_actor_manager's
+        OnNodeDead reconstruction, run EARLY). Migration must not spend
+        the user's failure budget: the incarnation number (restarts)
+        bumps so callers reset their per-actor sequence counters, but
+        max_restarts is extended to match."""
+        node = self.nodes.get(node_id)
+        migrated = 0
+        for actor_id, a in list(self.actors.items()):
+            if a.get("node_id") != node_id or a["state"] != ACTOR_ALIVE:
+                continue
+            restartable = (a["max_restarts"] == -1
+                           or a["restarts"] < a["max_restarts"]
+                           or a.get("detached") or a.get("name"))
+            if not restartable:
+                continue
+            addr = a.get("address")
+            if addr and len(addr) > 2:
+                # Pre-record the current worker as dead so the eventual
+                # death report from the raylet (kill below, or node
+                # death) dedupes instead of consuming another restart.
+                a.setdefault("dead_worker_ids", set()).add(addr[2])
+            a["restarts"] += 1
+            if a["max_restarts"] >= 0:
+                a["max_restarts"] += 1  # migration is not a failure
+            a["migrations"] = a.get("migrations", 0) + 1
+            a["state"] = ACTOR_RESTARTING
+            a["address"] = None
+            self._touch("actors", actor_id)
+            self.mark_dirty(("actors",))
+            await self.publish("ACTOR", {
+                "actor_id": actor_id, "state": ACTOR_RESTARTING,
+                "reason": f"migrating off draining node ({reason})"})
+            nconn = self.node_conns.get(node_id)
+            if nconn is not None and not nconn.closed:
+                try:
+                    await nconn.call("KillActorWorker",
+                                     {"actor_id": actor_id, "address": addr},
+                                     timeout=self.config.rpc_call_timeout_s)
+                except Exception:
+                    pass  # node may die mid-drain; reschedule regardless
+            migrated += 1
+            asyncio.ensure_future(self._schedule_actor(actor_id))
+        if node is not None and migrated:
+            node.drain_stats["migrated_actors"] = \
+                node.drain_stats.get("migrated_actors", 0) + migrated
+            self._touch("nodes", node_id)
+            logger.info("migrated %d actor(s) off draining node %s",
+                        migrated, node_id[:8])
+
+    def _note_relocations(self, relocations: dict) -> None:
+        for oid_hex, nid in relocations.items():
+            if oid_hex not in self.object_relocations:
+                self._relocation_order.append(oid_hex)
+            self.object_relocations[oid_hex] = nid
+        while len(self._relocation_order) > self._relocation_cap:
+            self.object_relocations.pop(self._relocation_order.popleft(),
+                                        None)
+
+    async def handle_drain_complete(self, conn, payload):
+        """The raylet finished evacuating: DRAINED in the node table,
+        relocated-object directory updated, stats recorded. From here
+        the node's death is expected and cheap."""
+        node_id = payload["node_id"]
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"ok": False, "error": f"unknown node {node_id[:12]}"}
+        self._note_relocations(payload.get("relocations") or {})
+        node.state = NODE_DRAINED
+        stats = dict(payload.get("stats") or {})
+        # Merge: migrated_actors is GCS-side accounting, the rest is the
+        # raylet's evacuation report.
+        node.drain_stats.update(stats)
+        self._touch("nodes", node_id)
+        from ray_tpu.util import events
+
+        events.record("INFO", "gcs", "node drained", node_id=node_id,
+                      **{k: v for k, v in stats.items()
+                         if isinstance(v, (int, float))})
+        logger.info("node %s DRAINED (%s): %s", node_id[:8],
+                    node.drain_reason or "?", node.drain_stats)
+        await self.publish("NODE", {"event": "drained", "node_id": node_id,
+                                    "stats": node.drain_stats})
+        return {"ok": True, "state": NODE_DRAINED}
+
+    async def handle_get_object_relocations(self, conn, payload):
+        """Owner-side lookup: where did evacuated copies of these
+        objects land? (Consulted before lineage reconstruction.)"""
+        out = {}
+        for oid_hex in payload.get("object_ids") or []:
+            nid = self.object_relocations.get(oid_hex)
+            if nid is not None:
+                node = self.nodes.get(nid)
+                if node is not None and node.alive:
+                    out[oid_hex] = nid
+        return {"relocations": out}
 
     async def handle_notify_node_dead(self, conn, payload):
         await self._mark_node_dead(payload["node_id"], payload.get("reason", "reported dead"))
@@ -746,26 +947,41 @@ class GcsServer:
         node = self.nodes.get(node_id)
         if node is None or not node.alive:
             return
+        drained = node.state == NODE_DRAINED
         node.alive = False
+        node.state = NODE_DEAD if not drained else NODE_DRAINED
         node.available_resources = {}
         self.node_conns.pop(node_id, None)
         if self.native_sched is not None:
             self.native_sched.update_node(node_id, available={}, alive=False)
         self.pending_demand.pop(node_id, None)
         self._touch("nodes", node_id)
-        logger.warning("node %s dead: %s", node_id[:8], reason)
         self.mark_dirty(("nodes", "actors", "placement_groups"))
         from ray_tpu.util import events
 
-        events.record("ERROR", "gcs", f"node dead: {reason}",
-                      node_id=node_id)
-        await self.publish("NODE", {"event": "dead", "node_id": node_id, "reason": reason})
+        if drained:
+            # Expected death of an evacuated node: a non-event, not a
+            # failure (no ERROR record, no unexpected-death log).
+            logger.info("drained node %s removed cleanly (%s)",
+                        node_id[:8], reason)
+            events.record("INFO", "gcs", "drained node removed",
+                          node_id=node_id)
+        else:
+            logger.warning("node %s dead: %s", node_id[:8], reason)
+            events.record("ERROR", "gcs", f"node dead: {reason}",
+                          node_id=node_id)
+        await self.publish("NODE", {"event": "dead", "node_id": node_id,
+                                    "reason": reason, "drained": drained})
         # Actor fault tolerance: restart or kill actors that lived there
-        # (reference: gcs_actor_manager.cc OnNodeDead).
+        # (reference: gcs_actor_manager.cc OnNodeDead). On a DRAINED
+        # node every restartable actor migrated before death; anything
+        # left goes through the normal path with a drain-flavored cause.
         for actor_id, a in list(self.actors.items()):
             if a.get("node_id") == node_id and a["state"] in (ACTOR_ALIVE, ACTOR_PENDING):
                 await self._on_actor_worker_death(
-                    actor_id, f"node {node_id[:8]} died: {reason}")
+                    actor_id,
+                    f"node {node_id[:8]} drained and removed" if drained
+                    else f"node {node_id[:8]} died: {reason}")
         for pg_id, pg in self.placement_groups.items():
             if pg["state"] == PG_CREATED and any(
                     b.get("node_id") == node_id for b in pg["bundles"]):
@@ -864,11 +1080,14 @@ class GcsServer:
         """Node selection for actors/PGs at the GCS (raylets do their own
         hybrid policy for tasks). Mirrors the reference's GcsActorScheduler
         falling back onto raylet scheduling."""
-        alive = [n for n in self.nodes.values() if n.alive]
+        # DRAINING/DRAINED nodes take no new placements (their native-
+        # scheduler mirror is already marked dead at drain start).
+        alive = [n for n in self.nodes.values()
+                 if n.alive and n.state == NODE_ALIVE]
         if strategy and strategy[0] == "node_affinity":
             target, soft = strategy[1], strategy[2]
             node = self.nodes.get(target)
-            if node is not None and node.alive:
+            if node is not None and node.alive and node.state == NODE_ALIVE:
                 return target
             if not soft:
                 return None
@@ -949,9 +1168,20 @@ class GcsServer:
                  "pg_bundle_index": a.get("pg_bundle_index", -1)},
                 timeout=self.config.rpc_call_timeout_s)
             if not resp.get("ok"):
+                reason = resp.get("reason", "creation failed")
+                if "draining" in reason:
+                    # Creation raced a drain: not a failure, just pick a
+                    # different node — consuming a restart here would
+                    # spend the user's budget on an infrastructure event.
+                    logger.info("actor %s creation bounced off draining "
+                                "node %s; rescheduling", actor_id[:8],
+                                node_id[:8])
+                    asyncio.ensure_future(
+                        self._schedule_actor(actor_id, delay=0.2))
+                    return
                 logger.warning("actor %s creation on node %s failed: %s",
-                               actor_id[:8], node_id[:8], resp.get("reason"))
-                await self._on_actor_worker_death(actor_id, resp.get("reason", "creation failed"))
+                               actor_id[:8], node_id[:8], reason)
+                await self._on_actor_worker_death(actor_id, reason)
         except Exception as e:
             logger.warning("actor %s creation rpc to node %s failed: %s",
                            actor_id[:8], node_id[:8], e)
@@ -1202,7 +1432,8 @@ class GcsServer:
             if got is None:
                 return None
             return list(enumerate(got))
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values()
+                 if n.alive and n.state == NODE_ALIVE]
         if strategy == "STRICT_ICI":
             # Group nodes by slice label; try each slice as a unit.
             slices: dict[str, list[NodeInfo]] = defaultdict(list)

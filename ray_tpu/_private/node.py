@@ -75,6 +75,19 @@ class NodeHandle:
         except OSError:
             pass
 
+    def preempt(self):
+        """Deliver a platform preemption notice (SIGTERM) to the raylet.
+        Its preemption watcher (raylet.main) self-initiates a graceful
+        drain with the RAY_TPU_PREEMPTION_DEADLINE_S deadline (30s
+        default) and exits 0 once DRAINED — the spot/maintenance
+        reclaim path, exercised by test_utils.NodePreempter."""
+        import signal as _signal
+
+        try:
+            self.proc.send_signal(_signal.SIGTERM)
+        except Exception:
+            pass
+
 
 class RuntimeNode:
     """Drives head bring-up and node management for one session."""

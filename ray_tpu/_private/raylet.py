@@ -364,8 +364,27 @@ class Raylet:
         self.host = "127.0.0.1"
         self.port: int | None = None
         self.draining = False
+        # Drain/evacuation state (reference: node_manager.cc
+        # HandleDrainRaylet, grown into a full evacuation pipeline —
+        # see _run_drain). drain_done fires once DrainComplete reported.
+        self.drain_reason = ""
+        self.drain_deadline_s = 0.0
+        # Absolute (monotonic) evacuation cutoff; a superseding, more
+        # urgent DrainNode tightens it mid-pipeline (handle_drain).
+        self._drain_deadline_mono = float("inf")
+        self._drain_task: asyncio.Task | None = None
+        self._drain_stats: dict = {}
+        self._drain_done = asyncio.Event()
         self._peer_conns: dict[tuple[str, int], rpc.Connection] = {}
         self._pull_locks: dict[str, asyncio.Lock] = {}
+        # Objects this raylet PULLED from peers (secondary copies),
+        # oid_hex -> source node id: the drain evacuation pushes
+        # primaries first — the bounded window must not be spent
+        # re-shipping redundant copies while an object whose only copy
+        # lives here waits its turn. An entry only counts as secondary
+        # while its source node is still alive (rolling preemptions
+        # promote relocated copies to primaries).
+        self._pulled_copies: dict[str, str] = {}
         self._tasks: list[asyncio.Task] = []
         self._lease_seq = 0
         self._num_leases_granted = 0
@@ -723,6 +742,10 @@ class Raylet:
                     await self._on_worker_death(w, f"worker process exited "
                                                    f"with code {w.proc.returncode}")
             # Trim idle workers beyond the soft limit / idle timeout.
+            # Not while draining: idle workers may hold HBM pins the
+            # evacuation pipeline is about to re-home.
+            if self.draining:
+                continue
             soft = self._idle_soft_limit()
             while len(self.idle_workers) > soft:
                 w = self.idle_workers.popleft()
@@ -1325,7 +1348,10 @@ class Raylet:
             self._native_sched.update_node(
                 nid, total=info.get("total_resources"),
                 available=info.get("available_resources"),
-                labels=info.get("labels"))
+                labels=info.get("labels"),
+                # Draining peers stay in the data-plane view (object
+                # pulls) but must not win spillback picks.
+                alive=info.get("state", "ALIVE") == "ALIVE")
         for nid in self._native_known - seen:
             self._native_sched.remove_node(nid)
         self._native_known = seen
@@ -1360,6 +1386,8 @@ class Raylet:
                           else self.cluster_view).items():
             if nid == self.node_id:
                 continue
+            if info.get("state", "ALIVE") != "ALIVE":
+                continue  # never spill onto a draining/drained peer
             if resources_fit(info.get("available_resources", {}), resources):
                 util = sum(info["total_resources"].get(k, 0)
                            - info["available_resources"].get(k, 0)
@@ -1399,7 +1427,12 @@ class Raylet:
             spill = self._pick_spillback(resources)
             if spill:
                 return {"spillback": self._debit_spill(spill, resources)}
-            return {"error": "node draining"}
+            # No peer fits right now: a drain rejection is retry-
+            # elsewhere, NEVER a permanent failure — a task that raced
+            # the drain flag must not be failed infeasible (the owner
+            # backs off and re-resolves from its local raylet's view).
+            return {"error": "node draining", "draining": True,
+                    "retry": True}
 
         if strategy and strategy[0] == "node_affinity" \
                 and strategy[1] != self.node_id:
@@ -1461,8 +1494,10 @@ class Raylet:
                 # This node can never run it; hand off to any peer whose
                 # TOTAL capacity fits (it will queue there), else error.
                 for nid, info in self.cluster_view.items():
-                    if nid != self.node_id and resources_fit(
-                            info.get("total_resources", {}), resources):
+                    if nid != self.node_id \
+                            and info.get("state", "ALIVE") == "ALIVE" \
+                            and resources_fit(
+                                info.get("total_resources", {}), resources):
                         return {"spillback": {"node_id": nid, "host": info["host"],
                                               "port": info["raylet_port"]}}
                 self._note_infeasible(resources)
@@ -1624,6 +1659,11 @@ class Raylet:
     # ---------- actors ----------
 
     async def handle_create_actor(self, conn, payload):
+        if self.draining:
+            # The GCS excludes draining nodes from placement, but a
+            # creation can race the drain flag; bounce it so the GCS
+            # repicks (without consuming a restart — see _schedule_actor).
+            return {"ok": False, "reason": "node draining"}
         resources = normalize_resources(payload.get("resources"))
         pg_id = payload.get("placement_group", "")
         bundle_index = payload.get("pg_bundle_index", -1)
@@ -1678,6 +1718,22 @@ class Raylet:
         actor_id = payload["actor_id"]
         for w in list(self.workers.values()):
             if w.actor_id == actor_id:
+                if self.draining and w.conn is not None \
+                        and not w.conn.closed:
+                    # Drain-migration kill: this worker's HBM pins must
+                    # re-home NOW — the pipeline's own device phase
+                    # (_run_drain step 3) runs later and would find the
+                    # process already dead.
+                    try:
+                        out = await w.conn.call(
+                            "DeviceObjectEvacuate", {},
+                            timeout=min(30.0,
+                                        self.drain_deadline_s or 30.0))
+                        self._note_device_evac(out)
+                    except Exception:
+                        logger.warning("pre-kill device evacuation of "
+                                       "actor %s failed", actor_id[:8],
+                                       exc_info=True)
                 self._release_lease_resources(w)
                 self._kill_worker(w)
                 self._pump_pending_leases()
@@ -1932,6 +1988,12 @@ class Raylet:
             if native_peers:
                 if await self._native_pull(native_peers, oid):
                     self._pull_locks.pop(oid_hex, None)
+                    # Stripes may have come from several peers; any one
+                    # alive source is enough for "a copy exists there".
+                    src = next((nid for nid in locations
+                                if nid in self.cluster_view), "")
+                    if src:
+                        self._pulled_copies[oid_hex] = src
                     return {"ok": True}
                 last_err = "native pull failed from all peers"
             for nid in locations:
@@ -1943,6 +2005,7 @@ class Raylet:
                     ok = await self._pull_from(peer, oid)
                     if ok:
                         self._pull_locks.pop(oid_hex, None)
+                        self._pulled_copies[oid_hex] = nid
                         return {"ok": True}
                     last_err = f"object not on node {nid[:8]}"
                 except Exception as e:
@@ -2061,6 +2124,7 @@ class Raylet:
 
     async def handle_free_objects(self, conn, payload):
         for oid_hex in payload["object_ids"]:
+            self._pulled_copies.pop(oid_hex, None)
             self.store.delete(ObjectID.from_hex(oid_hex), force=True)
             entry = self.spilled.pop(oid_hex, None)
             if entry is not None:
@@ -2104,9 +2168,270 @@ class Raylet:
         return {"ok": True}
 
     async def handle_drain(self, conn, payload):
-        """reference: node_manager.cc:1940 HandleDrainRaylet."""
+        """Start graceful evacuation (reference: node_manager.cc:1940
+        HandleDrainRaylet, grown into a full drain pipeline). Acks
+        immediately; _run_drain evacuates in the background and reports
+        DrainComplete to the GCS when the node is safe to kill."""
+        reason = payload.get("reason") or "manual"
+        deadline_s = float(payload.get("deadline_s") or 30.0)
+        if self.draining:
+            # A more urgent drain supersedes an in-flight one: a
+            # preemption notice landing mid-idle-drain must TIGHTEN the
+            # running pipeline's deadline (the platform reclaims the VM
+            # on ITS schedule), never extend it.
+            new_abs = time.monotonic() + deadline_s
+            if new_abs < self._drain_deadline_mono:
+                self._drain_deadline_mono = new_abs
+                self.drain_reason = reason
+                self.drain_deadline_s = deadline_s
+                logger.warning("drain deadline tightened to %.1fs (%s)",
+                               deadline_s, reason)
+            return {"ok": True, "draining": True,
+                    "already": True, "reason": self.drain_reason}
         self.draining = True
-        return {"ok": True}
+        self.drain_reason = reason
+        self.drain_deadline_s = deadline_s
+        self._drain_deadline_mono = time.monotonic() + deadline_s
+        self._drain_task = asyncio.ensure_future(
+            self._run_drain(reason, deadline_s))
+        self._tasks.append(self._drain_task)
+        return {"ok": True, "draining": True}
+
+    async def _run_drain(self, reason: str, deadline_s: float):
+        """The evacuation pipeline, bounded by `deadline_s`:
+
+        1. re-spill queued pending leases to peer raylets (or reject
+           them retryable when no peer fits),
+        2. wait for running leases to finish — reserving a slice of the
+           deadline for data evacuation,
+        3. evacuate HBM-pinned device objects from every live worker
+           (device_objects.evacuate: collective re-pin or counted host
+           fallback to each ref owner),
+        4. kill overdue leased workers (their owners retry elsewhere —
+           retryable, not infeasible),
+        5. push the store's primary object copies to peers and record
+           the relocations,
+        6. report DrainComplete{stats, relocations} to the GCS.
+
+        Actor migration runs concurrently on the GCS side
+        (gcs._migrate_actors_off), started by the same DrainNode."""
+        from ray_tpu.util import events
+
+        t0 = time.monotonic()
+        stats = self._drain_stats
+        stats.update({"reason": reason, "deadline_s": deadline_s})
+        events.record("INFO", "raylet",
+                      f"drain started ({reason}, {deadline_s:g}s deadline)",
+                      node_id=self.node_id)
+        logger.info("draining node %s: reason=%s deadline=%.1fs",
+                    self.node_id[:8], reason, deadline_s)
+        try:
+            # -- 1. queued leases ------------------------------------
+            respilled = rejected = 0
+            for item in list(self.pending_leases):
+                resources, _pg, _bi, fut, spillable, _received = item
+                try:
+                    self.pending_leases.remove(item)
+                except ValueError:
+                    continue
+                if fut.done():
+                    continue
+                spill = self._pick_spillback(resources) if spillable \
+                    else None
+                if spill is not None:
+                    fut.set_result(
+                        {"spillback": self._debit_spill(spill, resources)})
+                    respilled += 1
+                else:
+                    fut.set_result({"error": "node draining",
+                                    "draining": True, "retry": True})
+                    rejected += 1
+            stats["respilled_leases"] = respilled
+            stats["rejected_leases"] = rejected
+
+            # -- 2. running leases (bounded wait) --------------------
+            # Reserve part of the deadline for the data-evacuation
+            # phases; a node that waits the full budget on one slow
+            # task would have nothing left to move its objects with.
+            # Cutoff re-read each tick: a superseding preemption drain
+            # may tighten _drain_deadline_mono mid-wait.
+
+            def running_leases():
+                return [w for w in self.workers.values()
+                        if w.leased and not w.dead and w.actor_id is None]
+
+            while running_leases():
+                reserve = min(max(1.0, self.drain_deadline_s * 0.3), 10.0)
+                if time.monotonic() >= self._drain_deadline_mono - reserve:
+                    break
+                await asyncio.sleep(0.05)
+            stats["lease_wait_s"] = round(time.monotonic() - t0, 3)
+
+            # -- 3. device objects (before any worker is killed) -----
+            # Accumulated, not assigned: drain-migration kills
+            # (handle_kill_actor_worker) may have evacuated some
+            # workers' pins already.
+            for w in list(self.workers.values()):
+                if w.dead or w.conn is None or w.conn.closed:
+                    continue
+                try:
+                    out = await w.conn.call(
+                        "DeviceObjectEvacuate", {},
+                        timeout=max(2.0, self._drain_deadline_mono
+                                    - time.monotonic()))
+                except Exception as e:
+                    logger.warning("device evacuation on worker %s "
+                                   "failed: %s", w.worker_id[:8], e)
+                    continue
+                self._note_device_evac(out)
+            for key in ("evacuated_device_objects",
+                        "evacuated_device_bytes",
+                        "skipped_device_objects"):
+                stats.setdefault(key, 0)
+            stats.setdefault("device_routes", {})
+
+            # -- 4. overdue running leases: fail retryable -----------
+            killed = 0
+            for w in running_leases():
+                await self._on_worker_death(
+                    w, "node drained before lease completed "
+                       "(owner retries elsewhere)")
+                self._kill_worker(w)
+                killed += 1
+            stats["killed_leases"] = killed
+
+            # -- 5. primary object copies → peers --------------------
+            relocations, evac_objects, evac_bytes, left = \
+                await self._evacuate_objects()
+            stats["evacuated_objects"] = evac_objects
+            stats["evacuated_bytes"] = evac_bytes
+            stats["unevacuated_objects"] = left
+        except Exception:
+            logger.exception("drain evacuation failed; reporting what "
+                             "completed")
+            relocations = {}
+        stats["duration_s"] = round(time.monotonic() - t0, 3)
+
+        # -- 6. DrainComplete ------------------------------------
+        for _attempt in range(3):
+            try:
+                await self.gcs_conn.call(
+                    "DrainComplete",
+                    {"node_id": self.node_id, "stats": stats,
+                     "relocations": relocations},
+                    timeout=self.config.rpc_call_timeout_s)
+                break
+            except Exception:
+                await asyncio.sleep(0.5)
+        else:
+            logger.error("could not report DrainComplete to GCS")
+        events.record("INFO", "raylet", "drain complete",
+                      node_id=self.node_id,
+                      **{k: v for k, v in stats.items()
+                         if isinstance(v, (int, float))})
+        logger.info("node %s drain complete in %.2fs: %s",
+                    self.node_id[:8], stats["duration_s"], stats)
+        self._drain_done.set()
+
+    def _note_device_evac(self, out: dict) -> None:
+        """Fold one worker's DeviceObjectEvacuate report into the drain
+        stats (called from the pipeline's device phase AND from
+        drain-migration actor kills, which evacuate early)."""
+        s = self._drain_stats
+        s["evacuated_device_objects"] = \
+            s.get("evacuated_device_objects", 0) \
+            + out.get("evacuated_objects", 0)
+        s["evacuated_device_bytes"] = \
+            s.get("evacuated_device_bytes", 0) \
+            + out.get("evacuated_bytes", 0)
+        s["skipped_device_objects"] = \
+            s.get("skipped_device_objects", 0) + out.get("skipped", 0)
+        routes = s.setdefault("device_routes", {})
+        for route, n in (out.get("routes") or {}).items():
+            routes[route] = routes.get(route, 0) + n
+
+    async def _evacuate_objects(self):
+        """Push every sealed (or spilled) local object to an alive peer
+        by asking the peer to PullObject from us — the existing pull
+        plane (native shm/TCP stripes, spill-restore) does the bytes.
+        Bounded by self._drain_deadline_mono (re-read per object: a
+        superseding drain may tighten it). Returns (relocations,
+        n_evacuated, bytes_evacuated, n_left)."""
+        peers = [(nid, info) for nid, info in self.cluster_view.items()
+                 if nid != self.node_id
+                 and info.get("state", "ALIVE") == "ALIVE"]
+        todo: list[tuple[str, int]] = []  # (oid_hex, size)
+        if self.store is not None:
+            for oid in self.store.list_objects():
+                got = self.store.get_buffer(oid)
+                if got is None:
+                    continue  # unsealed/mid-write: nothing to push yet
+                meta, data = got
+                size = len(meta) + len(data)
+                self.store.release(oid)
+                todo.append((oid.hex(), size))
+        in_store = {h for h, _ in todo}
+        for oid_hex, (_path, _ms, size) in list(self.spilled.items()):
+            if oid_hex not in in_store:
+                todo.append((oid_hex, size))
+        if not todo:
+            return {}, 0, 0, 0
+        # Primaries first: copies we pulled from a STILL-ALIVE peer
+        # exist elsewhere — pushing them is belt-and-braces, not
+        # survival, so they must not eat the bounded window ahead of
+        # objects whose only copy lives here. A pulled copy whose
+        # source node has since died (rolling preemption) is a primary
+        # now and sorts with them.
+        alive_ids = {nid for nid, _info in peers}
+
+        def is_secondary(oid_hex: str) -> bool:
+            return self._pulled_copies.get(oid_hex) in alive_ids
+
+        todo.sort(key=lambda item: is_secondary(item[0]))
+        if not peers:
+            logger.warning("drain: %d objects have no peer to evacuate "
+                           "to", len(todo))
+            return {}, 0, 0, len(todo)
+        relocations: dict[str, str] = {}
+        evac_bytes = 0
+        bad_peers: set[str] = set()  # errored once: stop paying for it
+        i = 0
+        for oid_hex, size in todo:
+            # Round-robin across peers (spreads transfer load and the
+            # post-drain storage burden), retrying each object on the
+            # NEXT peer when one fails — a single dead peer must not
+            # silently lose its round-robin slice of the evacuation.
+            for _attempt in range(len(peers)):
+                remaining = self._drain_deadline_mono - time.monotonic()
+                if remaining <= 0:
+                    break
+                nid, info = peers[i % len(peers)]
+                i += 1
+                if nid in bad_peers:
+                    continue
+                try:
+                    peer = await self._peer_conn(info["host"],
+                                                 info["raylet_port"])
+                    resp = await peer.call(
+                        "PullObject",
+                        {"object_id": oid_hex,
+                         "locations": [self.node_id]},
+                        timeout=min(10.0, max(1.0, remaining)))
+                except Exception as e:
+                    logger.warning("drain: peer %s failed evacuating "
+                                   "%s (%s); excluded", nid[:8],
+                                   oid_hex[:12], e)
+                    bad_peers.add(nid)
+                    continue
+                if resp.get("ok"):
+                    relocations[oid_hex] = nid
+                    evac_bytes += size
+                    break
+            if time.monotonic() >= self._drain_deadline_mono \
+                    or len(bad_peers) == len(peers):
+                break
+        return (relocations, len(relocations), evac_bytes,
+                len(todo) - len(relocations))
 
     async def handle_get_state(self, conn, payload):
         return {
@@ -2124,6 +2449,9 @@ class Raylet:
             "spilled_bytes": self._spilled_bytes,
             "num_restored": self._num_restored,
             "draining": self.draining,
+            "drain_reason": self.drain_reason,
+            "drain_stats": self._drain_stats,
+            "drained": self._drain_done.is_set(),
         }
 
     async def handle_get_event_loop_stats(self, conn, payload):
@@ -2132,6 +2460,41 @@ class Raylet:
         EventLoopStats surface; analogue of event_stats.h)."""
         return {"node_id": self.node_id,
                 "server": self.server.stats.snapshot()}
+
+    async def self_drain(self, reason: str = "preemption",
+                         deadline_s: float | None = None):
+        """Self-initiated drain — the preemption-notice path. Platforms
+        deliver SIGTERM ~30s before reclaiming a spot/maintenance node;
+        the watcher in main() routes it here. Goes through the GCS so
+        actor migration and the node-table ladder run exactly as for an
+        operator-initiated drain; falls back to a local evacuation when
+        the GCS is unreachable. Exits 0 once DRAINED."""
+        if deadline_s is None:
+            deadline_s = float(os.environ.get(
+                "RAY_TPU_PREEMPTION_DEADLINE_S", "30"))
+        logger.warning("preemption notice on node %s: draining with "
+                       "%.0fs deadline", self.node_id[:8], deadline_s)
+        try:
+            resp = await self.gcs_conn.call(
+                "DrainNode", {"node_id": self.node_id, "reason": reason,
+                              "deadline_s": deadline_s},
+                timeout=min(10.0, self.config.rpc_call_timeout_s))
+            if not resp.get("ok"):
+                raise RuntimeError(resp.get("error", "DrainNode refused"))
+        except Exception:
+            logger.warning("GCS-coordinated drain failed; evacuating "
+                           "locally", exc_info=True)
+            await self.handle_drain(
+                None, {"reason": reason, "deadline_s": deadline_s})
+        try:
+            await asyncio.wait_for(self._drain_done.wait(),
+                                   deadline_s + 15.0)
+        except asyncio.TimeoutError:
+            logger.error("drain did not complete within deadline; "
+                         "exiting anyway")
+        logger.info("raylet %s exiting after preemption drain",
+                    self.node_id[:8])
+        os._exit(0)
 
 
 def main():
@@ -2172,6 +2535,18 @@ def main():
             node_id=args.node_id or None,
             is_head=args.head)
         host, port = await raylet.start(args.host, args.port)
+        # Preemption watcher: spot/maintenance reclamation delivers
+        # SIGTERM with a short grace window — self-initiate a drain with
+        # the platform deadline instead of dying with leases, objects,
+        # and pinned HBM on board. RAY_TPU_PREEMPTION_WATCHER=0 opts out
+        # (SIGTERM then takes the default fatal path).
+        if os.environ.get("RAY_TPU_PREEMPTION_WATCHER", "1") != "0":
+            try:
+                asyncio.get_running_loop().add_signal_handler(
+                    signal.SIGTERM,
+                    lambda: asyncio.ensure_future(raylet.self_drain()))
+            except (NotImplementedError, RuntimeError):
+                pass  # non-main-thread / platform without signal support
         if args.ready_fd >= 0:
             os.write(args.ready_fd,
                      f"{host}:{port}:{raylet.node_id}:"

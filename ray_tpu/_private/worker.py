@@ -269,6 +269,12 @@ class CoreWorker:
         # generator's yields share one spec — freeing the first consumed
         # yield must not strand the others without reconstruction).
         self._lineage_live: dict[str, int] = {}
+        # Recovery accounting: lineage re-executions started by this
+        # owner, and losses recovered instead from the GCS's drained-node
+        # relocation directory. A clean drain shows relocations > 0 and
+        # reconstructions == 0 (what the chaos tests assert).
+        self._num_reconstructions = 0
+        self._num_relocation_recoveries = 0
         self.actor_handles_state: dict[str, dict] = {}  # actor_id -> conn/seq/queue
         self._fn_cache: dict[str, object] = {}
         self._put_counter = itertools.count(1)
@@ -442,6 +448,8 @@ class CoreWorker:
             "DeviceObjectPull": self._handle_device_object_pull,
             "DeviceObjectRelease": self._handle_device_object_release,
             "DeviceObjectStats": self._handle_device_object_stats,
+            "DeviceObjectEvacuate": self._handle_device_object_evacuate,
+            "DeviceObjectRepin": self._handle_device_object_repin,
             "CancelTask": self._handle_cancel_task,
             "Exit": self._handle_exit,
             "Ping": lambda conn, p: {"ok": True},
@@ -956,7 +964,11 @@ class CoreWorker:
                                                owner)
                 if ok:
                     continue
-                # All copies lost → lineage reconstruction
+                # All known copies lost. A drained node's copies were
+                # pushed to peers — consult the GCS relocation
+                # directory before paying for lineage reconstruction.
+                if await self._try_relocated(oid_hex, o, owner):
+                    continue
                 recovered = await self._try_reconstruct(oid_hex)
                 if not recovered:
                     raise exc.ObjectLostError(oid_hex)
@@ -1017,6 +1029,11 @@ class CoreWorker:
                 return same_host
             ok = await self._pull_to_local(oid.hex(), resp["locations"],
                                            owner)
+            if not ok:
+                # The owner's locations may predate a node drain: pull
+                # from the relocated copy (and report ours back so the
+                # owner's directory heals for later borrowers).
+                await self._try_relocated(oid.hex(), None, owner)
             return None
         if status == "failed":
             return bytes(resp["meta"]), bytes(resp["data"]), None
@@ -1121,6 +1138,30 @@ class CoreWorker:
         except Exception:
             pass  # best-effort: the hint only widens future pulls
 
+    async def _try_relocated(self, oid_hex: str, o, owner=None) -> bool:
+        """Recover a lost object from the GCS drained-node relocation
+        directory (raylet._evacuate_objects pushed primary copies to
+        peers before the node died). Returns True when the object is
+        now in the local store — the cheap alternative to lineage
+        reconstruction for every foreseen node death."""
+        try:
+            resp = await self.gcs.call(
+                "GetObjectRelocations", {"object_ids": [oid_hex]},
+                timeout=self.config.rpc_call_timeout_s)
+        except Exception:
+            return False
+        nid = (resp.get("relocations") or {}).get(oid_hex)
+        if not nid or (o is not None and nid in o.locations):
+            return False  # unknown, or the failed pull already tried it
+        if o is not None:
+            o.locations.add(nid)
+        ok = await self._pull_to_local(oid_hex, [nid], owner)
+        if ok:
+            self._num_relocation_recoveries += 1
+            logger.info("recovered %s from drained-node relocation on %s",
+                        oid_hex[:12], nid[:8])
+        return ok
+
     async def _try_reconstruct(self, oid_hex: str) -> bool:
         """Lineage reconstruction (reference: object_recovery_manager.h:96
         ReconstructObject → resubmit the creating task)."""
@@ -1130,6 +1171,7 @@ class CoreWorker:
         spec = self.lineage.get(o.lineage_task)
         if spec is None:
             return False
+        self._num_reconstructions += 1
         logger.warning("reconstructing %s via task %s", oid_hex[:12], spec.name)
         o.state = OBJ_PENDING
         o.locations.clear()
@@ -1963,6 +2005,19 @@ class CoreWorker:
                         raylet_conn = self.raylet
                         _hop = 0
                     continue
+                if resp.get("draining"):
+                    # Drain rejection: the node is evacuating and no
+                    # peer fit its spillback view. Retry-elsewhere, not
+                    # a permanent failure — re-resolve from the LOCAL
+                    # raylet (whose next heartbeat view excludes the
+                    # draining node); a task that raced the drain flag
+                    # must never be failed infeasible.
+                    if not self._queues[shape]:
+                        return
+                    await asyncio.sleep(0.2)
+                    raylet_conn = self.raylet
+                    _hop = 0
+                    continue
                 if resp.get("retry"):
                     # Raylet-side lease timeout under contention: retry
                     # for as long as there is queued work. Retries must
@@ -2662,6 +2717,45 @@ class CoreWorker:
 
         return await device_objects.handle_stats(self, payload)
 
+    async def _handle_device_object_evacuate(self, conn, payload):
+        """Drain path: the raylet asks this worker to re-home its pinned
+        arrays before the node dies (see device_objects.evacuate)."""
+        from ray_tpu._private import device_objects
+
+        return await device_objects.evacuate(self)
+
+    async def _handle_device_object_repin(self, conn, payload):
+        """Drain path, ref-owner side: accept evacuated arrays and pin
+        them locally under their original keys."""
+        from ray_tpu._private import device_objects
+
+        return await device_objects.handle_repin(self, payload)
+
+    def _repoint_device_pin(self, prefix: str, addr_wire) -> None:
+        """Loop-side: after a drain evacuation re-pinned a device
+        object's arrays in THIS process, repoint the owned object's pin
+        address (o.device) and rewrite an inline descriptor payload so
+        future fetches hand consumers live stub addresses (a sealed
+        store-resident payload cannot be rewritten; owner-side gets
+        still recover via the refreshed o.device)."""
+        from ray_tpu._private import device_objects
+
+        for o in self.objects.values():
+            if not o.device or o.device[1] != prefix:
+                continue
+            o.device[0] = addr_wire
+            if o.inline is not None:
+                try:
+                    kind, value = serialization.deserialize(*o.inline)
+                    if kind == serialization.KIND_DEVICE:
+                        sobj = serialization.serialize(
+                            device_objects.retarget_stubs(value, addr_wire),
+                            kind=serialization.KIND_DEVICE)
+                        o.inline = (sobj.meta, sobj.to_bytes())
+                except Exception:
+                    logger.exception("device descriptor rewrite failed")
+            break
+
     def _set_device_info(self, oid_hex: str, dev_info: list) -> None:
         """Loop-side: attach device-plane pin info to an owned object
         (device_objects.device_put posts this after storing the stub)."""
@@ -2692,6 +2786,14 @@ class CoreWorker:
         recovery path in _fetch_object."""
         from ray_tpu._private import device_objects
 
+        oid_hex0 = oid.hex()
+        o0 = self.objects.get(oid_hex0)
+        if o0 is not None and o0.device and o0.device[0]:
+            # The owner's pin record is authoritative: a drain
+            # evacuation (or reconstruction) may have re-homed the pins
+            # since the descriptor bytes were sealed — resolve against
+            # the live address, not the payload's.
+            value = device_objects.retarget_stubs(value, o0.device[0])
         try:
             return device_objects.resolve_value(value, self)
         except exc.DeviceObjectLostError:
@@ -3314,6 +3416,9 @@ class CoreWorker:
             value, prefix, self)
         if not n_leaves:
             return None
+        # The submitting caller owns the return ref: record it with the
+        # pins so a drain evacuation knows where to re-home the arrays.
+        device_objects.registry().note_ref_owner(prefix, spec.owner)
         with collect_nested_refs() as sink:
             sobj = serialization.serialize(stubbed,
                                            kind=serialization.KIND_DEVICE)

@@ -28,17 +28,19 @@ class StandardAutoscaler:
     def __init__(self, provider: NodeProvider, node_types: list[NodeType],
                  *, get_cluster_status, idle_timeout_s: float = 60.0,
                  upscaling_speed: float = 1.0, max_workers: int = 20,
-                 drain_node=None):
+                 drain_node=None, drain_deadline_s: float = 30.0):
         self.provider = provider
         self.node_types = {t.name: t for t in node_types}
         self.get_cluster_status = get_cluster_status
         self.idle_timeout_s = idle_timeout_s
         self.upscaling_speed = upscaling_speed
         self.max_workers = max_workers
-        # Called with each GCS node_id before the provider tears the VM
-        # down (reference: drain precedes termination so running leases
-        # finish — node_manager.cc HandleDrainRaylet analog).
+        # Called as drain_node(node_id, reason="idle", deadline_s=...)
+        # before the provider tears the VM down (reference: drain
+        # precedes termination so running leases finish and primary
+        # object copies evacuate — DrainNode / HandleDrainRaylet analog).
         self.drain_node = drain_node
+        self.drain_deadline_s = drain_deadline_s
         self._idle_since: dict[str, float] = {}
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -155,6 +157,7 @@ class StandardAutoscaler:
         # provider node = a whole multi-host slice registering under its
         # own GCS node ids) — a slice is idle only when EVERY host is.
         terminated = []
+        to_terminate: list[tuple[str, list[dict]]] = []
         now = time.monotonic()
         by_id = {n["node_id"]: n for n in alive}
         by_slice: dict[str, list[dict]] = {}
@@ -184,14 +187,30 @@ class StandardAutoscaler:
             if now - first_idle > self.idle_timeout_s and t is not None \
                     and kept >= t.min_workers:
                 logger.info("autoscaler terminating idle node %s", nid[:8])
-                if self.drain_node is not None:
-                    for i in infos:
-                        self.drain_node(i["node_id"])
+                to_terminate.append((nid, infos))
+            else:
+                min_by_type[t_name] = kept + 1
+        if to_terminate:
+            if self.drain_node is not None:
+                # Drain the whole batch CONCURRENTLY: each drain waits
+                # for DRAINED (up to its deadline), and serializing N of
+                # them would stall this single update thread — and with
+                # it upscale decisions — for N x deadline.
+                from concurrent.futures import ThreadPoolExecutor
+
+                drain_infos = [i for _nid, infos in to_terminate
+                               for i in infos]
+                with ThreadPoolExecutor(
+                        max_workers=min(8, len(drain_infos))) as pool:
+                    list(pool.map(
+                        lambda i: self.drain_node(
+                            i["node_id"], reason="idle",
+                            deadline_s=self.drain_deadline_s),
+                        drain_infos))
+            for nid, _infos in to_terminate:
                 self.provider.terminate_node(nid)
                 terminated.append(nid)
                 self._idle_since.pop(nid, None)
-            else:
-                min_by_type[t_name] = kept + 1
         return {"launched": launched, "terminated": terminated,
                 "demand": len(demand)}
 

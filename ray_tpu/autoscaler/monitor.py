@@ -58,15 +58,51 @@ class Monitor:
     def get_cluster_status(self) -> dict:
         return self._call_async(self._conn.call("GetClusterStatus", {}))
 
-    def drain_node(self, node_id: str) -> None:
-        """Stop new leases on the node and let running work finish
-        before the provider tears the VM down."""
-        try:
-            self._call_async(self._conn.call("DrainNode",
-                                             {"node_id": node_id}))
-        except Exception:
-            logger.warning("drain of node %s failed; terminating anyway",
-                           node_id[:8], exc_info=True)
+    def drain_node(self, node_id: str, reason: str = "idle",
+                   deadline_s: float = 30.0) -> bool:
+        """Graceful drain before the provider tears the VM down: the
+        raylet evacuates leases, objects, and pinned HBM while the GCS
+        migrates actors. Waits (bounded) for DRAINED so termination
+        never races the evacuation. The GCS now PROPAGATES drain
+        failures — retry once, then escalate in the log and let the
+        caller terminate an undrained node knowingly."""
+        resp = {}
+        for attempt in (1, 2):
+            try:
+                resp = self._call_async(self._conn.call(
+                    "DrainNode", {"node_id": node_id, "reason": reason,
+                                  "deadline_s": deadline_s}))
+            except Exception as e:
+                resp = {"ok": False, "error": str(e)}
+            if resp.get("ok"):
+                break
+            logger.warning("drain of node %s failed (attempt %d): %s",
+                           node_id[:8], attempt, resp.get("error"))
+        if not resp.get("ok"):
+            logger.error("node %s could not be drained (%s); terminating "
+                         "UNDRAINED — running work will be recovered the "
+                         "expensive way", node_id[:8], resp.get("error"))
+            return False
+        from ray_tpu._private.common import wait_for_drained
+
+        outcome, me = wait_for_drained(
+            lambda: self._call_async(
+                self._conn.call("GetAllNodes", {}))["nodes"],
+            node_id, deadline_s)
+        if outcome == "DRAINED":
+            return True
+        if outcome in ("DIED", "GONE"):
+            # Dead mid-drain WITHOUT reaching DRAINED: the evacuation
+            # never finished — running work on it is being recovered
+            # the expensive way. That is a drain failure, not success.
+            logger.error("node %s died mid-drain (state=%s) before "
+                         "DRAINED", node_id[:8],
+                         me.get("state") if me else "gone")
+            return False
+        logger.warning("node %s did not reach DRAINED within its "
+                       "deadline (%s); terminating anyway", node_id[:8],
+                       outcome)
+        return False
 
     def run(self, interval_s: float = 5.0):
         self.autoscaler.start(interval_s=interval_s)

@@ -56,6 +56,30 @@ class Cluster:
         if node in self._node.nodes:
             self._node.nodes.remove(node)
 
+    def drain_node(self, node: NodeHandle, *, deadline_s: float = 10.0,
+                   reason: str = "manual", wait: bool = True) -> dict:
+        """Gracefully drain one raylet through the GCS (DrainNode with
+        reason + deadline) and, by default, wait until the node table
+        reports DRAINED — after which remove_node() is a non-event (no
+        lineage storms, no actor-death errors). Requires a connected
+        driver."""
+        from ray_tpu._private.api_internal import get_core_worker
+
+        from ray_tpu._private.common import wait_for_drained
+
+        cw = get_core_worker()
+        resp = cw._run(cw.gcs.call(
+            "DrainNode", {"node_id": node.node_id, "reason": reason,
+                          "deadline_s": deadline_s}, timeout=30))
+        if wait and resp.get("ok"):
+            outcome, me = wait_for_drained(
+                ray_tpu.nodes, node.node_id, deadline_s,
+                poll_s=0.05, slack_s=15.0)
+            resp = dict(resp)
+            resp["state"] = (me.get("state") if me else "GONE") \
+                if outcome != "DRAINED" else "DRAINED"
+        return resp
+
     def connect(self):
         assert self.head_node is not None
         ray_tpu.init(

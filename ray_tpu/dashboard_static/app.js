@@ -195,14 +195,24 @@ const VIEWS = {
     const byId = Object.fromEntries(stats.map((s) => [s.node_id, s]));
     const rows = nodes.map((n) => {
       const s = byId[n.node_id] || {};
+      const ds = n.drain_stats || {};
       return {
-        node_id: n.node_id, host: n.host, state: n.alive ? "ALIVE" : "DEAD",
+        // Drain ladder from the GCS node table: ALIVE / DRAINING /
+        // DRAINED / DEAD (a DRAINED death is a clean removal).
+        node_id: n.node_id, host: n.host,
+        state: n.state || (n.alive ? "ALIVE" : "DEAD"),
         head: n.is_head, cpu_used:
           (n.total_resources.CPU || 0) - (n.available_resources.CPU || 0),
         cpu_total: n.total_resources.CPU || 0,
         workers: s.num_workers, pending: s.pending_leases,
         store_bytes: (s.store || {}).bytes_in_use,
-        spilled: s.spilled_bytes, draining: s.draining,
+        spilled: s.spilled_bytes,
+        drain: n.drain_reason
+          ? `${n.drain_reason}: ${ds.evacuated_objects || 0} obj/` +
+            `${ds.evacuated_device_objects || 0} dev/` +
+            `${ds.respilled_leases || 0} leases in ` +
+            `${ds.duration_s != null ? ds.duration_s + "s" : "…"}`
+          : "",
       };
     });
     return "<h1>Nodes</h1>" + renderTable(rows, {

@@ -270,18 +270,42 @@ def cmd_pump_stats(args):
 
 
 def cmd_drain(args):
-    """`ray_tpu drain <node_id>` — stop new leases on a node and let
-    running work finish (parity: reference `ray drain-node`; same
-    DrainNode RPC the autoscaler issues before terminating)."""
+    """`ray_tpu drain <node_id> [--reason r] [--deadline s] [--no-wait]`
+    — graceful evacuation (parity: reference `ray drain-node` /
+    autoscaler.proto DrainNode): the raylet re-spills queued leases,
+    waits for running work up to the deadline, pushes primary object
+    copies and pinned device objects to peers, while the GCS migrates
+    restartable actors. By default waits until the node reports
+    DRAINED (then it is safe to terminate)."""
     ray_tpu = _connect_from_state(args)
     from ray_tpu._private.api_internal import get_core_worker
 
     cw = get_core_worker()
-    resp = cw._run(cw.gcs.call("DrainNode", {"node_id": args.node_id},
-                               timeout=60))
-    print(json.dumps(resp if isinstance(resp, dict) else {"ok": resp}))
+    resp = cw._run(cw.gcs.call("DrainNode", {
+        "node_id": args.node_id, "reason": args.reason,
+        "deadline_s": args.deadline}, timeout=60))
+    if not isinstance(resp, dict):
+        resp = {"ok": resp}
+    if not resp.get("ok"):
+        print(json.dumps(resp))
+        _shutdown_if_owned(ray_tpu)
+        return 1
+    rc = 0
+    if not args.no_wait:
+        from ray_tpu._private.common import wait_for_drained
+
+        outcome, me = wait_for_drained(
+            lambda: cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"],
+            args.node_id, args.deadline, slack_s=15.0)
+        resp["state"] = "DRAINED" if outcome == "DRAINED" \
+            else (me.get("state", outcome) if me else outcome)
+        if me is not None:
+            resp["drain_stats"] = me.get("drain_stats") or {}
+        if outcome != "DRAINED":
+            rc = 1
+    print(json.dumps(resp))
     _shutdown_if_owned(ray_tpu)
-    return 0
+    return rc
 
 
 def cmd_memory(args):
@@ -479,10 +503,18 @@ def main():
                                           "(per-handler counts/latencies)")
     p.set_defaults(fn=cmd_pump_stats)
 
-    p = sub.add_parser("drain", help="drain a node: stop new leases, let "
-                                     "running work finish (parity: "
+    p = sub.add_parser("drain", help="gracefully drain a node: evacuate "
+                                     "leases, actors, objects, and pinned "
+                                     "HBM, then wait for DRAINED (parity: "
                                      "`ray drain-node`)")
     p.add_argument("node_id")
+    p.add_argument("--reason", default="manual",
+                   choices=["preemption", "idle", "manual"])
+    p.add_argument("--deadline", type=float, default=30.0,
+                   help="seconds the raylet may spend evacuating")
+    p.add_argument("--no-wait", action="store_true",
+                   help="return after initiating the drain instead of "
+                        "waiting for DRAINED")
     p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("memory", help="cluster object-memory report "

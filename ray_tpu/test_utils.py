@@ -79,6 +79,102 @@ class NodeKiller:
         self.stop()
 
 
+class NodePreempter:
+    """Graceful-preemption chaos: drain-with-deadline, then kill — the
+    spot/maintenance reclamation model (NodeKiller's SIGKILL cousin;
+    reference: autoscaler.proto DrainNode preceding reclaim). The
+    assertion model inverts NodeKiller's: a PREEMPTED node's death must
+    be a non-event — zero lineage reconstructions, zero client-visible
+    actor errors (drain evacuated everything first).
+
+    Deterministic use (what most tests want)::
+
+        preempter = NodePreempter(cluster, deadline_s=10)
+        result = preempter.preempt(node)   # drain → DRAINED → kill
+        assert result["state"] == "DRAINED"
+
+    Interval mode mirrors NodeKiller::
+
+        with NodePreempter(cluster, interval_s=2.0, respawn=True,
+                           node_args={"num_cpus": 2}) as p:
+            ... workload ...
+        assert p.preemptions >= 1
+    """
+
+    def __init__(self, cluster, *, deadline_s: float = 10.0,
+                 reason: str = "preemption", interval_s: float | None = None,
+                 respawn: bool = False, node_args: dict | None = None,
+                 max_preemptions: int | None = None, seed: int | None = None):
+        self.cluster = cluster
+        self.deadline_s = deadline_s
+        self.reason = reason
+        self.interval_s = interval_s
+        self.respawn = respawn
+        self.node_args = node_args or {}
+        self.max_preemptions = max_preemptions
+        self.rng = random.Random(seed)
+        self.preemptions = 0
+        self.results: list[dict] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def preempt(self, node, *, kill: bool = True) -> dict:
+        """Drain one node with the configured deadline, wait for
+        DRAINED, then (by default) kill it. Returns the drain response
+        (its "state" is DRAINED on a clean evacuation)."""
+        result = self.cluster.drain_node(
+            node, deadline_s=self.deadline_s, reason=self.reason,
+            wait=True)
+        self.results.append(result)
+        if kill:
+            self.cluster.remove_node(node)
+        self.preemptions += 1
+        return result
+
+    def _victims(self):
+        return [n for n in self.cluster._node.nodes
+                if n is not self.cluster.head_node
+                and n.proc.poll() is None]
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            if self.max_preemptions is not None \
+                    and self.preemptions >= self.max_preemptions:
+                return
+            victims = self._victims()
+            if not victims:
+                continue
+            node = self.rng.choice(victims)
+            try:
+                self.preempt(node)
+            except Exception:
+                continue
+            if self.respawn:
+                try:
+                    self.cluster.add_node(**self.node_args)
+                except Exception:
+                    pass
+
+    def start(self):
+        assert self.interval_s is not None, \
+            "interval mode needs interval_s; use preempt() directly"
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-preempter")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
 def wait_for_condition(predicate, timeout: float = 30.0,
                        retry_interval_ms: float = 100.0) -> None:
     """Parity: reference _private/test_utils.py wait_for_condition."""

@@ -367,6 +367,46 @@ def core_prometheus_text() -> str:
     for key, avail in (("CPU", "cpu"), ("TPU", "tpu")):
         gauge(f"ray_tpu_node_{avail}_available", f"available {key} per node",
               [(nid(st), st.get("available", {}).get(key, 0)) for st in ok])
+    # Drain ladder: node states plus per-drain evacuation accounting
+    # (duration, evacuated bytes/objects, respilled leases, migrated
+    # actors) straight from the GCS node table's drain_stats.
+    try:
+        import ray_tpu as _rt
+
+        nodes = _rt.nodes()
+        by_state: dict = {}
+        for n in nodes:
+            by_state[n.get("state", "?")] = \
+                by_state.get(n.get("state", "?"), 0) + 1
+        gauge("ray_tpu_nodes_by_state",
+              "nodes per drain-ladder state (ALIVE/DRAINING/DRAINED/DEAD)",
+              [({"state": k}, v) for k, v in sorted(by_state.items())])
+        drain_rows = [(n, n.get("drain_stats") or {}) for n in nodes]
+        drain_rows = [(n, d) for n, d in drain_rows if d]
+        nlab = lambda n: {"node_id": str(n.get("node_id", "?"))[:12],
+                          "reason": n.get("drain_reason", "")}
+        for metric, key, help_ in (
+                ("ray_tpu_drain_duration_seconds", "duration_s",
+                 "wall time one node's drain evacuation took"),
+                ("ray_tpu_drain_evacuated_bytes", "evacuated_bytes",
+                 "object-store bytes pushed to peers during drain"),
+                ("ray_tpu_drain_evacuated_objects", "evacuated_objects",
+                 "object-store objects pushed to peers during drain"),
+                ("ray_tpu_drain_evacuated_device_objects",
+                 "evacuated_device_objects",
+                 "HBM-pinned arrays re-homed during drain"),
+                ("ray_tpu_drain_respilled_leases", "respilled_leases",
+                 "queued leases re-spilled to peers during drain"),
+                ("ray_tpu_drain_killed_leases", "killed_leases",
+                 "running leases failed retryable at the drain deadline"),
+                ("ray_tpu_drain_migrated_actors", "migrated_actors",
+                 "actors proactively restarted off draining nodes")):
+            samples = [(nlab(n), d.get(key, 0)) for n, d in drain_rows
+                       if key in d]
+            if samples:
+                gauge(metric, help_, samples)
+    except Exception:
+        pass
     try:
         actors = _state.summarize_actors()["by_state"]
         gauge("ray_tpu_actors", "actors by state",

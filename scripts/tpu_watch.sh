@@ -64,6 +64,36 @@ print("device-plane gauge (bench run):",
       "counters=", extra.get("plane_counters"))
 PYEOF
         fi
+        # Drain-protocol probe: two local nodes, an object pinned to the
+        # doomed one, drain with a 10s deadline — the log then carries
+        # the robustness path's metrics (drain duration, evacuated
+        # objects/bytes, respilled leases, migrated actors) alongside
+        # the bench numbers, so a drain regression is visible from the
+        # same watcher artifact.
+        timeout 300 python - >> "$LOG" 2>&1 <<'PYEOF' || true
+import json
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+cluster = Cluster(initialize_head=True, connect=True,
+                  head_node_args={"num_cpus": 2})
+target = cluster.add_node(num_cpus=2, resources={"probe": 1})
+cluster.wait_for_nodes()
+
+@ray_tpu.remote(resources={"probe": 0.1})
+def _blob():
+    return bytes(1 << 20)
+
+ref = _blob.remote()
+ray_tpu.wait([ref], timeout=30)
+resp = cluster.drain_node(target, deadline_s=10, reason="manual")
+info = next((n for n in ray_tpu.nodes()
+             if n["node_id"] == target.node_id), {})
+print("drain-probe:", json.dumps({
+    "state": resp.get("state"),
+    "stats": info.get("drain_stats", {})}))
+cluster.shutdown()
+PYEOF
         timeout 1800 python scripts/tpu_kernel_sweep.py --check-only \
           > KERNEL_SWEEP_TPU.txt 2>&1 || true
         exit 0

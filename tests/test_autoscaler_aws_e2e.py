@@ -135,7 +135,8 @@ def test_provision_register_drain_terminate_cycle(fake_aws):
               "pending_placement_groups": []}
     scaler = StandardAutoscaler(
         provider, [cpu_type], get_cluster_status=lambda: status,
-        drain_node=drained.append, idle_timeout_s=0.0)
+        drain_node=lambda nid, **kw: drained.append((nid, kw)),
+        idle_timeout_s=0.0)
 
     # Tick 1: unmet CPU demand -> run-instances with Name tag + raylet
     # bootstrap user-data.
@@ -170,7 +171,11 @@ def test_provision_register_drain_terminate_cycle(fake_aws):
          "labels": {"node-name": name}}]
     scaler.update()  # marks idle
     scaler.update()  # terminates after the (0s) timeout
-    assert drained == ["gcsnode0"]
+    # Idle termination drains first, with reason + deadline (the raylet
+    # evacuates leases/objects before the VM is reclaimed).
+    assert [d[0] for d in drained] == ["gcsnode0"]
+    assert drained[0][1]["reason"] == "idle"
+    assert drained[0][1]["deadline_s"] > 0
     assert fake_aws.state()["instances"] == {}
     assert provider.non_terminated_nodes() == []
     terms = [c for c in fake_aws.calls()

@@ -98,7 +98,8 @@ def test_provision_bootstrap_drain_terminate_cycle(fake_gcloud):
               "pending_placement_groups": []}
     scaler = StandardAutoscaler(
         provider, [tpu_type], get_cluster_status=lambda: status,
-        drain_node=drained.append, idle_timeout_s=0.0)
+        drain_node=lambda nid, **kw: drained.append((nid, kw)),
+        idle_timeout_s=0.0)
 
     # Tick 1: unmet TPU demand -> queued-resource created.
     scaler.update()
@@ -140,7 +141,9 @@ def test_provision_bootstrap_drain_terminate_cycle(fake_gcloud):
     ]
     scaler.update()  # marks idle
     scaler.update()  # terminates after the (0s) timeout
-    assert drained == ["gcsnode0", "gcsnode1"]
+    assert [d[0] for d in drained] == ["gcsnode0", "gcsnode1"]
+    assert all(kw["reason"] == "idle" and kw["deadline_s"] > 0
+               for _nid, kw in drained)
     assert fake_gcloud.state()["queued"] == {}
     assert provider.non_terminated_nodes() == []
     deletes = [c for c in fake_gcloud.calls()

@@ -1,12 +1,15 @@
 """Chaos tests: workloads survive random node kills (parity model:
-reference python/ray/tests/chaos/ + NodeKillerActor suites)."""
+reference python/ray/tests/chaos/ + NodeKillerActor suites), and
+PREEMPTED nodes — drain-with-deadline then kill, via NodePreempter —
+die as non-events: zero lineage reconstructions, zero actor errors."""
 
 import time
 
 import pytest
 
 import ray_tpu
-from ray_tpu.test_utils import NodeKiller, wait_for_condition
+from ray_tpu.test_utils import (NodeKiller, NodePreempter,
+                                wait_for_condition)
 
 
 @ray_tpu.remote
@@ -62,3 +65,76 @@ def test_actor_restart_after_chaos_kill(ray_start_cluster_head):
 def test_wait_for_condition_raises():
     with pytest.raises(TimeoutError):
         wait_for_condition(lambda: False, timeout=0.3)
+
+
+@pytest.mark.smoke
+def test_preempted_node_is_a_non_event(ray_start_cluster_head):
+    """NodeKiller's inverse: a node that is DRAINED before it dies must
+    cost nothing — the workload finishes with zero lineage
+    reconstructions and zero actor-death errors (drain evacuated the
+    queued leases, the actor, and the primary object copies first)."""
+    from ray_tpu._private.api_internal import get_core_worker
+
+    cluster = ray_start_cluster_head
+    target = cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.wait_for_nodes()
+    cw = get_core_worker()
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    actor = Counter.options(max_restarts=5, name="preempt-counter",
+                            resources={"side": 0.1}).remote()
+    assert ray_tpu.get(actor.incr.remote(), timeout=30) == 1
+
+    @ray_tpu.remote(resources={"side": 0.1})
+    def payload():
+        return bytes(bytearray(1 << 18))
+
+    blob = payload.remote()
+    ray_tpu.wait([blob], timeout=30)
+    refs = [_compute.options(max_retries=10).remote(i) for i in range(30)]
+
+    preempter = NodePreempter(cluster, deadline_s=10, reason="preemption")
+    result = preempter.preempt(target)
+    assert result.get("state") == "DRAINED", result
+    assert preempter.preemptions == 1
+
+    assert ray_tpu.get(refs, timeout=120) == [i * 2 for i in range(30)]
+    assert len(ray_tpu.get(blob, timeout=30)) == 1 << 18
+    # Actor calls never error — at worst they wait out a RESTARTING
+    # window while the GCS migrates the actor off the draining node.
+    assert ray_tpu.get(actor.incr.remote(), timeout=60) >= 1
+    assert cw._num_reconstructions == 0
+
+
+@pytest.mark.smoke
+def test_preemption_deadline_fail_fast(ray_start_cluster_head):
+    """Work that exceeds the drain deadline is failed fast and
+    RETRYABLE: the drain completes on time and the task finishes on a
+    surviving node instead of being failed infeasible."""
+    cluster = ray_start_cluster_head
+    target = cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"side": 0.1}, max_retries=3)
+    def outlives_deadline(x):
+        time.sleep(20.0)
+        return x * 3
+
+    ref = outlives_deadline.remote(5)
+    time.sleep(1.5)
+    preempter = NodePreempter(cluster, deadline_s=2)
+    t0 = time.monotonic()
+    result = preempter.preempt(target)
+    assert result.get("state") == "DRAINED", result
+    assert time.monotonic() - t0 < 15
+    assert ray_tpu.get(ref, timeout=90) == 15

@@ -378,8 +378,9 @@ def test_memory_cli_report(ray_start_regular, capsys):
 
 
 def test_drain_cli(ray_start_cluster_head, capsys):
-    """`ray_tpu drain <node>` issues the same DrainNode the autoscaler
-    uses: the node stops taking new leases (parity: `ray drain-node`)."""
+    """`ray_tpu drain <node> --deadline/--reason` issues the same
+    DrainNode the autoscaler uses, waits for DRAINED, and reports the
+    drain stats (parity: `ray drain-node`)."""
     from ray_tpu import scripts
     from ray_tpu.util import state
 
@@ -390,11 +391,15 @@ def test_drain_cli(ray_start_cluster_head, capsys):
     class _A:
         node_id = victim.node_id
         address = None
+        reason = "manual"
+        deadline = 10.0
+        no_wait = False
 
     rc = scripts.cmd_drain(_A())
     assert rc == 0
     out = capsys.readouterr().out
-    assert "ok" in out or "drain" in out.lower()
+    assert '"DRAINED"' in out
+    assert "drain_stats" in out
     # The drained node is excluded from new placement: spread tasks all
     # land on the head.
     @ray_tpu.remote
