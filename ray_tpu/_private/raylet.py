@@ -2226,6 +2226,22 @@ class Raylet:
         logger.info("draining node %s: reason=%s deadline=%.1fs",
                     self.node_id[:8], reason, deadline_s)
         try:
+            # -- 0. pre-death notice to live local workers -----------
+            # Fire-and-forget fan-out so in-process subscribers (elastic
+            # train sessions) can park at their next step boundary while
+            # the evacuation pipeline runs — the node-local complement
+            # of the GCS NODE "draining" publish, which only reaches
+            # remote owners.
+            for w in list(self.workers.values()):
+                if w.dead or w.conn is None or w.conn.closed:
+                    continue
+                try:
+                    await w.conn.notify("DrainNotice", {
+                        "node_id": self.node_id, "reason": reason,
+                        "deadline_s": deadline_s})
+                except Exception:
+                    pass
+
             # -- 1. queued leases ------------------------------------
             respilled = rejected = 0
             for item in list(self.pending_leases):

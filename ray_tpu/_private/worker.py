@@ -385,6 +385,12 @@ class CoreWorker:
         self._stream_queues: dict[str, _queue.Queue] = {}
         self._task_events: list = []
         self._tqdm_renderer = None  # lazy; driver-side progress bars
+        # Elastic-training signal surfaces: NODE state-transition
+        # subscribers (GCS pubsub, lazy channel subscribe) and
+        # raylet→worker DrainNotice subscribers (pre-death signal for
+        # processes ON the draining node).
+        self._node_event_listeners: list = []
+        self._drain_notice_listeners: list = []
         self._run(self._async_init())
         # GC tuning for task-burst workloads: default thresholds run a
         # collection every ~700 allocations, and with 100k+ pending
@@ -450,6 +456,7 @@ class CoreWorker:
             "DeviceObjectStats": self._handle_device_object_stats,
             "DeviceObjectEvacuate": self._handle_device_object_evacuate,
             "DeviceObjectRepin": self._handle_device_object_repin,
+            "DrainNotice": self._handle_drain_notice,
             "CancelTask": self._handle_cancel_task,
             "Exit": self._handle_exit,
             "Ping": lambda conn, p: {"ok": True},
@@ -3565,6 +3572,17 @@ class CoreWorker:
             if msg.get("state") in ("CREATED", "REMOVED"):
                 self._settle_pg_waiters(msg["pg_id"], msg["state"])
             return
+        if payload.get("channel") == "NODE":
+            # Node state transitions (alive/draining/drained/dead) fanned
+            # out to interested owners — the elastic trainer's pre-death
+            # signal. Listener errors must never poison the GCS conn.
+            msg = payload["message"]
+            for fn in list(self._node_event_listeners):
+                try:
+                    fn(msg)
+                except Exception:
+                    logger.exception("node event listener failed")
+            return
         if payload.get("channel") != "ACTOR":
             return
         msg = payload["message"]
@@ -3626,6 +3644,38 @@ class CoreWorker:
             # Reconnect resubscribes _gcs_channels; a failure here means
             # the GCS conn is already cycling.
             pass
+
+    def add_node_event_listener(self, fn) -> None:
+        """Subscribe `fn(msg)` to GCS NODE state transitions
+        ({"event": "alive"|"draining"|"drained"|"dead", ...}). The NODE
+        channel is joined lazily on the first listener (same pattern as
+        the per-handle ACTOR subscription) and resubscribed across GCS
+        reconnects via _gcs_channels."""
+        self._node_event_listeners.append(fn)
+        if "NODE" not in self._gcs_channels:
+            self._gcs_channels.append("NODE")
+            self._spawn(self._subscribe_channel("NODE"))
+
+    def remove_node_event_listener(self, fn) -> None:
+        try:
+            self._node_event_listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def add_drain_notice_listener(self, fn) -> None:
+        """Subscribe `fn(payload)` to this node's own drain notice (the
+        raylet fans DrainNotice to its workers at the top of
+        _run_drain) — lets in-process sessions park themselves even if
+        the GCS publish to their owner is still in flight."""
+        self._drain_notice_listeners.append(fn)
+
+    async def _handle_drain_notice(self, conn, payload):
+        for fn in list(self._drain_notice_listeners):
+            try:
+                fn(payload)
+            except Exception:
+                logger.exception("drain notice listener failed")
+        return {"ok": True}
 
     @staticmethod
     def _note_actor_incarnation(st, restarts: int):

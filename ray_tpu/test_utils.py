@@ -99,12 +99,27 @@ class NodePreempter:
                            node_args={"num_cpus": 2}) as p:
             ... workload ...
         assert p.preemptions >= 1
+
+    Stochastic STEP schedule (elastic-train chaos, reproducible): a
+    preemption every ~`step_interval` training steps with ±`step_jitter`
+    relative jitter, gaps drawn from the seeded rng — the same seed
+    replays the same schedule. `step_source` is a zero-arg callable
+    returning the workload's current global step::
+
+        p = NodePreempter(cluster, deadline_s=5, step_interval=20,
+                          step_source=lambda: trainer_step(), seed=7,
+                          respawn=True, node_args={"num_cpus": 2})
+        with p:
+            ... train ...
+        assert p.preemptions >= 2
     """
 
     def __init__(self, cluster, *, deadline_s: float = 10.0,
                  reason: str = "preemption", interval_s: float | None = None,
                  respawn: bool = False, node_args: dict | None = None,
-                 max_preemptions: int | None = None, seed: int | None = None):
+                 max_preemptions: int | None = None, seed: int | None = None,
+                 step_interval: int | None = None,
+                 step_jitter: float = 0.3, step_source=None):
         self.cluster = cluster
         self.deadline_s = deadline_s
         self.reason = reason
@@ -115,6 +130,10 @@ class NodePreempter:
         self.rng = random.Random(seed)
         self.preemptions = 0
         self.results: list[dict] = []
+        self.step_interval = step_interval
+        self.step_jitter = step_jitter
+        self.step_source = step_source
+        self.step_schedule: list[int] = []  # steps preemptions fired at
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -155,11 +174,53 @@ class NodePreempter:
                 except Exception:
                     pass
 
+    def _next_gap(self) -> int:
+        """Steps until the next preemption: step_interval ± jitter,
+        drawn from the seeded rng (deterministic schedule per seed)."""
+        lo = max(1, int(round(self.step_interval * (1 - self.step_jitter))))
+        hi = max(lo, int(round(self.step_interval * (1 + self.step_jitter))))
+        return self.rng.randint(lo, hi)
+
+    def _step_loop(self):
+        target = self._next_gap()
+        while not self._stop.wait(0.05):
+            if self.max_preemptions is not None \
+                    and self.preemptions >= self.max_preemptions:
+                return
+            try:
+                step = int(self.step_source())
+            except Exception:
+                continue
+            if step < target:
+                continue
+            victims = self._victims()
+            if not victims:
+                continue
+            node = self.rng.choice(victims)
+            try:
+                self.preempt(node)
+                self.step_schedule.append(step)
+            except Exception:
+                continue
+            if self.respawn:
+                try:
+                    self.cluster.add_node(**self.node_args)
+                except Exception:
+                    pass
+            target = step + self._next_gap()
+
     def start(self):
-        assert self.interval_s is not None, \
-            "interval mode needs interval_s; use preempt() directly"
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="node-preempter")
+        if self.step_interval is not None:
+            assert self.step_source is not None, \
+                "step schedule needs step_source (current-step callable)"
+            self._thread = threading.Thread(target=self._step_loop,
+                                            daemon=True,
+                                            name="node-preempter")
+        else:
+            assert self.interval_s is not None, \
+                "interval mode needs interval_s; use preempt() directly"
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="node-preempter")
         self._thread.start()
         return self
 

@@ -3,6 +3,7 @@ from ray_tpu.train.session import get_context, report
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (
     CheckpointConfig,
+    ElasticConfig,
     FailureConfig,
     RunConfig,
     ScalingConfig,
@@ -11,6 +12,7 @@ from ray_tpu.train.spmd import (
     TrainState,
     init_sharded_state,
     make_train_step,
+    reshard_to_mesh,
     shard_train_step,
     state_specs_from_rules,
 )
@@ -18,9 +20,9 @@ from ray_tpu.train.trainer import JaxTrainer, Result
 
 __all__ = [
     "JaxTrainer", "Result", "ScalingConfig", "RunConfig", "CheckpointConfig",
-    "FailureConfig", "Checkpoint", "CheckpointManager", "session",
-    "TrainState", "make_train_step", "shard_train_step", "init_sharded_state",
-    "state_specs_from_rules",
+    "ElasticConfig", "FailureConfig", "Checkpoint", "CheckpointManager",
+    "session", "TrainState", "make_train_step", "shard_train_step",
+    "init_sharded_state", "state_specs_from_rules", "reshard_to_mesh",
 ]
 
 # TorchTrainer / AccelerateTrainer / HF callbacks import torch lazily —

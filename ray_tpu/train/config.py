@@ -13,6 +13,26 @@ from typing import Any
 
 
 @dataclass
+class ElasticConfig:
+    """Resize envelope for elastic gang training (tentpole of the drain
+    ladder: a DRAINING member triggers a pause → device-plane re-shard →
+    resume on the survivors, never a checkpoint restart).
+
+    min_workers: smallest gang that keeps training (below it the elastic
+      path gives up and falls back to checkpoint restart).
+    max_workers: grow-back ceiling (defaults to ScalingConfig.num_workers).
+    reshard_timeout_s: budget for one resize (pause + state hand-off +
+      rendezvous rebuild); overrunning it falls back to checkpoint.
+    grow_poll_s: how often the trainer probes for restored capacity.
+    """
+
+    min_workers: int = 1
+    max_workers: int | None = None
+    reshard_timeout_s: float = 30.0
+    grow_poll_s: float = 2.0
+
+
+@dataclass
 class ScalingConfig:
     """How many workers, what resources, and (TPU-first) the mesh.
 
@@ -22,6 +42,10 @@ class ScalingConfig:
       (dp/fsdp/tp/pp/sp/ep), passed to ray_tpu.parallel.make_mesh.
     placement_strategy: PACK/SPREAD/STRICT_PACK/STRICT_SPREAD/STRICT_ICI —
       STRICT_ICI gang-places all workers on one ICI-connected slice.
+    elastic: opt the gang into drain-driven resize. Elastic gangs are
+      scheduled without a placement group (membership changes at runtime;
+      DRAINING nodes are already excluded from placement), so elastic
+      excludes the STRICT_* strategies.
     """
 
     num_workers: int = 1
@@ -31,6 +55,7 @@ class ScalingConfig:
     mesh: dict | None = None
     placement_strategy: str = "PACK"
     trainer_resources: dict | None = None
+    elastic: ElasticConfig | None = None
 
     def worker_resources(self) -> dict:
         res = dict(self.resources_per_worker or {})

@@ -17,6 +17,28 @@ from typing import Any
 _local = threading.local()
 
 
+class ElasticPauseInterrupt(BaseException):
+    """Raised inside the user loop at a step boundary (report() /
+    keep_state()) when the trainer requested a pause for an elastic
+    resize. A BaseException so user `except Exception` blocks cannot
+    swallow it; TrainWorker.run catches it and parks the worker in the
+    `paused` state — it is not an error."""
+
+
+class SessionStopped(BaseException):
+    """Raised at the next step boundary after TrainWorker.stop():
+    graceful session shutdown, never mid-report()."""
+
+
+class _SessionControl:
+    """Trainer→worker control plane shared between the actor thread
+    (request_pause/stop) and the user-loop thread (boundary checks)."""
+
+    def __init__(self):
+        self.pause_requested = threading.Event()
+        self.stop_requested = threading.Event()
+
+
 @dataclass
 class _Session:
     rank: int
@@ -29,6 +51,26 @@ class _Session:
     # Durable root for dict checkpoints (RunConfig.storage_path); None =
     # node-local tempdir (single-host semantics).
     storage_path: str | None = None
+    # Elastic gang training: pause/stop control, the state tree this
+    # worker preserved across the last pause, peer state handed over
+    # from departed ranks, and the resize epoch (0 = never resized).
+    control: Any = None
+    elastic_state: Any = None
+    elastic_state_step: int | None = None
+    peer_states: dict | None = None
+    elastic_epoch: int = 0
+    on_keep_state: Any = None
+
+
+def _check_boundary(s: _Session) -> None:
+    """Step-boundary control check: stop wins over pause."""
+    c = s.control
+    if c is None:
+        return
+    if c.stop_requested.is_set():
+        raise SessionStopped()
+    if c.pause_requested.is_set():
+        raise ElasticPauseInterrupt()
 
 
 def _set_session(s: _Session | None) -> None:
@@ -76,6 +118,54 @@ def report(metrics: dict, checkpoint=None) -> None:
             checkpoint = Checkpoint.from_dict(checkpoint, path)
         payload["checkpoint_path"] = checkpoint.path
     s.report_queue.put(payload)
+    # report() is THE step boundary: an elastic pause or a graceful stop
+    # lands here, after the metrics (and checkpoint pointer) are safely
+    # on the queue — never mid-report.
+    _check_boundary(s)
+
+
+def keep_state(state, step: int | None = None) -> None:
+    """Preserve `state` (params/opt-state pytree) for elastic resume.
+
+    The worker pins the tree's jax.Array leaves in its device registry
+    with the trainer as ref owner, so a node drain evacuates them via
+    the device plane (device_objects.evacuate → DeviceObjectRepin) and a
+    resize re-shards them to the surviving gang — no checkpoint
+    write/read. Survivors get their own tree back via
+    get_elastic_state(); departed ranks' trees arrive at the survivors
+    through get_peer_states(). Also a step boundary (pause/stop land
+    here), so call it once per step, after report()."""
+    s = _get_session()
+    s.elastic_state = state
+    s.elastic_state_step = int(step) if step is not None \
+        else (s.elastic_state_step or 0) + 1
+    if s.on_keep_state is not None:
+        s.on_keep_state(state, s.elastic_state_step)
+    _check_boundary(s)
+
+
+def get_elastic_state():
+    """This worker's own preserved state tree (from keep_state) when the
+    run is resuming after an elastic pause; None on a fresh start."""
+    return _get_session().elastic_state
+
+
+def get_elastic_state_step() -> int | None:
+    """Step recorded with the preserved state, or None."""
+    return _get_session().elastic_state_step
+
+
+def get_peer_states() -> dict:
+    """{old_rank: state_tree} handed over from ranks that left (shrink)
+    or, on a freshly grown worker, seeded from a survivor. Empty on a
+    fresh start and for survivors whose membership didn't change."""
+    return dict(_get_session().peer_states or {})
+
+
+def get_elastic_epoch() -> int:
+    """How many elastic resizes this run has been through (0 = none;
+    bumps on every shrink/grow the gang survived)."""
+    return _get_session().elastic_epoch
 
 
 class _TrainContext:

@@ -88,6 +88,18 @@ def _shape_key(leaf):
     return tuple(leaf.shape) if hasattr(leaf, "shape") else ()
 
 
+def reshard_to_mesh(state, specs, mesh: Mesh):
+    """Re-lay a state pytree out onto a (smaller or larger) mesh — the
+    elastic-resize hop after a gang member left or joined: the same
+    PartitionSpecs applied to the new mesh's device set. One device_put
+    per leaf; XLA moves only the shards that change owner."""
+    shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.tree_util.tree_map(
+        lambda leaf, sh: jax.device_put(leaf, sh), state, shardings)
+
+
 def init_sharded_state(mesh: Mesh, init_fn: Callable, rules: ShardingRules,
                        optimizer: optax.GradientTransformation,
                        *init_args) -> tuple[TrainState, Any]:

@@ -30,13 +30,31 @@ class TrainWorker:
     """One training worker process (reference: RayTrainWorker:19)."""
 
     def __init__(self, rank: int, world_size: int, env: dict | None = None):
+        import uuid
+
         self.rank = rank
         self.world_size = world_size
         self._reports: queue.Queue = queue.Queue()
         self._thread: threading.Thread | None = None
         self._done = False
+        self._paused = False
         self._error: str | None = None
         self._result = None
+        # Elastic state: the user loop's preserved pytree (keep_state),
+        # its device-registry pin prefix, and the stub tree the trainer
+        # resolves it through. _uid (not rank) keys the pin prefix —
+        # ranks are reassigned across resizes, registry keys must not be.
+        self._ctl = None
+        self._elastic_state = None
+        self._elastic_stub = None
+        self._state_step = -1
+        self._elastic_prefix: str | None = None
+        self._pin_seq = 0
+        self._owner_wire = None
+        self._peer_states: dict | None = None
+        self._elastic_epoch = 0
+        self._uid = uuid.uuid4().hex[:8]
+        self._drain_listener = False
         for k, v in (env or {}).items():
             os.environ[k] = str(v)
         os.environ["RAY_TPU_TRAIN_RANK"] = str(rank)
@@ -54,25 +72,95 @@ class TrainWorker:
         from ray_tpu._private import serialization
         from ray_tpu.train import session
 
+        prev = self._thread
+        if prev is not None and prev.is_alive():
+            prev.join(timeout=5.0)
         fn = serialization.loads_func(fn_blob)
+        self._owner_wire = config.get("_elastic_owner") or self._owner_wire
+        self._elastic_epoch = int(config.get("_elastic_epoch", 0))
+        if config.get("_elastic") and not self._drain_listener:
+            self._register_drain_listener()
+        ctl = session._SessionControl()
+        self._ctl = ctl
+        self._paused = False
+        self._done = False
+        self._error = None
 
         def target():
             session._set_session(session._Session(
                 rank=self.rank, world_size=self.world_size,
                 report_queue=self._reports,
                 restore_checkpoint_path=config.get("_checkpoint_path"),
-                storage_path=config.get("_storage_path")))
+                storage_path=config.get("_storage_path"),
+                control=ctl,
+                elastic_state=self._elastic_state,
+                elastic_state_step=(self._state_step
+                                    if self._state_step >= 0 else None),
+                peer_states=self._peer_states,
+                elastic_epoch=self._elastic_epoch,
+                on_keep_state=self._keep_state))
             try:
                 self._result = fn(config) if _wants_arg(fn) else fn()
+            except session.ElasticPauseInterrupt:
+                self._paused = True
+            except session.SessionStopped:
+                pass
             except BaseException as e:  # noqa: BLE001
                 self._error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
             finally:
-                self._done = True
+                if not self._paused:
+                    self._done = True
                 session._set_session(None)
 
         self._thread = threading.Thread(target=target, daemon=True)
         self._thread.start()
         return True
+
+    def _register_drain_listener(self):
+        """Worker-side pre-death signal (defense in depth next to the
+        trainer's GCS NODE subscription): the raylet fans a DrainNotice
+        to its workers at the top of _run_drain, and a draining gang
+        member parks itself at the next step boundary even if the
+        trainer's publish is still in flight."""
+        try:
+            from ray_tpu._private.api_internal import get_core_worker
+
+            cw = get_core_worker()
+            cw.add_drain_notice_listener(lambda payload: self._on_drain())
+            self._drain_listener = True
+        except Exception:
+            pass  # non-fatal: the trainer-side signal still pauses us
+
+    def _on_drain(self):
+        ctl = self._ctl
+        if ctl is not None:
+            ctl.pause_requested.set()
+
+    def _keep_state(self, state, step: int):
+        """session.keep_state hook (runs on the user-loop thread): pin
+        the tree's jax leaves with the TRAINER as ref owner so a node
+        drain evacuates them to the trainer (device_objects.evacuate →
+        DeviceObjectRepin), and keep a stub tree the trainer can resolve
+        from either end."""
+        self._elastic_state = state
+        self._state_step = int(step)
+        stub = state
+        if self._owner_wire is not None:
+            from ray_tpu._private import device_objects
+            from ray_tpu._private.api_internal import get_core_worker
+
+            self._pin_seq += 1
+            prefix = f"elastic:{self._uid}:{self._pin_seq}"
+            stubbed, _nbytes, n = device_objects.extract_arrays(
+                state, prefix, get_core_worker())
+            if n:
+                reg = device_objects.registry()
+                reg.note_ref_owner(prefix, self._owner_wire)
+                old, self._elastic_prefix = self._elastic_prefix, prefix
+                stub = stubbed
+                if old:
+                    reg.release_prefix(old, counted=False)
+        self._elastic_stub = stub
 
     def poll(self, max_items: int = 100) -> dict:
         """Drain buffered report()s; say whether the loop finished."""
@@ -83,7 +171,59 @@ class TrainWorker:
             except queue.Empty:
                 break
         return {"reports": items, "done": self._done, "error": self._error,
+                "paused": self._paused, "state_step": self._state_step,
                 "result": self._result if self._done and not self._error else None}
+
+    def request_pause(self) -> bool:
+        """Ask the user loop to park at its next step boundary."""
+        ctl = self._ctl
+        if ctl is not None:
+            ctl.pause_requested.set()
+        return ctl is not None
+
+    def stop(self, timeout: float = 5.0) -> dict:
+        """Graceful session shutdown: request a stop at the next step
+        boundary and JOIN the user-loop thread, so migration/teardown
+        never kills the worker mid-report() and loses the final
+        checkpoint pointer. Returns the final drained reports plus
+        whether the join landed."""
+        ctl = self._ctl
+        if ctl is not None:
+            ctl.stop_requested.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        out = self.poll(max_items=1_000_000)
+        out["joined"] = t is None or not t.is_alive()
+        return out
+
+    def reconfigure(self, rank: int, world_size: int) -> bool:
+        """Adopt a new gang shape (call only while paused/done)."""
+        self.rank = rank
+        self.world_size = world_size
+        os.environ["RAY_TPU_TRAIN_RANK"] = str(rank)
+        os.environ["RAY_TPU_TRAIN_WORLD_SIZE"] = str(world_size)
+        return True
+
+    def export_state(self) -> dict:
+        """The preserved state as a stub tree (device plane carries the
+        arrays; the trainer resolves — from this process while it lives,
+        from the trainer's own registry after a drain evacuated the
+        pins) plus the step it was kept at."""
+        return {"stub": self._elastic_stub, "step": self._state_step}
+
+    def receive_peer_states(self, states) -> bool:
+        """Peer state trees for the next run(): either a device-object
+        ref (shrink — resolved before the call lands) or a raw stub tree
+        (grow — resolved HERE, pulling the arrays straight from the
+        pinning survivor instead of bouncing through the trainer)."""
+        from ray_tpu._private import device_objects
+        from ray_tpu._private.api_internal import get_core_worker
+
+        self._peer_states = {
+            k: device_objects.resolve_value(v, get_core_worker())
+            for k, v in (states or {}).items()}
+        return True
 
     def receive_weights(self, weights) -> dict:
         """Device-plane weight broadcast sink: `weights` arrives already
@@ -120,9 +260,22 @@ def _wants_arg(fn) -> bool:
 class WorkerGroup:
     def __init__(self, scaling: ScalingConfig, env: dict | None = None):
         self.scaling = scaling
+        self.env = env or {}
         self.pg = None
+        self.elastic = scaling.elastic is not None
         n = scaling.num_workers
-        if n > 1 or scaling.placement_strategy != "PACK":
+        if self.elastic:
+            # Elastic gangs change membership at runtime; placement
+            # groups cannot resize, so elastic workers are scheduled by
+            # plain resource demand (DRAINING nodes are already excluded
+            # from placement). STRICT_* gang guarantees are therefore
+            # incompatible with elastic.
+            if scaling.placement_strategy.startswith("STRICT"):
+                raise ValueError(
+                    "elastic training cannot use a STRICT_* placement "
+                    f"strategy (got {scaling.placement_strategy!r}): "
+                    "membership changes at runtime")
+        elif n > 1 or scaling.placement_strategy != "PACK":
             self.pg = placement_group(scaling.as_placement_group_bundles(),
                                       strategy=scaling.placement_strategy)
             if not self.pg.wait(timeout=120):
@@ -134,15 +287,43 @@ class WorkerGroup:
                     f"({scaling.as_placement_group_bundles()}) not schedulable "
                     f"within 120s — not enough free cluster resources")
         self.workers = []
-        res = scaling.worker_resources()
         for rank in range(n):
-            opts = {"num_cpus": res.get("CPU", 1.0),
-                    "resources": {k: v for k, v in res.items() if k != "CPU"}}
-            if self.pg is not None:
-                opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
-                    placement_group=self.pg, placement_group_bundle_index=rank)
-            self.workers.append(
-                TrainWorker.options(**opts).remote(rank, n, env or {}))
+            self.workers.append(self._spawn(rank, n))
+
+    def _spawn(self, rank: int, world_size: int):
+        res = self.scaling.worker_resources()
+        opts = {"num_cpus": res.get("CPU", 1.0),
+                "resources": {k: v for k, v in res.items() if k != "CPU"}}
+        if self.pg is not None:
+            opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                placement_group=self.pg, placement_group_bundle_index=rank)
+        if self.elastic:
+            # poll/request_pause must land while a long stop() join (or
+            # a slow run boundary) holds another call slot.
+            opts["max_concurrency"] = 4
+        return TrainWorker.options(**opts).remote(rank, world_size, self.env)
+
+    def add_worker(self, rank: int, world_size: int):
+        """Grow the gang by one (elastic grow-back)."""
+        w = self._spawn(rank, world_size)
+        self.workers.append(w)
+        return w
+
+    def remove_worker(self, w, *, stop_timeout_s: float = 2.0) -> None:
+        """Drop one member (elastic shrink): graceful stop, then kill —
+        frees the actor's lease so a draining node's bounded lease wait
+        ends promptly."""
+        try:
+            ray_tpu.wait([w.stop.remote(stop_timeout_s)],
+                         timeout=stop_timeout_s + 3)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(w)
+        except Exception:
+            pass
+        if w in self.workers:
+            self.workers.remove(w)
 
     def run_on_all(self, method: str, *args, **kwargs) -> list:
         return ray_tpu.get([getattr(w, method).remote(*args, **kwargs)
@@ -166,7 +347,21 @@ class WorkerGroup:
         finally:
             del ref  # drop the pin once every worker has its copy
 
-    def shutdown(self):
+    def shutdown(self, graceful_timeout_s: float = 2.0):
+        # Graceful first: stop() parks each user loop at a step boundary
+        # and joins, so teardown never kills a worker mid-report().
+        stops = []
+        for w in self.workers:
+            try:
+                stops.append(w.stop.remote(graceful_timeout_s))
+            except Exception:
+                pass
+        if stops:
+            try:
+                ray_tpu.wait(stops, num_returns=len(stops),
+                             timeout=graceful_timeout_s + 3)
+            except Exception:
+                pass
         for w in self.workers:
             try:
                 ray_tpu.kill(w)

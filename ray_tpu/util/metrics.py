@@ -127,6 +127,60 @@ class Histogram(Metric):
         return base
 
 
+# ---------- elastic-training counters ----------
+# Process-local running totals for the trainer's resize telemetry,
+# exported as registry gauges (so /metrics and `ray_tpu status` surface
+# them like any published metric). Gauges carry totals, counter-style:
+# the trainer process is the single writer.
+
+_train_elastic_lock = threading.Lock()
+_train_elastic = {"shrink": 0, "grow": 0, "resizes_total": 0,
+                  "steps_lost_total": 0, "fallbacks_total": 0}
+_train_gauges: dict = {}
+
+
+def _train_elastic_gauges() -> dict:
+    with _train_elastic_lock:
+        if not _train_gauges:
+            _train_gauges["resizes"] = Gauge(
+                "ray_tpu_train_resizes_total",
+                "elastic gang resizes survived without a job restart",
+                tag_keys=("direction",))
+            _train_gauges["steps_lost"] = Gauge(
+                "ray_tpu_train_steps_lost_total",
+                "training steps lost across elastic resizes")
+            _train_gauges["fallbacks"] = Gauge(
+                "ray_tpu_train_elastic_fallbacks_total",
+                "elastic resumes that fell back to checkpoint restart")
+    return _train_gauges
+
+
+def note_train_elastic(event: str, steps_lost: int = 0) -> None:
+    """Record one elastic-training event ('shrink' / 'grow' /
+    'fallback') and push the totals to the GCS so a scrape right after
+    a resize sees it."""
+    g = _train_elastic_gauges()
+    with _train_elastic_lock:
+        if event in ("shrink", "grow"):
+            _train_elastic[event] += 1
+            _train_elastic["resizes_total"] += 1
+        elif event == "fallback":
+            _train_elastic["fallbacks_total"] += 1
+        _train_elastic["steps_lost_total"] += int(steps_lost)
+        snap = dict(_train_elastic)
+    g["resizes"].set(snap["shrink"], tags={"direction": "shrink"})
+    g["resizes"].set(snap["grow"], tags={"direction": "grow"})
+    g["steps_lost"].set(snap["steps_lost_total"])
+    g["fallbacks"].set(snap["fallbacks_total"])
+    flush_registry_now()
+
+
+def train_elastic_snapshot() -> dict:
+    """This process's elastic-training totals (the trainer driver's)."""
+    with _train_elastic_lock:
+        return dict(_train_elastic)
+
+
 def get_metrics_snapshot() -> dict:
     """Read all published metrics from the GCS (one entry per worker)."""
     from ray_tpu._private.api_internal import get_core_worker

@@ -190,7 +190,26 @@ def summarize_objects() -> dict:
 
 def cluster_status() -> dict:
     cw = get_core_worker()
-    return cw._run(cw.gcs.call("GetClusterStatus", {}))
+    out = cw._run(cw.gcs.call("GetClusterStatus", {}))
+    # Elastic-training counters: fold the published ray_tpu_train_*
+    # gauges (trainer drivers push running totals) into the status blob
+    # so `ray_tpu status` shows resize/steps-lost health next to the
+    # node table.
+    try:
+        from ray_tpu.util.metrics import get_metrics_snapshot
+
+        totals: dict[str, float] = {}
+        for snap in get_metrics_snapshot().values():
+            for name, m in snap.items():
+                if not name.startswith("ray_tpu_train_"):
+                    continue
+                for v in (m.get("values") or {}).values():
+                    totals[name] = totals.get(name, 0) + v
+        if totals:
+            out["train_elastic"] = totals
+    except Exception:
+        pass
+    return out
 
 
 def list_device_objects(entries: bool = True) -> dict:

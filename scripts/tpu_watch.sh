@@ -94,6 +94,79 @@ print("drain-probe:", json.dumps({
     "stats": info.get("drain_stats", {})}))
 cluster.shutdown()
 PYEOF
+        # Elastic-train probe: a 3-worker gang on dedicated nodes, one
+        # node preempted (drain -> DRAINED -> kill) mid-run — the run
+        # must finish by re-sharding onto the survivors with ZERO
+        # checkpoint restores. The log then carries the elastic
+        # telemetry (resizes, steps lost, fallbacks) next to the drain
+        # and bench numbers, so a regression in the resize path is
+        # visible from the same watcher artifact.
+        timeout 600 python - >> "$LOG" 2>&1 <<'PYEOF' || true
+import json
+import threading
+import time
+
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.test_utils import NodePreempter, wait_for_condition
+from ray_tpu.train import (ElasticConfig, FailureConfig, JaxTrainer,
+                           RunConfig, ScalingConfig)
+from ray_tpu.util import metrics as util_metrics
+
+cluster = Cluster(initialize_head=True, connect=True,
+                  head_node_args={"num_cpus": 2})
+nodes = [cluster.add_node(num_cpus=2, resources={"trainer": 1})
+         for _ in range(3)]
+cluster.wait_for_nodes()
+
+
+def loop(cfg):
+    import time as _t
+    import jax.numpy as jnp
+    from ray_tpu.train import session
+    state = session.get_elastic_state()
+    peers = session.get_peer_states()
+    if state is None and peers:
+        state = next(iter(peers.values()))
+    start = 0 if state is None else int(state["step"]) + 1
+    w = jnp.zeros((8,)) if state is None else state["w"]
+    for step in range(start, cfg["total_steps"]):
+        w = w + 1.0
+        session.report({"step": step,
+                        "restored": session.get_checkpoint() is not None,
+                        "world": session.get_world_size()})
+        session.keep_state({"step": step, "w": w}, step=step)
+        _t.sleep(max(0.0, cfg["t0"] + (step + 1) * 0.05 - _t.time()))
+    return float(w[0])
+
+
+trainer = JaxTrainer(
+    loop,
+    train_loop_config={"total_steps": 60, "t0": time.time()},
+    scaling_config=ScalingConfig(
+        num_workers=3,
+        resources_per_worker={"trainer": 1.0, "CPU": 0.5},
+        elastic=ElasticConfig(min_workers=2)),
+    run_config=RunConfig(failure_config=FailureConfig(max_failures=1)),
+    collective_backend=None)
+holder = {}
+th = threading.Thread(
+    target=lambda: holder.update(result=trainer.fit()), daemon=True)
+th.start()
+wait_for_condition(
+    lambda: trainer.latest_metrics.get("step", -1) >= 5, timeout=60)
+NodePreempter(cluster, deadline_s=10).preempt(nodes[1])
+th.join(timeout=300)
+t = trainer.telemetry
+print("elastic-train-probe:", json.dumps({
+    "final_step": holder["result"].metrics.get("step") if "result" in holder
+                  else None,
+    "resizes": t.get("resizes"), "shrinks": t.get("shrinks"),
+    "steps_lost": t.get("steps_lost"),
+    "elastic_fallbacks": t.get("elastic_fallbacks"),
+    "full_restarts": t.get("full_restarts"),
+    "gauges": util_metrics.train_elastic_snapshot()}))
+cluster.shutdown()
+PYEOF
         timeout 1800 python scripts/tpu_kernel_sweep.py --check-only \
           > KERNEL_SWEEP_TPU.txt 2>&1 || true
         exit 0

@@ -138,3 +138,39 @@ def test_preemption_deadline_fail_fast(ray_start_cluster_head):
     assert result.get("state") == "DRAINED", result
     assert time.monotonic() - t0 < 15
     assert ray_tpu.get(ref, timeout=90) == 15
+
+
+@ray_tpu.remote(resources={"side": 0.1})
+def _side_compute(x):
+    time.sleep(0.05)
+    return x * 2
+
+
+@pytest.mark.smoke
+def test_stochastic_step_schedule_preemption(ray_start_cluster_head):
+    """NodePreempter's seeded STEP schedule (spot-reclamation model for
+    elastic training): a preemption fires once the workload's own step
+    counter crosses a gap drawn from the seeded rng (~step_interval
+    ± jitter), the fired step is recorded in step_schedule, and the
+    drain-then-kill stays a non-event for the retried tasks."""
+    cluster = ray_start_cluster_head
+    for _ in range(2):
+        cluster.add_node(num_cpus=2, resources={"side": 1})
+    cluster.wait_for_nodes()
+
+    done = []
+    preempter = NodePreempter(
+        cluster, deadline_s=5, step_interval=10, step_jitter=0.2,
+        seed=1, respawn=True, max_preemptions=1,
+        node_args={"num_cpus": 2, "resources": {"side": 1}},
+        step_source=lambda: len(done))
+    with preempter:
+        for i in range(30):
+            done.append(ray_tpu.get(
+                _side_compute.options(max_retries=10).remote(i),
+                timeout=60))
+    assert done == [i * 2 for i in range(30)]
+    assert preempter.preemptions == 1
+    # Fired at (or a poll past) the first seeded gap ∈ [8, 12].
+    assert preempter.step_schedule
+    assert 8 <= preempter.step_schedule[0] <= 20

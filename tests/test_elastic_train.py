@@ -1,0 +1,376 @@
+"""Elastic gang training (trainer.py elastic path): a gang member's
+node entering DRAINING is a resize, not a failure. The trainer pauses
+the gang at a step boundary, re-homes the departing ranks' state through
+the device object plane (no checkpoint write/read), rebuilds the
+rendezvous for the smaller world, and resumes at step N+1; grow-back
+re-seeds new members from rank 0. Fallback ladder: re-shard →
+checkpoint restart (counted) → fail.
+
+Smoke-marked tier-1 gates. Gang workers are pinned to dedicated
+non-head nodes via a custom `trainer` resource — the driver (the
+device-plane ref owner of every keep_state pin) must not share a node
+with a drain victim, or the drain pipeline would skip evacuating its
+pins (evacuating to the same dying node is pointless).
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import Config
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.test_utils import NodePreempter, wait_for_condition
+from ray_tpu.train import (ElasticConfig, FailureConfig, JaxTrainer,
+                           RunConfig, ScalingConfig)
+from ray_tpu.util import metrics as util_metrics
+
+pytestmark = pytest.mark.smoke
+
+
+def _elastic_config() -> Config:
+    cfg = Config()
+    cfg.health_check_period_s = 0.2
+    cfg.num_heartbeats_timeout = 5
+    cfg.worker_lease_timeout_s = 10.0
+    cfg.object_store_memory = 64 * 1024 * 1024
+    cfg.num_workers_soft_limit = 16
+    return cfg
+
+
+@pytest.fixture
+def elastic_cluster():
+    cluster = Cluster(initialize_head=True, connect=True,
+                      head_node_args={"num_cpus": 2},
+                      config=_elastic_config())
+    yield cluster
+    cluster.shutdown()
+
+
+def _gang_node(cluster):
+    return cluster.add_node(num_cpus=2, resources={"trainer": 1})
+
+
+def _scaling(n, *, min_workers, max_workers=None, reshard_timeout_s=20.0,
+             grow_poll_s=0.5):
+    return ScalingConfig(
+        num_workers=n,
+        resources_per_worker={"trainer": 1.0, "CPU": 0.5},
+        elastic=ElasticConfig(min_workers=min_workers,
+                              max_workers=max_workers,
+                              reshard_timeout_s=reshard_timeout_s,
+                              grow_poll_s=grow_poll_s))
+
+
+def _elastic_loop(cfg):
+    """Counts steps in a jax array preserved via session.keep_state.
+
+    Steps are paced on wall-clock boundaries shared via cfg["t0"] — the
+    no-collective stand-in for a lockstep SPMD gang: every worker's
+    step k starts at t0 + k*period, so the gang stays within a step of
+    each other and self-realigns after a pause (steps behind schedule
+    run back-to-back). That keeps max_step − min(survivor_step) — the
+    steps-lost metric — an honest ≈1 per resize, like a real gang.
+
+    The invariant w[0] == kept_step + 1 proves the re-sharded array
+    really round-tripped through the device plane with its contents
+    intact (state_ok). Rank 0 also reports dict checkpoints so the
+    fallback rung WOULD be available — the happy-path assertions check
+    it is never taken (restored stays False)."""
+    import time as _t
+
+    import jax.numpy as jnp
+
+    from ray_tpu.train import session
+
+    total = cfg["total_steps"]
+    period = cfg.get("period", 0.05)
+    t0 = cfg["t0"]
+    restored = session.get_checkpoint() is not None
+    state = session.get_elastic_state()
+    peers = session.get_peer_states()
+    seeded = False
+    if state is None and peers:
+        # Freshly grown member: adopt a survivor's tree.
+        state = next(iter(peers.values()))
+        seeded = True
+    state_ok = True
+    if state is None:
+        # Fresh start: join at the CURRENT wall-clock step, not step 0.
+        # A real gang rendezvous-barriers at startup (nobody computes
+        # until all arrive); without that, a worker whose process spawn
+        # lost seconds to CPU contention would crawl through a hundred
+        # catch-up steps and its lag would read as "steps lost".
+        start = min(total - 1, max(0, int((_t.time() - t0) / period)))
+        w = jnp.full((8,), float(start), jnp.float32)
+    else:
+        start = int(state["step"]) + 1
+        w = state["w"]
+        state_ok = abs(float(w[0]) - (int(state["step"]) + 1)) < 1e-6
+    for step in range(start, total):
+        w = w + 1.0
+        ckpt = ({"step": step} if session.get_world_rank() == 0
+                and step % 10 == 0 else None)
+        session.report({"step": step, "restored": restored,
+                        "world": session.get_world_size(),
+                        "epoch": session.get_elastic_epoch(),
+                        "peers": len(peers), "seeded": seeded,
+                        "state_ok": bool(state_ok)}, checkpoint=ckpt)
+        session.keep_state({"step": step, "w": w}, step=step)
+        _t.sleep(max(0.0, t0 + (step + 1) * period - _t.time()))
+    return float(w[0])
+
+
+def _fit_in_thread(trainer):
+    holder = {}
+
+    def run():
+        try:
+            holder["result"] = trainer.fit()
+        except BaseException as e:  # noqa: BLE001
+            holder["error"] = e
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return th, holder
+
+
+def test_elastic_shrink_then_grow_back(elastic_cluster, tmp_path):
+    """The acceptance scenario: 4-worker gang, one node drained
+    mid-run → training resumes on 3 workers at the next step with ZERO
+    checkpoint restores; when a replacement node registers, the gang
+    grows back to 4 re-seeded from rank 0."""
+    cluster = elastic_cluster
+    nodes = [_gang_node(cluster) for _ in range(4)]
+    cluster.wait_for_nodes()
+    gauges_before = util_metrics.train_elastic_snapshot()
+
+    trainer = JaxTrainer(
+        _elastic_loop,
+        train_loop_config={"total_steps": 200, "period": 0.05,
+                           "t0": time.time()},
+        scaling_config=_scaling(4, min_workers=2, max_workers=4),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+        collective_backend=None)
+    th, holder = _fit_in_thread(trainer)
+
+    # Let the gang take a few steps (keep_state pins exist everywhere).
+    wait_for_condition(
+        lambda: trainer.latest_metrics.get("step", -1) >= 5, timeout=60)
+
+    # Preempt one gang node: drain → DRAINED → kill.
+    preempter = NodePreempter(cluster, deadline_s=10)
+    drain = preempter.preempt(nodes[1], kill=False)
+    assert drain["state"] == "DRAINED"
+    wait_for_condition(lambda: trainer.telemetry["shrinks"] >= 1, timeout=30)
+    cluster.remove_node(nodes[1])
+
+    # Capacity returns: the trainer must grow back on its own.
+    _gang_node(cluster)
+    wait_for_condition(lambda: trainer.telemetry["grows"] >= 1, timeout=60)
+
+    th.join(timeout=120)
+    assert not th.is_alive(), "fit() did not finish"
+    assert "error" not in holder, f"fit raised: {holder.get('error')}"
+    result = holder["result"]
+
+    hist = result.metrics_history
+    assert result.metrics["step"] == 199
+    # Membership went 4 → 3 → 4, and the run ended on the regrown gang.
+    worlds = [h["world"] for h in hist]
+    assert 3 in worlds and 4 in worlds
+    assert hist[-1]["world"] == 4
+    # Re-sharded state arrived intact at every resume.
+    assert all(h["state_ok"] for h in hist)
+    # After the shrink the survivors hold the departed rank's tree.
+    assert any(h["peers"] >= 1 for h in hist if h["world"] == 3)
+    # The grown member really was seeded through the device plane.
+    assert any(h.get("seeded") for h in hist) or hist[-1]["world"] == 4
+    # Zero checkpoint restores, zero full restarts: elastic resume only.
+    assert not any(h["restored"] for h in hist)
+    t = trainer.telemetry
+    assert t["shrinks"] >= 1 and t["grows"] >= 1
+    assert t["elastic_fallbacks"] == 0 and t["full_restarts"] == 0
+    # Steps-lost-per-resize ≤ 2 (target ≈ 1): pause lands at the NEXT
+    # step boundary, so survivors resume within a step of the leader.
+    assert t["steps_lost"] <= 2 * t["resizes"], str(t["resize_log"])
+    # History is continuous across the resizes (no step goes backward by
+    # more than the replayed boundary step).
+    steps = [h["step"] for h in hist]
+    assert steps[-1] == 199
+    assert all(b - a >= -2 for a, b in zip(steps, steps[1:]))
+    # The resize/steps-lost counters reached the util.metrics gauges
+    # (and through them /metrics + `ray_tpu status`).
+    after = util_metrics.train_elastic_snapshot()
+    assert after["resizes_total"] - gauges_before["resizes_total"] >= 2
+    assert after["shrink"] - gauges_before["shrink"] >= 1
+    assert after["grow"] - gauges_before["grow"] >= 1
+    assert after["fallbacks_total"] == gauges_before["fallbacks_total"]
+    delta_lost = after["steps_lost_total"] - gauges_before["steps_lost_total"]
+    assert 0 <= delta_lost <= 2 * (after["resizes_total"]
+                                   - gauges_before["resizes_total"])
+
+
+def _deadline_loop(cfg):
+    """Workers NOT on the drain target block 5s mid-step (no report /
+    keep_state boundary), so a resize can never park the gang inside
+    reshard_timeout_s — the deadline-expiry rung. Only on a fresh,
+    never-restored run: the checkpoint retry completes normally."""
+    import time as _t
+
+    from ray_tpu.train import session
+
+    import ray_tpu as _rt
+
+    ck = session.get_checkpoint()
+    start = int(ck.to_dict()["step"]) + 1 if ck is not None else 0
+    my_node = _rt.get_runtime_context().node_id
+    for step in range(start, cfg["total_steps"]):
+        ckpt = {"step": step} if session.get_world_rank() == 0 else None
+        session.report({"step": step, "restored": ck is not None},
+                       checkpoint=ckpt)
+        if (step == 3 and ck is None and session.get_elastic_epoch() == 0
+                and my_node != cfg["drain_node"]):
+            _t.sleep(5.0)
+        _t.sleep(0.1)
+
+
+def test_elastic_deadline_falls_back_to_checkpoint(elastic_cluster,
+                                                   tmp_path):
+    """When the gang cannot reach a step boundary within
+    reshard_timeout_s, the elastic path gives up and the retry restores
+    from the last checkpoint — COUNTED (elastic_fallbacks /
+    ray_tpu_train_elastic_fallbacks_total), never silent."""
+    cluster = elastic_cluster
+    nodes = [_gang_node(cluster) for _ in range(3)]
+    cluster.wait_for_nodes()
+    before = util_metrics.train_elastic_snapshot()
+
+    trainer = JaxTrainer(
+        _deadline_loop,
+        train_loop_config={"total_steps": 10,
+                           "drain_node": nodes[0].node_id},
+        scaling_config=_scaling(3, min_workers=2, reshard_timeout_s=1.5),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+        collective_backend=None)
+    th, holder = _fit_in_thread(trainer)
+    wait_for_condition(
+        lambda: trainer.latest_metrics.get("step", -1) >= 3, timeout=60)
+    time.sleep(0.5)  # the off-target workers are inside their 8s block
+
+    NodePreempter(cluster, deadline_s=6).preempt(nodes[0])
+    _gang_node(cluster)  # capacity for the checkpoint-restart gang
+
+    th.join(timeout=120)
+    assert not th.is_alive(), "fit() did not finish"
+    assert "error" not in holder, f"fit raised: {holder.get('error')}"
+    result = holder["result"]
+
+    assert result.metrics["step"] == 9
+    # The retry really did restore from the checkpoint...
+    assert result.metrics["restored"] is True
+    # ...and the fallback was counted at every surface.
+    assert trainer.telemetry["elastic_fallbacks"] == 1
+    assert trainer.telemetry["full_restarts"] == 1
+    after = util_metrics.train_elastic_snapshot()
+    assert after["fallbacks_total"] - before["fallbacks_total"] >= 1
+
+
+def test_chaos_spot_preemption_rate(elastic_cluster, tmp_path):
+    """The ISSUE acceptance run: NodePreempter on a seeded stochastic
+    STEP schedule (one preemption per ~20 steps, ±30% jitter) against an
+    elastic 4-gang with respawn. The run completes with steps-lost ≤ 2
+    per resize, zero full-job restarts, zero checkpoint restores."""
+    cluster = elastic_cluster
+    for _ in range(4):
+        _gang_node(cluster)
+    cluster.wait_for_nodes()
+
+    trainer = JaxTrainer(
+        _elastic_loop,
+        train_loop_config={"total_steps": 120, "period": 0.06,
+                           "t0": time.time()},
+        scaling_config=_scaling(4, min_workers=2, max_workers=4,
+                                grow_poll_s=0.5),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+        collective_backend=None)
+    th, holder = _fit_in_thread(trainer)
+
+    preempter = NodePreempter(
+        cluster, deadline_s=8, reason="spot-preemption",
+        step_interval=20, step_jitter=0.3, seed=7,
+        respawn=True, max_preemptions=2,
+        node_args={"num_cpus": 2, "resources": {"trainer": 1}},
+        step_source=lambda: int(trainer.latest_metrics.get("step", -1)))
+    with preempter:
+        th.join(timeout=240)
+    assert not th.is_alive(), "fit() did not finish"
+    assert "error" not in holder, f"fit raised: {holder.get('error')}"
+    result = holder["result"]
+
+    assert preempter.preemptions >= 1
+    # The schedule is reproducible: fired near the seeded gaps.
+    assert preempter.step_schedule
+    assert preempter.step_schedule[0] >= 14  # first gap ∈ [14, 26]
+
+    hist = result.metrics_history
+    assert result.metrics["step"] == 119
+    assert all(h["state_ok"] for h in hist)
+    # Zero checkpoint restores, zero full-job restarts.
+    assert not any(h["restored"] for h in hist)
+    t = trainer.telemetry
+    assert t["full_restarts"] == 0 and t["elastic_fallbacks"] == 0
+    assert t["shrinks"] >= 1
+    # steps-lost-per-preemption ≤ 2 (target ≈ 1).
+    assert t["steps_lost"] <= 2 * t["resizes"]
+
+
+def test_preempter_step_schedule_deterministic():
+    """Same seed → same stochastic schedule (satellite: reproducible
+    chaos)."""
+    p1 = NodePreempter(None, step_interval=20, step_jitter=0.3, seed=3,
+                       step_source=lambda: 0)
+    p2 = NodePreempter(None, step_interval=20, step_jitter=0.3, seed=3,
+                       step_source=lambda: 0)
+    gaps1 = [p1._next_gap() for _ in range(8)]
+    gaps2 = [p2._next_gap() for _ in range(8)]
+    assert gaps1 == gaps2
+    assert all(14 <= g <= 26 for g in gaps1)
+    # A different seed really is a different schedule.
+    p3 = NodePreempter(None, step_interval=20, step_jitter=0.3, seed=4,
+                       step_source=lambda: 0)
+    assert [p3._next_gap() for _ in range(8)] != gaps1
+
+
+def test_train_worker_stop_joins_user_loop(ray_start_regular):
+    """TrainWorker.stop(timeout): graceful session shutdown — the stop
+    lands at a step boundary (never mid-report), the user-loop thread is
+    JOINED, and the final buffered reports come back with the ack."""
+    from ray_tpu._private import serialization
+    from ray_tpu.train.worker_group import TrainWorker
+
+    def loop(cfg):
+        import time as _t
+
+        from ray_tpu.train import session
+
+        for step in range(100_000):
+            session.report({"step": step})
+            _t.sleep(0.01)
+
+    w = TrainWorker.remote(0, 1, None)
+    ray_tpu.get(w.run.remote(serialization.dumps_func(loop), {}),
+                timeout=30)
+    wait_for_condition(
+        lambda: ray_tpu.get(w.poll.remote(), timeout=10)["reports"],
+        timeout=30)
+    out = ray_tpu.get(w.stop.remote(5.0), timeout=30)
+    assert out["joined"] is True
+    assert out["done"] is True
+    assert out["error"] is None  # SessionStopped is shutdown, not failure
+    assert out["reports"]  # the boundary report was drained, not lost
+    ray_tpu.kill(w)
