@@ -8,11 +8,122 @@ plain floats with an epsilon), and the owner address embedded in object refs
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Any
 
+logger = logging.getLogger(__name__)
+
 RESOURCE_EPS = 1e-9
+
+
+# ---------- supervised task spawning (graftlint rule R1) ----------
+# asyncio.create_task/ensure_future keep only a WEAK reference to the
+# spawned task (it can be GC'd mid-flight) and park escaped exceptions
+# on the task object where nobody reads them. Both shapes have produced
+# real outages here: the lease pump died on an escaped
+# ConnectionRefusedError and wedged the task queue for 120s (PR 2), and
+# conn-retirement leaked pending recv tasks as GC cycles (r4 teardown
+# flake). supervised_task() is the ONLY sanctioned way to fire-and-
+# forget a coroutine — graftlint R1 flags every raw spawn.
+
+_BG_TASKS: set = set()
+_task_stats = {"spawned": 0, "errors_total": 0, "ignored_total": 0}
+
+
+def supervised_task(coro, *, name: str = "", tasks: set | None = None,
+                    ignore: tuple = (), on_error=None, log=None):
+    """Spawn `coro` as an asyncio task that cannot die silently.
+
+    - Holds a strong reference until the task finishes (in the
+      module-level registry, or in `tasks` if the caller needs its own
+      cancellation set, e.g. FastRpcServer._inflight).
+    - Attaches a done-callback that logs any escaped exception and bumps
+      the `errors_total` counter (see task_stats()).
+    - `ignore`: exception types that are an expected end-state for this
+      task (e.g. ConnectionLost on a best-effort notify); they are
+      counted and logged at DEBUG instead of ERROR.
+    - `on_error(exc)`: optional hook run before logging.
+
+    Returns the task, so callers may still await/cancel it.
+    """
+    import asyncio
+
+    task = asyncio.ensure_future(coro)  # graftlint: disable=R1
+    if name:
+        try:
+            task.set_name(name)
+        except AttributeError:
+            pass
+    registry = _BG_TASKS if tasks is None else tasks
+    registry.add(task)
+    _task_stats["spawned"] += 1
+    lg = log or logger
+
+    def _done(t, registry=registry):
+        registry.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is None:
+            return
+        if ignore and isinstance(exc, ignore):
+            _task_stats["ignored_total"] += 1
+            lg.debug("supervised task %r finished with expected %r",
+                     name or str(t), exc)
+            return
+        _task_stats["errors_total"] += 1
+        if on_error is not None:
+            try:
+                on_error(exc)
+            except Exception:
+                lg.exception("supervised task %r: on_error hook failed",
+                             name or str(t))
+        lg.error("supervised task %r died with escaped exception",
+                 name or str(t), exc_info=exc)
+
+    task.add_done_callback(_done)
+    return task
+
+
+def task_stats() -> dict:
+    """Snapshot of supervised-task counters (spawned/errors/ignored)."""
+    return dict(_task_stats)
+
+
+# ---------- request-frame validation (graftlint rule R5) ----------
+
+class MalformedError(Exception):
+    """A request frame failed field validation.
+
+    Raised by require_fields(); the RPC dispatchers turn it into a
+    MSG_ERROR response whose text carries "Malformed" — same contract
+    as the native service's Malformed() replies (src/gcs_service.cc) —
+    instead of a KeyError traceback from deep inside the handler.
+    """
+
+
+def require_fields(payload, *names, method: str = ""):
+    """Validate that `payload` is a map carrying every field in `names`.
+
+    Returns the payload so handlers can write
+    `payload = require_fields(payload, "node_id", method="Heartbeat")`
+    as their first line. graftlint R5 treats fields named here as
+    validated; unvalidated subscripts of the request payload are
+    flagged.
+    """
+    where = f" in {method}" if method else ""
+    if not isinstance(payload, dict):
+        raise MalformedError(
+            f"Malformed request{where}: payload must be a map, "
+            f"got {type(payload).__name__}")
+    missing = [n for n in names if n not in payload]
+    if missing:
+        raise MalformedError(
+            f"Malformed request{where}: missing field(s) "
+            f"{', '.join(missing)}")
+    return payload
 
 
 def _maybe_attach_daemon_profiler(name: str) -> None:
@@ -226,6 +337,8 @@ def wait_for_drained(get_nodes, node_id: str, deadline_s: float, *,
         try:
             nodes = get_nodes()
         except Exception:
+            logger.warning("wait_for_drained(%s): node listing failed",
+                           node_id[:8], exc_info=True)
             return "ERROR", me
         me = next((n for n in nodes if n["node_id"] == node_id), None)
         if me is None:

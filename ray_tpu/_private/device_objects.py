@@ -38,6 +38,7 @@ sys.modules.
 from __future__ import annotations
 
 import itertools
+import logging
 import sys
 import threading
 import time
@@ -45,6 +46,9 @@ import time
 import numpy as np
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private.common import require_fields
+
+logger = logging.getLogger(__name__)
 
 COLLECTIVE_GROUP = "__device_plane__"
 
@@ -60,6 +64,8 @@ _counters = {
     "released": 0,           # arrays unpinned by refcount release
     "evacuated_out": 0,      # arrays shipped off a draining node
     "evacuated_in": 0,       # arrays re-pinned here by an evacuation
+    "errors_total": 0,       # swallowed-but-logged failures on the
+                             # pull/evacuation/repin degraded paths
 }
 _handoff_seq = itertools.count(1)
 
@@ -560,7 +566,12 @@ async def handle_pull(cw, payload: dict) -> dict:
 
     from ray_tpu._private.common import Address
 
-    keys = payload.get("keys") or [payload["key"]]
+    keys = payload.get("keys")
+    if not keys:
+        # Single-object form: the batch field is absent, 'key' is the
+        # frame's one required field.
+        require_fields(payload, "key", method="handle_pull")
+        keys = [payload["key"]]
     reg = registry()
     entries, missing = [], []
     for key in keys:
@@ -582,6 +593,7 @@ async def handle_pull(cw, payload: dict) -> dict:
                 for key, array in entries]
     delivered: list[str] = []
     if payload.get("route") == "collective" and payload.get("requester_addr"):
+        require_fields(payload, "requester_addr", method="handle_pull")
         try:
             conn = await cw._owner_conn(
                 Address.from_wire(payload["requester_addr"]))
@@ -596,7 +608,11 @@ async def handle_pull(cw, payload: dict) -> dict:
             # Fall through to the host reply; tags already delivered
             # are reported so the consumer drains its mailbox (raw
             # tensor buffers must not strand in _PeerPlane._inbox).
-            pass
+            _count("errors_total")
+            logger.warning(
+                "handle_pull: collective push to %s failed after %d/%d "
+                "tags; serving host route", payload["requester_addr"],
+                len(delivered), len(gathered), exc_info=True)
     _count("host_out", len(gathered))
     return {"status": "host", "stray_tags": delivered,
             "items": [{"key": key, "dtype": dtype, "shape": shape,
@@ -605,6 +621,7 @@ async def handle_pull(cw, payload: dict) -> dict:
 
 
 async def handle_release(cw, payload: dict) -> dict:
+    require_fields(payload, "prefix", method="handle_release")
     n = registry().release_prefix(payload["prefix"])
     return {"released": n}
 
@@ -738,6 +755,7 @@ async def handle_repin(cw, payload: dict) -> dict:
     falling into lineage reconstruction."""
     import asyncio
 
+    require_fields(payload, "prefix", method="handle_repin")
     prefix = payload["prefix"]
     arrays: dict[str, np.ndarray] = {}
     if payload.get("route") == "collective":
@@ -752,6 +770,7 @@ async def handle_repin(cw, payload: dict) -> dict:
             # sent yet, so a refusal above costs the drain nothing.
             return {"ok": True}
         loop = asyncio.get_running_loop()
+        require_fields(payload, "tags", method="handle_repin")
         try:
             for tag in payload["tags"]:
                 arrays[tag] = await loop.run_in_executor(
@@ -766,7 +785,11 @@ async def handle_repin(cw, payload: dict) -> dict:
                     try:
                         plane.discard(COLLECTIVE_GROUP, tag)
                     except Exception:
-                        pass
+                        _count("errors_total")
+                        logger.warning(
+                            "handle_repin: mailbox discard of %r failed "
+                            "— buffer may strand until process exit",
+                            tag, exc_info=True)
             return {"ok": False, "error": f"collective recv failed: {e}"}
     else:
         # Host route after a degraded collective attempt: buffers the
@@ -777,13 +800,18 @@ async def handle_repin(cw, payload: dict) -> dict:
         if payload.get("stale_tags"):
             from ray_tpu.util.collective import collective as _coll
 
+            require_fields(payload, "stale_tags", method="handle_repin")
             plane = _coll._peer_plane
             if plane is not None:
                 for tag in payload["stale_tags"]:
                     try:
                         plane.discard(COLLECTIVE_GROUP, tag)
                     except Exception:
-                        pass
+                        _count("errors_total")
+                        logger.warning(
+                            "handle_repin: stale-tag discard of %r "
+                            "failed", tag, exc_info=True)
+        require_fields(payload, "items", method="handle_repin")
         for item in payload["items"]:
             arrays[item["key"]] = np.frombuffer(
                 bytearray(item["data"]),

@@ -29,6 +29,7 @@ import traceback
 from typing import Awaitable, Callable
 
 from ray_tpu._private import rpc
+from ray_tpu._private.common import supervised_task
 from ray_tpu._private.event_stats import EventLoopStats
 from ray_tpu._private.native_fastpath import (EV_ACCEPT, EV_CLOSE, EV_FRAME)
 from ray_tpu._private.rpc import (MSG_ERROR, MSG_NOTIFY, MSG_REQUEST,
@@ -227,11 +228,12 @@ class FastRpcServer:
             self._reply_error(conn, seq, method, e)
             return
         if isinstance(result, Awaitable):
-            task = asyncio.ensure_future(self._finish(conn, seq, method,
-                                                      result, t0))
-            # Keep a strong ref until done (create_task keeps only weak).
-            self._inflight.add(task)
-            task.add_done_callback(self._inflight.discard)
+            # supervised_task holds the strong ref in _inflight (raw
+            # create_task keeps only a weak one) and logs any exception
+            # that escapes _finish's own handling.
+            supervised_task(
+                self._finish(conn, seq, method, result, t0),
+                name=f"dispatch-{method}", tasks=self._inflight)
             self.stats.set_queue_depth(len(self._inflight))
         else:
             self.stats.record_handler(method, time.perf_counter() - t0)

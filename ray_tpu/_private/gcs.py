@@ -35,8 +35,10 @@ from ray_tpu._private.common import (  # noqa: F401
     NodeInfo,
     add_resources,
     normalize_resources,
+    require_fields,
     resources_fit,
     subtract_resources,
+    supervised_task,
 )
 from ray_tpu._private.config import Config
 
@@ -243,10 +245,13 @@ class GcsServer:
         if isinstance(self._server, FastRpcServer):
             self._server.service_factory = self._native_service_factory
         addr = await self._server.start(host, port)
-        self._health_task = asyncio.create_task(self._health_check_loop())
+        self._health_task = supervised_task(self._health_check_loop(),
+                                            name="gcs-health-loop")
         if self.persistence_path:
-            self._persist_task = asyncio.create_task(self._persist_loop())
-            asyncio.ensure_future(self._reap_restored_nodes())
+            self._persist_task = supervised_task(self._persist_loop(),
+                                                 name="gcs-persist-loop")
+            supervised_task(self._reap_restored_nodes(),
+                            name="gcs-reap-restored")
         logger.info("GCS listening on %s:%s", *addr)
         return addr
 
@@ -564,12 +569,12 @@ class GcsServer:
         for aid, a in self.actors.items():
             if a["state"] in (ACTOR_PENDING, ACTOR_RESTARTING):
                 asyncio.get_event_loop().call_later(
-                    1.0, lambda aid=aid: asyncio.ensure_future(
+                    1.0, lambda aid=aid: supervised_task(
                         self._schedule_actor(aid)))
         for pg_id, pg in self.placement_groups.items():
             if pg["state"] == PG_PENDING:
                 asyncio.get_event_loop().call_later(
-                    1.0, lambda p=pg_id: asyncio.ensure_future(
+                    1.0, lambda p=pg_id: supervised_task(
                         self._schedule_pg(p)))
         logger.info("GCS state restored from %s (%d actors, %d kv ns, "
                     "%d nodes)", self.persistence_path, len(self.actors),
@@ -651,12 +656,14 @@ class GcsServer:
     # ---------- pubsub ----------
 
     async def handle_subscribe(self, conn, payload):
+        require_fields(payload, "channels", method="handle_subscribe")
         for channel in payload["channels"]:
             self.subscribers[channel].add(conn)
             conn.on_close(lambda ch=channel: self.subscribers[ch].discard(conn))
         return {"ok": True}
 
     async def handle_publish(self, conn, payload):
+        require_fields(payload, "channel", "message", method="handle_publish")
         await self.publish(payload["channel"], payload["message"])
         return {"ok": True}
 
@@ -690,6 +697,8 @@ class GcsServer:
     # ---------- nodes ----------
 
     async def handle_register_node(self, conn, payload):
+        require_fields(payload, "host", "node_id", "raylet_port",
+                       "total_resources", method="handle_register_node")
         info = NodeInfo(
             node_id=payload["node_id"],
             host=payload["host"],
@@ -710,12 +719,13 @@ class GcsServer:
             self.native_sched.update_node(
                 info.node_id, total=info.total_resources,
                 available=info.available_resources, labels=info.labels)
-        conn.on_close(lambda: asyncio.ensure_future(self._on_node_conn_lost(info.node_id)))
+        conn.on_close(lambda: supervised_task(self._on_node_conn_lost(info.node_id)))
         await self.publish("NODE", {"event": "alive", "node": info.to_wire()})
         logger.info("node %s registered (%s:%s)", info.node_id[:8], info.host, info.raylet_port)
         return {"ok": True, "config": self.config.to_json()}
 
     async def handle_heartbeat(self, conn, payload):
+        require_fields(payload, "node_id", method="handle_heartbeat")
         node = self.nodes.get(payload["node_id"])
         if node is None or not node.alive:
             return {"ok": False, "reason": "unknown or dead node"}
@@ -762,6 +772,7 @@ class GcsServer:
         Failures PROPAGATE — a caller about to terminate the VM must
         know the node was never told to evacuate (the old handler
         swallowed every error and answered ok)."""
+        require_fields(payload, "node_id", method="handle_drain_node")
         node_id = payload["node_id"]
         reason = payload.get("reason") or "manual"
         if reason not in DRAIN_REASONS:
@@ -833,7 +844,7 @@ class GcsServer:
         # must not race a second migration pass into double-scheduling
         # the same actor (two CreateActors = a forked actor).
         if not already_draining:
-            asyncio.ensure_future(self._migrate_actors_off(node_id, reason))
+            supervised_task(self._migrate_actors_off(node_id, reason))
         return {"ok": True, "state": NODE_DRAINING}
 
     async def _migrate_actors_off(self, node_id: str, reason: str):
@@ -879,7 +890,7 @@ class GcsServer:
                 except Exception:
                     pass  # node may die mid-drain; reschedule regardless
             migrated += 1
-            asyncio.ensure_future(self._schedule_actor(actor_id))
+            supervised_task(self._schedule_actor(actor_id))
         if node is not None and migrated:
             node.drain_stats["migrated_actors"] = \
                 node.drain_stats.get("migrated_actors", 0) + migrated
@@ -900,6 +911,7 @@ class GcsServer:
         """The raylet finished evacuating: DRAINED in the node table,
         relocated-object directory updated, stats recorded. From here
         the node's death is expected and cheap."""
+        require_fields(payload, "node_id", method="handle_drain_complete")
         node_id = payload["node_id"]
         node = self.nodes.get(node_id)
         if node is None:
@@ -935,6 +947,7 @@ class GcsServer:
         return {"relocations": out}
 
     async def handle_notify_node_dead(self, conn, payload):
+        require_fields(payload, "node_id", method="handle_notify_node_dead")
         await self._mark_node_dead(payload["node_id"], payload.get("reason", "reported dead"))
         return {"ok": True}
 
@@ -985,7 +998,7 @@ class GcsServer:
         for pg_id, pg in self.placement_groups.items():
             if pg["state"] == PG_CREATED and any(
                     b.get("node_id") == node_id for b in pg["bundles"]):
-                asyncio.ensure_future(self._schedule_pg(pg_id))
+                supervised_task(self._schedule_pg(pg_id))
 
     async def _health_check_loop(self):
         # reference: gcs_health_check_manager.h:39 — gRPC health checks with
@@ -1002,6 +1015,7 @@ class GcsServer:
     # ---------- KV ----------
 
     async def handle_kv_put(self, conn, payload):
+        require_fields(payload, "key", "value", method="handle_kv_put")
         ns = payload.get("ns", "")
         table = self.kv[ns]
         key = payload["key"]
@@ -1012,9 +1026,11 @@ class GcsServer:
         return {"added": True}
 
     async def handle_kv_get(self, conn, payload):
+        require_fields(payload, "key", method="handle_kv_get")
         return {"value": self.kv[payload.get("ns", "")].get(payload["key"])}
 
     async def handle_kv_del(self, conn, payload):
+        require_fields(payload, "key", method="handle_kv_del")
         existed = self.kv[payload.get("ns", "")].pop(payload["key"], None) is not None
         if existed:
             self._touch("kv", (payload.get("ns", ""), payload["key"]))
@@ -1025,6 +1041,7 @@ class GcsServer:
         return {"keys": [k for k in self.kv[payload.get("ns", "")] if k.startswith(prefix)]}
 
     async def handle_kv_exists(self, conn, payload):
+        require_fields(payload, "key", method="handle_kv_exists")
         return {"exists": payload["key"] in self.kv[payload.get("ns", "")]}
 
     # ---------- actors ----------
@@ -1032,6 +1049,8 @@ class GcsServer:
     async def handle_register_actor(self, conn, payload):
         """Register + schedule an actor (reference: gcs_actor_manager.cc
         RegisterActor → GcsActorScheduler)."""
+        require_fields(payload, "actor_id", "spec",
+                       method="handle_register_actor")
         actor_id = payload["actor_id"]
         spec = payload["spec"]
         name = payload.get("name") or ""
@@ -1072,7 +1091,7 @@ class GcsServer:
             self._creation_task_id(actor_id, spec), payload.get("class_name", ""),
             "CREATE_REGISTERED", job_id=payload.get("job_id", ""),
             actor_id=actor_id)
-        asyncio.ensure_future(self._schedule_actor(actor_id))
+        supervised_task(self._schedule_actor(actor_id))
         return {"ok": True}
 
     def _pick_node_for(self, resources: dict, strategy=None,
@@ -1143,7 +1162,7 @@ class GcsServer:
             a.get("pg_bundle_index", -1))
         if node_id is None or node_id not in self.node_conns:
             # No feasible node right now; retry (autoscaler demand signal).
-            asyncio.ensure_future(self._schedule_actor(actor_id, delay=0.5))
+            supervised_task(self._schedule_actor(actor_id, delay=0.5))
             return
         # Transient debit of the placement demand against the GCS view: a
         # burst of concurrent creations fans out across nodes instead of
@@ -1176,7 +1195,7 @@ class GcsServer:
                     logger.info("actor %s creation bounced off draining "
                                 "node %s; rescheduling", actor_id[:8],
                                 node_id[:8])
-                    asyncio.ensure_future(
+                    supervised_task(
                         self._schedule_actor(actor_id, delay=0.2))
                     return
                 logger.warning("actor %s creation on node %s failed: %s",
@@ -1188,6 +1207,8 @@ class GcsServer:
             await self._on_actor_worker_death(actor_id, f"creation rpc failed: {e}")
 
     async def handle_actor_ready(self, conn, payload):
+        require_fields(payload, "actor_id", "address",
+                       method="handle_actor_ready")
         a = self.actors.get(payload["actor_id"])
         if a is None:
             return {"ok": False}
@@ -1211,6 +1232,7 @@ class GcsServer:
         # (process reap, socket close); only the first report per worker
         # may consume a restart (reference: ReconstructActor checks the
         # dead worker matches the actor's current incarnation).
+        require_fields(payload, "actor_id", method="handle_report_actor_death")
         a = self.actors.get(payload["actor_id"])
         wid = payload.get("worker_id")
         if a is not None and wid:
@@ -1242,7 +1264,7 @@ class GcsServer:
             self.mark_dirty(("actors",))
             await self.publish("ACTOR", {"actor_id": actor_id, "state": ACTOR_RESTARTING,
                                          "reason": reason})
-            asyncio.ensure_future(self._schedule_actor(actor_id))
+            supervised_task(self._schedule_actor(actor_id))
         else:
             a["state"] = ACTOR_DEAD
             self.mark_dirty(("actors", "named_actors"))
@@ -1259,6 +1281,7 @@ class GcsServer:
                                          "reason": reason})
 
     async def handle_get_actor_info(self, conn, payload):
+        require_fields(payload, "actor_id", method="handle_get_actor_info")
         a = self.actors.get(payload["actor_id"])
         if a is None:
             return {"found": False}
@@ -1267,6 +1290,7 @@ class GcsServer:
                 "class_name": a["class_name"], "name": a["name"]}
 
     async def handle_get_named_actor(self, conn, payload):
+        require_fields(payload, "name", method="handle_get_named_actor")
         key = (payload.get("namespace") or "default", payload["name"])
         actor_id = self.named_actors.get(key)
         if actor_id is None or actor_id not in self.actors:
@@ -1283,6 +1307,7 @@ class GcsServer:
             for a in self.actors.values()]}
 
     async def handle_kill_actor(self, conn, payload):
+        require_fields(payload, "actor_id", method="handle_kill_actor")
         actor_id = payload["actor_id"]
         a = self.actors.get(actor_id)
         if a is None:
@@ -1297,7 +1322,11 @@ class GcsServer:
                 await self.node_conns[node_id].call(
                     "KillActorWorker", {"actor_id": actor_id, "address": addr})
             except Exception:
-                pass
+                # Best-effort: the raylet may already be tearing the
+                # worker down; the death path below is authoritative.
+                logger.warning("kill_actor(%s): KillActorWorker rpc to "
+                               "node %s failed", actor_id[:8], node_id[:8],
+                               exc_info=True)
         if a["state"] != ACTOR_DEAD and no_restart:
             await self._on_actor_worker_death(actor_id, "killed via kill()", intended=True)
         return {"ok": True}
@@ -1305,6 +1334,7 @@ class GcsServer:
     # ---------- jobs ----------
 
     async def handle_register_job(self, conn, payload):
+        require_fields(payload, "job_id", method="handle_register_job")
         if payload.get("owns_cluster"):
             # This driver started the session (local mode): the whole tree
             # dies with it — GCS exits, raylets exit on GCS loss, workers
@@ -1333,6 +1363,7 @@ class GcsServer:
         return {"ok": True}
 
     async def handle_finish_job(self, conn, payload):
+        require_fields(payload, "job_id", method="handle_finish_job")
         job = self.jobs.get(payload["job_id"])
         if job:
             job["status"] = payload.get("status", "SUCCEEDED")
@@ -1351,6 +1382,7 @@ class GcsServer:
     # ---------- placement groups ----------
 
     async def handle_create_pg(self, conn, payload):
+        require_fields(payload, "bundles", "pg_id", method="handle_create_pg")
         pg_id = payload["pg_id"]
         bundles = [{"resources": normalize_resources(b), "node_id": None, "available": {}}
                    for b in payload["bundles"]]
@@ -1363,7 +1395,7 @@ class GcsServer:
             "job_id": payload.get("job_id", ""),
         }
         self._touch("placement_groups", pg_id)
-        asyncio.ensure_future(self._schedule_pg(pg_id))
+        supervised_task(self._schedule_pg(pg_id))
         return {"ok": True}
 
     async def _schedule_pg(self, pg_id: str, delay: float = 0.0):
@@ -1379,7 +1411,7 @@ class GcsServer:
             return
         placement = self._pack_bundles(pg)
         if placement is None:
-            asyncio.ensure_future(self._schedule_pg(pg_id, delay=0.5))
+            supervised_task(self._schedule_pg(pg_id, delay=0.5))
             return
         # Prepare on all nodes.
         prepared = []
@@ -1408,7 +1440,7 @@ class GcsServer:
                         await nconn.call("ReturnPGBundle", {"pg_id": pg_id, "bundle_index": idx})
                     except Exception:
                         pass
-            asyncio.ensure_future(self._schedule_pg(pg_id, delay=0.5))
+            supervised_task(self._schedule_pg(pg_id, delay=0.5))
             return
         for idx, node_id in placement:
             try:
@@ -1482,6 +1514,7 @@ class GcsServer:
         return placement
 
     async def handle_remove_pg(self, conn, payload):
+        require_fields(payload, "pg_id", method="handle_remove_pg")
         pg = self.placement_groups.get(payload["pg_id"])
         if pg is None:
             return {"ok": False}
@@ -1492,7 +1525,11 @@ class GcsServer:
                     await self.node_conns[node_id].call(
                         "ReturnPGBundle", {"pg_id": pg["pg_id"], "bundle_index": idx})
                 except Exception:
-                    pass
+                    # A dead raylet frees its bundles via node-death
+                    # cleanup; log so a live one failing is visible.
+                    logger.warning("remove_pg(%s): ReturnPGBundle %d on "
+                                   "node %s failed", pg["pg_id"][:8], idx,
+                                   node_id[:8], exc_info=True)
         pg["state"] = PG_REMOVED
         self._touch("placement_groups", payload["pg_id"])
         # Waiters on ready() promises fail instead of hanging forever.
@@ -1501,6 +1538,7 @@ class GcsServer:
         return {"ok": True}
 
     async def handle_get_pg(self, conn, payload):
+        require_fields(payload, "pg_id", method="handle_get_pg")
         pg = self.placement_groups.get(payload["pg_id"])
         if pg is None:
             return {"found": False}
@@ -1542,6 +1580,7 @@ class GcsServer:
         return actor_id
 
     async def handle_add_task_events(self, conn, payload):
+        require_fields(payload, "events", method="handle_add_task_events")
         self.task_events.extend(payload["events"])
         return {"ok": True}
 

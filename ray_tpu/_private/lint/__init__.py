@@ -1,0 +1,30 @@
+"""graftlint — static checker for the runtime's concurrency/protocol invariants.
+
+Every rule here encodes a bug class that was hand-found (and hand-fixed)
+in a past review round of the async daemons; the checker makes the fix
+permanent. See docs/linting.md for the rule catalogue with the
+historical bug behind each one.
+
+Usage:
+    python -m ray_tpu._private.lint [paths...]          # gate (baseline-aware)
+    python -m ray_tpu._private.lint --update-baseline   # ratchet down
+
+Library API (used by tests/test_lint.py):
+    from ray_tpu._private.lint import run_lint, lint_source, Violation
+"""
+
+from ray_tpu._private.lint.engine import (  # noqa: F401
+    LintReport,
+    Violation,
+    lint_source,
+    normalize_path,
+    run_lint,
+)
+from ray_tpu._private.lint.rules import ALL_RULES, DAEMON_MODULES  # noqa: F401
+from ray_tpu._private.lint.baseline import (  # noqa: F401
+    DEFAULT_BASELINE_PATH,
+    counts_by_rule_path,
+    load_baseline,
+    regressions,
+    save_baseline,
+)

@@ -1,0 +1,96 @@
+"""graftlint CLI.
+
+    python -m ray_tpu._private.lint [paths...]
+        Gate mode: lint the tree (default: the installed ray_tpu
+        package), subtract the checked-in baseline, exit 1 on any new
+        violation.
+
+    python -m ray_tpu._private.lint --update-baseline
+        Ratchet: rewrite baseline.json with the current counts (entries
+        that reached zero are dropped).
+
+    python -m ray_tpu._private.lint --all
+        Also print baselined (allowlisted) violations.
+
+    python -m ray_tpu._private.lint --list-rules
+        Print the rule catalogue.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ray_tpu._private.lint import baseline as baseline_mod
+from ray_tpu._private.lint.engine import run_lint
+from ray_tpu._private.lint.rules import ALL_RULES
+
+
+def _default_paths() -> list[str]:
+    import ray_tpu
+
+    return [os.path.dirname(os.path.abspath(ray_tpu.__file__))]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu._private.lint",
+        description="graftlint: distributed-runtime invariant checker")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the ray_tpu package)")
+    ap.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE_PATH,
+                    help="baseline file (default: the checked-in one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, ignore the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline with current counts")
+    ap.add_argument("--all", action="store_true",
+                    help="also print baselined violations")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.title}")
+            doc = (rule.__doc__ or "").strip()
+            if doc:
+                print(f"    {doc}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    report = run_lint(paths)
+    for err in report.parse_errors:
+        print(f"graftlint: parse error: {err}", file=sys.stderr)
+
+    if args.update_baseline:
+        counts = baseline_mod.counts_by_rule_path(report.violations)
+        baseline_mod.save_baseline(counts, args.baseline)
+        total = sum(n for paths_ in counts.values() for n in paths_.values())
+        print(f"graftlint: baseline updated ({total} allowlisted violations "
+              f"across {report.files_checked} files) -> {args.baseline}")
+        return 0
+
+    base = {} if args.no_baseline else baseline_mod.load_baseline(args.baseline)
+    new = baseline_mod.regressions(report.violations, base)
+
+    if args.all:
+        allowlisted = [v for v in report.violations if v not in set(new)]
+        for v in allowlisted:
+            print(f"(baseline) {v.format()}")
+    for v in new:
+        print(v.format())
+
+    n_base = len(report.violations) - len(new)
+    print(f"graftlint: {report.files_checked} files, "
+          f"{len(new)} new violation(s), {n_base} baselined, "
+          f"{report.suppressed} suppressed", file=sys.stderr)
+    if new:
+        print("graftlint: FAIL — fix the violations above or (only for "
+              "pre-existing debt) run --update-baseline", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

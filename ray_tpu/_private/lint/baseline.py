@@ -1,0 +1,76 @@
+"""graftlint baseline: explicit allowlist of pre-existing violations.
+
+The baseline maps rule -> path -> count. The gate fails only on
+REGRESSIONS (a (rule, path) count above its baselined value); shrinking
+counts are rewarded by `--update-baseline`, which drops entries that
+reached zero — the ratchet only turns one way (tests/test_lint.py
+asserts this).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                                     "baseline.json")
+
+_VERSION = 1
+
+
+def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> dict:
+    """Returns rule -> {path: count}. Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != _VERSION:
+        raise ValueError(
+            f"unsupported graftlint baseline version {data.get('version')!r} "
+            f"in {path}")
+    return data.get("rules", {})
+
+
+def save_baseline(counts: dict, path: str = DEFAULT_BASELINE_PATH) -> None:
+    rules = {
+        rule: {p: n for p, n in sorted(paths.items()) if n > 0}
+        for rule, paths in sorted(counts.items())
+    }
+    rules = {rule: paths for rule, paths in rules.items() if paths}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": _VERSION, "rules": rules}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def counts_by_rule_path(violations) -> dict:
+    """Violations -> rule -> {path: count}."""
+    out: dict[str, dict[str, int]] = {}
+    for v in violations:
+        paths = out.setdefault(v.rule, {})
+        paths[v.path] = paths.get(v.path, 0) + 1
+    return out
+
+
+def regressions(violations, baseline: dict) -> list:
+    """Violations not covered by the baseline.
+
+    For a (rule, path) with baseline count N, the first N violations at
+    that location are allowlisted (oldest-first by line) and the rest
+    are regressions — so ANY net increase fails, without pinning
+    baseline entries to line numbers that drift on unrelated edits.
+    """
+    budget = {
+        (rule, path): n
+        for rule, paths in baseline.items()
+        for path, n in paths.items()
+    }
+    out = []
+    for v in sorted(violations, key=lambda v: (v.rule, v.path, v.line)):
+        key = (v.rule, v.path)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+        else:
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out

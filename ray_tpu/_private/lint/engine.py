@@ -1,0 +1,168 @@
+"""graftlint engine: file walking, suppressions, and reporting.
+
+The engine is rule-agnostic: it parses each file once, builds a
+FileContext (AST + source lines + suppression map + daemon-module
+flag), and hands it to every registered rule. Rules yield Violations;
+the engine drops the ones a `# graftlint: disable=Rn` comment covers
+and compares the rest against the checked-in baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_DAEMON_MARKER = "# graftlint: daemon-module"
+
+_SKIP_DIRS = {"__pycache__", "_lib", "build", "build-asan", "build-tsan",
+              ".git", "node_modules"}
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str          # "R1".."R5"
+    path: str          # normalized posix path (ray_tpu/...)
+    line: int
+    col: int
+    func: str          # enclosing function qualname, or "<module>"
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.func}] {self.message}")
+
+
+@dataclass
+class FileContext:
+    path: str                       # normalized path used in reports
+    tree: ast.AST
+    lines: list[str]
+    suppressions: dict[int, set[str]]   # 1-based line -> rule ids ("*" = all)
+    is_daemon: bool = False
+
+
+@dataclass
+class LintReport:
+    violations: list[Violation] = field(default_factory=list)
+    suppressed: int = 0
+    files_checked: int = 0
+    parse_errors: list[str] = field(default_factory=list)
+
+    def by_rule(self) -> dict[str, list[Violation]]:
+        out: dict[str, list[Violation]] = {}
+        for v in self.violations:
+            out.setdefault(v.rule, []).append(v)
+        return out
+
+
+def normalize_path(path: str) -> str:
+    """Stable report path: from the `ray_tpu` package component onward
+    (baseline entries must survive checkouts at different roots); other
+    files fall back to a cwd-relative posix path."""
+    parts = os.path.abspath(path).replace(os.sep, "/").split("/")
+    if "ray_tpu" in parts:
+        i = len(parts) - 1 - parts[::-1].index("ray_tpu")
+        return "/".join(parts[i:])
+    rel = os.path.relpath(path)
+    return rel.replace(os.sep, "/")
+
+
+def _collect_suppressions(lines: list[str]) -> dict[int, set[str]]:
+    """Map line number -> suppressed rule ids. A suppression comment
+    covers its own line; a comment-only line also covers the next line
+    (for statements too long to share a line with the comment)."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if text.lstrip().startswith("#"):
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def _iter_py_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _is_daemon_module(norm_path: str, source: str) -> bool:
+    from ray_tpu._private.lint.rules import DAEMON_MODULES
+
+    if any(norm_path.endswith(suffix) for suffix in DAEMON_MODULES):
+        return True
+    head = source[:2000]
+    return _DAEMON_MARKER in head
+
+
+def _check_file(path: str, source: str, rules, report: LintReport,
+                norm_path: str | None = None) -> None:
+    norm = norm_path or normalize_path(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        report.parse_errors.append(f"{norm}: {e}")
+        return
+    lines = source.splitlines()
+    ctx = FileContext(
+        path=norm,
+        tree=tree,
+        lines=lines,
+        suppressions=_collect_suppressions(lines),
+        is_daemon=_is_daemon_module(norm, source),
+    )
+    report.files_checked += 1
+    for rule in rules:
+        for v in rule.check(ctx):
+            suppressed = ctx.suppressions.get(v.line, set())
+            if v.rule in suppressed or "*" in suppressed:
+                report.suppressed += 1
+            else:
+                report.violations.append(v)
+
+
+def run_lint(paths: list[str], rules=None) -> LintReport:
+    """Lint every .py file under `paths`. Returns the raw report; the
+    caller applies the baseline (see baseline.regressions)."""
+    from ray_tpu._private.lint.rules import ALL_RULES
+
+    rules = ALL_RULES if rules is None else rules
+    report = LintReport()
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            report.parse_errors.append(f"{path}: {e}")
+            continue
+        _check_file(path, source, rules, report)
+    report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return report
+
+
+def lint_source(source: str, filename: str = "<fixture>.py",
+                rules=None) -> LintReport:
+    """Lint a source string (test fixtures). `filename` is used verbatim
+    as the report path, so fixtures can impersonate daemon modules
+    (e.g. "ray_tpu/_private/raylet.py") or use the daemon-module marker
+    comment."""
+    from ray_tpu._private.lint.rules import ALL_RULES
+
+    rules = ALL_RULES if rules is None else rules
+    report = LintReport()
+    _check_file(filename, source, rules, report, norm_path=filename)
+    report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return report

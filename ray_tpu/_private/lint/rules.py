@@ -1,0 +1,415 @@
+"""graftlint rules R1-R5.
+
+Each rule encodes one bug class hand-found in past review rounds of the
+async daemons (the historical incident is named in docs/linting.md):
+
+  R1  raw asyncio.create_task/ensure_future (must use
+      common.supervised_task — weak-ref loss + silently escaped
+      exceptions killed the lease pump, PR 2)
+  R2  blocking calls inside `async def` in daemon modules (one
+      time.sleep on the raylet loop stalls every lease on the node)
+  R3  iterating a shared `self.*` container across an `await` without
+      snapshotting (asyncio interleaving mutates it mid-loop)
+  R4  `except Exception: pass/continue` inside handle_* RPC paths
+      (handle_drain_node swallowed errors, PR-3 satellite fix)
+  R5  unvalidated request-payload subscripts in handle_* entries (must
+      require_fields(...) first and answer Malformed, not KeyError —
+      PR-1's native-service Malformed gates, mirrored in Python)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu._private.lint.engine import FileContext, Violation
+
+# Modules whose event loops are cluster-critical: a blocked or dead
+# task here stalls every lease/object/actor on the node. R2 applies
+# only inside these (workers running user code may legitimately block).
+DAEMON_MODULES = (
+    "_private/gcs.py",
+    "_private/raylet.py",
+    "_private/worker.py",
+    "_private/rpc.py",
+    "_private/fast_rpc.py",
+    "_private/node.py",
+    "_private/worker_zygote.py",
+    "_private/object_store.py",
+    "_private/device_objects.py",
+)
+
+_HANDLER_PREFIXES = ("handle_", "_handle_")
+
+_SPAWN_NAMES = {"create_task", "ensure_future"}
+
+# Dotted call names that block the event loop. First segment is
+# resolved through the module's import aliases, so `import subprocess
+# as sp; sp.run(...)` and `from time import sleep; sleep(...)` both
+# match.
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.getoutput",
+    "subprocess.getstatusoutput",
+    "os.system", "os.popen", "os.waitpid", "os.wait",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname", "socket.gethostbyaddr",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.request",
+}
+
+_SNAPSHOT_WRAPPERS = {"list", "tuple", "sorted", "set", "dict", "frozenset"}
+_VIEW_METHODS = {"items", "keys", "values"}
+
+
+def _is_handler_name(name: str) -> bool:
+    return name.startswith(_HANDLER_PREFIXES)
+
+
+def _import_aliases(tree: ast.AST) -> dict[str, str]:
+    """Local name -> dotted origin, from top-level imports."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _dotted_name(func: ast.expr, aliases: dict[str, str]) -> str | None:
+    """Best-effort dotted name of a call target, alias-resolved."""
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(aliases.get(node.id, node.id))
+    else:
+        return None
+    return ".".join(reversed(parts))
+
+
+def _self_attr_chain(node: ast.expr) -> str | None:
+    """`self._x` / `self.x` (one attribute deep) -> attr name."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _shared_container(it: ast.expr) -> str | None:
+    """Return a display name when `it` iterates a shared self container
+    directly: `self._x`, `self._x[k]`, or `self._x.items()/keys()/
+    values()`. Snapshot wrappers (list(...), tuple(...)) around any of
+    these do not match."""
+    attr = _self_attr_chain(it)
+    if attr is not None:
+        return f"self.{attr}"
+    if isinstance(it, ast.Subscript):
+        attr = _self_attr_chain(it.value)
+        if attr is not None:
+            return f"self.{attr}[...]"
+    if (isinstance(it, ast.Call) and not it.args and not it.keywords
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr in _VIEW_METHODS):
+        base = it.func.value
+        attr = _self_attr_chain(base)
+        if attr is not None:
+            return f"self.{attr}.{it.func.attr}()"
+        if isinstance(base, ast.Subscript):
+            attr = _self_attr_chain(base.value)
+            if attr is not None:
+                return f"self.{attr}[...].{it.func.attr}()"
+    return None
+
+
+def _contains_await(nodes: list[ast.stmt]) -> ast.Await | None:
+    """First Await lexically inside `nodes`, not descending into nested
+    function definitions (their awaits run on their own schedule)."""
+    stack: list[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Await):
+            return node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Shared traversal tracking the enclosing-function stack. Rules
+    subclass and read self.qualname / self.in_async / self.handler."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.out: list[Violation] = []
+        self._stack: list[tuple[str, bool]] = []  # (name, is_async)
+
+    # -- stack helpers --
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(n for n, _ in self._stack) or "<module>"
+
+    @property
+    def in_async(self) -> bool:
+        """Whether the nearest enclosing function is an `async def`."""
+        return bool(self._stack) and self._stack[-1][1]
+
+    @property
+    def handler(self) -> str | None:
+        """Innermost enclosing handle_* function name, if any."""
+        for name, _ in reversed(self._stack):
+            if _is_handler_name(name):
+                return name
+        return None
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._stack.append((node.name, False))
+        self.enter_function(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._stack.append((node.name, True))
+        self.enter_function(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self._stack.append(("<lambda>", False))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def enter_function(self, node) -> None:  # rule hook
+        pass
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.out.append(Violation(
+            rule=rule, path=self.ctx.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            func=self.qualname, message=message))
+
+
+class RuleR1:
+    """Raw task spawns must go through common.supervised_task()."""
+
+    id = "R1"
+    title = "unsupervised asyncio task spawn"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        class V(_FuncWalker):
+            def visit_Call(self, node: ast.Call):
+                f = node.func
+                name = None
+                if isinstance(f, ast.Attribute) and f.attr in _SPAWN_NAMES:
+                    name = f.attr
+                elif isinstance(f, ast.Name) and f.id in _SPAWN_NAMES:
+                    name = f.id
+                if name is not None:
+                    self.emit(
+                        "R1", node,
+                        f"raw asyncio.{name}() — spawn through "
+                        "common.supervised_task() so the task keeps a "
+                        "strong ref and escaped exceptions are logged, "
+                        "not silently parked")
+                self.generic_visit(node)
+
+        v = V(ctx)
+        v.visit(ctx.tree)
+        return iter(v.out)
+
+
+class RuleR2:
+    """No blocking calls inside async def in daemon modules."""
+
+    id = "R2"
+    title = "blocking call on a daemon event loop"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if not ctx.is_daemon:
+            return iter(())
+        aliases = _import_aliases(ctx.tree)
+
+        class V(_FuncWalker):
+            def visit_Call(self, node: ast.Call):
+                if self.in_async:
+                    dotted = _dotted_name(node.func, aliases)
+                    if dotted in _BLOCKING_CALLS:
+                        self.emit(
+                            "R2", node,
+                            f"blocking call {dotted}() inside async def "
+                            "on a daemon event loop — use the asyncio "
+                            "equivalent or run_in_executor")
+                self.generic_visit(node)
+
+        v = V(ctx)
+        v.visit(ctx.tree)
+        return iter(v.out)
+
+
+class RuleR3:
+    """No iterating shared self containers across an await point."""
+
+    id = "R3"
+    title = "shared-container iteration across await"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        class V(_FuncWalker):
+            def visit_For(self, node: ast.For):
+                if self.in_async:
+                    shared = _shared_container(node.iter)
+                    if shared is not None:
+                        aw = _contains_await(node.body)
+                        if aw is not None:
+                            self.emit(
+                                "R3", node,
+                                f"iterating {shared} with an await at "
+                                f"line {aw.lineno} inside the loop — "
+                                "another coroutine can mutate it during "
+                                "the await; snapshot with list(...) "
+                                "first")
+                self.generic_visit(node)
+
+        v = V(ctx)
+        v.visit(ctx.tree)
+        return iter(v.out)
+
+
+class RuleR4:
+    """No silent except-pass/continue in handle_* RPC paths."""
+
+    id = "R4"
+    title = "swallowed exception in RPC handler"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        class V(_FuncWalker):
+            def visit_ExceptHandler(self, node: ast.ExceptHandler):
+                if self.handler and self._broad(node.type) \
+                        and self._silent(node.body):
+                    self.emit(
+                        "R4", node,
+                        f"except {self._type_name(node.type)} with a "
+                        "pass/continue body inside RPC handler "
+                        f"{self.handler!r} — log it, count it, or "
+                        "re-raise (silent drops hid real failures in "
+                        "handle_drain_node)")
+                self.generic_visit(node)
+
+            @staticmethod
+            def _broad(t) -> bool:
+                if t is None:
+                    return True  # bare except
+                if isinstance(t, ast.Name):
+                    return t.id in ("Exception", "BaseException")
+                if isinstance(t, ast.Tuple):
+                    return any(isinstance(e, ast.Name)
+                               and e.id in ("Exception", "BaseException")
+                               for e in t.elts)
+                return False
+
+            @staticmethod
+            def _silent(body) -> bool:
+                for stmt in body:
+                    if isinstance(stmt, (ast.Pass, ast.Continue)):
+                        continue
+                    if isinstance(stmt, ast.Expr) \
+                            and isinstance(stmt.value, ast.Constant):
+                        continue  # bare docstring/constant
+                    return False
+                return True
+
+            @staticmethod
+            def _type_name(t) -> str:
+                if t is None:
+                    return "<bare>"
+                return getattr(t, "id", "Exception")
+
+        v = V(ctx)
+        v.visit(ctx.tree)
+        return iter(v.out)
+
+
+class RuleR5:
+    """handle_* entries must validate frame fields before subscripting."""
+
+    id = "R5"
+    title = "unvalidated request-payload access in RPC handler"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_handler_name(node.name):
+                self._check_handler(ctx, node, out)
+        return iter(out)
+
+    def _check_handler(self, ctx: FileContext, fn, out: list[Violation]):
+        args = [a.arg for a in fn.args.args if a.arg != "self"]
+        if not args:
+            return
+        payload = args[-1]  # handler signature: (self, conn, payload)
+        validated: set[str] = set()
+        validated_all = False
+        subscripts: list[tuple[ast.Subscript, str]] = []
+
+        for node in ast.walk(fn):
+            # require_fields(payload, "a", "b") / common.require_fields
+            if isinstance(node, ast.Call):
+                callee = node.func
+                name = callee.attr if isinstance(callee, ast.Attribute) \
+                    else getattr(callee, "id", None)
+                if name == "require_fields" and node.args \
+                        and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id == payload:
+                    for a in node.args[1:]:
+                        if isinstance(a, ast.Constant) \
+                                and isinstance(a.value, str):
+                            validated.add(a.value)
+            # `"k" in payload` / `"k" not in payload` guards
+            elif isinstance(node, ast.Compare):
+                if len(node.ops) == 1 \
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                        and isinstance(node.comparators[0], ast.Name) \
+                        and node.comparators[0].id == payload \
+                        and isinstance(node.left, ast.Constant) \
+                        and isinstance(node.left.value, str):
+                    validated.add(node.left.value)
+            # isinstance(payload, dict) guard plus per-key `payload.get`
+            # is fine by construction (no subscript); record reads:
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == payload \
+                    and isinstance(node.ctx, ast.Load):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                    subscripts.append((node, sl.value))
+
+        if validated_all:
+            return
+        for node, key in subscripts:
+            if key in validated:
+                continue
+            out.append(Violation(
+                rule="R5", path=ctx.path, line=node.lineno,
+                col=node.col_offset, func=fn.name,
+                message=(
+                    f"payload[{key!r}] read without validation in RPC "
+                    f"handler {fn.name!r} — call common.require_fields("
+                    f"{payload}, {key!r}, ...) first so a short frame "
+                    "answers Malformed instead of raising KeyError")))
+
+
+ALL_RULES = [RuleR1(), RuleR2(), RuleR3(), RuleR4(), RuleR5()]
+
+RULE_DOCS = {r.id: r.title for r in ALL_RULES}

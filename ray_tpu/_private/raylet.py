@@ -35,7 +35,8 @@ from collections import defaultdict, deque
 
 from ray_tpu._private import rpc
 from ray_tpu._private.common import (_maybe_attach_daemon_profiler,
-                                     normalize_resources, resources_fit)
+                                     normalize_resources, require_fields,
+                                     resources_fit, supervised_task)
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullError
@@ -214,7 +215,8 @@ class _ZygoteClient:
                     asyncio.open_unix_connection(self.sock_path), timeout)
             except (OSError, asyncio.TimeoutError):
                 return False
-            self._reader_task = asyncio.ensure_future(self._read_loop())
+            self._reader_task = supervised_task(self._read_loop(),
+                                                name="zygote-read-loop")
             return True
 
     async def _read_loop(self):
@@ -553,11 +555,15 @@ class Raylet:
         self.runtime_env_manager = RuntimeEnvManager(
             os.path.join(self.session_dir, f"node-{self.node_id[:8]}"),
             kv_get=_kv_get)
-        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
-        self._tasks.append(asyncio.create_task(self._reap_loop()))
-        self._tasks.append(asyncio.create_task(self._log_tail_loop()))
+        self._tasks.append(supervised_task(self._heartbeat_loop(),
+                                           name="heartbeat-loop"))
+        self._tasks.append(supervised_task(self._reap_loop(),
+                                           name="reap-loop"))
+        self._tasks.append(supervised_task(self._log_tail_loop(),
+                                           name="log-tail-loop"))
         if self.config.memory_usage_threshold > 0:
-            self._tasks.append(asyncio.create_task(self._memory_monitor_loop()))
+            self._tasks.append(supervised_task(self._memory_monitor_loop(),
+                                               name="memory-monitor-loop"))
         # Prestart (reference: worker_pool.cc PrestartWorkers): warm the
         # pool concurrently with the rest of cluster bring-up — each
         # registration lands the worker in idle_workers and pumps leases.
@@ -713,6 +719,7 @@ class Raylet:
         return False
 
     async def handle_ensure_runtime_env(self, conn, payload):
+        require_fields(payload, "env", method="handle_ensure_runtime_env")
         ctx = await self.runtime_env_manager.ensure(
             payload["env"], payload.get("job_id", ""))
         return ctx
@@ -939,7 +946,7 @@ class Raylet:
         self.workers[worker_id] = w
         self._log_tails[worker_id] = self._LogTail(w, log_path)
         self._tasks.append(
-            asyncio.ensure_future(
+            supervised_task(
                 self._materialize_worker(w, worker_env, log_path)))
         return w
 
@@ -950,7 +957,7 @@ class Raylet:
         startup-concurrency slot from fork until registration."""
         await self._spawn_slots.acquire()
         self._tasks.append(
-            asyncio.ensure_future(self._release_spawn_slot(w)))
+            supervised_task(self._release_spawn_slot(w)))
         proc = None
         if self._zygote is not None:
             # Waiting for zygote warm-up beats cold-spawning in parallel
@@ -1046,6 +1053,8 @@ class Raylet:
             pass
 
     async def handle_register_worker(self, conn, payload):
+        require_fields(payload, "host", "port", "worker_id",
+                       method="handle_register_worker")
         w = self.workers.get(payload["worker_id"])
         if w is None:
             # Driver-side core workers also register so the raylet can track
@@ -1055,7 +1064,7 @@ class Raylet:
         w.conn = conn
         w.address = (payload["host"], payload["port"])
         w.fp_port = payload.get("fp_port", 0)
-        conn.on_close(lambda: None if w.dead else asyncio.ensure_future(
+        conn.on_close(lambda: None if w.dead else supervised_task(
             self._on_worker_death(w, "worker connection lost")))
         w.registered.set()
         if not w.leased and w.actor_id is None and not w.reserved:
@@ -1318,6 +1327,7 @@ class Raylet:
         return node
 
     def handle_worker_blocked(self, conn, payload):
+        require_fields(payload, "worker_id", method="handle_worker_blocked")
         w = self.workers.get(payload["worker_id"])
         if w is None or not w.leased or not w.lease_id:
             return {}
@@ -1327,6 +1337,7 @@ class Raylet:
         return {}
 
     def handle_worker_unblocked(self, conn, payload):
+        require_fields(payload, "worker_id", method="handle_worker_unblocked")
         w = self.workers.get(payload["worker_id"])
         if w is None or not w.lease_id:
             return {}
@@ -1571,6 +1582,7 @@ class Raylet:
                 }}
 
     async def handle_return_worker(self, conn, payload):
+        require_fields(payload, "lease_id", method="handle_return_worker")
         lease_id = payload["lease_id"]
         for w in self.workers.values():
             if w.lease_id == lease_id:
@@ -1654,7 +1666,7 @@ class Raylet:
                             break
                     else:
                         self.rcore.release(lease_id)
-            asyncio.ensure_future(grant())
+            supervised_task(grant(), name="fp-lease-grant")
 
     # ---------- actors ----------
 
@@ -1715,6 +1727,7 @@ class Raylet:
         return {"ok": True}
 
     async def handle_kill_actor_worker(self, conn, payload):
+        require_fields(payload, "actor_id", method="handle_kill_actor_worker")
         actor_id = payload["actor_id"]
         for w in list(self.workers.values()):
             if w.actor_id == actor_id:
@@ -1743,6 +1756,8 @@ class Raylet:
     # ---------- placement group bundles ----------
 
     async def handle_prepare_pg_bundle(self, conn, payload):
+        require_fields(payload, "bundle_index", "pg_id", "resources",
+                       method="handle_prepare_pg_bundle")
         resources = normalize_resources(payload["resources"])
         if self.rcore.pg_prepare(payload["pg_id"], payload["bundle_index"],
                                  resources):
@@ -1750,6 +1765,8 @@ class Raylet:
         return {"ok": False, "reason": "insufficient resources"}
 
     async def handle_commit_pg_bundle(self, conn, payload):
+        require_fields(payload, "bundle_index", "pg_id",
+                       method="handle_commit_pg_bundle")
         if not self.rcore.pg_commit(payload["pg_id"],
                                     payload["bundle_index"]):
             return {"ok": False}
@@ -1757,6 +1774,8 @@ class Raylet:
         return {"ok": True}
 
     async def handle_return_pg_bundle(self, conn, payload):
+        require_fields(payload, "bundle_index", "pg_id",
+                       method="handle_return_pg_bundle")
         held = self.rcore.pg_return(payload["pg_id"],
                                     payload["bundle_index"])
         if held is not None:
@@ -1920,6 +1939,7 @@ class Raylet:
     # ---------- objects ----------
 
     async def handle_object_info(self, conn, payload):
+        require_fields(payload, "object_id", method="handle_object_info")
         oid = ObjectID.from_hex(payload["object_id"])
         got = self.store.get_buffer(oid)
         if got is None and await self._restore_spilled(oid):
@@ -1934,6 +1954,8 @@ class Raylet:
     async def handle_fetch_chunk(self, conn, payload):
         """Serve a chunk of a local object to a peer raylet (reference:
         push_manager.h:30 streams chunks over the ObjectManager service)."""
+        require_fields(payload, "object_id", "offset", "size",
+                       method="handle_fetch_chunk")
         oid = ObjectID.from_hex(payload["object_id"])
         got = self.store.get_buffer(oid)
         if got is None and await self._restore_spilled(oid):
@@ -1966,6 +1988,7 @@ class Raylet:
     async def handle_pull_object(self, conn, payload):
         """Pull an object from a remote node into the local store
         (reference: pull_manager.h:52)."""
+        require_fields(payload, "object_id", method="handle_pull_object")
         oid_hex = payload["object_id"]
         oid = ObjectID.from_hex(oid_hex)
         if self.store.contains(oid):
@@ -2123,6 +2146,7 @@ class Raylet:
         return True
 
     async def handle_free_objects(self, conn, payload):
+        require_fields(payload, "object_ids", method="handle_free_objects")
         for oid_hex in payload["object_ids"]:
             self._pulled_copies.pop(oid_hex, None)
             self.store.delete(ObjectID.from_hex(oid_hex), force=True)
@@ -2143,6 +2167,7 @@ class Raylet:
         """(host, store_path) of a peer node — workers use it to map
         same-host arenas for zero-copy reads (one host = one shm
         domain; see worker._try_same_host_read)."""
+        require_fields(payload, "node_id", method="handle_node_store_info")
         nid = payload["node_id"]
         if nid == self.node_id:
             return {"found": True, "host": self.host,
@@ -2162,6 +2187,8 @@ class Raylet:
                 "labels": self.labels}
 
     async def handle_report_worker_death(self, conn, payload):
+        require_fields(payload, "worker_id",
+                       method="handle_report_worker_death")
         w = self.workers.get(payload["worker_id"])
         if w is not None:
             await self._on_worker_death(w, payload.get("reason", "reported"))
@@ -2192,7 +2219,7 @@ class Raylet:
         self.drain_reason = reason
         self.drain_deadline_s = deadline_s
         self._drain_deadline_mono = time.monotonic() + deadline_s
-        self._drain_task = asyncio.ensure_future(
+        self._drain_task = supervised_task(
             self._run_drain(reason, deadline_s))
         self._tasks.append(self._drain_task)
         return {"ok": True, "draining": True}
@@ -2560,7 +2587,8 @@ def main():
             try:
                 asyncio.get_running_loop().add_signal_handler(
                     signal.SIGTERM,
-                    lambda: asyncio.ensure_future(raylet.self_drain()))
+                    lambda: supervised_task(raylet.self_drain(),
+                                            name="sigterm-self-drain"))
             except (NotImplementedError, RuntimeError):
                 pass  # non-main-thread / platform without signal support
         if args.ready_fd >= 0:

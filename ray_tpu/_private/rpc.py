@@ -21,6 +21,8 @@ from typing import Awaitable, Callable
 
 import msgpack
 
+from ray_tpu._private.common import supervised_task
+
 logger = logging.getLogger(__name__)
 
 MSG_REQUEST = 0
@@ -69,7 +71,8 @@ class Connection:
         self._send_lock = asyncio.Lock()
 
     def start(self) -> None:
-        self._recv_task = asyncio.create_task(self._recv_loop())
+        self._recv_task = supervised_task(self._recv_loop(),
+                                          name=f"recv-{self.name}")
 
     def on_close(self, cb: Callable[[], None]) -> None:
         self._close_callbacks.append(cb)
@@ -133,9 +136,9 @@ class Connection:
                 body = await self.reader.readexactly(length)
                 msg_type, seq, method, payload = unpack(body)
                 if msg_type == MSG_REQUEST:
-                    asyncio.create_task(self._dispatch(seq, method, payload))
+                    supervised_task(self._dispatch(seq, method, payload))
                 elif msg_type == MSG_NOTIFY:
-                    asyncio.create_task(self._dispatch(None, method, payload))
+                    supervised_task(self._dispatch(None, method, payload))
                 elif msg_type in (MSG_RESPONSE, MSG_ERROR):
                     fut = self._pending.get(seq)
                     if fut is not None and not fut.done():

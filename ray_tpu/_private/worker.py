@@ -55,7 +55,8 @@ from collections import defaultdict, deque
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc, serialization
 from ray_tpu._private.common import (STREAMING_RETURNS, Address,
-                                     TaskSpec, normalize_resources)
+                                     TaskSpec, normalize_resources,
+                                     require_fields, supervised_task)
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullError
@@ -435,6 +436,14 @@ class CoreWorker:
         return fut.result(timeout)
 
     def _spawn(self, coro):
+        if self._shutdown:
+            # Teardown race: a spawn that lands between loop.stop() and
+            # the drain tick is only ever a ready callback — never a
+            # Task — and GC reports it 'never awaited'. Spawns after
+            # shutdown starts are best-effort by definition; drop them
+            # deterministically instead.
+            coro.close()
+            return
         try:
             asyncio.run_coroutine_threadsafe(coro, self.loop)
         except RuntimeError:
@@ -507,7 +516,8 @@ class CoreWorker:
                 # Local-mode sessions die with their driver (reference: a
                 # ray.init() head tears down when the driver exits).
                 "owns_cluster": self.owns_cluster})
-        asyncio.ensure_future(self._flush_task_events_loop())
+        supervised_task(self._flush_task_events_loop(),
+                        name="flush-task-events")
 
     def shutdown(self):
         if self._shutdown:
@@ -1402,6 +1412,8 @@ class CoreWorker:
     async def _handle_wait_for_ref_removed(self, conn, payload):
         """Borrower-side: park until our count for this object reaches
         zero (the owner holds this call open; our reply IS the release)."""
+        require_fields(payload, "object_id",
+                       method="_handle_wait_for_ref_removed")
         oid_hex = payload["object_id"]
         with self._borrow_lock:
             b = self.borrowed.get(oid_hex)
@@ -1545,6 +1557,8 @@ class CoreWorker:
                 await asyncio.sleep(delay)
 
     async def _handle_borrow_ref(self, conn, payload):
+        require_fields(payload, "borrower", "object_id",
+                       method="_handle_borrow_ref")
         self._add_borrower(payload["object_id"], payload["borrower"],
                            payload.get("borrower_addr"))
 
@@ -1892,7 +1906,7 @@ class CoreWorker:
                 pts = self._pop_batch(shape)
                 if pts:
                     s.busy = True
-                    asyncio.ensure_future(self._push_tasks(s, pts, shape))
+                    supervised_task(self._push_tasks(s, pts, shape))
         # Outstanding lease requests are capped in TOTAL (not per pump
         # call): extra requests just queue at the raylet and churn its
         # pending-lease timers without adding parallelism.
@@ -1900,7 +1914,7 @@ class CoreWorker:
         max_new = min(len(q), 32) - in_flight
         for _ in range(max(0, max_new)):
             self._lease_requests_in_flight[shape] += 1
-            asyncio.ensure_future(self._request_lease(shape, template_spec))
+            supervised_task(self._request_lease(shape, template_spec))
 
     async def _request_lease(self, shape: str, spec: TaskSpec):
         lease_requested_ts = time.time()
@@ -2126,7 +2140,7 @@ class CoreWorker:
             self._fp_backlog.extend(evs)
         if not self._fp_processing and self._fp_backlog:
             self._fp_processing = True
-            asyncio.ensure_future(self._fp_process())
+            supervised_task(self._fp_process())
 
     async def _fp_process(self):
         from ray_tpu._private.native_fastpath import EV_CLOSE, EV_FRAME
@@ -2300,7 +2314,7 @@ class CoreWorker:
                 except Exception:
                     pass
                 await slot.conn.close()
-            asyncio.ensure_future(give_back())
+            supervised_task(give_back())
             for pt in pts:
                 await self._handle_worker_failure(
                     pt, shape, "fastpath connection lost")
@@ -2323,6 +2337,7 @@ class CoreWorker:
         UNSTARTED rest of its batch: re-enqueue them for fresh placement
         (no retry consumed — they never ran). The blocked task stays
         outstanding on the slot."""
+        require_fields(payload, "task_ids", method="_handle_tasks_returned")
         for task_id in payload["task_ids"]:
             pt = slot.outstanding.pop(task_id, None)
             if pt is not None:
@@ -2331,6 +2346,7 @@ class CoreWorker:
 
     async def _handle_task_done(self, slot: _LeaseSlot, shape: str,
                                 conn, payload):
+        require_fields(payload, "results", method="_handle_task_done")
         for task_id, result in payload["results"]:
             pt = slot.outstanding.pop(task_id, None)
             if pt is not None:
@@ -2338,7 +2354,7 @@ class CoreWorker:
                                           borrower_id=slot.worker_id,
                                           borrower_addr=slot.worker_addr)
         if not slot.outstanding:
-            asyncio.ensure_future(self._on_slot_idle(slot, shape))
+            supervised_task(self._on_slot_idle(slot, shape))
 
     def _on_slot_conn_closed(self, slot: _LeaseSlot, shape: str):
         """Worker connection died: drop the slot (idle or not) and
@@ -2355,7 +2371,7 @@ class CoreWorker:
             for pt in pts:
                 await self._handle_worker_failure(
                     pt, shape, "worker connection lost")
-        asyncio.ensure_future(fail_all())
+        supervised_task(fail_all())
 
     async def _handle_worker_failure(self, pt: _PendingTask, shape: str, reason: str):
         if pt.retries_left != 0:
@@ -2557,6 +2573,8 @@ class CoreWorker:
         it a return id, register ownership, and hand the ref to the
         driver-side generator (reference: streaming ObjectRefGenerator,
         task_manager.cc HandleReportGeneratorItemReturns)."""
+        require_fields(payload, "index", "result", "task_id",
+                       method="_handle_task_yield")
         pt = self.pending_tasks.get(payload["task_id"])
         if pt is None or pt.stream_q is None:
             return  # task already completed/failed; late yield dropped
@@ -2665,11 +2683,15 @@ class CoreWorker:
         """A node finished pulling a copy: record it so later pullers
         stripe across all holders (reference: object directory location
         updates, ownership_based_object_directory.h)."""
+        require_fields(payload, "node_id", "object_id",
+                       method="_handle_add_object_location")
         o = self.objects.get(payload["object_id"])
         if o is not None and o.state == OBJ_READY:
             o.locations.add(payload["node_id"])
 
     async def _handle_get_object_status(self, conn, payload):
+        require_fields(payload, "object_id",
+                       method="_handle_get_object_status")
         oid_hex = payload["object_id"]
         wait_s = payload.get("wait_s", 0)
         o = self.objects.get(oid_hex)
@@ -2837,6 +2859,7 @@ class CoreWorker:
     # ---------- execution (worker side) ----------
 
     async def _handle_push_task(self, conn, payload):
+        require_fields(payload, "spec", method="_handle_push_task")
         spec = TaskSpec.from_wire(payload["spec"])
         fut = asyncio.get_running_loop().create_future()
         self._exec_enqueue((spec, fut))
@@ -2847,6 +2870,7 @@ class CoreWorker:
         STREAMING each completion back as a TaskDone notify (coalesced by
         _queue_task_done). The whole batch is ONE exec-queue item so a
         burst of trivial tasks costs one thread handoff, not N."""
+        require_fields(payload, "specs", method="_handle_push_task_batch")
         specs = [TaskSpec.from_wire(w) for w in payload["specs"]]
         self._exec_enqueue((specs, conn))
 
@@ -2872,8 +2896,11 @@ class CoreWorker:
             results = self._done_buf.pop(conn, [])
             self._done_scheduled.discard(conn)
         if results and not conn.closed:
-            asyncio.ensure_future(
-                conn.notify("TaskDone", {"results": results}))
+            # Owner death between the closed check and the send is an
+            # expected end-state, not a daemon bug.
+            supervised_task(
+                conn.notify("TaskDone", {"results": results}),
+                name="notify-task-done", ignore=(rpc.ConnectionLost,))
 
     async def _handle_cancel_task(self, conn, payload):
         return {"ok": False, "reason": "running-task cancel not supported yet"}
@@ -2970,10 +2997,12 @@ class CoreWorker:
                 # TaskDone): loop FIFO keeps them ahead of the
                 # task's completion on the same connection.
                 self.loop.call_soon_threadsafe(
-                    lambda: asyncio.ensure_future(conn.notify(
+                    lambda: supervised_task(conn.notify(
                         "TaskYield",
                         {"task_id": task_id, "index": index,
-                         "result": entry})))
+                         "result": entry}),
+                        name="notify-yield",
+                        ignore=(rpc.ConnectionLost,)))
 
             remaining = _collections.deque(spec)
 
@@ -2984,8 +3013,10 @@ class CoreWorker:
                 remaining.clear()
                 if ids:
                     self.loop.call_soon_threadsafe(
-                        lambda: asyncio.ensure_future(conn.notify(
-                            "TasksReturned", {"task_ids": ids})))
+                        lambda: supervised_task(conn.notify(
+                            "TasksReturned", {"task_ids": ids}),
+                            name="notify-tasks-returned",
+                            ignore=(rpc.ConnectionLost,)))
 
             self._exec_tls.batch_return = return_unstarted
             try:
@@ -3001,10 +3032,12 @@ class CoreWorker:
             if len(item) > 2 and spec.num_returns == STREAMING_RETURNS:
                 def emit(task_id, index, entry, conn=item[2]):
                     self.loop.call_soon_threadsafe(
-                        lambda: asyncio.ensure_future(conn.notify(
+                        lambda: supervised_task(conn.notify(
                             "TaskYield",
                             {"task_id": task_id, "index": index,
-                             "result": entry})))
+                             "result": entry}),
+                            name="notify-yield",
+                            ignore=(rpc.ConnectionLost,)))
 
             result = self._execute_task(spec, emit)
             self.loop.call_soon_threadsafe(
@@ -3465,6 +3498,7 @@ class CoreWorker:
     # ---------- actors: worker side ----------
 
     async def _handle_assign_actor(self, conn, payload):
+        require_fields(payload, "spec", method="_handle_assign_actor")
         spec = TaskSpec.from_wire(payload["spec"])
         self._actor_id = spec.actor_id
         fut = asyncio.get_running_loop().create_future()
@@ -3483,7 +3517,11 @@ class CoreWorker:
                     bytes(err[0]), bytes(err[1]))
                 reason = f"{type(cause).__name__}: {cause}\n{tb}"
             except Exception:
-                pass
+                # Keep the generic reason; losing the pretty traceback
+                # must not lose the death report itself.
+                logger.warning("assign_actor(%s): could not deserialize "
+                               "constructor error", spec.actor_id,
+                               exc_info=True)
             await self.gcs.call("ReportActorDeath", {
                 "actor_id": spec.actor_id, "reason": reason, "intended": True})
             self.loop.call_later(0.2, lambda: os._exit(1))
@@ -3496,6 +3534,8 @@ class CoreWorker:
         """Ordered per-caller actor task execution (reference:
         direct_actor_task_submitter.h:68 client seq-nos + server
         actor_scheduling_queue)."""
+        require_fields(payload, "caller_id", "spec",
+                       method="_handle_actor_call")
         spec = TaskSpec.from_wire(payload["spec"])
         caller = payload["caller_id"]
         state = self._actor_callers.setdefault(
@@ -3519,6 +3559,8 @@ class CoreWorker:
         terminally without ever being sent, e.g. retries exhausted across
         an actor restart).  Mark the slot so the ordered queue can advance
         — otherwise every later task from that caller waits forever."""
+        require_fields(payload, "caller_id", "seq",
+                       method="_handle_actor_seq_skip")
         state = self._actor_callers.setdefault(
             payload["caller_id"], {"next_seq": 0, "buffer": {}})
         seq = payload["seq"]
@@ -3616,9 +3658,8 @@ class CoreWorker:
         old = st.get("conn")
         st["conn"] = None
         if old is not None and not old.closed:
-            task = asyncio.ensure_future(old.close())
-            self._bg_tasks.add(task)
-            task.add_done_callback(self._bg_tasks.discard)
+            supervised_task(old.close(), name="retire-actor-conn",
+                            tasks=self._bg_tasks)
 
     def _actor_state(self, actor_id: str):
         st = self.actor_handles_state.get(actor_id)

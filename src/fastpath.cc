@@ -28,7 +28,9 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <pthread.h>
 #include <stdint.h>
+#include <time.h>
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
@@ -497,9 +499,29 @@ int fpump_next(FPump* p, int64_t* conn_id, int* kind, void* out,
     auto pred = [p] { return !p->recv_q.empty() || p->stopping.load(); };
     if (timeout_ms < 0) {
       p->recv_cv.wait(lk, pred);
-    } else if (!p->recv_cv.wait_for(
-                   lk, std::chrono::milliseconds(timeout_ms), pred)) {
-      return 0;
+    } else {
+      // Timed wait through the native handles: libstdc++'s wait_for
+      // lowers to pthread_cond_clockwait (CLOCK_MONOTONIC), which TSan
+      // does not intercept — the unlock/relock inside the wait becomes
+      // invisible and every recv_mu-guarded access then reports as a
+      // race. pthread_cond_timedwait IS intercepted; a REALTIME clock
+      // jump only skews waits of tens of ms, which callers already
+      // tolerate (0 just means "poll again").
+      struct timespec ts;
+      clock_gettime(CLOCK_REALTIME, &ts);
+      ts.tv_sec += timeout_ms / 1000;
+      ts.tv_nsec += (long)(timeout_ms % 1000) * 1000000L;
+      if (ts.tv_nsec >= 1000000000L) {
+        ts.tv_sec++;
+        ts.tv_nsec -= 1000000000L;
+      }
+      while (!pred()) {
+        if (pthread_cond_timedwait(p->recv_cv.native_handle(),
+                                   p->recv_mu.native_handle(),
+                                   &ts) == ETIMEDOUT)
+          break;
+      }
+      if (!pred()) return 0;
     }
     if (p->recv_q.empty()) return 0;  // stopping
   }

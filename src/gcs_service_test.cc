@@ -53,6 +53,7 @@ int gsvc_sub_count(void* h, const char* channel, int ch_len);
 void gsvc_kv_stats(void* h, int64_t* n_ns, int64_t* n_rows);
 void gsvc_counters(void* h, uint64_t* handled, uint64_t* wal_appends,
                    uint64_t* wal_failures);
+uint64_t gsvc_proto_errors(void* h);
 }
 
 namespace {
@@ -349,6 +350,201 @@ void TestPubSubThroughPump() {
   gsvc_destroy(svc);
 }
 
+// ---- malformed / corrupt frame robustness ----
+//
+// The wire contract under garbage input: an unparseable ENVELOPE is
+// passed through to Python (return 0, no reply — Python owns the
+// can't-even-read-the-header error path); an owned method whose
+// PAYLOAD fails to parse must be answered with a Malformed error frame
+// (return 1) and never crash or mutate state.  Runs the decoder over
+// every truncation point, deterministic single-byte corruptions, and
+// PRNG garbage — under ASan/UBSan (make test-asan) this is the fuzz
+// gate for msgpack_lite.h's has()/skip() truncation guards.
+
+int g_sent_frames = 0;
+std::string g_last_sent;
+
+int CountingSend(void* /*pump*/, int64_t /*conn_id*/, const void* buf,
+                 uint32_t len) {
+  g_sent_frames++;
+  g_last_sent.assign((const char*)buf, len);
+  return 0;
+}
+
+// Decode an error envelope; returns the error text.
+bool DecodeError(const std::string& body, int64_t* seq, std::string* text) {
+  mplite::View v{(const uint8_t*)body.data(), body.size(), 0};
+  uint32_t alen;
+  int64_t msg_type;
+  std::string_view method, msg;
+  if (!mplite::read_array(v, &alen) || alen != 4) return false;
+  if (!mplite::read_int(v, &msg_type) || msg_type != 2) return false;
+  if (!mplite::read_int(v, seq)) return false;
+  if (!mplite::read_str(v, &method)) return false;
+  if (!mplite::read_str(v, &msg)) return false;
+  text->assign(msg);
+  return true;
+}
+
+void TestMalformedFrames() {
+  void* svc = gsvc_create((void*)&CountingSend, nullptr, nullptr, nullptr,
+                          nullptr);
+  g_sent_frames = 0;
+
+  // Envelope and payload built separately so truncation points can be
+  // classified: inside the envelope -> pass-through, inside the
+  // payload of an owned method -> Malformed reply.
+  std::string env;
+  mplite::w_array(env, 4);
+  mplite::w_int(env, 0);  // MSG_REQUEST
+  mplite::w_int(env, 99);
+  mplite::w_str(env, "KVPut");
+  std::string payload;
+  mplite::w_map(payload, 3);
+  mplite::w_str(payload, "ns");
+  mplite::w_str(payload, "fn");
+  mplite::w_str(payload, "key");
+  mplite::w_bin(payload, "k1");
+  mplite::w_str(payload, "value");
+  mplite::w_bin(payload, "v1");
+  std::string frame = env + payload;
+
+  // 1) Truncation at every offset inside the envelope: unparseable
+  // header, pass to Python, nothing sent.
+  for (size_t cut = 0; cut < env.size(); cut++) {
+    CHECK(gsvc_on_frame(svc, 1, frame.data(), (uint32_t)cut) == 0);
+  }
+  CHECK(g_sent_frames == 0);
+  CHECK(gsvc_proto_errors(svc) == 0);
+
+  // 2) Truncation at every offset inside the payload: envelope names an
+  // owned method, so each must answer exactly one Malformed error frame
+  // echoing the request seq — never a KeyError-style crash.
+  int malformed = 0;
+  for (size_t cut = env.size(); cut < frame.size(); cut++) {
+    CHECK(gsvc_on_frame(svc, 1, frame.data(), (uint32_t)cut) == 1);
+    malformed++;
+    CHECK(g_sent_frames == malformed);
+    int64_t seq;
+    std::string text;
+    CHECK(DecodeError(g_last_sent, &seq, &text));
+    CHECK(seq == 99);
+    CHECK(text.find("malformed payload for KVPut") != std::string::npos);
+  }
+  CHECK(gsvc_proto_errors(svc) == (uint64_t)malformed);
+
+  // 3) A malformed NOTIFY has no seq to answer: counted, not replied.
+  std::string nenv;
+  mplite::w_array(nenv, 4);
+  mplite::w_int(nenv, 3);  // MSG_NOTIFY
+  mplite::w_int(nenv, 0);
+  mplite::w_str(nenv, "Publish");
+  int sent_before = g_sent_frames;
+  CHECK(gsvc_on_frame(svc, 1, nenv.data(), (uint32_t)nenv.size()) == 1);
+  CHECK(g_sent_frames == sent_before);
+  CHECK(gsvc_proto_errors(svc) == (uint64_t)malformed + 1);
+
+  // 4) Deterministic single-byte corruption at every offset: any
+  // outcome (pass-through, Malformed, or an accidentally-valid frame)
+  // is acceptable; crashing or over-reading (ASan) is not.
+  for (size_t i = 0; i < frame.size(); i++) {
+    for (uint8_t mask : {0xFF, 0x80, 0x01}) {
+      std::string m = frame;
+      m[i] = (char)(m[i] ^ mask);
+      int r = gsvc_on_frame(svc, 1, m.data(), (uint32_t)m.size());
+      CHECK(r == 0 || r == 1);
+    }
+  }
+
+  // 5) PRNG garbage (fixed seed: reproducible, CI-stable). Short
+  // buffers exercise the header guards, longer ones the nested
+  // skip()/depth paths when bytes happen to form container headers.
+  uint64_t rng = 0x9e3779b97f4a7c15ull;
+  auto next = [&rng]() {
+    rng = rng * 6364136223846793005ull + 1442695040888963407ull;
+    return (uint8_t)(rng >> 33);
+  };
+  for (int it = 0; it < 512; it++) {
+    std::string buf;
+    size_t n = next() % 97;
+    for (size_t i = 0; i < n; i++) buf.push_back((char)next());
+    int r = gsvc_on_frame(svc, 1, buf.data(), (uint32_t)buf.size());
+    CHECK(r == 0 || r == 1);
+  }
+
+  // 6) The service still works after the storm: a valid KVPut is
+  // handled and answered with a response frame.
+  sent_before = g_sent_frames;
+  CHECK(gsvc_on_frame(svc, 1, frame.data(), (uint32_t)frame.size()) == 1);
+  CHECK(g_sent_frames == sent_before + 1);
+  int64_t seq;
+  std::string result;
+  CHECK(DecodeResponse(g_last_sent, &seq, &result));
+  CHECK(seq == 99);
+  gsvc_destroy(svc);
+}
+
+// Same storm over real loopback TCP: corrupt frames must not wedge the
+// pump loop thread or poison the connection for later valid requests.
+void TestMalformedFramesThroughPump() {
+  void* server = fpump_create();
+  void* svc = gsvc_create((void*)&fpump_send, server, nullptr, nullptr,
+                          nullptr);
+  fpump_set_service(server, (void*)&gsvc_on_frame, (void*)&gsvc_on_close,
+                    svc);
+  int port = fpump_listen(server, "127.0.0.1", 0);
+  CHECK(port > 0);
+  void* client = fpump_create();
+  int64_t conn = fpump_connect(client, "127.0.0.1", port);
+  CHECK(conn > 0);
+
+  std::string payload;
+  mplite::w_map(payload, 2);
+  mplite::w_str(payload, "ns");
+  mplite::w_str(payload, "fn");
+  mplite::w_str(payload, "key");
+  mplite::w_bin(payload, "k1");
+  std::string req = PackRequest(5, "KVGet", payload);
+
+  // Truncated bodies of an owned-method request (well-framed on the
+  // wire — the 4-byte length prefix is the pump's, the rot is inside).
+  // Each must come back as an error frame, in order.
+  int expect_errors = 0;
+  for (size_t cut = req.size() - 1; cut > req.size() - (size_t)4; cut--) {
+    CHECK(fpump_send(client, conn, req.data(), (uint32_t)cut) == 0);
+    expect_errors++;
+  }
+  for (int i = 0; i < expect_errors; i++) {
+    std::string body, text;
+    int64_t seq;
+    CHECK(NextFrame(client, &body));
+    CHECK(DecodeError(body, &seq, &text));
+    CHECK(seq == 5);
+  }
+  // Pure garbage body: not even an envelope — passed to the Python
+  // queue, no reply.
+  const char junk[] = "\xc1\xc1\xc1\xc1junkjunk";
+  CHECK(fpump_send(client, conn, junk, (uint32_t)sizeof(junk) - 1) == 0);
+  std::string passed;
+  CHECK(NextFrame(server, &passed));
+  CHECK(passed == std::string(junk, sizeof(junk) - 1));
+
+  // The same connection still serves a valid request afterwards.
+  CHECK(fpump_send(client, conn, req.data(), (uint32_t)req.size()) == 0);
+  std::string body, result;
+  int64_t seq;
+  CHECK(NextFrame(client, &body));
+  CHECK(DecodeResponse(body, &seq, &result));
+  CHECK(seq == 5);
+  // {"value": nil} — the table is empty; what matters is a well-formed
+  // response, not an error or a hang.
+  CHECK(result.size() >= 1);
+
+  fpump_destroy(client);
+  fpump_destroy(server);
+  gsvc_destroy(svc);
+}
+
 void TestRestoreLoad() {
   void* svc = gsvc_create((void*)&fpump_send, nullptr, nullptr, nullptr,
                           nullptr);
@@ -372,6 +568,8 @@ int main() {
   std::string prefix = std::string(tmpl) + "/gcs_state";
   TestKvThroughPump(prefix.c_str());
   TestPubSubThroughPump();
+  TestMalformedFrames();
+  TestMalformedFramesThroughPump();
   TestRestoreLoad();
   if (failures == 0) {
     std::printf("gcs_service_test: all OK\n");

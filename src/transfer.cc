@@ -131,10 +131,15 @@ struct Server {
   // down and waits for the live count to reach zero (joining blocked
   // threads would hang forever on silently-dead peers, and keeping
   // joinable thread objects around would leak a stack per connection).
+  // The drain is a plain atomic poll, not a condition variable: the
+  // exiting thread's LAST touch of this object must be a single
+  // release-store so the stopper's acquire-load of zero proves nothing
+  // still dereferences srv — a cv would put the notify (and libstdc++'s
+  // timed wait goes through pthread_cond_clockwait, which TSan does not
+  // model) between that point and thread exit.
   std::mutex conns_mu;
-  std::condition_variable conns_cv;
   std::set<int> conn_fds;
-  int live_conns = 0;
+  std::atomic<int> live_conns{0};
 
   // Returns true when every connection thread has exited — only then is
   // it safe to free this object (a timed-out wait means wedged detached
@@ -148,10 +153,15 @@ struct Server {
     if (accept_thread.joinable()) accept_thread.join();
     // Only after the join: the accept loop reads listen_fd concurrently.
     listen_fd = -1;
-    std::unique_lock<std::mutex> g(conns_mu);
-    for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
-    return conns_cv.wait_for(g, std::chrono::seconds(5),
-                             [this] { return live_conns == 0; });
+    {
+      std::lock_guard<std::mutex> g(conns_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (int waited_ms = 0; waited_ms < 5000; waited_ms += 10) {
+      if (live_conns.load(std::memory_order_acquire) == 0) return true;
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    return live_conns.load(std::memory_order_acquire) == 0;
   }
 
   ~Server() {
@@ -208,15 +218,15 @@ void serve_conn(Server* srv, int fd) {
     if (!ok) break;
   }
   {
+    // Erase BEFORE close: once closed, the fd number can be reused by a
+    // concurrent accept, and the stopper's shutdown loop must never hit
+    // a stranger's socket.
     std::lock_guard<std::mutex> g(srv->conns_mu);
     srv->conn_fds.erase(fd);
-    srv->live_conns--;
-    // Notify UNDER the lock: the destructor may destroy this cv the
-    // moment its predicate holds, and an unlocked broadcast could still
-    // be touching it (TSan-verified ordering).
-    srv->conns_cv.notify_all();
   }
   ::close(fd);
+  // Release-store LAST: after this the stopper may free *srv.
+  srv->live_conns.fetch_sub(1, std::memory_order_release);
 }
 
 // Fetch-side attach cache: one mapping per store path per process.
@@ -320,8 +330,8 @@ void* transfer_server_start(const char* store_path, int* out_port) {
       {
         std::lock_guard<std::mutex> g(srv->conns_mu);
         srv->conn_fds.insert(fd);
-        srv->live_conns++;
       }
+      srv->live_conns.fetch_add(1, std::memory_order_relaxed);
       std::thread(serve_conn, srv, fd).detach();
     }
   });

@@ -1,0 +1,460 @@
+"""graftlint gate + rule fixtures (tier-1).
+
+Two jobs:
+
+1. The GATE: `ray_tpu/` must lint clean against the checked-in
+   baseline. A new raw create_task, a blocking sleep on a daemon loop,
+   or an unvalidated `payload[...]` in a handler fails this test — the
+   bug classes hand-fixed in PRs 1-4 stay un-reintroducible.
+
+2. Rule unit coverage: every rule gets a positive fixture (violation
+   detected), a negative fixture (compliant code passes), and a
+   suppression fixture (`# graftlint: disable=Rn` works). R2/R3 found
+   zero violations on the current tree, so without fixtures nothing
+   would prove they fire at all.
+
+Fixtures are linted in-memory via lint_source(); `filename` (or the
+`# graftlint: daemon-module` marker) makes a snippet count as a daemon
+module for R2.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private.lint import (ALL_RULES, DEFAULT_BASELINE_PATH,
+                                   counts_by_rule_path, lint_source,
+                                   load_baseline, regressions, run_lint)
+
+import ray_tpu
+
+PKG_DIR = ray_tpu.__path__[0]
+
+DAEMON_NAME = "ray_tpu/_private/raylet.py"  # impersonate a daemon module
+
+
+def rules_of(report):
+    return [v.rule for v in report.violations]
+
+
+# ---------------------------------------------------------------------------
+# The gate: the real tree must be clean modulo the checked-in baseline.
+# ---------------------------------------------------------------------------
+
+
+def test_tree_lints_clean_against_baseline():
+    report = run_lint([PKG_DIR])
+    assert not report.parse_errors, report.parse_errors
+    new = regressions(report.violations, load_baseline())
+    assert not new, (
+        "graftlint regressions (run `python -m ray_tpu._private.lint "
+        "ray_tpu/` for details):\n"
+        + "\n".join(v.format() for v in new))
+
+
+def test_daemon_modules_have_zero_r1_baseline():
+    """The burn-down is done: no daemon module may carry R1 debt."""
+    baseline = load_baseline()
+    r1 = baseline.get("R1", {})
+    daemon_entries = {p: n for p, n in r1.items() if "_private" in p}
+    assert not daemon_entries, daemon_entries
+
+
+def test_cli_exits_zero_on_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu._private.lint", PKG_DIR],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# R1: raw spawns
+# ---------------------------------------------------------------------------
+
+
+R1_BAD = """
+import asyncio
+
+async def main():
+    asyncio.create_task(work())
+    t = asyncio.ensure_future(work())
+"""
+
+R1_GOOD = """
+from ray_tpu._private.common import supervised_task
+
+async def main():
+    supervised_task(work(), name="work")
+"""
+
+
+def test_r1_flags_raw_spawns():
+    assert rules_of(lint_source(R1_BAD)) == ["R1", "R1"]
+
+
+def test_r1_passes_supervised():
+    assert rules_of(lint_source(R1_GOOD)) == []
+
+
+def test_r1_suppression():
+    src = R1_BAD.replace("asyncio.create_task(work())",
+                         "asyncio.create_task(work())  # graftlint: disable=R1")
+    report = lint_source(src)
+    assert rules_of(report) == ["R1"]  # only the unsuppressed ensure_future
+    assert report.suppressed == 1
+
+
+def test_r1_comment_line_covers_next_line():
+    src = (
+        "import asyncio\n"
+        "async def main():\n"
+        "    # graftlint: disable=R1\n"
+        "    asyncio.create_task(work())\n"
+    )
+    report = lint_source(src)
+    assert rules_of(report) == []
+    assert report.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# R2: blocking calls on daemon loops
+# ---------------------------------------------------------------------------
+
+
+R2_BAD = """
+import time
+import subprocess as sp
+from time import sleep
+
+async def handle_lease(self, conn, payload):
+    time.sleep(1)
+    sp.run(["ls"])
+    sleep(0.1)
+"""
+
+R2_GOOD = """
+import asyncio
+import time
+
+async def handle_lease(self, conn, payload):
+    await asyncio.sleep(1)
+
+def sync_helper():
+    time.sleep(1)  # fine: not on the event loop
+"""
+
+
+def test_r2_flags_blocking_in_daemon_async():
+    report = lint_source(R2_BAD, filename=DAEMON_NAME)
+    assert rules_of(report) == ["R2", "R2", "R2"]
+
+
+def test_r2_resolves_import_aliases():
+    msgs = [v.message for v in lint_source(R2_BAD, filename=DAEMON_NAME).violations]
+    assert any("subprocess.run" in m for m in msgs)
+    assert any("time.sleep" in m for m in msgs)
+
+
+def test_r2_ignores_non_daemon_modules():
+    assert rules_of(lint_source(R2_BAD, filename="ray_tpu/util/misc.py")) == []
+
+
+def test_r2_daemon_marker_comment():
+    src = "# graftlint: daemon-module\n" + R2_BAD
+    assert "R2" in rules_of(lint_source(src, filename="ray_tpu/util/misc.py"))
+
+
+def test_r2_passes_async_equivalents():
+    assert rules_of(lint_source(R2_GOOD, filename=DAEMON_NAME)) == []
+
+
+def test_r2_sync_scope_inside_async_module_ok():
+    # A nested sync def (executor target) may block.
+    src = (
+        "import time\n"
+        "async def handle_x(self, conn, payload):\n"
+        "    def gather():\n"
+        "        time.sleep(1)\n"
+        "    return gather\n"
+    )
+    assert rules_of(lint_source(src, filename=DAEMON_NAME)) == []
+
+
+# ---------------------------------------------------------------------------
+# R3: shared-container iteration across await
+# ---------------------------------------------------------------------------
+
+
+R3_BAD = """
+class Raylet:
+    async def reap(self):
+        for wid, w in self._workers.items():
+            await w.close()
+"""
+
+R3_GOOD = """
+class Raylet:
+    async def reap(self):
+        for wid, w in list(self._workers.items()):
+            await w.close()
+
+    async def no_await(self):
+        for w in self._workers:
+            w.touch()
+"""
+
+
+def test_r3_flags_unsnapshotted_iteration():
+    report = lint_source(R3_BAD)
+    assert rules_of(report) == ["R3"]
+    assert "self._workers.items()" in report.violations[0].message
+
+
+def test_r3_passes_snapshot_and_awaitless():
+    assert rules_of(lint_source(R3_GOOD)) == []
+
+
+def test_r3_subscripted_container():
+    src = (
+        "class S:\n"
+        "    async def run(self, k):\n"
+        "        for item in self._queues[k]:\n"
+        "            await item.go()\n"
+    )
+    assert rules_of(lint_source(src)) == ["R3"]
+
+
+def test_r3_nested_sync_def_await_not_counted():
+    src = (
+        "class S:\n"
+        "    async def run(self):\n"
+        "        for item in self._queues:\n"
+        "            async def later():\n"
+        "                await item.go()\n"
+        "            register(later)\n"
+    )
+    assert rules_of(lint_source(src)) == []
+
+
+def test_r3_suppression():
+    src = R3_BAD.replace(
+        "for wid, w in self._workers.items():",
+        "for wid, w in self._workers.items():  # graftlint: disable=R3")
+    report = lint_source(src)
+    assert rules_of(report) == []
+    assert report.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# R4: swallowed exceptions in handlers
+# ---------------------------------------------------------------------------
+
+
+R4_BAD = """
+class Gcs:
+    async def handle_drain_node(self, conn, payload):
+        for node in list(self.nodes):
+            try:
+                await node.evacuate()
+            except Exception:
+                continue
+        try:
+            await self.publish()
+        except Exception:
+            pass
+"""
+
+R4_GOOD = """
+import logging
+logger = logging.getLogger(__name__)
+
+class Gcs:
+    async def handle_drain_node(self, conn, payload):
+        try:
+            await self.publish()
+        except Exception:
+            logger.warning("publish failed", exc_info=True)
+        try:
+            await self.touch()
+        except ConnectionResetError:
+            pass  # narrow except is allowed
+
+    async def not_a_handler(self):
+        try:
+            await self.publish()
+        except Exception:
+            pass  # outside handle_*: R4 does not apply
+"""
+
+
+def test_r4_flags_silent_broad_excepts():
+    assert rules_of(lint_source(R4_BAD)) == ["R4", "R4"]
+
+
+def test_r4_passes_logged_narrow_and_non_handler():
+    assert rules_of(lint_source(R4_GOOD)) == []
+
+
+def test_r4_bare_except():
+    src = (
+        "async def handle_x(self, conn, payload):\n"
+        "    try:\n"
+        "        await go()\n"
+        "    except:\n"
+        "        pass\n"
+    )
+    assert rules_of(lint_source(src)) == ["R4"]
+
+
+def test_r4_suppression():
+    src = R4_BAD.replace("except Exception:\n                continue",
+                         "except Exception:  # graftlint: disable=R4\n"
+                         "                continue")
+    assert rules_of(lint_source(src)) == ["R4"]  # the `pass` one remains
+
+
+# ---------------------------------------------------------------------------
+# R5: unvalidated payload access in handlers
+# ---------------------------------------------------------------------------
+
+
+R5_BAD = """
+class Gcs:
+    async def handle_kv_put(self, conn, payload):
+        self.kv[payload["key"]] = payload["value"]
+        return {"ok": True}
+"""
+
+R5_GOOD = """
+from ray_tpu._private.common import require_fields
+
+class Gcs:
+    async def handle_kv_put(self, conn, payload):
+        require_fields(payload, "key", "value", method="handle_kv_put")
+        self.kv[payload["key"]] = payload["value"]
+        return {"ok": True}
+
+    async def handle_kv_get(self, conn, payload):
+        if "key" not in payload:
+            return {"error": "Malformed"}
+        return {"value": self.kv.get(payload["key"])}
+
+    async def handle_stats(self, conn, payload):
+        return {"entries": payload.get("entries")}
+"""
+
+
+def test_r5_flags_unvalidated_subscripts():
+    report = lint_source(R5_BAD)
+    assert rules_of(report) == ["R5", "R5"]
+    keys = {v.message.split("'")[1] for v in report.violations}
+    assert keys == {"key", "value"}
+
+
+def test_r5_passes_require_fields_membership_and_get():
+    assert rules_of(lint_source(R5_GOOD)) == []
+
+
+def test_r5_branch_local_require_fields_counts():
+    # The validated-set is function-wide: a branch-local require_fields
+    # (handle_repin's conditional routes) satisfies the rule.
+    src = (
+        "async def handle_repin(self, conn, payload):\n"
+        "    if payload.get('route') == 'collective':\n"
+        "        require_fields(payload, 'tags', method='handle_repin')\n"
+        "        return payload['tags']\n"
+        "    return None\n"
+    )
+    assert rules_of(lint_source(src)) == []
+
+
+def test_r5_non_handler_free_to_subscript():
+    src = (
+        "async def apply(self, payload):\n"
+        "    return payload['key']\n"
+    )
+    assert rules_of(lint_source(src)) == []
+
+
+def test_r5_suppression():
+    src = R5_BAD.replace(
+        'self.kv[payload["key"]] = payload["value"]',
+        'self.kv[payload["key"]] = payload["value"]  # graftlint: disable=R5')
+    report = lint_source(src)
+    assert rules_of(report) == []
+    assert report.suppressed == 2
+
+
+# ---------------------------------------------------------------------------
+# Baseline ratchet
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_is_a_ratchet(tmp_path):
+    """Counts above baseline are regressions; at-or-below are not."""
+    report = lint_source(R1_BAD)  # two R1 violations at <fixture>.py
+    counts = counts_by_rule_path(report.violations)
+    assert counts == {"R1": {"<fixture>.py": 2}}
+
+    # Exactly-baselined: no regressions.
+    assert regressions(report.violations, {"R1": {"<fixture>.py": 2}}) == []
+    # Over-baselined (debt paid down elsewhere): still no regressions.
+    assert regressions(report.violations, {"R1": {"<fixture>.py": 5}}) == []
+    # One more violation than baselined: exactly one regression, and it
+    # is the LAST one (newest line) — the old debt stays allowlisted.
+    new = regressions(report.violations, {"R1": {"<fixture>.py": 1}})
+    assert len(new) == 1
+    assert new[0].line == max(v.line for v in report.violations)
+    # Unknown (rule, path): everything is a regression.
+    assert len(regressions(report.violations, {})) == 2
+
+
+def test_checked_in_baseline_total_only_decreases():
+    """The checked-in baseline reached zero in this PR; it must never
+    grow again. If a future PR must baseline NEW debt, that is exactly
+    the situation this gate exists to prevent — fix the violation
+    instead."""
+    with open(DEFAULT_BASELINE_PATH, encoding="utf-8") as f:
+        data = json.load(f)
+    total = sum(n for paths in data.get("rules", {}).values()
+                for n in paths.values())
+    assert total == 0, (
+        f"baseline grew to {total} allowlisted violations; the ratchet "
+        "only turns one way")
+
+
+def test_update_baseline_drops_zeroed_entries(tmp_path):
+    from ray_tpu._private.lint.baseline import load_baseline as load
+    from ray_tpu._private.lint.baseline import save_baseline as save
+
+    path = str(tmp_path / "baseline.json")
+    save({"R1": {"a.py": 2, "b.py": 0}, "R4": {}}, path=path)
+    assert load(path) == {"R1": {"a.py": 2}}
+
+
+def test_all_rules_registered():
+    assert [r.id for r in ALL_RULES] == ["R1", "R2", "R3", "R4", "R5"]
+
+
+# ---------------------------------------------------------------------------
+# Engine details that correctness of the gate depends on
+# ---------------------------------------------------------------------------
+
+
+def test_parse_error_is_reported_not_raised():
+    report = lint_source("def broken(:\n")
+    assert report.parse_errors
+    assert report.files_checked == 0
+
+
+def test_require_fields_runtime_behavior():
+    from ray_tpu._private.common import MalformedError, require_fields
+
+    ok = {"key": "k", "value": b"v"}
+    assert require_fields(ok, "key", "value", method="KvPut") is ok
+    with pytest.raises(MalformedError, match="Malformed request in KvPut"):
+        require_fields({"key": "k"}, "key", "value", method="KvPut")
+    with pytest.raises(MalformedError, match="payload must be a map"):
+        require_fields(["not", "a", "map"], "key", method="KvPut")

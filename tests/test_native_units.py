@@ -1,8 +1,14 @@
 """Build and run the C++ unit tests (src/*_test.cc).
 
 Sanitizer variants (`make test-asan` / `make test-tsan`) are the
-race-detection CI story (reference: .bazelrc tsan/asan configs); they run
-here only when RAY_TPU_SANITIZE=1 to keep the default suite fast.
+race-detection CI story (reference: .bazelrc tsan/asan configs): the
+pthread-using libs (object_store, transfer, fastpath, raylet_core) and
+the in-pump GCS service — including the malformed-frame robustness test
+in gcs_service_test.cc — run under ASan/UBSan and TSan. They are
+`slow`-marked (a sanitizer rebuild + run takes minutes), so the tier-1
+gate (`-m 'not slow'`) skips them while `pytest -m slow
+tests/test_native_units.py` or plain `make test-asan` runs them on
+demand.
 """
 
 import os
@@ -13,14 +19,17 @@ import pytest
 
 SRC = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", "src"))
 
+_toolchain = pytest.mark.skipif(
+    shutil.which("make") is None or shutil.which("g++") is None,
+    reason="native toolchain unavailable")
 
-def _make(target: str):
+
+def _make(target: str, timeout: int = 300):
     return subprocess.run(["make", target], cwd=SRC, capture_output=True,
-                          text=True, timeout=300)
+                          text=True, timeout=timeout)
 
 
-@pytest.mark.skipif(shutil.which("make") is None or shutil.which("g++") is None,
-                    reason="native toolchain unavailable")
+@_toolchain
 def test_cpp_unit_tests():
     res = _make("test")
     assert res.returncode == 0, res.stdout + res.stderr
@@ -28,11 +37,20 @@ def test_cpp_unit_tests():
     assert "scheduler_test: OK" in res.stdout
     assert "raylet_core_test: all passed" in res.stdout
     assert "gcs_store_test: all passed" in res.stdout
+    assert "gcs_service_test: all OK" in res.stdout
 
 
-@pytest.mark.skipif(os.environ.get("RAY_TPU_SANITIZE") != "1",
-                    reason="set RAY_TPU_SANITIZE=1 to run sanitizer builds")
+@pytest.mark.slow
+@_toolchain
 @pytest.mark.parametrize("target", ["test-asan", "test-tsan"])
 def test_cpp_sanitizers(target):
-    res = _make(target)
+    # Separate build dirs (build-asan/build-tsan), so this never
+    # poisons the plain `make test` objects. 600s: sanitizer builds
+    # compile every test from scratch and run ~4x slower.
+    res = _make(target, timeout=600)
     assert res.returncode == 0, res.stdout + res.stderr
+    # A sanitizer report aborts the failing test binary (non-zero exit
+    # fails the assert above), but be explicit about the big two so a
+    # future `halt_on_error=0` environment still fails loudly.
+    assert "ERROR: AddressSanitizer" not in res.stdout + res.stderr
+    assert "WARNING: ThreadSanitizer" not in res.stdout + res.stderr
