@@ -150,7 +150,7 @@ def _query_nodes(gcs_host: str, gcs_port: int, cfg: Config) -> list[dict]:
     from ray_tpu._private import rpc
 
     async def go():
-        conn = await rpc.connect_retry(
+        conn = await rpc.dial(
             gcs_host, gcs_port, name="init-bootstrap",
             timeout=cfg.rpc_connect_timeout_s)
         try:

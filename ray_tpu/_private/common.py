@@ -92,6 +92,88 @@ def task_stats() -> dict:
     return dict(_task_stats)
 
 
+# ---------- retry policy (session layer; graftlint rule R6) ----------
+# Every transient-failure loop in the tree used to roll its own
+# delay/except tuple; two of them busy-looped with no jitter and one
+# swallowed EMFILE as a bring-up race. RetryPolicy is the ONE shape:
+# jittered exponential backoff, a total deadline, and a transient/
+# permanent classifier that refuses to retry resource-exhaustion and
+# permission errnos.
+
+import errno as _errno
+
+# Local resource exhaustion / misconfiguration: retrying cannot help and
+# only hides the bug (the EMFILE class of failure).
+_NON_TRANSIENT_ERRNOS = frozenset({
+    _errno.EMFILE, _errno.ENFILE, _errno.EACCES, _errno.EPERM,
+    _errno.EBADF, _errno.EAFNOSUPPORT, _errno.EPROTONOSUPPORT,
+})
+
+
+@dataclass
+class RetryPolicy:
+    """Jittered exponential backoff with a total deadline.
+
+    `run(fn)` awaits `fn()` until it succeeds, the deadline expires, or
+    a non-transient exception escapes. Transient means: connection-level
+    failures (refused/reset/pipe), timeouts, and OSErrors whose errno is
+    NOT in the non-transient set; anything in `also_transient` joins the
+    set (e.g. rpc.ConnectionLost, which common can't import).
+    """
+
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5           # each delay drawn from [d*(1-j), d]
+    deadline_s: float = 10.0      # total budget; float("inf") = forever
+    also_transient: tuple = ()
+
+    def is_transient(self, exc: BaseException) -> bool:
+        import asyncio
+
+        if self.also_transient and isinstance(exc, self.also_transient):
+            return True
+        if isinstance(exc, (ConnectionRefusedError, ConnectionResetError,
+                            BrokenPipeError, ConnectionAbortedError,
+                            asyncio.TimeoutError, TimeoutError)):
+            return True
+        if isinstance(exc, OSError):
+            return exc.errno not in _NON_TRANSIENT_ERRNOS
+        return False
+
+    def delay(self, attempt: int) -> float:
+        """Backoff for retry number `attempt` (0-based), jittered."""
+        import random
+
+        d = min(self.max_delay_s,
+                self.base_delay_s * (self.multiplier ** attempt))
+        return d * (1.0 - self.jitter * random.random())
+
+    async def run(self, fn, *, name: str = "", log=None):
+        """Await `fn()` under this policy. On deadline expiry the LAST
+        transient exception is re-raised (not a generic TimeoutError) so
+        callers keep their existing except clauses."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.deadline_s
+        attempt = 0
+        while True:
+            try:
+                return await fn()
+            except BaseException as e:
+                if not self.is_transient(e):
+                    raise
+                d = self.delay(attempt)
+                if loop.time() + d > deadline:
+                    raise
+                (log or logger).debug(
+                    "%s: transient %r; retry %d in %.2fs",
+                    name or getattr(fn, "__name__", "retry"), e, attempt, d)
+                attempt += 1
+                await asyncio.sleep(d)
+
+
 # ---------- request-frame validation (graftlint rule R5) ----------
 
 class MalformedError(Exception):
@@ -296,6 +378,14 @@ class NodeInfo:
     drain_reason: str = ""        # preemption | idle | manual
     drain_deadline_s: float = 0.0
     drain_stats: dict = field(default_factory=dict)
+    # Suspicion rung (partition tolerance): connection loss marks a node
+    # SUSPECT (excluded from new placement, like DRAINING); only a
+    # heartbeat-timeout expiry promotes SUSPECT -> DEAD. A re-register
+    # inside the grace window restores `pre_suspect_state` and bumps
+    # `suspect_recoveries` — the flap was a non-event.
+    suspect_since_s: float = 0.0      # wall clock, for display; 0 = not suspect
+    pre_suspect_state: str = ""       # state to restore on reconnect
+    suspect_recoveries: int = 0       # times this node flapped and came back
 
     def to_wire(self):
         return {
@@ -313,6 +403,8 @@ class NodeInfo:
             "drain_reason": self.drain_reason,
             "drain_deadline_s": self.drain_deadline_s,
             "drain_stats": self.drain_stats,
+            "suspect_since_s": self.suspect_since_s,
+            "suspect_recoveries": self.suspect_recoveries,
         }
 
 

@@ -104,6 +104,11 @@ class Config:
     # --- rpc ---
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 120.0
+    # Resilient sessions (rpc.connect_session): how long one outage may
+    # last before the session — and the caller's on_close — gives up.
+    # Daemon->GCS sessions use gcs_reconnect_timeout_s instead; this is
+    # the default for everything else (monitor, clients).
+    rpc_session_grace_s: float = 30.0
 
     # --- gcs ---
     gcs_pubsub_max_buffer: int = 10000

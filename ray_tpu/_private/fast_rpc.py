@@ -218,6 +218,19 @@ class FastRpcServer:
     def _dispatch(self, conn: FastConn, seq, method: str, payload) -> None:
         handler = conn.handlers.get(method)
         t0 = time.perf_counter()
+        record = None
+        if isinstance(payload, dict) and rpc._SID_KEY in payload:
+            # Session-stamped request: consult the shared reply cache so
+            # a replayed mutating RPC answers from cache instead of
+            # executing twice (see rpc.SessionManager).
+            def _dup_reply(kind, value, _cid=conn._conn_id, _seq=seq,
+                           _method=method):
+                self._send(_cid, [kind, _seq, _method, value])
+
+            execute, record, payload = rpc._session_intercept(
+                payload, seq, _dup_reply)
+            if not execute:
+                return
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method!r}")
@@ -225,24 +238,26 @@ class FastRpcServer:
         except Exception as e:
             self.stats.record_handler(method, time.perf_counter() - t0,
                                       error=True)
-            self._reply_error(conn, seq, method, e)
+            self._reply_error(conn, seq, method, e, record)
             return
         if isinstance(result, Awaitable):
             # supervised_task holds the strong ref in _inflight (raw
             # create_task keeps only a weak one) and logs any exception
             # that escapes _finish's own handling.
             supervised_task(
-                self._finish(conn, seq, method, result, t0),
+                self._finish(conn, seq, method, result, t0, record),
                 name=f"dispatch-{method}", tasks=self._inflight)
             self.stats.set_queue_depth(len(self._inflight))
         else:
             self.stats.record_handler(method, time.perf_counter() - t0)
+            if record is not None:
+                record(MSG_RESPONSE, result)
             if seq is not None:
                 self._send(conn._conn_id,
                            [MSG_RESPONSE, seq, method, result])
 
     async def _finish(self, conn: FastConn, seq, method: str, coro,
-                      t0: float) -> None:
+                      t0: float, record=None) -> None:
         try:
             result = await coro
         except asyncio.CancelledError:
@@ -250,19 +265,23 @@ class FastRpcServer:
         except Exception as e:
             self.stats.record_handler(method, time.perf_counter() - t0,
                                       error=True)
-            self._reply_error(conn, seq, method, e)
+            self._reply_error(conn, seq, method, e, record)
             return
         finally:
             self.stats.set_queue_depth(max(0, len(self._inflight) - 1))
         self.stats.record_handler(method, time.perf_counter() - t0)
+        if record is not None:
+            record(MSG_RESPONSE, result)
         if seq is not None:
             self._send(conn._conn_id, [MSG_RESPONSE, seq, method, result])
 
-    def _reply_error(self, conn: FastConn, seq, method: str, e: Exception):
+    def _reply_error(self, conn: FastConn, seq, method: str, e: Exception,
+                     record=None):
+        err = f"{e}\n{traceback.format_exc()}"
+        if record is not None:
+            record(MSG_ERROR, err)
         if seq is not None:
-            self._send(conn._conn_id,
-                       [MSG_ERROR, seq, method,
-                        f"{e}\n{traceback.format_exc()}"])
+            self._send(conn._conn_id, [MSG_ERROR, seq, method, err])
         else:
             logger.error("%s: error in notify handler %s: %s",
                          self.name, method, e)

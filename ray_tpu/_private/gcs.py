@@ -27,6 +27,7 @@ import logging
 import os
 import threading
 import time
+import uuid
 from collections import defaultdict, deque
 
 from ray_tpu._private import rpc
@@ -58,7 +59,16 @@ PG_REMOVED = "REMOVED"
 # node_manager.cc HandleDrainRaylet). ALIVE nodes schedule normally;
 # DRAINING nodes take no new placements while they evacuate; DRAINED
 # nodes are safe to terminate and their death is a non-event.
+#
+# SUSPECT is the suspicion rung of failure detection (reference treats
+# connection loss and health as separate signals: gcs_server/
+# gcs_health_check_manager vs the node's pubsub channel dying): a lost
+# raylet connection marks the node SUSPECT — excluded from NEW placement
+# like DRAINING, but nothing is migrated or reconstructed. Only
+# heartbeat-timeout expiry promotes SUSPECT -> DEAD; a re-registration
+# inside the grace window restores the prior state as a logged non-event.
 NODE_ALIVE = "ALIVE"
+NODE_SUSPECT = "SUSPECT"
 NODE_DRAINING = "DRAINING"
 NODE_DRAINED = "DRAINED"
 NODE_DEAD = "DEAD"
@@ -99,6 +109,10 @@ class GcsServer:
         self._needs_sync = False  # WAL appends since last fdatasync
         self.nodes: dict[str, NodeInfo] = {}
         self.node_conns: dict[str, rpc.Connection] = {}
+        # Per-node GCS->raylet call sessions (see _call_node): the GCS
+        # stamps its raylet-bound mutating RPCs so a call replayed across
+        # a raylet re-registration executes at most once on the raylet.
+        self._node_call_sessions: dict[str, dict] = {}
         self.kv: dict[str, dict[bytes, bytes]] = defaultdict(dict)
         self.actors: dict[str, dict] = {}
         self.named_actors: dict[tuple[str, str], str] = {}
@@ -699,8 +713,17 @@ class GcsServer:
     async def handle_register_node(self, conn, payload):
         require_fields(payload, "host", "node_id", "raylet_port",
                        "total_resources", method="handle_register_node")
+        node_id = payload["node_id"]
+        existing = self.nodes.get(node_id)
+        if existing is not None and existing.alive:
+            # Re-registration of a LIVE node: the raylet's session
+            # reconnected after a socket flap (or a half-open link the
+            # GCS never noticed). Re-bind the connection and restore the
+            # pre-suspect state — a fresh NodeInfo here would wipe drain
+            # progress and heartbeat history, the flap-resurrect hole.
+            return await self._handle_node_reregister(conn, existing, payload)
         info = NodeInfo(
-            node_id=payload["node_id"],
+            node_id=node_id,
             host=payload["host"],
             raylet_port=payload["raylet_port"],
             total_resources=normalize_resources(payload["total_resources"]),
@@ -719,16 +742,149 @@ class GcsServer:
             self.native_sched.update_node(
                 info.node_id, total=info.total_resources,
                 available=info.available_resources, labels=info.labels)
-        conn.on_close(lambda: supervised_task(self._on_node_conn_lost(info.node_id)))
+        conn.on_close(lambda: supervised_task(
+            self._on_node_conn_lost(info.node_id, conn)))
         await self.publish("NODE", {"event": "alive", "node": info.to_wire()})
         logger.info("node %s registered (%s:%s)", info.node_id[:8], info.host, info.raylet_port)
         return {"ok": True, "config": self.config.to_json()}
+
+    async def _handle_node_reregister(self, conn, node: NodeInfo, payload):
+        """A live node re-registered over a fresh connection: a logged
+        non-event. No migrations, no reconstructions — just re-bind the
+        connection, clear SUSPECT, and preserve the drain ladder."""
+        require_fields(payload, "host", "raylet_port",
+                       method="RegisterNode")
+        node.host = payload["host"]
+        node.raylet_port = payload["raylet_port"]
+        node.store_path = payload.get("store_path", node.store_path)
+        node.transfer_port = payload.get("transfer_port", node.transfer_port)
+        node.labels = payload.get("labels") or node.labels
+        node.last_heartbeat = time.monotonic()
+        was_suspect = node.state == NODE_SUSPECT
+        if was_suspect:
+            node.state = node.pre_suspect_state or NODE_ALIVE
+            node.pre_suspect_state = ""
+            outage_s = time.time() - node.suspect_since_s \
+                if node.suspect_since_s else 0.0
+            node.suspect_since_s = 0.0
+            node.suspect_recoveries += 1
+            logger.info(
+                "node %s reconnected inside the grace window after %.1fs "
+                "(flap #%d): non-event, state restored to %s",
+                node.node_id[:8], outage_s, node.suspect_recoveries,
+                node.state)
+            from ray_tpu.util import events
+
+            events.record("INFO", "gcs", "suspect node reconnected",
+                          node_id=node.node_id)
+        self.node_conns[node.node_id] = conn
+        self._touch("nodes", node.node_id)
+        if self.native_sched is not None:
+            self.native_sched.update_node(
+                node.node_id, total=node.total_resources,
+                available=node.available_resources, labels=node.labels,
+                alive=node.state == NODE_ALIVE)
+        conn.on_close(lambda: supervised_task(
+            self._on_node_conn_lost(node.node_id, conn)))
+        await self.publish("NODE", {
+            "event": "reconnected" if was_suspect else "alive",
+            "node": node.to_wire()})
+        return {"ok": True, "config": self.config.to_json(),
+                "reconnected": True}
+
+    async def _call_node(self, node_id: str, method: str, payload=None, *,
+                         timeout: float | None = None,
+                         wait_rebind: bool = True):
+        """At-most-once GCS->raylet call.
+
+        GCS->raylet RPCs ride the raylet-OPENED connection, so the GCS
+        cannot redial a dead socket — it can only wait for the raylet to
+        re-register (node_conns rebind). This helper stamps the request
+        with a GCS-side per-node session id so a call replayed across
+        that rebind hits the raylet's reply cache instead of executing a
+        second time (a replayed CreateActor must not fork the actor).
+        Waits up to the SUSPECT grace window for the rebind; raises
+        rpc.ConnectionLost once the node is dead or the window expires.
+        """
+        sess = self._node_call_sessions.get(node_id)
+        if sess is None:
+            sess = self._node_call_sessions[node_id] = {
+                "sid": uuid.uuid4().hex, "rseq": 0, "outstanding": set()}
+        stamped = None
+        rseq = 0
+        if method not in rpc.SESSION_EXEMPT_METHODS \
+                and (payload is None or isinstance(payload, dict)):
+            sess["rseq"] += 1
+            rseq = sess["rseq"]
+            stamped = dict(payload or {})
+            stamped[rpc._SID_KEY] = sess["sid"]
+            stamped[rpc._RSEQ_KEY] = rseq
+            sess["outstanding"].add(rseq)
+        loop = asyncio.get_running_loop()
+        grace = (self.config.health_check_period_s
+                 * self.config.num_heartbeats_timeout)
+        rebind_deadline = loop.time() + grace
+        call_deadline = None if timeout is None else loop.time() + timeout
+        sent_once = False
+        try:
+            while True:
+                node = self.nodes.get(node_id)
+                if node is None or not node.alive:
+                    raise rpc.ConnectionLost(
+                        f"node {node_id[:8]} is dead")
+                conn = self.node_conns.get(node_id)
+                if conn is None or conn.closed:
+                    if not wait_rebind or loop.time() > rebind_deadline:
+                        raise rpc.ConnectionLost(
+                            f"no raylet connection to node {node_id[:8]}")
+                    await asyncio.sleep(0.05)
+                    continue
+                if stamped is not None:
+                    outstanding = sess["outstanding"]
+                    stamped[rpc._ACK_KEY] = (min(outstanding) - 1
+                                             if outstanding else sess["rseq"])
+                if sent_once:
+                    rpc._session_stats["replayed_requests_total"] += 1
+                sent_once = True
+                try:
+                    att = None if call_deadline is None \
+                        else max(0.01, call_deadline - loop.time())
+                    return await conn.call(
+                        method, stamped if stamped is not None else payload,
+                        timeout=att)
+                except rpc.ConnectionLost:
+                    # Socket died mid-call: wait for the raylet to
+                    # re-register, then replay (deduped server-side).
+                    logger.debug(
+                        "%s to node %s interrupted by connection loss; "
+                        "awaiting re-registration to replay",
+                        method, node_id[:8])
+                    continue
+        finally:
+            if stamped is not None:
+                sess["outstanding"].discard(rseq)
 
     async def handle_heartbeat(self, conn, payload):
         require_fields(payload, "node_id", method="handle_heartbeat")
         node = self.nodes.get(payload["node_id"])
         if node is None or not node.alive:
-            return {"ok": False, "reason": "unknown or dead node"}
+            # Explicit death notice: a raylet that outlived its own
+            # SUSPECT->DEAD promotion (long partition healed) must not
+            # be silently resurrected by a late heartbeat — its actors
+            # and leases were already failed over. It must exit or
+            # re-register as a fresh node.
+            return {"ok": False, "dead": True,
+                    "reason": "unknown or dead node; this identity was "
+                              "declared dead — re-register as a new node"}
+        if node.state == NODE_SUSPECT:
+            # A heartbeat over a fresh connection from a SUSPECT node:
+            # the node is clearly up, but its registration conn is gone.
+            # Don't resurrect it from a side channel — tell it to re-run
+            # the RegisterNode handshake (which rebinds node_conns and
+            # clears SUSPECT as a non-event).
+            return {"ok": False, "reregister": True,
+                    "reason": "node is SUSPECT (connection lost); "
+                              "re-register to reattach"}
         node.last_heartbeat = time.monotonic()
         node.available_resources = payload.get("available_resources", node.available_resources)
         if self.native_sched is not None:
@@ -791,11 +947,18 @@ class GcsServer:
         if not node.alive:
             return {"ok": False, "error": f"node {node_id[:12]} is not alive"}
         nconn = self.node_conns.get(node_id)
-        if nconn is None or nconn.closed:
+        if (nconn is None or nconn.closed) and node.state != NODE_SUSPECT:
+            # A SUSPECT node has no conn right now but may re-register
+            # inside the grace window — _call_node below waits for the
+            # rebind, so a drain issued during a flap still lands.
             return {"ok": False,
                     "error": f"no raylet connection to node {node_id[:12]}"}
         already_draining = node.state == NODE_DRAINING
         node.state = NODE_DRAINING
+        # A drain overrides suspicion: clear the SUSPECT bookkeeping so a
+        # later re-registration doesn't restore a stale pre-drain state.
+        node.pre_suspect_state = ""
+        node.suspect_since_s = 0.0
         node.drain_reason = reason
         node.drain_deadline_s = deadline_s
         node.drain_stats.setdefault("started_at", time.time())
@@ -820,8 +983,9 @@ class GcsServer:
                     alive=True)
 
         try:
-            resp = await nconn.call(
-                "Drain", {"reason": reason, "deadline_s": deadline_s},
+            resp = await self._call_node(
+                node_id, "Drain",
+                {"reason": reason, "deadline_s": deadline_s},
                 timeout=self.config.rpc_call_timeout_s)
         except Exception as e:
             rollback()
@@ -881,14 +1045,13 @@ class GcsServer:
             await self.publish("ACTOR", {
                 "actor_id": actor_id, "state": ACTOR_RESTARTING,
                 "reason": f"migrating off draining node ({reason})"})
-            nconn = self.node_conns.get(node_id)
-            if nconn is not None and not nconn.closed:
-                try:
-                    await nconn.call("KillActorWorker",
-                                     {"actor_id": actor_id, "address": addr},
-                                     timeout=self.config.rpc_call_timeout_s)
-                except Exception:
-                    pass  # node may die mid-drain; reschedule regardless
+            try:
+                await self._call_node(
+                    node_id, "KillActorWorker",
+                    {"actor_id": actor_id, "address": addr},
+                    timeout=self.config.rpc_call_timeout_s)
+            except Exception:
+                pass  # node may die mid-drain; reschedule regardless
             migrated += 1
             supervised_task(self._schedule_actor(actor_id))
         if node is not None and migrated:
@@ -951,10 +1114,45 @@ class GcsServer:
         await self._mark_node_dead(payload["node_id"], payload.get("reason", "reported dead"))
         return {"ok": True}
 
-    async def _on_node_conn_lost(self, node_id: str):
-        # Connection loss is a strong death signal; health check loop would
-        # also catch it via missed heartbeats.
-        await self._mark_node_dead(node_id, "raylet connection lost")
+    async def _on_node_conn_lost(self, node_id: str, conn=None):
+        # Connection loss is a SUSPICION, not a death certificate: a
+        # network flap or a GCS-side socket hiccup looks identical to a
+        # crashed raylet at this layer. Mark the node SUSPECT (out of NEW
+        # placement, nothing migrated) and let the heartbeat-timeout
+        # expiry in _health_check_loop issue the actual death.
+        if conn is not None and self.node_conns.get(node_id) is not conn:
+            # A stale conn's close callback fired after the raylet
+            # already re-registered over a fresh connection — suspecting
+            # the healthy node now would be a false positive.
+            return
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return
+        if node.state == NODE_DRAINED:
+            # An evacuated node hanging up is its expected exit — keep
+            # the clean-removal path instead of a pointless grace window.
+            await self._mark_node_dead(node_id, "raylet connection lost")
+            return
+        if node.state == NODE_SUSPECT:
+            return
+        node.pre_suspect_state = node.state
+        node.state = NODE_SUSPECT
+        node.suspect_since_s = time.time()
+        self.node_conns.pop(node_id, None)
+        if self.native_sched is not None:
+            self.native_sched.update_node(node_id, available={}, alive=False)
+        self._touch("nodes", node_id)
+        from ray_tpu.util import events
+
+        grace = (self.config.health_check_period_s
+                 * self.config.num_heartbeats_timeout)
+        logger.info(
+            "node %s connection lost: SUSPECT (grace %.1fs before "
+            "promotion to DEAD)", node_id[:8], grace)
+        events.record("INFO", "gcs", "node suspect: connection lost",
+                      node_id=node_id)
+        await self.publish("NODE", {"event": "suspect",
+                                    "node": node.to_wire()})
 
     async def _mark_node_dead(self, node_id: str, reason: str):
         node = self.nodes.get(node_id)
@@ -965,6 +1163,7 @@ class GcsServer:
         node.state = NODE_DEAD if not drained else NODE_DRAINED
         node.available_resources = {}
         self.node_conns.pop(node_id, None)
+        self._node_call_sessions.pop(node_id, None)
         if self.native_sched is not None:
             self.native_sched.update_node(node_id, available={}, alive=False)
         self.pending_demand.pop(node_id, None)
@@ -1009,8 +1208,17 @@ class GcsServer:
             await asyncio.sleep(period)
             now = time.monotonic()
             for node in list(self.nodes.values()):
-                if node.alive and not node.is_head and now - node.last_heartbeat > timeout:
-                    await self._mark_node_dead(node.node_id, "heartbeat timeout")
+                # Heads are exempt from heartbeat policing (the GCS lives
+                # there) — EXCEPT once SUSPECT: a head whose connection
+                # died and never came back must still be promoted.
+                if node.alive and \
+                        (not node.is_head or node.state == NODE_SUSPECT) \
+                        and now - node.last_heartbeat > timeout:
+                    reason = ("suspect grace expired (connection lost, "
+                              "no re-registration)"
+                              if node.state == NODE_SUSPECT
+                              else "heartbeat timeout")
+                    await self._mark_node_dead(node.node_id, reason)
 
     # ---------- KV ----------
 
@@ -1180,8 +1388,12 @@ class GcsServer:
             "CREATE_SCHEDULED", job_id=a.get("job_id", ""),
             actor_id=actor_id, target_node=node_id)
         try:
-            resp = await self.node_conns[node_id].call(
-                "CreateActor",
+            # _call_node, not a raw conn.call: a socket flap mid-create
+            # replays the request after the raylet re-registers, and the
+            # raylet's reply cache guarantees the actor is created at
+            # most once (a forked actor is the worst control-plane bug).
+            resp = await self._call_node(
+                node_id, "CreateActor",
                 {"actor_id": actor_id, "spec": a["spec"], "resources": a["resources"],
                  "placement_group": a.get("placement_group", ""),
                  "pg_bundle_index": a.get("pg_bundle_index", -1)},
@@ -1319,8 +1531,10 @@ class GcsServer:
         node_id = a.get("node_id")
         if node_id in self.node_conns:
             try:
-                await self.node_conns[node_id].call(
-                    "KillActorWorker", {"actor_id": actor_id, "address": addr})
+                await self._call_node(
+                    node_id, "KillActorWorker",
+                    {"actor_id": actor_id, "address": addr},
+                    timeout=self.config.rpc_call_timeout_s)
             except Exception:
                 # Best-effort: the raylet may already be tearing the
                 # worker down; the death path below is authoritative.
@@ -1417,12 +1631,11 @@ class GcsServer:
         prepared = []
         ok = True
         for idx, node_id in placement:
-            nconn = self.node_conns.get(node_id)
-            if nconn is None:
+            if node_id not in self.node_conns:
                 ok = False
                 break
             try:
-                resp = await nconn.call("PreparePGBundle", {
+                resp = await self._call_node(node_id, "PreparePGBundle", {
                     "pg_id": pg_id, "bundle_index": idx,
                     "resources": pg["bundles"][idx]["resources"]})
                 if not resp.get("ok"):
@@ -1434,18 +1647,19 @@ class GcsServer:
                 break
         if not ok:
             for idx, node_id in prepared:
-                nconn = self.node_conns.get(node_id)
-                if nconn:
-                    try:
-                        await nconn.call("ReturnPGBundle", {"pg_id": pg_id, "bundle_index": idx})
-                    except Exception:
-                        pass
+                try:
+                    await self._call_node(
+                        node_id, "ReturnPGBundle",
+                        {"pg_id": pg_id, "bundle_index": idx})
+                except Exception:
+                    pass
             supervised_task(self._schedule_pg(pg_id, delay=0.5))
             return
         for idx, node_id in placement:
             try:
-                await self.node_conns[node_id].call(
-                    "CommitPGBundle", {"pg_id": pg_id, "bundle_index": idx})
+                await self._call_node(
+                    node_id, "CommitPGBundle",
+                    {"pg_id": pg_id, "bundle_index": idx})
             except Exception:
                 pass
             pg["bundles"][idx]["node_id"] = node_id
@@ -1522,8 +1736,9 @@ class GcsServer:
             node_id = b.get("node_id")
             if node_id and node_id in self.node_conns:
                 try:
-                    await self.node_conns[node_id].call(
-                        "ReturnPGBundle", {"pg_id": pg["pg_id"], "bundle_index": idx})
+                    await self._call_node(
+                        node_id, "ReturnPGBundle",
+                        {"pg_id": pg["pg_id"], "bundle_index": idx})
                 except Exception:
                     # A dead raylet frees its bundles via node-death
                     # cleanup; log so a live one failing is visible.
@@ -1603,6 +1818,9 @@ class GcsServer:
             "placement_groups": len([p for p in self.placement_groups.values()
                                      if p["state"] == PG_CREATED]),
             "uptime_s": time.time() - self.start_time,
+            "suspect_nodes": len([n for n in self.nodes.values()
+                                  if n.state == NODE_SUSPECT]),
+            "rpc_sessions": rpc.session_stats(),
         }
 
     async def handle_get_event_loop_stats(self, conn, payload):

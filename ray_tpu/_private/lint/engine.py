@@ -23,7 +23,7 @@ _SKIP_DIRS = {"__pycache__", "_lib", "build", "build-asan", "build-tsan",
 
 @dataclass(frozen=True)
 class Violation:
-    rule: str          # "R1".."R5"
+    rule: str          # "R1".."R6"
     path: str          # normalized posix path (ray_tpu/...)
     line: int
     col: int

@@ -15,6 +15,12 @@ async daemons (the historical incident is named in docs/linting.md):
   R5  unvalidated request-payload subscripts in handle_* entries (must
       require_fields(...) first and answer Malformed, not KeyError —
       PR-1's native-service Malformed gates, mirrored in Python)
+  R6  ad-hoc connection management outside the session layer: raw
+      rpc.connect()/connect_retry() calls, or except-ConnectionLost
+      handlers that silently `pass` (every caller must pick a policy —
+      rpc.dial() when conn death is a liveness signal, or
+      rpc.connect_session() for resilient replay/dedup sessions; the
+      PR-10 busy-loop and swallowed-disconnect bugs)
 """
 
 from __future__ import annotations
@@ -410,6 +416,85 @@ class RuleR5:
                     "answers Malformed instead of raising KeyError")))
 
 
-ALL_RULES = [RuleR1(), RuleR2(), RuleR3(), RuleR4(), RuleR5()]
+# The session layer itself: the only modules allowed to touch the raw
+# connect primitives (they implement dial()/connect_session()).
+_R6_EXEMPT = ("_private/rpc.py", "_private/fast_rpc.py")
+
+_R6_RAW_CONNECT = {"connect", "connect_retry"}
+
+
+class RuleR6:
+    """No ad-hoc connection management outside the session layer."""
+
+    id = "R6"
+    title = "ad-hoc RPC connection management outside the session layer"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if any(ctx.path.endswith(sfx) for sfx in _R6_EXEMPT):
+            return iter(())
+        aliases = _import_aliases(ctx.tree)
+
+        class V(_FuncWalker):
+            def visit_Call(self, node: ast.Call):
+                dotted = _dotted_name(node.func, aliases)
+                if dotted is not None:
+                    parts = dotted.split(".")
+                    # Matches rpc.connect / rpc.connect_retry through any
+                    # alias: `from .. import rpc as r; r.connect(...)`,
+                    # `from ..rpc import connect_retry; connect_retry(..)`.
+                    if parts[-1] in _R6_RAW_CONNECT and len(parts) >= 2 \
+                            and parts[-2] == "rpc":
+                        self.emit(
+                            "R6", node,
+                            f"raw rpc.{parts[-1]}() outside the session "
+                            "layer — use rpc.dial() when connection death "
+                            "is a liveness signal, or rpc.connect_session()"
+                            " for a resilient session (reconnect + replay "
+                            "+ server-side dedup)")
+                self.generic_visit(node)
+
+            def visit_ExceptHandler(self, node: ast.ExceptHandler):
+                if self._catches_connection_lost(node.type) \
+                        and self._silent(node.body):
+                    self.emit(
+                        "R6", node,
+                        "except ConnectionLost with only `pass` — a lost "
+                        "connection is a liveness signal, not noise: let "
+                        "the session layer redial/replay, or log it and "
+                        "act on it")
+                self.generic_visit(node)
+
+            @staticmethod
+            def _catches_connection_lost(t) -> bool:
+                def is_cl(e) -> bool:
+                    if isinstance(e, ast.Name):
+                        return e.id == "ConnectionLost"
+                    if isinstance(e, ast.Attribute):
+                        return e.attr == "ConnectionLost"
+                    return False
+
+                if t is None:
+                    return False  # bare except: R4's territory
+                if isinstance(t, ast.Tuple):
+                    return any(is_cl(e) for e in t.elts)
+                return is_cl(t)
+
+            @staticmethod
+            def _silent(body) -> bool:
+                for stmt in body:
+                    if isinstance(stmt, (ast.Pass, ast.Continue)):
+                        continue
+                    if isinstance(stmt, ast.Expr) \
+                            and isinstance(stmt.value, ast.Constant):
+                        continue  # bare docstring/constant
+                    return False
+                return True
+
+        v = V(ctx)
+        v.visit(ctx.tree)
+        return iter(v.out)
+
+
+ALL_RULES = [RuleR1(), RuleR2(), RuleR3(), RuleR4(), RuleR5(), RuleR6()]
 
 RULE_DOCS = {r.id: r.title for r in ALL_RULES}

@@ -139,11 +139,16 @@ class RuntimeNode:
         self.gcs_host, self.gcs_port = host, port
 
     def start_raylet(self, resources: dict | None = None, labels: dict | None = None,
-                     is_head: bool = False) -> NodeHandle:
+                     is_head: bool = False,
+                     gcs_addr: tuple[str, int] | None = None) -> NodeHandle:
+        """gcs_addr overrides the GCS endpoint this raylet dials — the
+        hook chaos tests use to route one node's control-plane traffic
+        through a NetChaos proxy (test_utils.NetChaos)."""
         assert self.gcs_host is not None, "start or attach GCS first"
+        gcs_host, gcs_port = gcs_addr or (self.gcs_host, self.gcs_port)
         node_id = NodeID.from_random().hex()
         cmd = [sys.executable, "-m", "ray_tpu._private.raylet",
-               f"--gcs-host={self.gcs_host}", f"--gcs-port={self.gcs_port}",
+               f"--gcs-host={gcs_host}", f"--gcs-port={gcs_port}",
                f"--session-dir={self.session_dir}",
                f"--resources={json.dumps(resources or {})}",
                f"--labels={json.dumps(labels or {})}",

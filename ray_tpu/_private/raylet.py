@@ -473,7 +473,7 @@ class Raylet:
         # (reference: raylets load the system config from the GCS at boot,
         # node_manager.cc HandleGetSystemConfig).
         try:
-            boot = await rpc.connect_retry(
+            boot = await rpc.dial(
                 self.gcs_host, self.gcs_port, name="raylet-boot->gcs",
                 timeout=self.config.rpc_connect_timeout_s)
             resp = await boot.call("GetConfig", {}, timeout=10)
@@ -519,11 +519,18 @@ class Raylet:
         self._num_restored = 0
         # The GCS issues calls (CreateActor, PG prepare/commit, Drain) back
         # over this same connection, so it gets the full handler table.
-        self.gcs_conn = await rpc.connect_retry(
+        # A resilient session: socket death redials under
+        # gcs_reconnect_timeout_s and re-runs _gcs_handshake (RegisterNode
+        # + Subscribe + queued death reports) before any stamped call is
+        # replayed — a flap is a non-event, not a raylet death.
+        self.gcs_conn = await rpc.connect_session(
             self.gcs_host, self.gcs_port,
             handlers={**self._handlers(), "Publish": self._on_publish},
             name=f"raylet-{self.node_id[:8]}->gcs",
-            timeout=self.config.rpc_connect_timeout_s)
+            grace_s=self.config.gcs_reconnect_timeout_s,
+            connect_timeout_s=self.config.rpc_connect_timeout_s,
+            on_reconnect=self._gcs_handshake)
+        self.gcs_conn.on_close(self._on_gcs_session_failed)
         # Native data plane: serve this store's objects to peers from C++
         # (payload bytes never cross the Python daemons).
         from ray_tpu._private.native_transfer import TransferServer
@@ -652,71 +659,73 @@ class Raylet:
                     self._sync_native_view()
                     # A fresher view may unblock queued leases via spillback.
                     self._pump_pending_leases()
+                elif resp.get("reregister"):
+                    # One-way partition: this side's socket looks healthy
+                    # but the GCS-side conn died and marked the node
+                    # SUSPECT. Re-run the handshake over the live session
+                    # to rebind — do NOT exit; nothing was failed over.
+                    logger.warning("GCS marked node %s SUSPECT; "
+                                   "re-registering over live connection",
+                                   self.node_id[:8])
+                    await self._gcs_handshake(self.gcs_conn)
                 else:
                     # A LIVE GCS answering not-ok has declared this node
-                    # dead (missed heartbeats) and may already have failed
-                    # actors over; resurrecting would fork them. Exit like
-                    # the reference's stale raylet. (A RESTARTED GCS is
-                    # reached via the ConnectionLost path below instead.)
+                    # dead (SUSPECT grace expired / missed heartbeats) and
+                    # may already have failed actors over; resurrecting
+                    # would fork them. Exit like the reference's stale
+                    # raylet. (A RESTARTED GCS is reached via the session
+                    # reconnect + re-registration path instead.)
                     logger.error("GCS declared node %s dead; raylet exiting",
                                  self.node_id[:8])
                     os._exit(1)
-            except rpc.ConnectionLost:
-                logger.warning("lost GCS connection; raylet %s reconnecting",
-                               self.node_id[:8])
-                if not await self._reconnect_gcs():
-                    logger.error("GCS unreachable for %.0fs; raylet %s exiting",
-                                 self.config.gcs_reconnect_timeout_s,
-                                 self.node_id[:8])
-                    os._exit(1)
+            except (rpc.ConnectionLost, asyncio.TimeoutError) as e:
+                # The resilient session redials and re-runs the handshake
+                # underneath; heartbeats just resume when it's back. The
+                # session's on_close (grace exhausted) is what exits.
+                logger.debug("heartbeat deferred (%s); session redialing", e)
             except Exception:
-                pass
+                logger.debug("heartbeat error", exc_info=True)
             await asyncio.sleep(period)
 
-    async def _reconnect_gcs(self) -> bool:
-        """Re-establish the GCS session after a GCS restart: fresh
-        connection, re-registration under the SAME node id (leases, PG
-        bundles, and the object store all survive in this process)."""
-        deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s
-        while time.monotonic() < deadline:
+    async def _gcs_handshake(self, conn):
+        """Re-attach this raylet to the GCS over a fresh (or live) conn:
+        re-register under the SAME node id (leases, PG bundles, and the
+        object store all survive in this process), re-subscribe, flush
+        queued death reports, reconcile actor ground truth. Runs as the
+        session's on_reconnect BEFORE any replayed request, so the GCS
+        rebinds node_conns first (reference: NotifyGCSRestart resync,
+        node_manager.cc:1168)."""
+        resp = await conn.call("RegisterNode", {
+            "node_id": self.node_id,
+            "host": self.host,
+            "raylet_port": self.port,
+            "total_resources": self.total_resources,
+            "labels": self.labels,
+            "store_path": self.store_path,
+            "is_head": self.is_head,
+            "transfer_port": getattr(self, "transfer_server", None)
+            and self.transfer_server.port or 0,
+        }, timeout=self.config.rpc_call_timeout_s)
+        if not resp.get("ok"):
+            # Permanent rejection (the GCS knows this identity is dead):
+            # a non-transient error fails the session -> _on_gcs_session_failed.
+            raise rpc.RpcError(
+                f"GCS refused re-registration: {resp.get('reason', resp)}")
+        await conn.call("Subscribe", {"channels": ["NODE", "JOB"]})
+        while self._pending_death_reports:
+            report = self._pending_death_reports.pop(0)
             try:
-                conn = await rpc.connect_retry(
-                    self.gcs_host, self.gcs_port,
-                    handlers={**self._handlers(), "Publish": self._on_publish},
-                    name=f"raylet-{self.node_id[:8]}->gcs",
-                    timeout=min(5.0, self.config.rpc_connect_timeout_s))
-                resp = await conn.call("RegisterNode", {
-                    "node_id": self.node_id,
-                    "host": self.host,
-                    "raylet_port": self.port,
-                    "total_resources": self.total_resources,
-                    "labels": self.labels,
-                    "store_path": self.store_path,
-                    "is_head": self.is_head,
-                    "transfer_port": getattr(self, "transfer_server", None)
-                    and self.transfer_server.port or 0,
-                }, timeout=self.config.rpc_call_timeout_s)
-                if resp.get("ok"):
-                    old, self.gcs_conn = self.gcs_conn, conn
-                    if old is not None and not old.closed:
-                        await old.close()
-                    await conn.call("Subscribe", {"channels": ["NODE", "JOB"]})
-                    while self._pending_death_reports:
-                        report = self._pending_death_reports.pop(0)
-                        try:
-                            await conn.call("ReportActorDeath", report)
-                        except Exception:
-                            self._pending_death_reports.insert(0, report)
-                            break
-                    await self._reconcile_actors(conn)
-                    logger.info("raylet %s re-registered with GCS",
-                                self.node_id[:8])
-                    return True
-                await conn.close()
+                await conn.call("ReportActorDeath", report)
             except Exception:
-                pass
-            await asyncio.sleep(0.5)
-        return False
+                self._pending_death_reports.insert(0, report)
+                break
+        await self._reconcile_actors(conn)
+        logger.info("raylet %s re-registered with GCS", self.node_id[:8])
+
+    def _on_gcs_session_failed(self):
+        logger.error("GCS unreachable for %.0fs; raylet %s exiting",
+                     self.config.gcs_reconnect_timeout_s, self.node_id[:8])
+        os._exit(1)
 
     async def handle_ensure_runtime_env(self, conn, payload):
         require_fields(payload, "env", method="handle_ensure_runtime_env")
@@ -1981,7 +1990,10 @@ class Raylet:
         key = (host, port)
         conn = self._peer_conns.get(key)
         if conn is None or conn.closed:
-            conn = await rpc.connect(host, port, name=f"raylet-peer-{port}")
+            # dial, not a session: a dead peer conn is itself the signal
+            # to re-resolve the peer from the cluster view.
+            conn = await rpc.dial(host, port, name=f"raylet-peer-{port}",
+                                  timeout=self.config.rpc_connect_timeout_s)
             self._peer_conns[key] = conn
         return conn
 
@@ -2495,6 +2507,10 @@ class Raylet:
             "drain_reason": self.drain_reason,
             "drain_stats": self._drain_stats,
             "drained": self._drain_done.is_set(),
+            # Resilient-session counters for this raylet process (GCS
+            # session flaps, replays, server-side dedup hits) — surfaced
+            # as ray_tpu_rpc_* gauges in util/metrics.
+            "rpc_sessions": rpc.session_stats(),
         }
 
     async def handle_get_event_loop_stats(self, conn, payload):

@@ -475,11 +475,20 @@ class CoreWorker:
         }, name=f"worker-{self.worker_id[:8]}")
         host, port = await self.server.start("127.0.0.1", 0)
         self.address = Address(host, port, self.worker_id, self.node_id)
-        self.gcs = await rpc.connect_retry(
+        self._gcs_channels = []
+        # Resilient session: survives GCS restarts AND network flaps —
+        # the _gcs_reattach handshake (resubscribe + job re-registration
+        # + PG-waiter requery) runs on every re-established socket before
+        # any stamped call is replayed (reference: workers retry through
+        # gcs_client across GCS failover).
+        self.gcs = await rpc.connect_session(
             self.gcs_host, self.gcs_port,
             handlers={"Publish": self._on_gcs_publish},
             name=f"w{self.worker_id[:8]}->gcs",
-            timeout=self.config.rpc_connect_timeout_s)
+            grace_s=self.config.gcs_reconnect_timeout_s,
+            connect_timeout_s=self.config.rpc_connect_timeout_s,
+            on_reconnect=self._gcs_reattach)
+        self.gcs.on_close(self._on_gcs_session_failed)
         # Drivers subscribe eagerly (they hold actor handles from the
         # start); pool workers subscribe lazily on their first handle —
         # see _actor_state (an eager per-worker ACTOR subscription made
@@ -490,23 +499,24 @@ class CoreWorker:
         self._gcs_channels = channels
         if channels:
             await self.gcs.call("Subscribe", {"channels": channels})
-        # Survive GCS restarts: reconnect + resubscribe (reference: workers
-        # retry through gcs_client across GCS failover).
-        self.gcs.on_close(lambda: (not self._shutdown)
-                          and self._spawn(self._reconnect_gcs()))
         # The raylet pushes AssignActor/Exit over this same connection, so
-        # it carries the worker's full handler table.
-        self.raylet = await rpc.connect_retry(
-            self.raylet_host, self.raylet_port, handlers=self.server.handlers,
+        # it carries the worker's full handler table. Drivers get a short
+        # reconnect grace (a flapped local socket re-registers); pool
+        # workers keep grace 0 — a lost raylet conn still means exit
+        # (reference: workers exit on raylet socket disconnect), so a
+        # dead node leaves no orphans racing against retried tasks.
+        self.raylet = await rpc.connect_session(
+            self.raylet_host, self.raylet_port,
+            handlers=self.server.handlers,
             name=f"w{self.worker_id[:8]}->raylet",
-            timeout=self.config.rpc_connect_timeout_s)
+            grace_s=(self.config.rpc_session_grace_s
+                     if self.is_driver else 0.0),
+            connect_timeout_s=self.config.rpc_connect_timeout_s,
+            on_reconnect=self._raylet_reattach)
         await self.raylet.call("RegisterWorker", {
             "worker_id": self.worker_id, "host": host, "port": port,
             "fp_port": self.fp_port})
         if not self.is_driver:
-            # Pool workers die with their raylet (reference: workers exit on
-            # raylet socket disconnect), so a dead node leaves no orphans
-            # racing against retried tasks.
             self.raylet.on_close(
                 lambda: (not self._shutdown) and os._exit(1))
         if self.is_driver:
@@ -1073,7 +1083,12 @@ class CoreWorker:
         async with lock:
             conn = cache.get(key)
             if conn is None or conn.closed:
-                conn = await rpc.connect(host, port, name=name)
+                # dial, not a session: a dead owner/raylet conn IS the
+                # liveness signal callers consume (borrow watches, lease
+                # fallback paths) — transparent reconnection would mask it.
+                conn = await rpc.dial(
+                    host, port, name=name,
+                    timeout=self.config.rpc_connect_timeout_s)
                 cache[key] = conn
         return conn
 
@@ -1259,67 +1274,48 @@ class CoreWorker:
                 return False
         return False
 
-    async def _reconnect_gcs(self):
-        """Re-establish the GCS connection after a GCS restart; RPCs issued
-        during the gap fail and their callers retry. Guarded so on_close
-        flaps never run two loops at once; on_close is armed only after
-        the FULL re-handshake (subscribe + job registration) succeeds."""
-        if getattr(self, "_gcs_reconnecting", False):
-            return
-        self._gcs_reconnecting = True
-        try:
-            deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s
-            while not self._shutdown and time.monotonic() < deadline:
-                conn = None
-                try:
-                    conn = await rpc.connect_retry(
-                        self.gcs_host, self.gcs_port,
-                        handlers={"Publish": self._on_gcs_publish},
-                        name=f"w{self.worker_id[:8]}->gcs",
-                        timeout=min(5.0, self.config.rpc_connect_timeout_s))
-                    await conn.call("Subscribe",
-                                    {"channels": self._gcs_channels})
-                    if self.is_driver:
-                        # Re-arm the session-teardown hook (owns_cluster
-                        # sessions die with their driver connection).
-                        await conn.call("RegisterJob", {
-                            "job_id": self.job_id,
-                            "driver_address": self.address.to_wire(),
-                            "entrypoint": " ".join(os.sys.argv),
-                            "owns_cluster": self.owns_cluster,
-                        })
-                    self.gcs = conn
-                    conn.on_close(lambda: (not self._shutdown)
-                                  and self._spawn(self._reconnect_gcs()))
-                    logger.info("reconnected to GCS")
-                    # PG-ready promises have no polling fallback (unlike
-                    # the actor path): a CREATED/REMOVED published while
-                    # we were down is gone, so re-query every armed
-                    # waiter's state now.
-                    for pg_id in list(self._pg_ready_waiters):
-                        try:
-                            resp = await conn.call(
-                                "GetPlacementGroup", {"pg_id": pg_id})
-                        except Exception:
-                            continue
-                        if resp.get("found") and resp.get("state") in (
-                                "CREATED", "REMOVED"):
-                            self._settle_pg_waiters(pg_id, resp["state"])
-                    return
-                except Exception:
-                    if conn is not None:
-                        try:
-                            await conn.close()
-                        except Exception:
-                            pass
-                    await asyncio.sleep(0.5)
-            if not self._shutdown:
-                logger.error(
-                    "gave up reconnecting to GCS after %.0fs; control-plane "
-                    "operations will fail until restart",
-                    self.config.gcs_reconnect_timeout_s)
-        finally:
-            self._gcs_reconnecting = False
+    async def _gcs_reattach(self, conn):
+        """Session handshake run on every re-established GCS socket
+        (BEFORE replayed calls resume): resubscribe, re-arm the job's
+        session-teardown hook, and requery armed PG-ready waiters —
+        a CREATED/REMOVED published during the gap is gone (PG promises
+        have no polling fallback, unlike the actor path)."""
+        if self._gcs_channels:
+            await conn.call("Subscribe", {"channels": self._gcs_channels})
+        if self.is_driver:
+            # Re-arm the session-teardown hook (owns_cluster sessions
+            # die with their driver connection).
+            await conn.call("RegisterJob", {
+                "job_id": self.job_id,
+                "driver_address": self.address.to_wire(),
+                "entrypoint": " ".join(os.sys.argv),
+                "owns_cluster": self.owns_cluster,
+            })
+        logger.info("reconnected to GCS")
+        for pg_id in list(self._pg_ready_waiters):
+            try:
+                resp = await conn.call(
+                    "GetPlacementGroup", {"pg_id": pg_id})
+            except Exception:
+                continue
+            if resp.get("found") and resp.get("state") in (
+                    "CREATED", "REMOVED"):
+                self._settle_pg_waiters(pg_id, resp["state"])
+
+    def _on_gcs_session_failed(self):
+        if not self._shutdown:
+            logger.error(
+                "gave up reconnecting to GCS after %.0fs; control-plane "
+                "operations will fail until restart",
+                self.config.gcs_reconnect_timeout_s)
+
+    async def _raylet_reattach(self, conn):
+        """Re-register with the local raylet after its session socket
+        flapped (driver-only: pool workers run with grace 0 and exit)."""
+        await conn.call("RegisterWorker", {
+            "worker_id": self.worker_id, "host": self.address.host,
+            "port": self.address.port, "fp_port": self.fp_port})
+        logger.info("reconnected to raylet")
 
     # ---------- ref counting ----------
 
@@ -1959,10 +1955,13 @@ class CoreWorker:
                     continue
                 if resp.get("granted"):
                     try:
-                        conn = await rpc.connect(
+                        # Short deadline: this connect doubles as the
+                        # liveness probe for the leased worker.
+                        conn = await rpc.dial(
                             resp["worker_host"], resp["worker_port"],
-                            name=f"owner->{resp['worker_id'][:6]}")
-                    except OSError:
+                            name=f"owner->{resp['worker_id'][:6]}",
+                            timeout=2.0)
+                    except (OSError, asyncio.TimeoutError):
                         # Leased worker already gone; release and retry.
                         try:
                             await raylet_conn.call(
@@ -3808,12 +3807,16 @@ class CoreWorker:
                         continue   # state changed while waiting; re-resolve
                     if st["conn"] is None or st["conn"].closed:
                         addr = Address.from_wire(st["address"])
-                        st["conn"] = await rpc.connect(
+                        # dial, not a session: this conn's death is the
+                        # signal to re-resolve the actor's address from
+                        # the GCS (it may have restarted elsewhere).
+                        st["conn"] = await rpc.dial(
                             addr.host, addr.port,
                             # Streaming actor methods push their yields
                             # back over this same ordered connection.
                             handlers={"TaskYield": self._handle_task_yield},
-                            name=f"->actor{actor_id[:6]}")
+                            name=f"->actor{actor_id[:6]}",
+                            timeout=self.config.rpc_connect_timeout_s)
             if st["conn"] is None or st["conn"].closed:
                 continue
             return st["conn"]

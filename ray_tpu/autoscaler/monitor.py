@@ -42,8 +42,11 @@ class Monitor:
         self._thread = threading.Thread(target=self._loop.run_forever,
                                         daemon=True, name="monitor-rpc")
         self._thread.start()
-        self._conn = self._call_async(rpc.connect_retry(
-            gcs_host, gcs_port, name="monitor->gcs", timeout=30.0))
+        # Resilient session: the monitor polls across GCS restarts and
+        # network flaps without rebuilding its loop thread.
+        self._conn = self._call_async(rpc.connect_session(
+            gcs_host, gcs_port, name="monitor->gcs",
+            grace_s=60.0, connect_timeout_s=30.0))
         self.autoscaler = StandardAutoscaler(
             provider, node_types,
             get_cluster_status=self.get_cluster_status,

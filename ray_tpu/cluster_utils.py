@@ -37,7 +37,12 @@ class Cluster:
         return self.gcs_address
 
     def add_node(self, resources: dict | None = None, num_cpus: float | None = None,
-                 labels: dict | None = None, _head: bool = False) -> NodeHandle:
+                 labels: dict | None = None, _head: bool = False,
+                 gcs_addr: tuple[str, int] | None = None) -> NodeHandle:
+        """gcs_addr routes THIS node's raylet->GCS control traffic
+        through an alternate endpoint (a test_utils.NetChaos proxy) so
+        partition tests can fault one link without touching the rest of
+        the cluster."""
         if self.gcs_address is None:
             host, port = self._node.start_gcs()
             self.gcs_address = f"{host}:{port}"
@@ -46,7 +51,7 @@ class Cluster:
         if num_cpus is not None:
             res.setdefault("CPU", num_cpus)
         handle = self._node.start_raylet(resources=res or None, labels=labels,
-                                         is_head=_head)
+                                         is_head=_head, gcs_addr=gcs_addr)
         if _head and self.head_node is None:
             self.head_node = handle
         return handle

@@ -197,10 +197,12 @@ const VIEWS = {
       const s = byId[n.node_id] || {};
       const ds = n.drain_stats || {};
       return {
-        // Drain ladder from the GCS node table: ALIVE / DRAINING /
-        // DRAINED / DEAD (a DRAINED death is a clean removal).
+        // Lifecycle ladder from the GCS node table: ALIVE / SUSPECT /
+        // DRAINING / DRAINED / DEAD (a DRAINED death is a clean
+        // removal; SUSPECT = connection lost, inside the grace window).
         node_id: n.node_id, host: n.host,
         state: n.state || (n.alive ? "ALIVE" : "DEAD"),
+        flaps: n.suspect_recoveries || 0,
         head: n.is_head, cpu_used:
           (n.total_resources.CPU || 0) - (n.available_resources.CPU || 0),
         cpu_total: n.total_resources.CPU || 0,

@@ -167,7 +167,7 @@ class RpcIngressClient:
                                         daemon=True, name="serve-rpc-client")
         self._thread.start()
         self._conn = asyncio.run_coroutine_threadsafe(
-            rpc.connect_retry(host, port, handlers={
+            rpc.dial(host, port, handlers={
                 "ServeStreamChunk": self._on_stream,
                 "ServeStreamEnd": self._on_stream,
                 "ServeStreamError": self._on_stream,

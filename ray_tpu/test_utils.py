@@ -11,6 +11,7 @@ restarts, and lineage reconstruction keep the workload correct
 
 from __future__ import annotations
 
+import asyncio
 import random
 import threading
 import time
@@ -228,6 +229,248 @@ class NodePreempter:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class _ChaosLink:
+    """One proxied TCP link (internal to NetChaos).
+
+    Fault knobs are plain attributes read by the pump coroutines on
+    every frame; writes from the test thread are atomic under the GIL,
+    so no locking is needed for test purposes. Each direction gets its
+    own seeded rng so the two pumps never interleave draws — the same
+    seed replays the same drop/dup schedule per stream.
+    """
+
+    def __init__(self, name: str, upstream: tuple[str, int], seed):
+        self.name = name
+        self.upstream = upstream
+        self.rng = {d: random.Random(f"{seed}:{name}:{d}")
+                    for d in ("c2s", "s2c")}
+        self.drop = 0.0       # P(silently drop a frame)
+        self.delay_s = 0.0    # added one-way latency per frame
+        self.dup = 0.0        # P(forward a frame twice)
+        self.blackhole: set[str] = set()  # directions silently eaten
+        self.refusing = False  # new connections rejected (link "down")
+        self.server = None
+        self.host: str | None = None
+        self.port: int | None = None
+        self.writers: list = []  # live writers, for cut()
+        self.stats = {"conns": 0, "conns_refused": 0,
+                      "frames_forwarded": 0, "frames_dropped": 0,
+                      "frames_duplicated": 0, "frames_blackholed": 0}
+
+
+class NetChaos:
+    """Seeded, deterministic network fault injector: a frame-aware TCP
+    proxy interposed on the repo's length-prefixed msgpack RPC links.
+
+    Faults operate on WHOLE frames (4-byte BE length + body, the
+    _private/rpc.py wire format), so injected drops/dups/partitions
+    exercise the resilient-session layer (reconnect, replay, server-side
+    dedup, SUSPECT-before-DEAD) rather than producing protocol garbage.
+    Composable with NodeKiller/NodePreempter — proxy the control links,
+    then kill/preempt through the same cluster.
+
+    Usage::
+
+        chaos = NetChaos(seed=7).start()
+        ph, pp = chaos.link("n1-gcs", gcs_host, gcs_port)
+        node = cluster.add_node(num_cpus=2, gcs_addr=(ph, pp))
+        chaos.set_faults("n1-gcs", drop=0.05, delay_s=0.01, dup=0.02)
+        chaos.partition("n1-gcs", "c2s")  # one-way: raylet->GCS eaten
+        chaos.heal("n1-gcs")
+        chaos.flap("n1-gcs", down_s=0.5)  # cut + refuse, then heal
+        chaos.cut("n1-gcs")               # close live sockets once
+        print(chaos.stats("n1-gcs"))
+        chaos.stop()
+
+    Fault vocabulary:
+      - drop/delay_s/dup — per-frame probabilistic faults (seeded rng).
+      - partition(direction=None) — silently eat frames one way ("c2s"
+        client->server, "s2c" server->client) or both; sockets stay OPEN.
+        This is the asymmetric partition SUSPECT exists for.
+      - cut() — close every live proxied socket (clean connection loss).
+      - flap(down_s) — refuse + cut for down_s, then heal: the
+        transient outage that must be a non-event (no false DEAD).
+    """
+
+    def __init__(self, seed: int | None = None):
+        self.seed = seed if seed is not None else random.randrange(2**31)
+        self._links: dict[str, _ChaosLink] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        started = threading.Event()
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.call_soon(started.set)
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="net-chaos")
+        self._thread.start()
+        if not started.wait(10.0):
+            raise RuntimeError("NetChaos loop failed to start")
+        return self
+
+    def link(self, name: str, upstream_host: str,
+             upstream_port: int) -> tuple[str, int]:
+        """Open a proxy listener for `upstream`; returns (host, port)
+        to hand to the client side (e.g. Cluster.add_node(gcs_addr=))."""
+        assert self._loop is not None, "call start() first"
+        assert name not in self._links, f"link {name!r} already exists"
+        link = _ChaosLink(name, (upstream_host, upstream_port), self.seed)
+        asyncio.run_coroutine_threadsafe(
+            self._open(link), self._loop).result(10.0)
+        self._links[name] = link
+        return link.host, link.port
+
+    async def _open(self, link: _ChaosLink):
+        async def on_conn(reader, writer):
+            if link.refusing:
+                link.stats["conns_refused"] += 1
+                writer.close()
+                return
+            try:
+                up_reader, up_writer = await asyncio.open_connection(
+                    *link.upstream)
+            except OSError:
+                link.stats["conns_refused"] += 1
+                writer.close()
+                return
+            from ray_tpu._private.common import supervised_task
+
+            link.stats["conns"] += 1
+            link.writers += [writer, up_writer]
+            pumps = [
+                supervised_task(
+                    self._pump(link, reader, up_writer, "c2s"),
+                    name=f"chaos-{link.name}-c2s"),
+                supervised_task(
+                    self._pump(link, up_reader, writer, "s2c"),
+                    name=f"chaos-{link.name}-s2c"),
+            ]
+            # One side dying kills the whole proxied conn, like a real
+            # TCP reset would.
+            await asyncio.wait(pumps, return_when=asyncio.FIRST_COMPLETED)
+            for p in pumps:
+                p.cancel()
+            for w in (writer, up_writer):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+                if w in link.writers:
+                    link.writers.remove(w)
+
+        link.server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        link.host, link.port = link.server.sockets[0].getsockname()[:2]
+
+    async def _pump(self, link: _ChaosLink, reader, writer, direction: str):
+        rng = link.rng[direction]
+        try:
+            while True:
+                header = await reader.readexactly(4)
+                body = await reader.readexactly(int.from_bytes(header, "big"))
+                frame = header + body
+                if direction in link.blackhole:
+                    link.stats["frames_blackholed"] += 1
+                    continue
+                if link.drop and rng.random() < link.drop:
+                    link.stats["frames_dropped"] += 1
+                    continue
+                if link.delay_s:
+                    await asyncio.sleep(link.delay_s)
+                writer.write(frame)
+                link.stats["frames_forwarded"] += 1
+                if link.dup and rng.random() < link.dup:
+                    # Replays the identical REQUEST frame — exercises
+                    # the server-side (session_id, seq) reply cache.
+                    writer.write(frame)
+                    link.stats["frames_duplicated"] += 1
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+
+    def set_faults(self, name: str, *, drop: float = 0.0,
+                   delay_s: float = 0.0, dup: float = 0.0):
+        link = self._links[name]
+        link.drop, link.delay_s, link.dup = drop, delay_s, dup
+
+    def partition(self, name: str, direction: str | None = None):
+        """Silently eat frames — one way ("c2s"/"s2c") or both (None).
+        Sockets stay open: neither side sees a connection error, only
+        silence, so failure detection must come from heartbeat expiry."""
+        link = self._links[name]
+        link.blackhole |= {direction} if direction else {"c2s", "s2c"}
+
+    def heal(self, name: str):
+        """Lift partitions and connection refusal (probabilistic faults
+        set via set_faults persist until reset explicitly)."""
+        link = self._links[name]
+        link.blackhole.clear()
+        link.refusing = False
+
+    def cut(self, name: str):
+        """Close every live proxied socket on this link — both ends see
+        a clean connection loss (the reconnect/replay trigger)."""
+        link = self._links[name]
+
+        def _close():
+            for w in list(link.writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            link.writers.clear()
+
+        self._loop.call_soon_threadsafe(_close)
+
+    def flap(self, name: str, down_s: float = 0.5):
+        """Take the link fully down (refuse new conns + cut live ones)
+        for `down_s`, then bring it back. Blocks the calling thread."""
+        link = self._links[name]
+        link.refusing = True
+        self.cut(name)
+        time.sleep(down_s)
+        self.heal(name)
+
+    def stats(self, name: str) -> dict:
+        return dict(self._links[name].stats)
+
+    def stop(self):
+        if self._loop is None:
+            return
+
+        async def _shutdown():
+            for link in self._links.values():
+                if link.server is not None:
+                    link.server.close()
+                for w in list(link.writers):
+                    try:
+                        w.close()
+                    except Exception:
+                        pass
+                link.writers.clear()
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _shutdown(), self._loop).result(10.0)
+        except Exception:
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._loop = None
 
     def __enter__(self):
         return self.start()

@@ -136,14 +136,17 @@ class ClientContext:
             target=self._loop.run_forever, name="ray-tpu-client", daemon=True)
         self._thread.start()
         self._streams: dict[str, _queue.Queue] = {}
+        # dial, not a session: the proxy keeps per-connection state, so
+        # a lost socket means this client session is over (the _rpc
+        # ConnectionLost path surfaces that to the caller).
         self._conn: rpc.Connection = self._call_soon(
-            rpc.connect_retry(host, port, name="client",
-                              handlers={
-                                  "ClientStreamItem": self._on_stream_ev,
-                                  "ClientStreamEnd": self._on_stream_ev,
-                                  "ClientStreamError": self._on_stream_ev,
-                              },
-                              timeout=connect_timeout),
+            rpc.dial(host, port, name="client",
+                     handlers={
+                         "ClientStreamItem": self._on_stream_ev,
+                         "ClientStreamEnd": self._on_stream_ev,
+                         "ClientStreamError": self._on_stream_ev,
+                     },
+                     timeout=connect_timeout),
             timeout=connect_timeout + 5.0)
         self._token = common.current_client.set(self)
         self._closed = False

@@ -74,7 +74,7 @@ class _PeerPlane:
         key = tuple(addr)
         conn = self._conns.get(key)
         if conn is None or conn.closed:
-            conn = self.cw._run(rpc.connect(
+            conn = self.cw._run(rpc.dial(
                 addr[0], int(addr[1]), name="collective-peer"))
             self._conns[key] = conn
         return conn

@@ -433,8 +433,23 @@ def core_prometheus_text() -> str:
             by_state[n.get("state", "?")] = \
                 by_state.get(n.get("state", "?"), 0) + 1
         gauge("ray_tpu_nodes_by_state",
-              "nodes per drain-ladder state (ALIVE/DRAINING/DRAINED/DEAD)",
+              "nodes per lifecycle state "
+              "(ALIVE/SUSPECT/DRAINING/DRAINED/DEAD)",
               [({"state": k}, v) for k, v in sorted(by_state.items())])
+        suspect = [n for n in nodes if n.get("state") == "SUSPECT"]
+        lines.append("# HELP ray_tpu_nodes_suspect nodes whose GCS "
+                     "connection is lost, inside the re-registration "
+                     "grace window (excluded from new placement)")
+        lines.append("# TYPE ray_tpu_nodes_suspect gauge")
+        lines.append(f"ray_tpu_nodes_suspect {len(suspect)}")
+        recoveries = [({"node_id": str(n.get("node_id", "?"))[:12]},
+                       n.get("suspect_recoveries", 0))
+                      for n in nodes if n.get("suspect_recoveries")]
+        if recoveries:
+            gauge("ray_tpu_node_suspect_recoveries_total",
+                  "times this node re-registered inside the SUSPECT "
+                  "grace window (partition flaps survived)",
+                  recoveries)
         drain_rows = [(n, n.get("drain_stats") or {}) for n in nodes]
         drain_rows = [(n, d) for n, d in drain_rows if d]
         nlab = lambda n: {"node_id": str(n.get("node_id", "?"))[:12],
@@ -461,6 +476,25 @@ def core_prometheus_text() -> str:
                 gauge(metric, help_, samples)
     except Exception:
         pass
+    # Resilient-session counters, per raylet (each daemon's process-
+    # global rpc.session_stats(): reconnects it performed as a client,
+    # replays it sent, retried requests it answered from the reply
+    # cache as a server).
+    for metric, help_, key in (
+            ("ray_tpu_rpc_reconnects_total",
+             "resilient-session reconnects since daemon boot",
+             "reconnects_total"),
+            ("ray_tpu_rpc_replayed_requests_total",
+             "un-acked requests replayed after a session reconnect",
+             "replayed_requests_total"),
+            ("ray_tpu_rpc_deduped_requests_total",
+             "retried requests answered from the (session_id, seq) "
+             "reply cache instead of re-executing",
+             "deduped_requests_total")):
+        samples = [(nid(st), st.get("rpc_sessions", {}).get(key, 0))
+                   for st in ok if st.get("rpc_sessions")]
+        if samples:
+            gauge(metric, help_, samples)
     try:
         actors = _state.summarize_actors()["by_state"]
         gauge("ray_tpu_actors", "actors by state",

@@ -96,8 +96,8 @@ def _per_node_call(method: str, payload: dict | None = None,
 
     async def one(n):
         try:
-            conn = await rpc.connect(n["host"], n["raylet_port"],
-                                     name=f"state-{method}")
+            conn = await rpc.dial(n["host"], n["raylet_port"],
+                                  name=f"state-{method}", timeout=5.0)
             try:
                 return await conn.call(method, payload or {},
                                        timeout=timeout)

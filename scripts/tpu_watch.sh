@@ -167,6 +167,57 @@ print("elastic-train-probe:", json.dumps({
     "gauges": util_metrics.train_elastic_snapshot()}))
 cluster.shutdown()
 PYEOF
+        # Partition probe: one node's raylet->GCS link runs through a
+        # seeded NetChaos proxy; the link flaps mid-workload. The run
+        # must finish with the node ALIVE (SUSPECT was entered and
+        # recovered — a non-event), so the log carries the partition
+        # path's metrics (suspect recoveries, session reconnects/
+        # replays/dedups) next to the drain and bench numbers.
+        timeout 300 python - >> "$LOG" 2>&1 <<'PYEOF' || true
+import json
+
+import ray_tpu
+from ray_tpu._private.config import Config
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.test_utils import NetChaos, wait_for_condition
+from ray_tpu.util import state as util_state
+
+config = Config(health_check_period_s=0.2, num_heartbeats_timeout=10)
+cluster = Cluster(initialize_head=True, connect=True,
+                  head_node_args={"num_cpus": 2}, config=config)
+chaos = NetChaos(seed=11).start()
+gcs_host, gcs_port = cluster.gcs_address.rsplit(":", 1)
+proxy = chaos.link("probe-gcs", gcs_host, int(gcs_port))
+target = cluster.add_node(num_cpus=2, resources={"probe": 1},
+                          gcs_addr=proxy)
+cluster.wait_for_nodes()
+
+@ray_tpu.remote(resources={"probe": 0.1})
+def _inc(x):
+    return x + 1
+
+refs = []
+for i in range(50):
+    if i == 10:
+        chaos.flap("probe-gcs", down_s=0.5)
+    refs.append(_inc.remote(i))
+vals = ray_tpu.get(refs)
+node_row = lambda: next((n for n in ray_tpu.nodes()
+                         if n["node_id"] == target.node_id), {})
+wait_for_condition(lambda: node_row().get("state") == "ALIVE",
+                   timeout=15)
+info = node_row()
+status = util_state.cluster_status()
+print("partition-probe:", json.dumps({
+    "tasks_ok": vals == [i + 1 for i in range(50)],
+    "state": info.get("state"),
+    "suspect_recoveries": info.get("suspect_recoveries"),
+    "suspect_nodes": status.get("suspect_nodes"),
+    "rpc_sessions": status.get("rpc_sessions"),
+    "proxy": chaos.stats("probe-gcs")}))
+chaos.stop()
+cluster.shutdown()
+PYEOF
         timeout 1800 python scripts/tpu_kernel_sweep.py --check-only \
           > KERNEL_SWEEP_TPU.txt 2>&1 || true
         exit 0

@@ -174,3 +174,53 @@ def test_stochastic_step_schedule_preemption(ray_start_cluster_head):
     # Fired at (or a poll past) the first seeded gap ∈ [8, 12].
     assert preempter.step_schedule
     assert 8 <= preempter.step_schedule[0] <= 20
+
+
+@pytest.mark.smoke
+def test_partition_flap_composes_with_preemption(ray_start_cluster_head):
+    """The two seeded fault injectors together (PR 10): one node's GCS
+    link runs through a NetChaos proxy and flaps inside the heartbeat
+    grace window while ANOTHER node is spot-preempted (drain-then-kill).
+    The workload still finishes exactly, the flapped node recovers
+    through the SUSPECT rung (a non-event), and the driver counts zero
+    lineage reconstructions — neither fault is allowed to amplify the
+    other into a false death."""
+    from ray_tpu._private.api_internal import get_core_worker
+    from ray_tpu.test_utils import NetChaos
+
+    cluster = ray_start_cluster_head
+    cw = get_core_worker()
+    chaos = NetChaos(seed=3).start()
+    try:
+        gcs_host, gcs_port = cluster.gcs_address.rsplit(":", 1)
+        proxy = chaos.link("flappy-gcs", gcs_host, int(gcs_port))
+        flappy = cluster.add_node(num_cpus=2, resources={"side": 1},
+                                  gcs_addr=proxy)
+        doomed = cluster.add_node(num_cpus=2, resources={"side": 1})
+        cluster.wait_for_nodes()
+
+        refs = [_side_compute.options(max_retries=10).remote(i)
+                for i in range(40)]
+        # Flap (0.4s, under the 0.2s x 5 = 1s grace) then immediately
+        # preempt the other 'side' node while the flapped one may still
+        # be SUSPECT — its capacity must come back for the re-spilled
+        # leases.
+        chaos.flap("flappy-gcs", down_s=0.4)
+        preempter = NodePreempter(cluster, deadline_s=10,
+                                  reason="preemption")
+        result = preempter.preempt(doomed)
+        assert result.get("state") == "DRAINED", result
+
+        assert ray_tpu.get(refs, timeout=120) == [i * 2 for i in range(40)]
+
+        def row():
+            return next((n for n in ray_tpu.nodes()
+                         if n["node_id"] == flappy.node_id), {})
+
+        wait_for_condition(lambda: row().get("state") == "ALIVE",
+                           timeout=15)
+        assert row().get("suspect_recoveries", 0) >= 1, row()
+        assert preempter.preemptions == 1
+        assert cw._num_reconstructions == 0
+    finally:
+        chaos.stop()

@@ -187,3 +187,122 @@ def test_pg_ready_promise_survives_gcs_restart(ft_cluster):
     waiter.join(timeout=90)
     assert got == [True], f"pg.ready() promise lost across GCS restart: {got}"
     remove_placement_group(pg)
+
+
+# ---------------------------------------------------------------------------
+# Network partitions (PR 10): a raylet whose GCS link flaps inside the
+# heartbeat grace window is a NON-EVENT — SUSPECT, then restored, with
+# zero reconstructions, zero duplicate actor creations, and the workload
+# unbothered. Only an outage that outlives the grace window promotes
+# SUSPECT -> DEAD. The link runs through a seeded NetChaos proxy so the
+# fault schedule is deterministic.
+# ---------------------------------------------------------------------------
+
+
+def _node_row(node_id):
+    return next((n for n in ray_tpu.nodes()
+                 if n["node_id"] == node_id), {})
+
+
+def test_partition_flap_is_a_non_event(ft_cluster):
+    """~500 tasks flow while the target raylet's GCS link flaps twice
+    (each outage well under the 0.2s x 10 = 2s grace). Every result must
+    arrive, the node must end ALIVE with suspect_recoveries bumped, the
+    pinned actor must keep its process (no duplicate creation), and the
+    driver must count zero lineage reconstructions — the raylet's
+    resilient session reconnected instead of the node dying."""
+    from ray_tpu._private.api_internal import get_core_worker
+    from ray_tpu.test_utils import NetChaos, wait_for_condition
+    from ray_tpu.util import state as util_state
+
+    cw = get_core_worker()
+    chaos = NetChaos(seed=7).start()
+    try:
+        gcs_host, gcs_port = ft_cluster.gcs_address.rsplit(":", 1)
+        proxy = chaos.link("flap-gcs", gcs_host, int(gcs_port))
+        target = ft_cluster.add_node(num_cpus=4, resources={"part": 1},
+                                     gcs_addr=proxy)
+        ft_cluster.wait_for_nodes()
+
+        @ray_tpu.remote
+        class Pinned:
+            def __init__(self):
+                import os
+                self.pid = os.getpid()
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return (self.pid, self.n)
+
+        actor = Pinned.options(max_restarts=5,
+                               resources={"part": 0.1}).remote()
+        pid0, n0 = ray_tpu.get(actor.incr.remote(), timeout=30)
+        assert n0 == 1
+
+        @ray_tpu.remote(resources={"part": 0.01})
+        def inc(x):
+            return x + 1
+
+        refs = []
+        for i in range(500):
+            if i in (100, 300):
+                chaos.flap("flap-gcs", down_s=0.5)
+            refs.append(inc.remote(i))
+        assert ray_tpu.get(refs, timeout=180) == [i + 1 for i in range(500)]
+
+        wait_for_condition(
+            lambda: _node_row(target.node_id).get("state") == "ALIVE",
+            timeout=15)
+        row = _node_row(target.node_id)
+        assert row.get("suspect_recoveries", 0) >= 1, \
+            f"flap never entered the SUSPECT rung: {row}"
+        # Same actor process, same counter: no duplicate creation, no
+        # restart — the flap was invisible to it.
+        pid1, n1 = ray_tpu.get(actor.incr.remote(), timeout=30)
+        assert (pid1, n1) == (pid0, 2), "actor restarted across a flap"
+        assert cw._num_reconstructions == 0
+        # The raylet rode its resilient session through the cuts instead
+        # of re-dialing ad hoc.
+        stats = util_state.node_stats(node_id=target.node_id)
+        sess = stats[0].get("rpc_sessions", {}) if stats else {}
+        assert sess.get("reconnects_total", 0) >= 1, sess
+        status = util_state.cluster_status()
+        assert status.get("suspect_nodes") == 0
+    finally:
+        chaos.stop()
+
+
+def test_partition_longer_than_grace_promotes_to_dead(ft_cluster):
+    """The other side of the contract: an outage that OUTLIVES the grace
+    window must not be forgiven. The node walks ALIVE -> SUSPECT (on
+    connection loss) -> DEAD (on grace expiry), observably from the
+    driver, while the outage is still in progress."""
+    import threading
+
+    from ray_tpu.test_utils import NetChaos, wait_for_condition
+
+    chaos = NetChaos(seed=8).start()
+    try:
+        gcs_host, gcs_port = ft_cluster.gcs_address.rsplit(":", 1)
+        proxy = chaos.link("dead-gcs", gcs_host, int(gcs_port))
+        target = ft_cluster.add_node(num_cpus=2, resources={"gone": 1},
+                                     gcs_addr=proxy)
+        ft_cluster.wait_for_nodes()
+        assert _node_row(target.node_id).get("state") == "ALIVE"
+
+        # Outage (6s) > grace (0.2s x 10 = 2s). flap() blocks for the
+        # full outage, so run it on the side and watch the ladder.
+        flapper = threading.Thread(
+            target=lambda: chaos.flap("dead-gcs", down_s=6.0), daemon=True)
+        flapper.start()
+        wait_for_condition(
+            lambda: _node_row(target.node_id).get("state") == "SUSPECT",
+            timeout=10)
+        wait_for_condition(
+            lambda: _node_row(target.node_id).get("state") == "DEAD",
+            timeout=10)
+        assert _node_row(target.node_id).get("alive") is False
+        flapper.join(timeout=15)
+    finally:
+        chaos.stop()

@@ -388,6 +388,91 @@ def test_r5_suppression():
 
 
 # ---------------------------------------------------------------------------
+# R6: ad-hoc connection management outside the session layer
+# ---------------------------------------------------------------------------
+
+
+R6_BAD = """
+from ray_tpu._private import rpc
+
+async def attach(host, port):
+    conn = await rpc.connect(host, port)
+    conn2 = await rpc.connect_retry(host, port)
+    try:
+        await conn.call("Ping", {})
+    except rpc.ConnectionLost:
+        pass
+"""
+
+R6_GOOD = """
+import logging
+from ray_tpu._private import rpc
+
+logger = logging.getLogger(__name__)
+
+async def attach(host, port):
+    conn = await rpc.dial(host, port)
+    sess = await rpc.connect_session(host, port, name="x")
+    try:
+        await conn.call("Ping", {})
+    except rpc.ConnectionLost:
+        logger.warning("peer died; treating as node death")
+        raise
+
+def tcp(sock, addr):
+    sock.connect(addr)  # not rpc.connect: out of scope
+"""
+
+
+def test_r6_flags_raw_connects_and_silent_catch():
+    assert rules_of(lint_source(R6_BAD)) == ["R6", "R6", "R6"]
+
+
+def test_r6_alias_aware():
+    src = (
+        "from ray_tpu._private import rpc as _r\n"
+        "from ray_tpu._private.rpc import connect_retry\n"
+        "async def go(h, p):\n"
+        "    await _r.connect(h, p)\n"
+        "    await connect_retry(h, p)\n"
+    )
+    assert rules_of(lint_source(src)) == ["R6", "R6"]
+
+
+def test_r6_session_layer_exempt():
+    assert rules_of(lint_source(
+        R6_BAD, filename="ray_tpu/_private/rpc.py")) == []
+    assert rules_of(lint_source(
+        R6_BAD, filename="ray_tpu/_private/fast_rpc.py")) == []
+
+
+def test_r6_tuple_catch_with_pass():
+    src = (
+        "import asyncio\n"
+        "from ray_tpu._private import rpc\n"
+        "async def beat(conn):\n"
+        "    try:\n"
+        "        await conn.call('Heartbeat', {})\n"
+        "    except (rpc.ConnectionLost, asyncio.TimeoutError):\n"
+        "        pass\n"
+    )
+    assert rules_of(lint_source(src)) == ["R6"]
+
+
+def test_r6_passes_dial_session_and_handled_catch():
+    assert rules_of(lint_source(R6_GOOD)) == []
+
+
+def test_r6_suppression():
+    src = R6_BAD.replace(
+        "conn = await rpc.connect(host, port)",
+        "conn = await rpc.connect(host, port)  # graftlint: disable=R6")
+    report = lint_source(src)
+    assert rules_of(report) == ["R6", "R6"]
+    assert report.suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # Baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -435,7 +520,7 @@ def test_update_baseline_drops_zeroed_entries(tmp_path):
 
 
 def test_all_rules_registered():
-    assert [r.id for r in ALL_RULES] == ["R1", "R2", "R3", "R4", "R5"]
+    assert [r.id for r in ALL_RULES] == ["R1", "R2", "R3", "R4", "R5", "R6"]
 
 
 # ---------------------------------------------------------------------------
