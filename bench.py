@@ -181,6 +181,7 @@ def _replay_live_capture() -> int | None:
 
 
 _DEVICE_HANDOFF_MODE = "--device-handoff" in sys.argv[1:]
+_SERVE_DISAGG_MODE = "--serve-disagg" in sys.argv[1:]
 
 if os.environ.get("RAY_TPU_BENCH_CHILD") == "1":
     import jax  # hermetic CPU child: axon site already stripped
@@ -188,8 +189,9 @@ elif _probe_accelerator() is not None:
     import jax  # accelerator alive: init the real backend in-process
 else:
     # Training-capture replay only applies to the MFU bench; a handoff
-    # run must produce its own (cpu-backend) capture instead.
-    rc = None if _DEVICE_HANDOFF_MODE else _replay_live_capture()
+    # or serve run must produce its own (cpu-backend) capture instead.
+    rc = None if (_DEVICE_HANDOFF_MODE or _SERVE_DISAGG_MODE) \
+        else _replay_live_capture()
     if rc is not None:
         sys.exit(rc)
     print("bench: no live accelerator and no live capture to replay; "
@@ -450,7 +452,141 @@ def device_handoff_main():
     return 0
 
 
+def serve_disagg_main():
+    """Disaggregated-serving bench: 2 prefill + 2 decode replica pools
+    under one router on a local cluster, concurrent streams with
+    repeated prompts so the prefix cache and the device-plane KV
+    handoff both light up.
+
+    Emits ONE JSON line — tokens/s, TTFT p50/p99, the decode pool's
+    per-route KV counters (which route the prefill→decode handoff
+    actually took), prefix-cache hit rate — health-stamped like the
+    training captures.
+    """
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu._private.bench_health import make_stamp
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+    from ray_tpu.serve.llm_disagg import deploy_disagg
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, d_model=1024, n_layers=8,
+                          n_heads=16, n_kv_heads=8, d_ff=4096,
+                          max_seq_len=1024, dtype=jnp.bfloat16)
+        max_len, max_new, prompt_len = 512, 64, 64
+        n_requests, max_batch = 32, 8
+    else:
+        cfg = LlamaConfig(vocab_size=256, d_model=64, n_layers=2,
+                          n_heads=4, n_kv_heads=2, d_ff=128,
+                          max_seq_len=128, dtype=jnp.float32,
+                          attention="reference", remat=False)
+        max_len, max_new, prompt_len = 96, 16, 12
+        n_requests, max_batch = 12, 4
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    probe_before = _health_probe()
+    ray_tpu.init(num_cpus=4)
+    try:
+        h = deploy_disagg(cfg, params, prefill_replicas=2,
+                          decode_replicas=2, max_batch=max_batch,
+                          max_len=max_len,
+                          prefill_actor_options={"num_cpus": 0},
+                          decode_actor_options={"num_cpus": 0})
+        rng = np.random.default_rng(0)
+        distinct = [list(map(int, rng.integers(1, cfg.vocab_size,
+                                               size=prompt_len)))
+                    for _ in range(4)]
+        # Warmup outside the timed window: compiles the prefill buckets
+        # and the decode step on every replica's first touch (several
+        # concurrent streams so the picker reaches all four replicas).
+        warm = [threading.Thread(target=lambda: list(h.stream(
+            {"prompt_tokens": distinct[0], "max_new_tokens": 4})))
+            for _ in range(4)]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(timeout=300)
+        ttfts: list = []
+        counts: list = []
+        lock = threading.Lock()
+
+        def run(i):
+            p = distinct[i % len(distinct)]  # repeats → prefix-cache hits
+            t0 = time.perf_counter()
+            first, n = None, 0
+            for _tok in h.stream({"prompt_tokens": p,
+                                  "max_new_tokens": max_new}):
+                if first is None:
+                    first = time.perf_counter() - t0
+                n += 1
+            with lock:
+                ttfts.append(first if first is not None else 0.0)
+                counts.append(n)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(n_requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+        total = sum(counts)
+        pm = h.pool_metrics()
+        routes: dict = {}
+        for m in pm["decode"]:
+            for k, v in (m.get("plane_counters") or {}).items():
+                routes[k] = routes.get(k, 0) + int(v)
+        hits = sum(m.get("prefix_cache_hits", 0) for m in pm["prefill"])
+        misses = sum(m.get("prefix_cache_misses", 0)
+                     for m in pm["prefill"])
+        router_stats = dict(h.stats)
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+    probe_after = _health_probe()
+    health = make_stamp(probe_before, probe_after, jax.default_backend())
+    srt = sorted(ttfts)
+    pick = lambda q: srt[min(len(srt) - 1,  # noqa: E731
+                             int(q * len(srt)))] if srt else 0.0
+    tps = round(total / wall, 1) if wall > 0 else 0.0
+    print(json.dumps({
+        "metric": "serve_disagg_tokens_per_s",
+        "value": tps,
+        "unit": "tokens/s",
+        "vs_baseline": tps,
+        "extra": {
+            "health": health,
+            "backend": jax.default_backend(),
+            "prefill_replicas": 2, "decode_replicas": 2,
+            "requests": n_requests, "completed": len(counts),
+            "prompt_len": prompt_len, "max_new_tokens": max_new,
+            "total_generated": total, "wall_s": round(wall, 2),
+            "ttft_p50_ms": round(pick(0.5) * 1e3, 1),
+            "ttft_p99_ms": round(pick(0.99) * 1e3, 1),
+            "kv_route_counters": {
+                k: routes.get(k, 0)
+                for k in ("in_process", "collective", "host_fallback",
+                          "evacuated_in", "evacuated_out")},
+            "prefix_cache_hit_rate": round(hits / (hits + misses), 3)
+                                     if hits + misses else 0.0,
+            "router_stats": router_stats,
+        }}))
+    return 0
+
+
 if __name__ == "__main__":
     if _DEVICE_HANDOFF_MODE:
         sys.exit(device_handoff_main())
+    if _SERVE_DISAGG_MODE:
+        sys.exit(serve_disagg_main())
     main()

@@ -637,6 +637,29 @@ async def handle_stats(cw, payload: dict) -> dict:
 
 # ---------- drain-path evacuation ----------
 
+# Callbacks that materialize last-moment pins (e.g. a serving engine
+# snapshotting in-flight stream KV) — run to completion INSIDE
+# evacuate() before the registry is snapshotted. A DrainNotice listener
+# cannot do this: the raylet fires DeviceObjectEvacuate milliseconds
+# after the notice, and pins created on a listener thread lose that
+# race and silently miss the evacuation.
+_evac_preparers: list = []
+
+
+def add_evacuation_preparer(fn) -> None:
+    """Register fn() to run (in an executor thread, awaited) before a
+    drain evacuation gathers this process's pins."""
+    if fn not in _evac_preparers:
+        _evac_preparers.append(fn)
+
+
+def remove_evacuation_preparer(fn) -> None:
+    try:
+        _evac_preparers.remove(fn)
+    except ValueError:
+        pass
+
+
 async def evacuate(cw) -> dict:
     """Re-home every pinned array whose ObjectRef owner lives off this
     node — called by the raylet's drain pipeline before the node dies.
@@ -652,6 +675,13 @@ async def evacuate(cw) -> dict:
 
     from ray_tpu._private.common import Address
 
+    loop = asyncio.get_running_loop()
+    for fn in list(_evac_preparers):
+        try:
+            await loop.run_in_executor(None, fn)
+        except Exception:
+            logger.warning("evacuation preparer failed; continuing with "
+                           "existing pins", exc_info=True)
     reg = registry()
     with reg._lock:
         snap = list(reg._entries.items())
@@ -661,7 +691,6 @@ async def evacuate(cw) -> dict:
         by_prefix.setdefault(key.split("#", 1)[0], []).append((key, entry))
     stats = {"evacuated_objects": 0, "evacuated_bytes": 0, "skipped": 0,
              "routes": {}}
-    loop = asyncio.get_running_loop()
     want_collective = os.environ.get("RAY_TPU_DEVICE_COLLECTIVE") == "1"
     for prefix, leaves in by_prefix.items():
         owner_wire = owners.get(prefix)

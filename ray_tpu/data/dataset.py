@@ -154,6 +154,32 @@ def _shuffle_sample(sample_blob, block):
     return serialization.loads_func(sample_blob)(block)
 
 
+def _device_runtime_ready() -> bool:
+    """True when this process is attached to a running cluster (the
+    device-object plane can route landings); standalone/local use of
+    Dataset falls back to host-side device_put."""
+    try:
+        from ray_tpu._private.api_internal import get_core_worker
+
+        return get_core_worker() is not None
+    except Exception:
+        return False
+
+
+@ray_tpu.remote
+def _land_block_jax(block):
+    """Device-landing stage for iter_jax_batches: the host→HBM copy for
+    a block's numeric columns happens HERE, on a worker, and the arrays
+    return as pinned device objects (tensor_transport="device") — the
+    consumer resolves them over the device plane instead of paying the
+    copy itself."""
+    import jax
+
+    batch = rows_to_batch(block_to_rows(block))
+    return {k: jax.device_put(np.ascontiguousarray(np.asarray(v)))
+            for k, v in batch.items()}
+
+
 @ray_tpu.remote
 class _StageActor:
     """Stateful map worker: constructs the UDF once, applies it per block."""
@@ -478,8 +504,8 @@ class Dataset:
 
     # ------------- execution -------------
 
-    def _iter_output_blocks(self, max_in_flight: int | None = None
-                            ) -> Iterator[Any]:
+    def _iter_output_blocks(self, max_in_flight: int | None = None,
+                            yield_refs: bool = False) -> Iterator[Any]:
         """The streaming loop: push blocks through stages with bounded
         in-flight remote tasks (reference: streaming_executor.py:217
         scheduling loop + ExecutionResources backpressure :280).
@@ -494,7 +520,8 @@ class Dataset:
         t0 = _time.perf_counter()
         n_blocks = n_rows = 0
         try:
-            for blk in self._iter_output_blocks_inner(max_in_flight):
+            for blk in self._iter_output_blocks_inner(max_in_flight,
+                                                      yield_refs=yield_refs):
                 n_blocks += 1
                 try:
                     n_rows += len(blk)
@@ -550,8 +577,8 @@ class Dataset:
                 f"Output: {s['output_blocks']} blocks, {s['output_rows']} rows\n"
                 f"Wall time: {s['wall_s']}s")
 
-    def _iter_output_blocks_inner(self, max_in_flight: int
-                                  ) -> Iterator[Any]:
+    def _iter_output_blocks_inner(self, max_in_flight: int,
+                                  yield_refs: bool = False) -> Iterator[Any]:
         from ray_tpu._private import serialization
         from ray_tpu.data.context import DataContext
 
@@ -698,6 +725,14 @@ class Dataset:
                 materialized = [b if not isinstance(b, ray_tpu.ObjectRef)
                                 else ray_tpu.get(b) for b in blocks]
                 blocks = iter(barrier.all_to_all_fn(materialized))
+        if yield_refs:
+            # Consumer-side landing sinks (iter_jax_batches' device
+            # path) feed each ref into their own remote stage — handing
+            # the refs through keeps blocks off this process entirely.
+            # Segment boundaries (barriers, actor pools) may have
+            # materialized already; those pass through as values.
+            yield from blocks
+            return
         # Windowed fetch: keep up to max_in_flight refs outstanding so
         # stage-less pipelines (bare lazy reads) still run reads in
         # parallel instead of one round-trip per block.
@@ -737,8 +772,18 @@ class Dataset:
             yield rows_to_batch(carry) if batch_format == "numpy" else carry
 
     def iter_jax_batches(self, *, batch_size: int, mesh=None, spec=None,
-                         drop_last: bool = True) -> Iterator:
-        """Batches as (mesh-sharded) jax arrays — the TPU ingest path."""
+                         drop_last: bool = True,
+                         device_transport: bool | None = None) -> Iterator:
+        """Batches as (mesh-sharded) jax arrays — the TPU ingest path.
+
+        With device_transport (default: on whenever the runtime is up),
+        each output block's host→HBM copy runs on a WORKER via a
+        tensor_transport="device" landing task; this consumer resolves
+        the pinned arrays over the cheapest device-plane route
+        (same-mesh collective, counted host fallback) and batches
+        on-device — the consuming process never does the host→device
+        copy itself. Off (or with no runtime), batches are formed on
+        the host here and device_put directly."""
         import jax
 
         sharding = None
@@ -746,11 +791,59 @@ class Dataset:
             from jax.sharding import NamedSharding, PartitionSpec
 
             sharding = NamedSharding(mesh, spec or PartitionSpec(("dp", "fsdp")))
+        if device_transport is None:
+            device_transport = _device_runtime_ready()
+        if device_transport:
+            yield from self._iter_jax_batches_device(batch_size, sharding,
+                                                     drop_last)
+            return
         for batch in self.iter_batches(batch_size=batch_size,
                                        drop_last=drop_last):
             arrs = {k: jax.device_put(v, sharding) if sharding is not None
                     else jax.device_put(v) for k, v in batch.items()}
             yield arrs
+
+    def _iter_jax_batches_device(self, batch_size: int, sharding,
+                                 drop_last: bool) -> Iterator:
+        """Pipelined device landings: one landing task per output block
+        (window-bounded, like the host fetch path), resolved in order
+        and rebatched on-device with jnp concatenation/slicing."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.data.context import DataContext
+
+        max_in_flight = DataContext.get_current().max_in_flight_blocks
+        task_timeout = DataContext.get_current().block_task_timeout_s
+
+        def landings():
+            window: list = []
+            for b in self._iter_output_blocks(yield_refs=True):
+                window.append(_land_block_jax.options(
+                    tensor_transport="device").remote(b))
+                if len(window) >= max_in_flight:
+                    yield ray_tpu.get(window.pop(0), timeout=task_timeout)
+            while window:
+                yield ray_tpu.get(window.pop(0), timeout=task_timeout)
+
+        def place(batch):
+            return {k: jax.device_put(v, sharding) if sharding is not None
+                    else v for k, v in batch.items()}
+
+        carry: dict | None = None
+        for landed in landings():
+            if not landed:
+                continue
+            carry = landed if carry is None else \
+                {k: jnp.concatenate([carry[k], landed[k]]) for k in carry}
+            n = len(next(iter(carry.values())))
+            while n >= batch_size:
+                yield place({k: v[:batch_size] for k, v in carry.items()})
+                carry = {k: v[batch_size:] for k, v in carry.items()}
+                n -= batch_size
+        if carry is not None and not drop_last and \
+                len(next(iter(carry.values()))):
+            yield place(carry)
 
     def iter_torch_batches(self, *, batch_size: int = 256,
                            dtypes=None, device: str | None = None,

@@ -411,10 +411,19 @@ def deploy_config(config):
     return _impl(config)
 
 
+def deploy_disagg(cfg, params, **kwargs):
+    """Disaggregated LLM serving: prefill + decode replica pools under
+    one router, device-plane KV handoff, prefix caching, per-pool
+    autoscaling. See serve/llm_disagg.py."""
+    from ray_tpu.serve.llm_disagg import deploy_disagg as _impl
+
+    return _impl(cfg, params, **kwargs)
+
+
 __all__ = [
     "deployment", "run", "get_deployment_handle", "status", "delete",
     "shutdown", "batch", "start_http_proxy", "start_rpc_proxy",
-    "start_proxies", "deploy_config", "Deployment",
+    "start_proxies", "deploy_config", "deploy_disagg", "Deployment",
     "DeploymentHandle", "DeploymentResponse", "DeploymentResponseGenerator",
     "AutoscalingConfig", "multiplexed", "get_multiplexed_model_id",
 ]

@@ -174,6 +174,58 @@ class ServeController:
                     except Exception:
                         pass
                     self._publish_replicas(name)
+                try:
+                    self._autoscale_on_metrics(name, d)
+                except Exception:
+                    pass
+
+    def _autoscale_on_metrics(self, name: str, d: dict):
+        """Per-pool autoscaling on a REPLICA-REPORTED named metric
+        (autoscaling.metric / target_value): each health tick polls every
+        replica's report_metrics(), sums the named gauge, windows it over
+        look_back_period_s, and reconciles toward ceil(avg / target).
+        Deployments without `metric` keep the handle-side
+        outstanding-request signal (record_handle_load)."""
+        asc = d.get("autoscaling") or {}
+        metric = asc.get("metric")
+        target = asc.get("target_value")
+        if not metric or not target:
+            return
+        refs = []
+        for r in list(d["replicas"]):
+            try:
+                refs.append(r.report_metrics.remote())
+            except Exception:
+                pass
+        if refs:
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=5)
+        total = 0.0
+        for ref in refs:
+            try:
+                total += float(
+                    ray_tpu.get(ref, timeout=0.5).get(metric, 0.0))
+            except Exception:
+                pass
+        now = time.time()
+        samples = self._load_samples.setdefault(name, deque(maxlen=256))
+        samples.append((now, total))
+        look_back = asc.get("look_back_period_s", 10.0)
+        window = [v for ts, v in samples if now - ts <= look_back]
+        avg = sum(window) / max(1, len(window))
+        desired = max(asc.get("min_replicas", 1),
+                      min(asc.get("max_replicas", 4),
+                          int(-(-avg // target))))
+        last = self._last_scale.get(name, 0.0)
+        if desired > d["target"] and \
+                now - last > asc.get("upscale_delay_s", 0.5):
+            d["target"] = desired
+            self._last_scale[name] = now
+            self._reconcile(name)
+        elif desired < d["target"] and \
+                now - last > asc.get("downscale_delay_s", 5.0):
+            d["target"] = desired
+            self._last_scale[name] = now
+            self._reconcile(name)
 
     # ---------- deploy / reconcile / rolling update ----------
 
@@ -231,6 +283,12 @@ class ServeController:
             kwargs["num_cpus"] = opts["num_cpus"]
         if "resources" in opts:
             kwargs["resources"] = opts["resources"]
+        if "max_concurrency" in opts:
+            # Streaming engine replicas need concurrent lanes: a
+            # prepare_drain that blocks until streams finish would
+            # otherwise deadlock against the next_chunks pulls those
+            # streams need to finish.
+            kwargs["max_concurrency"] = opts["max_concurrency"]
         cls = ReplicaActor.options(**kwargs) if kwargs else ReplicaActor
         return cls.remote(d["callable_blob"], init_args, init_kwargs,
                           user_config)
@@ -312,6 +370,13 @@ class ServeController:
         replica-death retry path."""
         time.sleep(1.0)
         try:
+            # Scale-in drain protocol: let the callable finish (or
+            # evacuate) its in-flight streams before the kill — this is
+            # what makes a decode-pool downscale lose zero requests.
+            ray_tpu.get(replica.prepare_drain.remote(), timeout=300)
+        except Exception:
+            pass
+        try:
             ray_tpu.get(replica.health_check.remote(), timeout=300)
         except Exception:
             pass
@@ -343,12 +408,17 @@ class ServeController:
         over look_back_period_s — instantaneous gauges flap under bursty
         load)."""
         now = time.time()
-        samples = self._load_samples.setdefault(name, deque(maxlen=256))
-        samples.append((now, outstanding))
         d = self.deployments.get(name)
         if d is None or not d.get("autoscaling"):
             return
         asc = d["autoscaling"]
+        if asc.get("metric"):
+            # This pool scales on a replica-reported named metric polled
+            # by the health loop; the handle-side queue signal would
+            # fight it (and its samples would pollute the same window).
+            return
+        samples = self._load_samples.setdefault(name, deque(maxlen=256))
+        samples.append((now, outstanding))
         look_back = asc.get("look_back_period_s", 10.0)
         window = [v for ts, v in samples if now - ts <= look_back]
         avg = sum(window) / max(1, len(window))

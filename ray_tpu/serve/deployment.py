@@ -31,6 +31,16 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 5.0
+    # Replica-reported named metric ("queue_depth", "tokens_in_flight",
+    # ...): when set, the controller polls each replica's report_metrics()
+    # and scales this pool on sum(metric)/target_value instead of the
+    # handle-side outstanding-request count. This is what lets a
+    # disaggregated prefill pool scale on queue depth while the decode
+    # pool scales on tokens-in-flight (reference: Serve autoscaling on
+    # custom metrics).
+    metric: str | None = None
+    target_value: float | None = None
+    look_back_period_s: float = 10.0
 
 
 @dataclass
@@ -131,6 +141,32 @@ class ReplicaActor:
         return True
 
     def health_check(self):
+        return True
+
+    def report_metrics(self) -> dict:
+        """Named metrics for per-pool autoscaling: forwarded from the
+        deployment callable when it implements report_metrics()."""
+        fn = getattr(self._instance, "report_metrics", None)
+        if fn is None:
+            return {}
+        try:
+            return dict(fn())
+        except Exception:
+            return {}
+
+    def prepare_drain(self) -> bool:
+        """Called by the controller before killing this replica on
+        scale-in: blocks until the callable has finished (or evacuated)
+        its in-flight work. Replicas hosting streaming engines need
+        max_concurrency > 1 so concurrent next_chunks pulls can keep
+        draining streams while this call waits."""
+        fn = getattr(self._instance, "prepare_drain", None)
+        if fn is None:
+            return True
+        try:
+            fn()
+        except Exception:
+            pass
         return True
 
     # -- streaming (reference: serve streaming responses / generator

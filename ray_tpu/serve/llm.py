@@ -33,14 +33,14 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from ray_tpu.models.generate import SamplingParams
 from ray_tpu.models.llama import LlamaConfig, LlamaModel, init_kv_caches
-
-_SENTINEL = object()
 
 
 @dataclass
@@ -53,25 +53,85 @@ class _Slot:
     prefill_pos: int = 0
     # Paged mode: allocator key owning this slot's pages.
     seq_id: str = ""
+    # Every token this stream has generated (including ones still queued
+    # in the handle). A drain snapshot ships this so a resumed stream can
+    # re-deliver exactly the tokens the consumer never received.
+    history: list = field(default_factory=list)
+
+
+class _Prefilled:
+    """Admission payload for a request whose prefill ran in ANOTHER
+    engine (the disaggregated prefill pool, or a resume after a drain
+    evacuation): the per-layer KV prefix plus the decode cursor."""
+
+    __slots__ = ("kv_layers", "token", "prompt_len", "lens", "generated",
+                 "history", "emit_first")
+
+    def __init__(self, kv_layers, token, prompt_len, lens, generated,
+                 history, emit_first):
+        self.kv_layers = kv_layers  # [(k, v)] per layer, (Hkv, L, D)
+        self.token = int(token)      # next decode input (last sampled)
+        self.prompt_len = int(prompt_len)
+        self.lens = int(lens)        # valid KV entries
+        self.generated = int(generated)
+        self.history = list(history or [])
+        self.emit_first = bool(emit_first)
 
 
 class RequestHandle:
-    """Client-side stream of generated tokens for one request."""
+    """Client-side stream of generated tokens for one request.
 
-    def __init__(self, prompt_len: int, sampling: SamplingParams):
+    The token queue is BOUNDED (`max_buffered`): a consumer that stops
+    draining while decode keeps producing parks the producing slot
+    (backpressure) instead of growing host memory without limit."""
+
+    def __init__(self, prompt_len: int, sampling: SamplingParams,
+                 max_buffered: int = 256, tag: str = ""):
         self.prompt_len = prompt_len
         self.sampling = sampling
-        self._q: queue.Queue = queue.Queue()
+        self.tag = tag  # router-visible stream key (disagg resume)
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, max_buffered))
+        self._done = threading.Event()
+        self._submit_ts = time.monotonic()
         self.error: Exception | None = None
+
+    def _offer(self, tok: int) -> bool:
+        """Non-blocking enqueue; False = consumer backlog full. The
+        engine parks the slot on False — it must never block its loop
+        on a slow consumer."""
+        try:
+            self._q.put_nowait(tok)
+            return True
+        except queue.Full:
+            return False
+
+    def _finish(self, error: Exception | None = None) -> None:
+        if error is not None and self.error is None:
+            self.error = error
+        self._done.set()
+
+    def backlog_full(self) -> bool:
+        return self._q.full()
 
     def __iter__(self):
         while True:
-            item = self._q.get()
-            if item is _SENTINEL:
+            try:
+                yield self._q.get(timeout=0.05)
+                continue
+            except queue.Empty:
+                pass
+            if self._done.is_set():
+                # Drain tokens that raced the done flag: _finish is
+                # ordered after the final _offer, but this iterator may
+                # observe the event before emptying the queue.
+                while True:
+                    try:
+                        yield self._q.get_nowait()
+                    except queue.Empty:
+                        break
                 if self.error is not None:
                     raise self.error
                 return
-            yield item
 
     def tokens(self) -> list[int]:
         """Block until completion; all tokens as a list."""
@@ -85,7 +145,7 @@ class LLMEngine:
                  max_len: int = 1024, decode_chunk: int = 8,
                  prefill_chunk: int = 0, rng_seed: int = 0,
                  page_size: int = 0, kv_pool_tokens: int = 0,
-                 use_device_plane: bool = True):
+                 use_device_plane: bool = True, stream_buffer: int = 256):
         import jax
         import jax.numpy as jnp
 
@@ -93,6 +153,9 @@ class LLMEngine:
         self.params = params
         self.max_batch = max_batch
         self.max_len = max_len
+        # Per-stream token-queue bound: a non-draining consumer parks its
+        # slot (backpressure) once this many tokens are buffered.
+        self._stream_buffer = max(1, stream_buffer)
         # Prefill→decode KV handoff rides the device object plane
         # (_private/device_objects.py): the freshly prefilled per-request
         # KV is pinned, resolved by decode over the cheapest route
@@ -344,14 +407,22 @@ class LLMEngine:
         self._prefill_rr = 0  # round-robin cursor over prefilling slots
         self._pending: queue.Queue = queue.Queue()
         self._stop = threading.Event()
+        # Drain quiesce handshake: _quiesce asks the loop to pause at a
+        # tick boundary; the loop acks via _quiet, after which slot/KV
+        # state is stable for snapshot_active_streams().
+        self._quiesce = threading.Event()
+        self._quiet = threading.Event()
+        # Named metrics for the per-pool autoscaler + bench surface.
+        self._ttft = deque(maxlen=256)  # seconds, submit -> first token
+        self._parked_events = 0  # backpressure: offers rejected (q full)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="llm-engine")
         self._thread.start()
 
     # ---- public API ------------------------------------------------------
 
-    def submit(self, prompt_tokens, sampling: SamplingParams | None = None
-               ) -> RequestHandle:
+    def submit(self, prompt_tokens, sampling: SamplingParams | None = None,
+               tag: str = "") -> RequestHandle:
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         sp = sampling or SamplingParams()
         if len(prompt) + sp.max_new_tokens > self.max_len:
@@ -365,8 +436,29 @@ class LLMEngine:
                 raise ValueError(
                     f"request needs {need} KV pages but the pool holds "
                     f"{self._alloc.num_pages - 1}; raise kv_pool_tokens")
-        handle = RequestHandle(len(prompt), sp)
+        handle = RequestHandle(len(prompt), sp,
+                               max_buffered=self._stream_buffer, tag=tag)
         self._pending.put((prompt, handle))
+        return handle
+
+    def submit_prefilled(self, pack: _Prefilled,
+                         sampling: SamplingParams | None = None,
+                         tag: str = "") -> RequestHandle:
+        """Admit a request whose prefill ran elsewhere (disaggregated
+        prefill pool, or a drain-evacuated stream being resumed): the
+        KV prefix lands in a free slot and decoding continues from
+        `pack.token` without re-running prefill here."""
+        sp = sampling or SamplingParams()
+        budget = sp.max_new_tokens - pack.generated
+        if budget <= 0:
+            raise ValueError("prefilled request has no decode budget left")
+        if pack.lens + budget > self.max_len:
+            raise ValueError(
+                f"kv_len({pack.lens}) + remaining({budget}) exceeds "
+                f"engine max_len={self.max_len}")
+        handle = RequestHandle(pack.prompt_len, sp,
+                               max_buffered=self._stream_buffer, tag=tag)
+        self._pending.put((pack, handle))
         return handle
 
     def generate(self, prompt_tokens,
@@ -375,6 +467,84 @@ class LLMEngine:
 
     def num_active(self) -> int:
         return sum(1 for s in self._slots if s.request is not None)
+
+    def tokens_in_flight(self) -> int:
+        """Remaining decode budget across active streams — the decode
+        pool's autoscaling signal."""
+        total = 0
+        for st in self._slots:
+            h = st.request
+            if h is not None:
+                total += max(0, h.sampling.max_new_tokens - st.generated)
+        return total
+
+    def queue_depth(self) -> int:
+        return self._pending.qsize() + len(getattr(self, "_deferred", []))
+
+    def report_metrics(self) -> dict:
+        ttft = sorted(self._ttft)
+        pick = lambda q: ttft[min(len(ttft) - 1,  # noqa: E731
+                                  int(q * len(ttft)))] if ttft else 0.0
+        return {
+            "queue_depth": float(self.queue_depth()),
+            "tokens_in_flight": float(self.tokens_in_flight()),
+            "active_streams": float(self.num_active()),
+            "parked_events": float(self._parked_events),
+            "ttft_p50_ms": pick(0.5) * 1e3,
+            "ttft_p99_ms": pick(0.99) * 1e3,
+        }
+
+    def quiesce_for_drain(self, timeout: float = 10.0) -> bool:
+        """Pause the loop at a tick boundary so slot/KV state is stable
+        for snapshot_active_streams(). Returns True once the loop acked."""
+        self._quiesce.set()
+        return self._quiet.wait(timeout)
+
+    def resume(self) -> None:
+        self._quiesce.clear()
+        self._quiet.clear()
+
+    def snapshot_active_streams(self) -> dict:
+        """Host-side snapshot of every decoding stream — caller must
+        quiesce first. Keyed by the handle's tag; each value holds the
+        trimmed per-layer KV (numpy) and the full decode cursor, enough
+        to rebuild the stream via submit_prefilled on another replica."""
+        out: dict = {}
+        for i, st in enumerate(self._slots):
+            h = st.request
+            if h is None or st.prefill_prompt is not None:
+                continue
+            L = int(self._lens[i])
+            kv = []
+            if self.page_size:
+                ps = self.page_size
+                n = -(-L // ps)
+                row = self._tables[i][:n]
+                for kp, vp in self._pools:
+                    Hkv, D = kp.shape[1], kp.shape[3]
+                    k = np.asarray(kp[row]).transpose(1, 0, 2, 3).reshape(
+                        Hkv, n * ps, D)[:, :L]
+                    v = np.asarray(vp[row]).transpose(1, 0, 2, 3).reshape(
+                        Hkv, n * ps, D)[:, :L]
+                    kv.append((k, v))
+            else:
+                for kf, vf in self._kv:
+                    kv.append((np.asarray(kf[i, :, :L]),
+                               np.asarray(vf[i, :, :L])))
+            sp = h.sampling
+            out[h.tag or f"slot{i}"] = {
+                "kv": kv,
+                "prompt_len": int(h.prompt_len),
+                "lens": L,
+                "token": int(self._token[i]),
+                "generated": int(st.generated),
+                "history": list(st.history),
+                "sampling": {"max_new_tokens": sp.max_new_tokens,
+                             "temperature": sp.temperature,
+                             "top_k": sp.top_k, "top_p": sp.top_p,
+                             "eos_token": sp.eos_token},
+            }
+        return out
 
     def shutdown(self):
         self._stop.set()
@@ -385,14 +555,12 @@ class LLMEngine:
         """Unblock every waiter: active slots, deferred and queued requests."""
         for i, st in enumerate(self._slots):
             if st.request is not None:
-                st.request.error = err
-                st.request._q.put(_SENTINEL)
+                st.request._finish(err)
                 st.request = None
             st.prefill_prompt = None
             self._free_slot_pages(i)
         for _prompt, handle in getattr(self, "_deferred", []):
-            handle.error = err
-            handle._q.put(_SENTINEL)
+            handle._finish(err)
         if self.page_size:
             self._deferred.clear()
         while True:
@@ -400,8 +568,7 @@ class LLMEngine:
                 _prompt, handle = self._pending.get_nowait()
             except queue.Empty:
                 break
-            handle.error = err
-            handle._q.put(_SENTINEL)
+            handle._finish(err)
 
     # ---- engine loop -----------------------------------------------------
 
@@ -423,6 +590,9 @@ class LLMEngine:
         assert not self.page_size
         jnp = self._jnp
         slot = next(i for i, s in enumerate(self._slots) if s.request is None)
+        if isinstance(prompt, _Prefilled):
+            self._admit_prefilled_dense(slot, prompt, handle)
+            return
         # Chunked only when the chunk GRID fits the cache: the final
         # chunk's write window [start, start+C) must not run past max_len,
         # where dynamic_update_slice clamping would silently relocate it
@@ -442,6 +612,7 @@ class LLMEngine:
             st.generated = 0
             st.prefill_prompt = prompt
             st.prefill_pos = 0
+            st.history = []
             self._lens[slot] = self.max_len - 1
             self._temps[slot] = handle.sampling.temperature
             self._topks[slot] = handle.sampling.top_k
@@ -500,7 +671,76 @@ class LLMEngine:
         st.request = handle
         st.generated = 0
         st.prefill_prompt = None
+        st.history = []
+        self._ttft.append(time.monotonic() - handle._submit_ts)
         self._emit(slot, tok)
+
+    def _commit_prefilled(self, slot: int, handle: RequestHandle,
+                          pack: _Prefilled):
+        """Commit decode state for an externally prefilled stream. A
+        fresh handoff (emit_first=True) behaves like _commit_token with
+        the prefill pool's sampled first token; a resume carries the
+        full history/cursor and emits nothing until decode advances."""
+        sp = handle.sampling
+        self._lens[slot] = pack.lens
+        self._pos[slot] = pack.lens
+        self._token[slot] = pack.token
+        self._temps[slot] = sp.temperature
+        self._topks[slot] = sp.top_k
+        self._topps[slot] = sp.top_p
+        st = self._slots[slot]
+        st.request = handle
+        st.generated = pack.generated
+        st.prefill_prompt = None
+        st.history = list(pack.history)
+        if pack.emit_first:
+            self._ttft.append(time.monotonic() - handle._submit_ts)
+            self._emit(slot, pack.token)
+
+    def _admit_prefilled_dense(self, slot: int, pack: _Prefilled,
+                               handle: RequestHandle):
+        """Land an external KV prefix in a dense slot row. Cache entries
+        past `pack.lens` keep whatever garbage they hold — decode masks
+        kpos<=qpos and overwrites index lens before attending."""
+        jnp = self._jnp
+        L = pack.lens
+        for li, (k_full, v_full) in enumerate(self._kv):
+            k1, v1 = pack.kv_layers[li]
+            k1 = jnp.asarray(np.asarray(k1)[:, :L], self.cfg.dtype)
+            v1 = jnp.asarray(np.asarray(v1)[:, :L], self.cfg.dtype)
+            self._kv[li] = (k_full.at[slot, :, :L, :].set(k1),
+                            v_full.at[slot, :, :L, :].set(v1))
+        self._commit_prefilled(slot, handle, pack)
+
+    def _admit_prefilled_paged(self, slot: int, seq_id: str,
+                               pack: _Prefilled, handle: RequestHandle):
+        """Scatter an external KV prefix into this sequence's reserved
+        pages. The prefix is padded up to the engine bucket (a page
+        multiple) so write_prompt_pages compiles one variant per bucket,
+        not one per arbitrary kv length; pad rows scatter into the dummy
+        page, never a page a live sequence owns."""
+        jnp = self._jnp
+        ps = self.page_size
+        Lb = -(-max(self._bucket(pack.lens), ps) // ps) * ps
+        n_real = -(-pack.lens // ps)
+        row = np.asarray(self._alloc.table(seq_id, self._np_pages))
+        page_ids = np.full(Lb // ps, self._dummy_page, np.int32)
+        page_ids[:n_real] = row[:n_real]
+        kv_pad = []
+        for k1, v1 in pack.kv_layers:
+            k1 = np.asarray(k1)[:, :pack.lens]
+            v1 = np.asarray(v1)[:, :pack.lens]
+            Hkv, L, D = k1.shape
+            kp = np.zeros((Hkv, Lb, D), k1.dtype)
+            vp = np.zeros((Hkv, Lb, D), v1.dtype)
+            kp[:, :L] = k1
+            vp[:, :L] = v1
+            kv_pad.append((jnp.asarray(kp, self.cfg.dtype),
+                           jnp.asarray(vp, self.cfg.dtype)))
+        self._pools = self._write_prompt_pages(
+            self._pools, kv_pad, jnp.asarray(page_ids))
+        self._tables[slot] = row
+        self._commit_prefilled(slot, handle, pack)
 
     def _reserve_paged(self, slot: int, prompt: np.ndarray,
                        handle: RequestHandle) -> str:
@@ -511,7 +751,11 @@ class LLMEngine:
         sp = handle.sampling
         st = self._slots[slot]
         seq_id = f"slot{slot}-{id(handle):x}"
-        need = len(prompt) + sp.max_new_tokens + self.decode_chunk
+        if isinstance(prompt, _Prefilled):
+            need = prompt.lens + (sp.max_new_tokens - prompt.generated) \
+                + self.decode_chunk
+        else:
+            need = len(prompt) + sp.max_new_tokens + self.decode_chunk
         self._alloc.allocate(seq_id, need)  # MemoryError -> caller defers
         st.seq_id = seq_id
         return seq_id
@@ -523,6 +767,16 @@ class LLMEngine:
         single-sequence program. cands: (slot, seq_id, prompt, handle)
         with pages already reserved."""
         jnp = self._jnp
+        # Externally prefilled streams skip the prefill programs entirely:
+        # their KV prefix scatters straight into the reserved pages.
+        for slot, seq_id, pack, handle in \
+                [c for c in cands if isinstance(c[2], _Prefilled)]:
+            try:
+                self._admit_prefilled_paged(slot, seq_id, pack, handle)
+            except BaseException as e:
+                self._free_slot_pages(slot)
+                handle._finish(e)
+        cands = [c for c in cands if not isinstance(c[2], _Prefilled)]
         groups: dict = {}
         for c in cands:
             bucket = max(self._bucket(len(c[2])), self.page_size)
@@ -545,8 +799,7 @@ class LLMEngine:
                                                  len(prompt))
                     except BaseException as e:
                         self._free_slot_pages(slot)
-                        handle.error = e
-                        handle._q.put(_SENTINEL)
+                        handle._finish(e)
                     continue
                 W = self._batch_prefill_width
                 npages_row = self.max_len // self.page_size
@@ -590,8 +843,7 @@ class LLMEngine:
                     # every member and return their pages.
                     for slot, seq_id, prompt, handle in chunk:
                         self._free_slot_pages(slot)
-                        handle.error = e
-                        handle._q.put(_SENTINEL)
+                        handle._finish(e)
                     continue
                 # Host-only from here: no device call can strand waiters.
                 for r, (slot, seq_id, prompt, handle) in enumerate(chunk):
@@ -675,25 +927,41 @@ class LLMEngine:
         self._pos[slot] = len(prompt)
         self._token[slot] = tok
         st.prefill_prompt = None
+        self._ttft.append(time.monotonic() - st.request._submit_ts)
         self._emit(slot, tok)
 
-    def _emit(self, slot: int, tok: int):
+    def _emit(self, slot: int, tok: int) -> bool:
+        """Offer one token to the stream. False = the consumer's bounded
+        queue is full: the caller must NOT commit the token — the slot
+        parks (its decode cursor stays put) and the same token is
+        re-produced next chunk once the consumer drains."""
         st = self._slots[slot]
-        st.request._q.put(tok)
+        if not st.request._offer(tok):
+            self._parked_events += 1
+            return False
         st.generated += 1
+        st.history.append(tok)
         sp = st.request.sampling
         if (sp.eos_token is not None and tok == sp.eos_token) or \
                 st.generated >= sp.max_new_tokens:
-            st.request._q.put(_SENTINEL)
+            st.request._finish()
             st.request = None
             # Paged mode: the stream's pages return to the pool the
             # moment it completes — this is what lets a deferred request
             # admit on the next loop pass.
             self._free_slot_pages(slot)
+        return True
 
     def _loop(self):
         jax, jnp = self._jax, self._jnp
         while not self._stop.is_set():
+            # Drain quiesce: ack and idle at a tick boundary — every
+            # admitted token is committed, so slot/KV state is a
+            # consistent snapshot for the evacuation path.
+            if self._quiesce.is_set():
+                self._quiet.set()
+                self._stop.wait(0.01)
+                continue
             # Admit as many pending requests as there are free slots —
             # without stalling slots that are mid-decode. Paged mode also
             # gates on pool pages: a dry pool defers the request (FIFO)
@@ -723,8 +991,7 @@ class LLMEngine:
                     except Exception as e:  # surfacing beats a dead stream
                         if from_deferred:
                             self._deferred.pop(0)
-                        handle.error = e
-                        handle._q.put(_SENTINEL)
+                        handle._finish(e)
                     continue
                 slot = next(i for i, s in enumerate(self._slots)
                             if s.request is None and i not in picked)
@@ -739,8 +1006,7 @@ class LLMEngine:
                 except Exception as e:
                     if from_deferred:
                         self._deferred.pop(0)
-                    handle.error = e
-                    handle._q.put(_SENTINEL)
+                    handle._finish(e)
                     continue
                 if from_deferred:
                     self._deferred.pop(0)
@@ -763,13 +1029,18 @@ class LLMEngine:
                 except Exception as e:
                     st = self._slots[idx]
                     if st.request is not None:
-                        st.request.error = e
-                        st.request._q.put(_SENTINEL)
+                        st.request._finish(e)
                         st.request = None
                         st.prefill_prompt = None
-            decoding = any(s.request is not None and s.prefill_prompt is None
-                           for s in self._slots)
+            decoding = [s for s in self._slots
+                        if s.request is not None and s.prefill_prompt is None]
             if not decoding:
+                continue
+            # Backpressure: if EVERY decoding stream's consumer queue is
+            # full, a decode chunk would produce only parked tokens —
+            # skip the dispatch and give the consumers time to drain.
+            if all(s.request.backlog_full() for s in decoding):
+                self._stop.wait(0.002)
                 continue
             # One decode CHUNK for every slot (inactive slots compute
             # garbage on their stale state — discarded host-side; slots
@@ -810,10 +1081,17 @@ class LLMEngine:
                     continue
                 for kstep in range(toks.shape[0]):
                     tok = int(toks[kstep, i])
+                    if not self._emit(i, tok):
+                        # Consumer backlog full: park WITHOUT committing.
+                        # Decode re-runs from the committed cursor next
+                        # chunk — safe because decode writes KV at index
+                        # lens before attending and masks kpos<=qpos, so
+                        # the uncommitted steps' writes are garbage that
+                        # is simply rewritten.
+                        break
                     self._lens[i] += 1
                     self._pos[i] += 1
                     self._token[i] = tok
-                    self._emit(i, tok)
                     if st.request is None:  # eos/max_new hit mid-chunk
                         break
 
@@ -842,12 +1120,16 @@ class LLMServer:
     def __init__(self, cfg: LlamaConfig, params, *, max_batch: int = 4,
                  max_len: int = 1024, decode_chunk: int = 8,
                  prefill_chunk: int = 0, page_size: int = 0,
-                 kv_pool_tokens: int = 0):
+                 kv_pool_tokens: int = 0, stream_buffer: int = 256):
         self.engine = LLMEngine(cfg, params, max_batch=max_batch,
                                 max_len=max_len, decode_chunk=decode_chunk,
                                 prefill_chunk=prefill_chunk,
                                 page_size=page_size,
-                                kv_pool_tokens=kv_pool_tokens)
+                                kv_pool_tokens=kv_pool_tokens,
+                                stream_buffer=stream_buffer)
+
+    def report_metrics(self) -> dict:
+        return self.engine.report_metrics()
 
     def __call__(self, payload: dict):
         sp = SamplingParams(

@@ -181,6 +181,49 @@ def train_elastic_snapshot() -> dict:
         return dict(_train_elastic)
 
 
+# ---------- disaggregated-serving counters ----------
+# Same counter-style gauge pattern as the elastic trainer: the router
+# (and each pool replica, for its own events) is the single writer of
+# its process-local totals.
+
+_serve_disagg_lock = threading.Lock()
+_serve_disagg = {"streams_started": 0, "streams_completed": 0,
+                 "stream_resumes": 0, "streams_evacuated": 0,
+                 "fallback_reprefills": 0, "prefix_full_hits": 0,
+                 "prefix_partial_hits": 0}
+_serve_disagg_gauges: dict = {}
+
+
+def _serve_disagg_gauge() -> Gauge:
+    with _serve_disagg_lock:
+        if "events" not in _serve_disagg_gauges:
+            _serve_disagg_gauges["events"] = Gauge(
+                "ray_tpu_serve_disagg_events_total",
+                "disaggregated-serving lifecycle events "
+                "(streams, resumes, evacuations, prefix-cache hits)",
+                tag_keys=("event",))
+    return _serve_disagg_gauges["events"]
+
+
+def note_serve_disagg(event: str, n: int = 1) -> None:
+    """Record n disaggregated-serving events (a key of _serve_disagg)
+    and push the totals so a scrape mid-incident sees them."""
+    g = _serve_disagg_gauge()
+    with _serve_disagg_lock:
+        if event not in _serve_disagg:
+            return
+        _serve_disagg[event] += int(n)
+        val = _serve_disagg[event]
+    g.set(val, tags={"event": event})
+    flush_registry_now()
+
+
+def serve_disagg_snapshot() -> dict:
+    """This process's disaggregated-serving totals."""
+    with _serve_disagg_lock:
+        return dict(_serve_disagg)
+
+
 def get_metrics_snapshot() -> dict:
     """Read all published metrics from the GCS (one entry per worker)."""
     from ray_tpu._private.api_internal import get_core_worker

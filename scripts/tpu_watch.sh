@@ -64,6 +64,27 @@ print("device-plane gauge (bench run):",
       "counters=", extra.get("plane_counters"))
 PYEOF
         fi
+        # Disaggregated serving: prefill/decode pools + device-plane KV
+        # handoff on the live TPU — tokens/s, TTFT p50/p99, per-route
+        # KV counters (did the handoff actually ride the device plane?)
+        # and prefix-cache hit rate, health-stamped like the rest.
+        if timeout 1800 python bench.py --serve-disagg \
+            > .bench_serve_disagg.json 2>> "$LOG"; then
+          if ! grep -q '"backend": "cpu"' .bench_serve_disagg.json; then
+            python bench.py --save-artifact .bench_serve_disagg.json \
+              BENCH_TPU_SERVE_DISAGG.json >> "$LOG" 2>&1
+            echo "[$(date +%T)] serve-disagg capture:" >> "$LOG"
+            cat .bench_serve_disagg.json >> "$LOG"
+          fi
+          timeout 60 python - .bench_serve_disagg.json >> "$LOG" 2>&1 <<'PYEOF' || true
+import json, sys
+extra = json.load(open(sys.argv[1])).get("extra", {})
+print("serve-disagg routes:", extra.get("kv_route_counters"),
+      "ttft_p50_ms=", extra.get("ttft_p50_ms"),
+      "ttft_p99_ms=", extra.get("ttft_p99_ms"),
+      "prefix_hit_rate=", extra.get("prefix_cache_hit_rate"))
+PYEOF
+        fi
         # Drain-protocol probe: two local nodes, an object pinned to the
         # doomed one, drain with a 10s deadline — the log then carries
         # the robustness path's metrics (drain duration, evacuated

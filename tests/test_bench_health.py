@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from ray_tpu._private.bench_health import (best_recorded_probe,
                                            degraded_sibling,
                                            is_healthy_accelerator,
@@ -152,3 +154,29 @@ def test_bench_cli_save_artifact_no_jax(tmp_path):
          "--save-artifact", src],
         capture_output=True, text=True, timeout=60, env=env, cwd=_REPO)
     assert r.returncode == 2 and "usage:" in r.stderr
+
+
+@pytest.mark.smoke
+def test_bench_cli_serve_disagg_smoke():
+    """`python bench.py --serve-disagg` on the CPU backend stands up the
+    two-pool deployment and emits ONE health-stamped JSON line with the
+    disagg serving numbers — tokens/s, TTFT percentiles, per-route KV
+    counters, prefix-cache hit rate."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_JAX_PLATFORM"] = "cpu"
+    env["RAY_TPU_BENCH_CHILD"] = "1"  # skip the probe ladder + re-exec
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--serve-disagg"],
+        capture_output=True, text=True, timeout=280, env=env, cwd=_REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "serve_disagg_tokens_per_s"
+    assert rec["value"] > 0
+    extra = rec["extra"]
+    assert extra["health"]["verdict"] in ("ok", "degraded")
+    assert extra["completed"] == extra["requests"]
+    assert sum(extra["kv_route_counters"].values()) > 0  # handoff counted
+    assert extra["prefix_cache_hit_rate"] > 0  # repeated prompts hit
+    assert extra["ttft_p99_ms"] >= extra["ttft_p50_ms"] > 0
+    assert extra["router_stats"]["fallback_reprefills"] == 0
