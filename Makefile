@@ -1,0 +1,22 @@
+PYTHON ?= python
+
+.PHONY: lint contract test native
+
+# graftlint + graftwire gate: per-file rules R1-R6 and the whole-program
+# wire pass W1-W5 over the whole package. Exits non-zero on any new
+# violation (the checked-in baseline is empty, so: on any violation).
+lint:
+	$(PYTHON) -m ray_tpu._private.lint --jobs 8
+
+# Regenerate the extracted wire contract (docs/wire_contract.{md,json}).
+# A tier-1 test regenerates and diffs these, so run this after changing
+# any RPC handler, call site, or replay registry.
+contract:
+	$(PYTHON) -m ray_tpu._private.lint --jobs 8 --emit-contract docs/
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+# Native (C++) unit tests; see src/Makefile for sanitizer knobs.
+native:
+	$(MAKE) -C src test

@@ -1047,8 +1047,7 @@ class GcsServer:
                 "reason": f"migrating off draining node ({reason})"})
             try:
                 await self._call_node(
-                    node_id, "KillActorWorker",
-                    {"actor_id": actor_id, "address": addr},
+                    node_id, "KillActorWorker", {"actor_id": actor_id},
                     timeout=self.config.rpc_call_timeout_s)
             except Exception:
                 pass  # node may die mid-drain; reschedule regardless
@@ -1527,13 +1526,11 @@ class GcsServer:
         no_restart = payload.get("no_restart", True)
         if no_restart:
             a["max_restarts"] = a["restarts"]  # exhaust restarts
-        addr = a.get("address")
         node_id = a.get("node_id")
         if node_id in self.node_conns:
             try:
                 await self._call_node(
-                    node_id, "KillActorWorker",
-                    {"actor_id": actor_id, "address": addr},
+                    node_id, "KillActorWorker", {"actor_id": actor_id},
                     timeout=self.config.rpc_call_timeout_s)
             except Exception:
                 # Best-effort: the raylet may already be tearing the

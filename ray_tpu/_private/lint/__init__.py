@@ -17,10 +17,18 @@ from ray_tpu._private.lint.engine import (  # noqa: F401
     LintReport,
     Violation,
     lint_source,
+    lint_sources,
     normalize_path,
     run_lint,
 )
 from ray_tpu._private.lint.rules import ALL_RULES, DAEMON_MODULES  # noqa: F401
+from ray_tpu._private.lint.wire import (  # noqa: F401
+    ALL_PROGRAM_RULES,
+    WIRE_EXTERNAL,
+    build_contract,
+    contract_markdown,
+    generate_contract,
+)
 from ray_tpu._private.lint.baseline import (  # noqa: F401
     DEFAULT_BASELINE_PATH,
     counts_by_rule_path,

@@ -2,8 +2,18 @@
 
     python -m ray_tpu._private.lint [paths...]
         Gate mode: lint the tree (default: the installed ray_tpu
-        package), subtract the checked-in baseline, exit 1 on any new
-        violation.
+        package) with the per-file rules R1-R6 AND the whole-program
+        wire pass W1-W5 (auto-enabled when the session layer is in the
+        walked set), subtract the checked-in baseline, exit 1 on any
+        new violation.
+
+    python -m ray_tpu._private.lint --jobs 8
+        Parallelize the per-file phase (parse + index + rules + wire
+        extraction) across processes.
+
+    python -m ray_tpu._private.lint --emit-contract docs/
+        Also write the extracted wire contract (wire_contract.md +
+        wire_contract.json) into the given directory.
 
     python -m ray_tpu._private.lint --update-baseline
         Ratchet: rewrite baseline.json with the current counts (entries
@@ -19,6 +29,7 @@
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -33,10 +44,27 @@ def _default_paths() -> list[str]:
     return [os.path.dirname(os.path.abspath(ray_tpu.__file__))]
 
 
+def emit_contract(paths: list[str], out_dir: str) -> None:
+    from ray_tpu._private.lint import wire
+
+    contract = wire.generate_contract(paths)
+    os.makedirs(out_dir, exist_ok=True)
+    json_path = os.path.join(out_dir, "wire_contract.json")
+    md_path = os.path.join(out_dir, "wire_contract.md")
+    with open(json_path, "w", encoding="utf-8") as f:
+        json.dump(contract, f, indent=2, sort_keys=True)
+        f.write("\n")
+    with open(md_path, "w", encoding="utf-8") as f:
+        f.write(wire.contract_markdown(contract))
+    print(f"graftwire: contract ({len(contract['methods'])} methods) -> "
+          f"{json_path}, {md_path}", file=sys.stderr)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ray_tpu._private.lint",
-        description="graftlint: distributed-runtime invariant checker")
+        description="graftlint: distributed-runtime invariant checker "
+                    "(per-file rules + whole-program wire contracts)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the ray_tpu package)")
     ap.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE_PATH,
@@ -47,21 +75,35 @@ def main(argv: list[str] | None = None) -> int:
                     help="rewrite the baseline with current counts")
     ap.add_argument("--all", action="store_true",
                     help="also print baselined violations")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="run the per-file phase in N parallel processes")
+    ap.add_argument("--no-wire", action="store_true",
+                    help="skip the whole-program wire pass (W1-W5)")
+    ap.add_argument("--emit-contract", metavar="DIR",
+                    help="write wire_contract.{md,json} into DIR")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_rules:
+        from ray_tpu._private.lint.wire import WIRE_RULE_DOCS
+
         for rule in ALL_RULES:
             print(f"{rule.id}  {rule.title}")
             doc = (rule.__doc__ or "").strip()
             if doc:
                 print(f"    {doc}")
+        for rid, doc in WIRE_RULE_DOCS.items():
+            print(f"{rid}  {doc}")
         return 0
 
     paths = args.paths or _default_paths()
-    report = run_lint(paths)
+    report = run_lint(paths, jobs=args.jobs,
+                      wire=False if args.no_wire else None)
     for err in report.parse_errors:
         print(f"graftlint: parse error: {err}", file=sys.stderr)
+
+    if args.emit_contract:
+        emit_contract(paths, args.emit_contract)
 
     if args.update_baseline:
         counts = baseline_mod.counts_by_rule_path(report.violations)

@@ -1,10 +1,25 @@
 """graftlint engine: file walking, suppressions, and reporting.
 
-The engine is rule-agnostic: it parses each file once, builds a
-FileContext (AST + source lines + suppression map + daemon-module
-flag), and hands it to every registered rule. Rules yield Violations;
-the engine drops the ones a `# graftlint: disable=Rn` comment covers
-and compares the rest against the checked-in baseline.
+The engine is rule-agnostic: it parses each file ONCE, builds a
+FileContext (AST + a shared single-pass FileIndex + source lines +
+suppression map + daemon-module flag), and hands it to every registered
+rule. Rules read the pre-built index (nodes grouped by type, with the
+enclosing-function info every rule needs) instead of re-walking the
+tree per rule — one traversal serves all of R1-R6 plus the wire-model
+extraction.
+
+Two rule kinds:
+
+- per-file rules (R1-R6): `rule.check(ctx) -> Iterator[Violation]`
+- program rules (the graftwire pass, W1-W5): `rule.extract(ctx) ->
+  facts` per file, then `rule.analyze(all_facts) -> list[Violation]`
+  once over the whole file set. Program violations respect the same
+  inline suppressions as per-file ones (the engine keeps every file's
+  suppression map until analysis time).
+
+`--jobs N` parallelizes the per-file phase (parse + index + per-file
+rules + fact extraction) across processes; the whole-program analysis
+then runs once in the parent over the merged facts.
 """
 
 from __future__ import annotations
@@ -23,7 +38,7 @@ _SKIP_DIRS = {"__pycache__", "_lib", "build", "build-asan", "build-tsan",
 
 @dataclass(frozen=True)
 class Violation:
-    rule: str          # "R1".."R6"
+    rule: str          # "R1".."R6", "W1".."W5"
     path: str          # normalized posix path (ray_tpu/...)
     line: int
     col: int
@@ -35,6 +50,75 @@ class Violation:
                 f"[{self.func}] {self.message}")
 
 
+@dataclass(frozen=True)
+class FuncInfo:
+    """Enclosing-function context of one AST node (precomputed)."""
+    qualname: str      # dotted enclosing-def chain, or "<module>"
+    in_async: bool     # nearest enclosing function is an `async def`
+    handler: str | None  # innermost enclosing handle_*/_handle_* name
+
+_MODULE_INFO = FuncInfo("<module>", False, None)
+
+_HANDLER_PREFIXES = ("handle_", "_handle_")
+
+
+class FileIndex:
+    """One-pass index of a parsed module, shared by every rule.
+
+    - `by_type[ast.Call]` etc.: every node of that type, in source order
+    - `info(node)`: the FuncInfo of the node's enclosing function. For a
+      function/lambda node itself the info INCLUDES that function (it is
+      its own innermost scope), matching the old per-rule walker.
+    - `functions`: def name -> first def node with that name (handler
+      resolution in the wire pass)
+    - `aliases`: local name -> dotted import origin (R2/R6/wire share it)
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.by_type: dict[type, list[ast.AST]] = {}
+        self._info: dict[int, FuncInfo] = {}
+        self.functions: dict[str, ast.AST] = {}
+        self.aliases: dict[str, str] = {}
+        self._walk(tree, _MODULE_INFO, [])
+
+    def info(self, node: ast.AST) -> FuncInfo:
+        return self._info.get(id(node), _MODULE_INFO)
+
+    def nodes(self, *types: type) -> list[ast.AST]:
+        if len(types) == 1:
+            return self.by_type.get(types[0], [])
+        out: list[ast.AST] = []
+        for t in types:
+            out.extend(self.by_type.get(t, []))
+        return out
+
+    def _walk(self, node: ast.AST, info: FuncInfo,
+              stack: list[tuple[str, bool]]) -> None:
+        t = type(node)
+        if t in (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda):
+            name = getattr(node, "name", "<lambda>")
+            is_async = t is ast.AsyncFunctionDef
+            stack = stack + [(name, is_async)]
+            handler = None
+            for n, _ in reversed(stack):
+                if n.startswith(_HANDLER_PREFIXES):
+                    handler = n
+                    break
+            info = FuncInfo(".".join(n for n, _ in stack), is_async, handler)
+            if name != "<lambda>" and name not in self.functions:
+                self.functions[name] = node
+        elif t is ast.Import:
+            for a in node.names:
+                self.aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif t is ast.ImportFrom and node.module:
+            for a in node.names:
+                self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        self.by_type.setdefault(t, []).append(node)
+        self._info[id(node)] = info
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, info, stack)
+
+
 @dataclass
 class FileContext:
     path: str                       # normalized path used in reports
@@ -42,12 +126,22 @@ class FileContext:
     lines: list[str]
     suppressions: dict[int, set[str]]   # 1-based line -> rule ids ("*" = all)
     is_daemon: bool = False
+    index: FileIndex = None         # built once in _check_file
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> Violation:
+        """Violation at `node` with the indexed enclosing-function name."""
+        return Violation(
+            rule=rule, path=self.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            func=self.index.info(node).qualname, message=message)
 
 
 @dataclass
 class LintReport:
     violations: list[Violation] = field(default_factory=list)
     suppressed: int = 0
+    suppressed_by_rule: dict[str, int] = field(default_factory=dict)
     files_checked: int = 0
     parse_errors: list[str] = field(default_factory=list)
 
@@ -56,6 +150,11 @@ class LintReport:
         for v in self.violations:
             out.setdefault(v.rule, []).append(v)
         return out
+
+    def _suppress(self, v: Violation) -> None:
+        self.suppressed += 1
+        self.suppressed_by_rule[v.rule] = \
+            self.suppressed_by_rule.get(v.rule, 0) + 1
 
 
 def normalize_path(path: str) -> str:
@@ -108,14 +207,31 @@ def _is_daemon_module(norm_path: str, source: str) -> bool:
     return _DAEMON_MARKER in head
 
 
-def _check_file(path: str, source: str, rules, report: LintReport,
-                norm_path: str | None = None) -> None:
+@dataclass
+class _FileResult:
+    """Everything the per-file phase produces (picklable for --jobs)."""
+    path: str
+    violations: list[Violation] = field(default_factory=list)
+    suppressed: list[Violation] = field(default_factory=list)
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+    facts: dict[str, object] = field(default_factory=dict)  # rule id -> facts
+    parse_error: str | None = None
+
+
+def _is_suppressed(suppressions: dict[int, set[str]], v: Violation) -> bool:
+    on_line = suppressions.get(v.line, set())
+    return v.rule in on_line or "*" in on_line
+
+
+def _check_file(path: str, source: str, rules, program_rules,
+                norm_path: str | None = None) -> _FileResult:
     norm = norm_path or normalize_path(path)
+    res = _FileResult(path=norm)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
-        report.parse_errors.append(f"{norm}: {e}")
-        return
+        res.parse_error = f"{norm}: {e}"
+        return res
     lines = source.splitlines()
     ctx = FileContext(
         path=norm,
@@ -123,46 +239,122 @@ def _check_file(path: str, source: str, rules, report: LintReport,
         lines=lines,
         suppressions=_collect_suppressions(lines),
         is_daemon=_is_daemon_module(norm, source),
+        index=FileIndex(tree),
     )
-    report.files_checked += 1
+    res.suppressions = ctx.suppressions
     for rule in rules:
         for v in rule.check(ctx):
-            suppressed = ctx.suppressions.get(v.line, set())
-            if v.rule in suppressed or "*" in suppressed:
-                report.suppressed += 1
+            if _is_suppressed(ctx.suppressions, v):
+                res.suppressed.append(v)
+            else:
+                res.violations.append(v)
+    for prule in program_rules:
+        facts = prule.extract(ctx)
+        if facts is not None:
+            res.facts[prule.id] = facts
+    return res
+
+
+def _load_and_check(path: str, rules, program_rules) -> _FileResult:
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        res = _FileResult(path=normalize_path(path))
+        res.parse_error = f"{path}: {e}"
+        return res
+    return _check_file(path, source, rules, program_rules)
+
+
+def _jobs_worker(path: str) -> _FileResult:
+    # Child-process entry (fork): rules are re-imported per process.
+    from ray_tpu._private.lint.rules import ALL_RULES
+    from ray_tpu._private.lint.wire import ALL_PROGRAM_RULES
+
+    return _load_and_check(path, ALL_RULES, ALL_PROGRAM_RULES)
+
+
+def _finish(results: list[_FileResult], program_rules) -> LintReport:
+    """Merge per-file results, run whole-program analyses, apply
+    suppressions to program violations, sort."""
+    report = LintReport()
+    by_path: dict[str, _FileResult] = {}
+    for res in results:
+        if res.parse_error is not None:
+            report.parse_errors.append(res.parse_error)
+            continue
+        report.files_checked += 1
+        by_path[res.path] = res
+        report.violations.extend(res.violations)
+        for v in res.suppressed:
+            report._suppress(v)
+    for prule in program_rules:
+        all_facts = [res.facts[prule.id] for res in by_path.values()
+                     if prule.id in res.facts]
+        for v in prule.analyze(all_facts):
+            res = by_path.get(v.path)
+            if res is not None and _is_suppressed(res.suppressions, v):
+                report._suppress(v)
             else:
                 report.violations.append(v)
+    report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return report
 
 
-def run_lint(paths: list[str], rules=None) -> LintReport:
+def _wire_rules_for(paths_or_files, enabled: bool | None):
+    """Program rules to run. `enabled=None` auto-detects whole-program
+    mode: the wire pass only makes sense when the session layer itself
+    is in the linted set (otherwise every call would look unhandled)."""
+    from ray_tpu._private.lint.wire import ALL_PROGRAM_RULES
+
+    if enabled is None:
+        enabled = any(p.replace(os.sep, "/").endswith("_private/rpc.py")
+                      for p in paths_or_files)
+    return ALL_PROGRAM_RULES if enabled else []
+
+
+def run_lint(paths: list[str], rules=None, jobs: int = 1,
+             wire: bool | None = None) -> LintReport:
     """Lint every .py file under `paths`. Returns the raw report; the
-    caller applies the baseline (see baseline.regressions)."""
+    caller applies the baseline (see baseline.regressions).
+
+    jobs > 1 runs the per-file phase in a process pool. `wire` forces
+    the whole-program pass on/off; None auto-enables it when the walked
+    set contains the session layer (`_private/rpc.py`)."""
     from ray_tpu._private.lint.rules import ALL_RULES
 
     rules = ALL_RULES if rules is None else rules
-    report = LintReport()
-    for path in _iter_py_files(paths):
-        try:
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
-        except OSError as e:
-            report.parse_errors.append(f"{path}: {e}")
-            continue
-        _check_file(path, source, rules, report)
-    report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
-    return report
+    files = list(_iter_py_files(paths))
+    program_rules = _wire_rules_for(files, wire)
+    if jobs > 1 and len(files) > 1:
+        import concurrent.futures as cf
+
+        with cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(_jobs_worker, files, chunksize=8))
+    else:
+        results = [_load_and_check(p, rules, program_rules) for p in files]
+    return _finish(results, program_rules)
 
 
 def lint_source(source: str, filename: str = "<fixture>.py",
-                rules=None) -> LintReport:
+                rules=None, wire: bool = False) -> LintReport:
     """Lint a source string (test fixtures). `filename` is used verbatim
     as the report path, so fixtures can impersonate daemon modules
     (e.g. "ray_tpu/_private/raylet.py") or use the daemon-module marker
-    comment."""
+    comment. `wire=True` additionally runs the whole-program W rules
+    over this single file; the default keeps single-file fixtures scoped
+    to the per-file R rules."""
+    return lint_sources({filename: source}, rules=rules, wire=wire)
+
+
+def lint_sources(sources: dict[str, str], rules=None,
+                 wire: bool = False) -> LintReport:
+    """Lint several in-memory files as one program (wire-rule fixtures:
+    caller module + handler module + a stub rpc.py with the registries)."""
     from ray_tpu._private.lint.rules import ALL_RULES
 
     rules = ALL_RULES if rules is None else rules
-    report = LintReport()
-    _check_file(filename, source, rules, report, norm_path=filename)
-    report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
-    return report
+    program_rules = _wire_rules_for(list(sources), True) if wire else []
+    results = [_check_file(fn, src, rules, program_rules, norm_path=fn)
+               for fn, src in sources.items()]
+    return _finish(results, program_rules)

@@ -1,4 +1,4 @@
-"""graftlint rules R1-R5.
+"""graftlint per-file rules R1-R6.
 
 Each rule encodes one bug class hand-found in past review rounds of the
 async daemons (the historical incident is named in docs/linting.md):
@@ -21,6 +21,10 @@ async daemons (the historical incident is named in docs/linting.md):
       rpc.dial() when conn death is a liveness signal, or
       rpc.connect_session() for resilient replay/dedup sessions; the
       PR-10 busy-loop and swallowed-disconnect bugs)
+
+Rules read the engine's shared FileIndex (one AST traversal per file
+serves every rule) instead of running their own NodeVisitor walks; see
+engine.FileIndex. The whole-program wire rules W1-W5 live in wire.py.
 """
 
 from __future__ import annotations
@@ -33,6 +37,10 @@ from ray_tpu._private.lint.engine import FileContext, Violation
 # Modules whose event loops are cluster-critical: a blocked or dead
 # task here stalls every lease/object/actor on the node. R2 applies
 # only inside these (workers running user code may legitimately block).
+# The post-PR-5 additions: llm_disagg's async router/pool paths,
+# dataset.py's device-transport landing stages, and test_utils' NetChaos
+# proxy (a blocked chaos pump stalls every link it proxies, which turns
+# deterministic fault injection into nondeterministic hangs).
 DAEMON_MODULES = (
     "_private/gcs.py",
     "_private/raylet.py",
@@ -43,6 +51,9 @@ DAEMON_MODULES = (
     "_private/worker_zygote.py",
     "_private/object_store.py",
     "_private/device_objects.py",
+    "serve/llm_disagg.py",
+    "data/dataset.py",
+    "ray_tpu/test_utils.py",
 )
 
 _HANDLER_PREFIXES = ("handle_", "_handle_")
@@ -72,19 +83,6 @@ _VIEW_METHODS = {"items", "keys", "values"}
 
 def _is_handler_name(name: str) -> bool:
     return name.startswith(_HANDLER_PREFIXES)
-
-
-def _import_aliases(tree: ast.AST) -> dict[str, str]:
-    """Local name -> dotted origin, from top-level imports."""
-    aliases: dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                aliases[a.asname or a.name.split(".")[0]] = a.name
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            for a in node.names:
-                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
-    return aliases
 
 
 def _dotted_name(func: ast.expr, aliases: dict[str, str]) -> str | None:
@@ -151,62 +149,6 @@ def _contains_await(nodes: list[ast.stmt]) -> ast.Await | None:
     return None
 
 
-class _FuncWalker(ast.NodeVisitor):
-    """Shared traversal tracking the enclosing-function stack. Rules
-    subclass and read self.qualname / self.in_async / self.handler."""
-
-    def __init__(self, ctx: FileContext):
-        self.ctx = ctx
-        self.out: list[Violation] = []
-        self._stack: list[tuple[str, bool]] = []  # (name, is_async)
-
-    # -- stack helpers --
-
-    @property
-    def qualname(self) -> str:
-        return ".".join(n for n, _ in self._stack) or "<module>"
-
-    @property
-    def in_async(self) -> bool:
-        """Whether the nearest enclosing function is an `async def`."""
-        return bool(self._stack) and self._stack[-1][1]
-
-    @property
-    def handler(self) -> str | None:
-        """Innermost enclosing handle_* function name, if any."""
-        for name, _ in reversed(self._stack):
-            if _is_handler_name(name):
-                return name
-        return None
-
-    def visit_FunctionDef(self, node: ast.FunctionDef):
-        self._stack.append((node.name, False))
-        self.enter_function(node)
-        self.generic_visit(node)
-        self._stack.pop()
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
-        self._stack.append((node.name, True))
-        self.enter_function(node)
-        self.generic_visit(node)
-        self._stack.pop()
-
-    def visit_Lambda(self, node: ast.Lambda):
-        self._stack.append(("<lambda>", False))
-        self.generic_visit(node)
-        self._stack.pop()
-
-    def enter_function(self, node) -> None:  # rule hook
-        pass
-
-    def emit(self, rule: str, node: ast.AST, message: str) -> None:
-        self.out.append(Violation(
-            rule=rule, path=self.ctx.path,
-            line=getattr(node, "lineno", 0),
-            col=getattr(node, "col_offset", 0),
-            func=self.qualname, message=message))
-
-
 class RuleR1:
     """Raw task spawns must go through common.supervised_task()."""
 
@@ -214,26 +156,20 @@ class RuleR1:
     title = "unsupervised asyncio task spawn"
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
-        class V(_FuncWalker):
-            def visit_Call(self, node: ast.Call):
-                f = node.func
-                name = None
-                if isinstance(f, ast.Attribute) and f.attr in _SPAWN_NAMES:
-                    name = f.attr
-                elif isinstance(f, ast.Name) and f.id in _SPAWN_NAMES:
-                    name = f.id
-                if name is not None:
-                    self.emit(
-                        "R1", node,
-                        f"raw asyncio.{name}() — spawn through "
-                        "common.supervised_task() so the task keeps a "
-                        "strong ref and escaped exceptions are logged, "
-                        "not silently parked")
-                self.generic_visit(node)
-
-        v = V(ctx)
-        v.visit(ctx.tree)
-        return iter(v.out)
+        for node in ctx.index.nodes(ast.Call):
+            f = node.func
+            name = None
+            if isinstance(f, ast.Attribute) and f.attr in _SPAWN_NAMES:
+                name = f.attr
+            elif isinstance(f, ast.Name) and f.id in _SPAWN_NAMES:
+                name = f.id
+            if name is not None:
+                yield ctx.emit(
+                    "R1", node,
+                    f"raw asyncio.{name}() — spawn through "
+                    "common.supervised_task() so the task keeps a "
+                    "strong ref and escaped exceptions are logged, "
+                    "not silently parked")
 
 
 class RuleR2:
@@ -244,24 +180,17 @@ class RuleR2:
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
         if not ctx.is_daemon:
-            return iter(())
-        aliases = _import_aliases(ctx.tree)
-
-        class V(_FuncWalker):
-            def visit_Call(self, node: ast.Call):
-                if self.in_async:
-                    dotted = _dotted_name(node.func, aliases)
-                    if dotted in _BLOCKING_CALLS:
-                        self.emit(
-                            "R2", node,
-                            f"blocking call {dotted}() inside async def "
-                            "on a daemon event loop — use the asyncio "
-                            "equivalent or run_in_executor")
-                self.generic_visit(node)
-
-        v = V(ctx)
-        v.visit(ctx.tree)
-        return iter(v.out)
+            return
+        aliases = ctx.index.aliases
+        for node in ctx.index.nodes(ast.Call):
+            if ctx.index.info(node).in_async:
+                dotted = _dotted_name(node.func, aliases)
+                if dotted in _BLOCKING_CALLS:
+                    yield ctx.emit(
+                        "R2", node,
+                        f"blocking call {dotted}() inside async def "
+                        "on a daemon event loop — use the asyncio "
+                        "equivalent or run_in_executor")
 
 
 class RuleR3:
@@ -271,25 +200,20 @@ class RuleR3:
     title = "shared-container iteration across await"
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
-        class V(_FuncWalker):
-            def visit_For(self, node: ast.For):
-                if self.in_async:
-                    shared = _shared_container(node.iter)
-                    if shared is not None:
-                        aw = _contains_await(node.body)
-                        if aw is not None:
-                            self.emit(
-                                "R3", node,
-                                f"iterating {shared} with an await at "
-                                f"line {aw.lineno} inside the loop — "
-                                "another coroutine can mutate it during "
-                                "the await; snapshot with list(...) "
-                                "first")
-                self.generic_visit(node)
-
-        v = V(ctx)
-        v.visit(ctx.tree)
-        return iter(v.out)
+        for node in ctx.index.nodes(ast.For):
+            if not ctx.index.info(node).in_async:
+                continue
+            shared = _shared_container(node.iter)
+            if shared is None:
+                continue
+            aw = _contains_await(node.body)
+            if aw is not None:
+                yield ctx.emit(
+                    "R3", node,
+                    f"iterating {shared} with an await at "
+                    f"line {aw.lineno} inside the loop — "
+                    "another coroutine can mutate it during "
+                    "the await; snapshot with list(...) first")
 
 
 class RuleR4:
@@ -299,51 +223,47 @@ class RuleR4:
     title = "swallowed exception in RPC handler"
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
-        class V(_FuncWalker):
-            def visit_ExceptHandler(self, node: ast.ExceptHandler):
-                if self.handler and self._broad(node.type) \
-                        and self._silent(node.body):
-                    self.emit(
-                        "R4", node,
-                        f"except {self._type_name(node.type)} with a "
-                        "pass/continue body inside RPC handler "
-                        f"{self.handler!r} — log it, count it, or "
-                        "re-raise (silent drops hid real failures in "
-                        "handle_drain_node)")
-                self.generic_visit(node)
+        for node in ctx.index.nodes(ast.ExceptHandler):
+            handler = ctx.index.info(node).handler
+            if handler and self._broad(node.type) and _silent(node.body):
+                yield ctx.emit(
+                    "R4", node,
+                    f"except {self._type_name(node.type)} with a "
+                    "pass/continue body inside RPC handler "
+                    f"{handler!r} — log it, count it, or "
+                    "re-raise (silent drops hid real failures in "
+                    "handle_drain_node)")
 
-            @staticmethod
-            def _broad(t) -> bool:
-                if t is None:
-                    return True  # bare except
-                if isinstance(t, ast.Name):
-                    return t.id in ("Exception", "BaseException")
-                if isinstance(t, ast.Tuple):
-                    return any(isinstance(e, ast.Name)
-                               and e.id in ("Exception", "BaseException")
-                               for e in t.elts)
-                return False
+    @staticmethod
+    def _broad(t) -> bool:
+        if t is None:
+            return True  # bare except
+        if isinstance(t, ast.Name):
+            return t.id in ("Exception", "BaseException")
+        if isinstance(t, ast.Tuple):
+            return any(isinstance(e, ast.Name)
+                       and e.id in ("Exception", "BaseException")
+                       for e in t.elts)
+        return False
 
-            @staticmethod
-            def _silent(body) -> bool:
-                for stmt in body:
-                    if isinstance(stmt, (ast.Pass, ast.Continue)):
-                        continue
-                    if isinstance(stmt, ast.Expr) \
-                            and isinstance(stmt.value, ast.Constant):
-                        continue  # bare docstring/constant
-                    return False
-                return True
+    @staticmethod
+    def _type_name(t) -> str:
+        if t is None:
+            return "<bare>"
+        return getattr(t, "id", "Exception")
 
-            @staticmethod
-            def _type_name(t) -> str:
-                if t is None:
-                    return "<bare>"
-                return getattr(t, "id", "Exception")
 
-        v = V(ctx)
-        v.visit(ctx.tree)
-        return iter(v.out)
+def _silent(body) -> bool:
+    """True when an except body only passes/continues (modulo a bare
+    docstring/constant)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
 
 
 class RuleR5:
@@ -354,9 +274,8 @@ class RuleR5:
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
         out: list[Violation] = []
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and _is_handler_name(node.name):
+        for node in ctx.index.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            if _is_handler_name(node.name):
                 self._check_handler(ctx, node, out)
         return iter(out)
 
@@ -366,7 +285,6 @@ class RuleR5:
             return
         payload = args[-1]  # handler signature: (self, conn, payload)
         validated: set[str] = set()
-        validated_all = False
         subscripts: list[tuple[ast.Subscript, str]] = []
 
         for node in ast.walk(fn):
@@ -401,8 +319,6 @@ class RuleR5:
                 if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
                     subscripts.append((node, sl.value))
 
-        if validated_all:
-            return
         for node, key in subscripts:
             if key in validated:
                 continue
@@ -431,68 +347,49 @@ class RuleR6:
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
         if any(ctx.path.endswith(sfx) for sfx in _R6_EXEMPT):
-            return iter(())
-        aliases = _import_aliases(ctx.tree)
+            return
+        aliases = ctx.index.aliases
+        for node in ctx.index.nodes(ast.Call):
+            dotted = _dotted_name(node.func, aliases)
+            if dotted is None:
+                continue
+            parts = dotted.split(".")
+            # Matches rpc.connect / rpc.connect_retry through any
+            # alias: `from .. import rpc as r; r.connect(...)`,
+            # `from ..rpc import connect_retry; connect_retry(..)`.
+            if parts[-1] in _R6_RAW_CONNECT and len(parts) >= 2 \
+                    and parts[-2] == "rpc":
+                yield ctx.emit(
+                    "R6", node,
+                    f"raw rpc.{parts[-1]}() outside the session "
+                    "layer — use rpc.dial() when connection death "
+                    "is a liveness signal, or rpc.connect_session()"
+                    " for a resilient session (reconnect + replay "
+                    "+ server-side dedup)")
+        for node in ctx.index.nodes(ast.ExceptHandler):
+            if self._catches_connection_lost(node.type) \
+                    and _silent(node.body):
+                yield ctx.emit(
+                    "R6", node,
+                    "except ConnectionLost with only `pass` — a lost "
+                    "connection is a liveness signal, not noise: let "
+                    "the session layer redial/replay, or log it and "
+                    "act on it")
 
-        class V(_FuncWalker):
-            def visit_Call(self, node: ast.Call):
-                dotted = _dotted_name(node.func, aliases)
-                if dotted is not None:
-                    parts = dotted.split(".")
-                    # Matches rpc.connect / rpc.connect_retry through any
-                    # alias: `from .. import rpc as r; r.connect(...)`,
-                    # `from ..rpc import connect_retry; connect_retry(..)`.
-                    if parts[-1] in _R6_RAW_CONNECT and len(parts) >= 2 \
-                            and parts[-2] == "rpc":
-                        self.emit(
-                            "R6", node,
-                            f"raw rpc.{parts[-1]}() outside the session "
-                            "layer — use rpc.dial() when connection death "
-                            "is a liveness signal, or rpc.connect_session()"
-                            " for a resilient session (reconnect + replay "
-                            "+ server-side dedup)")
-                self.generic_visit(node)
+    @staticmethod
+    def _catches_connection_lost(t) -> bool:
+        def is_cl(e) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id == "ConnectionLost"
+            if isinstance(e, ast.Attribute):
+                return e.attr == "ConnectionLost"
+            return False
 
-            def visit_ExceptHandler(self, node: ast.ExceptHandler):
-                if self._catches_connection_lost(node.type) \
-                        and self._silent(node.body):
-                    self.emit(
-                        "R6", node,
-                        "except ConnectionLost with only `pass` — a lost "
-                        "connection is a liveness signal, not noise: let "
-                        "the session layer redial/replay, or log it and "
-                        "act on it")
-                self.generic_visit(node)
-
-            @staticmethod
-            def _catches_connection_lost(t) -> bool:
-                def is_cl(e) -> bool:
-                    if isinstance(e, ast.Name):
-                        return e.id == "ConnectionLost"
-                    if isinstance(e, ast.Attribute):
-                        return e.attr == "ConnectionLost"
-                    return False
-
-                if t is None:
-                    return False  # bare except: R4's territory
-                if isinstance(t, ast.Tuple):
-                    return any(is_cl(e) for e in t.elts)
-                return is_cl(t)
-
-            @staticmethod
-            def _silent(body) -> bool:
-                for stmt in body:
-                    if isinstance(stmt, (ast.Pass, ast.Continue)):
-                        continue
-                    if isinstance(stmt, ast.Expr) \
-                            and isinstance(stmt.value, ast.Constant):
-                        continue  # bare docstring/constant
-                    return False
-                return True
-
-        v = V(ctx)
-        v.visit(ctx.tree)
-        return iter(v.out)
+        if t is None:
+            return False  # bare except: R4's territory
+        if isinstance(t, ast.Tuple):
+            return any(is_cl(e) for e in t.elts)
+        return is_cl(t)
 
 
 ALL_RULES = [RuleR1(), RuleR2(), RuleR3(), RuleR4(), RuleR5(), RuleR6()]

@@ -1,0 +1,1147 @@
+"""graftwire: whole-program RPC wire-contract & replay-safety rules.
+
+The control plane is ~110 string-keyed `conn.call("Method", {...})`
+client sites talking to ~70 handlers with no compiler between them.
+This pass builds the missing wire model from the ASTs the engine
+already parsed (one traversal per file, shared with R1-R6):
+
+  per file  -> WireFileFacts:
+    - every client call/notify site: method name + literal payload
+      field set (forwarder helpers like state._per_node_call and
+      gcs._call_node are detected and their literal-method call sites
+      attributed to the forwarded method)
+    - every registered handler: required fields (require_fields),
+      consumed fields (subscripts, .get, membership guards) and the
+      field set produced on every return path
+    - every reply-field subscript on a call result (`resp["keys"]`)
+    - the session-layer registries (SESSION_EXEMPT_METHODS,
+      REPLAY_IDEMPOTENT) and the GCS side-effect table (_MUTATING)
+
+  whole-program analyze() -> violations:
+    W1  call with no matching handler / handler no caller ever reaches
+    W2  payload drift: required fields some caller never sends; fields
+        callers send that no handler reads
+    W3  reply drift: response fields consumers subscript that no
+        handler return path produces
+    W4  replay safety: every stamping-exempt method must carry an
+        audited idempotence justification (rpc.REPLAY_IDEMPOTENT), no
+        stale audit entries, and no side-effecting method may be called
+        with a payload the session layer cannot stamp
+    W5  pjit sharding handoff (train/, serve/llm*): producer
+        out_shardings provably mismatching consumer in_shardings
+        (silent reshard on the hot path)
+
+Everything extracted is deliberately conservative: a payload that
+escapes as a bare name, a reply built by a helper, a non-literal method
+string — each degrades to "opaque" and silences the checks it would
+feed, never to a guess. A violation from this pass is a real contract
+statement about the tree.
+
+The same model is exported as the wire contract
+(docs/wire_contract.md + .json) — the spec a native C++ control-plane
+server must honor (ROADMAP item 1): method -> request fields, reply
+fields, replay class.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ray_tpu._private.lint.engine import FileContext, Violation
+
+# Session-stamp keys injected/stripped by the rpc session layer; never
+# part of a method's logical contract.
+STAMP_KEYS = frozenset({"_session", "_rseq", "_acked"})
+
+# Audited endpoints invoked outside the statically-analyzed package
+# tree (tests, operator tooling, dynamic dispatch), or push endpoints
+# registered on connections whose peer lives outside the tree. Adding a
+# method here is the wire-pass equivalent of an inline suppression and
+# gets the same review bar: write down WHO calls it.
+WIRE_EXTERNAL = {
+    "Ping": "liveness probe: dialed by tests (test_fast_rpc) and "
+            "operator tooling against live daemons; no in-tree caller",
+}
+
+_CALL_ATTRS = ("call", "notify")
+
+
+@dataclass(frozen=True)
+class CallSite:
+    method: str
+    path: str
+    line: int
+    col: int
+    func: str
+    kind: str                    # "call" | "notify"
+    # Literal payload classification:
+    #   fields is a frozenset for a literal dict (or none payload),
+    #   None when the payload is a non-literal expression (opaque).
+    fields: frozenset | None
+    payload_kind: str            # "dict" | "none" | "nondict" | "opaque"
+
+
+@dataclass(frozen=True)
+class ReplyRead:
+    method: str
+    key: str
+    path: str
+    line: int
+    col: int
+    func: str
+
+
+@dataclass(frozen=True)
+class HandlerDef:
+    method: str
+    path: str
+    line: int
+    func: str
+    required: frozenset          # require_fields(...) names
+    consumed: frozenset | None   # None: payload escapes / iterated (opaque)
+    replies: tuple | None        # tuple[frozenset, ...] per return path;
+                                 # None: some path is opaque
+
+
+@dataclass
+class WireFileFacts:
+    path: str
+    calls: list = field(default_factory=list)
+    reads: list = field(default_factory=list)
+    handlers: list = field(default_factory=list)
+    session_exempt: tuple | None = None    # (set, line) from rpc.py
+    replay_idempotent: tuple | None = None  # (dict, line) from rpc.py
+    mutating: set = field(default_factory=set)  # gcs._MUTATING keys
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+
+
+def _scope_walk(root: ast.AST):
+    """Walk `root` without descending into nested function/lambda defs
+    (their returns/reads belong to their own scope)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _parent_map(root: ast.AST) -> dict[int, ast.AST]:
+    out: dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+def _callee_name(func: ast.expr) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _const_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _unwrap(expr: ast.expr) -> ast.expr:
+    """Peel transparent wrappers off an expression so
+    `cw._run(cw.gcs.call(...))`, `await conn.call(...)`, and
+    `run_coroutine_threadsafe(conn.call(...), loop).result(t)` all
+    expose the rpc call underneath."""
+    while True:
+        if isinstance(expr, ast.Await):
+            expr = expr.value
+        elif isinstance(expr, ast.Call) \
+                and isinstance(expr.func, ast.Attribute) \
+                and expr.func.attr == "result":
+            expr = expr.func.value
+        elif isinstance(expr, ast.Call) and len(expr.args) == 1:
+            expr = expr.args[0]
+        elif isinstance(expr, ast.Call) and len(expr.args) == 2 \
+                and _callee_name(expr.func) == "run_coroutine_threadsafe":
+            expr = expr.args[0]
+        else:
+            return expr
+
+
+def _rpc_call(node: ast.expr):
+    """(method, kind, payload_node|None) when `node` is a literal-method
+    `X.call("M", ...)` / `X.notify("M", ...)`, else None."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CALL_ATTRS
+            and node.args):
+        return None
+    method = _const_str(node.args[0])
+    if method is None:
+        return None
+    payload = node.args[1] if len(node.args) > 1 else None
+    if payload is None:
+        for kw in node.keywords:
+            if kw.arg == "payload":
+                payload = kw.value
+    return method, node.func.attr, payload
+
+
+def _classify_payload(payload) -> tuple[frozenset | None, str]:
+    if payload is None or (isinstance(payload, ast.Constant)
+                           and payload.value is None):
+        return frozenset(), "none"
+    if isinstance(payload, ast.Dict):
+        keys = []
+        for k in payload.keys:
+            if k is None:          # {**splat}: unknowable
+                return None, "opaque"
+            s = _const_str(k)
+            if s is None:
+                return None, "opaque"
+            keys.append(s)
+        return frozenset(keys), "dict"
+    if isinstance(payload, (ast.List, ast.Tuple, ast.Constant)):
+        # A non-dict literal: the session layer cannot stamp it (W4).
+        return None, "nondict"
+    return None, "opaque"
+
+
+# --------------------------------------------------------------------------
+# forwarder detection: helpers that pass a `method` parameter through to
+# conn.call/notify (state._per_node_call, gcs._call_node, client _rpc)
+
+
+@dataclass(frozen=True)
+class _Forwarder:
+    name: str
+    params: tuple                # def params, leading "self" dropped
+    method_param: str
+    payload_param: str | None
+    transparent: bool            # returns the rpc reply unchanged
+
+
+def _find_forwarders(index) -> dict[str, _Forwarder]:
+    out: dict[str, _Forwarder] = {}
+    for fn in index.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        params = [a.arg for a in fn.args.args]
+        visible = tuple(p for p in params if p != "self")
+        inner = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _CALL_ATTRS and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in params:
+                inner = node
+                break
+        if inner is None:
+            continue
+        method_param = inner.args[0].id
+        payload_param = None
+        if len(inner.args) > 1 and isinstance(inner.args[1], ast.Name) \
+                and inner.args[1].id in params:
+            payload_param = inner.args[1].id
+        elif "payload" in params:
+            payload_param = "payload"
+        out[fn.name] = _Forwarder(
+            fn.name, visible, method_param, payload_param,
+            transparent=_returns_expr(fn, inner))
+    return out
+
+
+def _returns_expr(fn, target: ast.Call) -> bool:
+    """Does `fn` return `target`'s result unchanged (possibly through an
+    alias assigned once)? Transparent forwarders let reply-field reads
+    at their call sites attribute to the forwarded method."""
+    aliases: dict[str, int] = {}      # name -> times assigned
+    alias_of: set[str] = set()
+    for node in _scope_walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if _unwrap(node.value) is target:
+                return True
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            aliases[name] = aliases.get(name, 0) + 1
+            if _unwrap(node.value) is target:
+                alias_of.add(name)
+    for node in _scope_walk(fn):
+        if isinstance(node, ast.Return) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in alias_of \
+                and aliases.get(node.value.id) == 1:
+            return True
+    return False
+
+
+def _bind_args(fwd: _Forwarder, call: ast.Call, is_method: bool):
+    """Map a forwarder call site's args onto the forwarder's params.
+    Returns (method_literal|None, payload_node|'absent')."""
+    params = list(fwd.params)
+    bound: dict[str, ast.expr] = {}
+    for i, a in enumerate(call.args):
+        if i < len(params):
+            bound[params[i]] = a
+    for kw in call.keywords:
+        if kw.arg:
+            bound[kw.arg] = kw.value
+    method = _const_str(bound.get(fwd.method_param))
+    payload = bound.get(fwd.payload_param) if fwd.payload_param else None
+    return method, payload
+
+
+# --------------------------------------------------------------------------
+# handler extraction
+
+
+def _handler_tables(index, parents):
+    """Yield (method, value_expr) pairs from every handler-registration
+    idiom in the file:
+      - RpcServer({...}) / FastRpcServer / make_server first arg
+      - handlers={...} kwargs (dial / connect_session / server ctors)
+      - dict (or {inner}.items() comprehension) returned by _handlers()
+      - obj.handlers["Method"] = fn subscript assignment
+    """
+    seen: set[int] = set()
+
+    def from_dict(d: ast.Dict):
+        if id(d) in seen:
+            return
+        seen.add(id(d))
+        for k, v in zip(d.keys, d.values):
+            if k is None:           # {**other, "X": fn}: splat half opaque
+                continue
+            s = _const_str(k)
+            if s is not None:
+                yield s, v
+
+    def dict_of(expr):
+        """Dict literal behind `expr` (unwraps the `{...}.items()`
+        comprehension idiom)."""
+        if isinstance(expr, ast.Dict):
+            return expr
+        if isinstance(expr, ast.DictComp) and expr.generators:
+            it = expr.generators[0].iter
+            if isinstance(it, ast.Call) \
+                    and isinstance(it.func, ast.Attribute) \
+                    and it.func.attr == "items" \
+                    and isinstance(it.func.value, ast.Dict):
+                return it.func.value
+        return None
+
+    for call in index.nodes(ast.Call):
+        name = _callee_name(call.func) or ""
+        if name.endswith("Server") or name == "make_server":
+            for arg in call.args[:1]:
+                d = dict_of(arg)
+                if d is not None:
+                    yield from from_dict(d)
+        for kw in call.keywords:
+            if kw.arg == "handlers":
+                d = dict_of(kw.value)
+                if d is not None:
+                    yield from from_dict(d)
+
+    for fn in index.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        if fn.name != "_handlers":
+            continue
+        for node in _scope_walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                d = dict_of(node.value)
+                if d is not None:
+                    yield from from_dict(d)
+
+    for assign in index.nodes(ast.Assign):
+        if len(assign.targets) != 1:
+            continue
+        t = assign.targets[0]
+        if isinstance(t, ast.Subscript) \
+                and ((isinstance(t.value, ast.Attribute)
+                      and t.value.attr == "handlers")
+                     or (isinstance(t.value, ast.Name)
+                         and t.value.id == "handlers")):
+            s = _const_str(t.slice)
+            if s is not None:
+                yield s, assign.value
+
+
+def _resolve_handler(expr, index):
+    """Handler expression -> analyzable def/lambda node, peeling
+    functools.partial(...) and single-arg wrappers (self._wrap(fn))."""
+    for _ in range(4):
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Attribute):
+            return index.functions.get(expr.attr)
+        if isinstance(expr, ast.Name):
+            return index.functions.get(expr.id)
+        if isinstance(expr, ast.Call):
+            name = _callee_name(expr.func)
+            if name == "partial" and expr.args:
+                expr = expr.args[0]
+                continue
+            if len(expr.args) == 1:
+                expr = expr.args[0]
+                continue
+            return None
+        return None
+    return None
+
+
+_TRUTHY_PARENTS = (ast.BoolOp, ast.UnaryOp, ast.IfExp, ast.If, ast.While,
+                   ast.Assert)
+_SAFE_CALLEES = {"require_fields", "isinstance", "bool", "len", "type"}
+
+
+def _analyze_handler(fn, method: str, ctx) -> HandlerDef:
+    """Field model of one handler: required / consumed / reply sets."""
+    if isinstance(fn, ast.Lambda):
+        args = [a.arg for a in fn.args.args]
+        body_nodes = list(ast.walk(fn.body))
+        returns: list = [fn.body]
+        line = fn.lineno
+        name = ctx.index.info(fn).qualname
+    else:
+        args = [a.arg for a in fn.args.args]
+        body_nodes = [n for stmt in fn.body for n in _scope_walk(stmt)]
+        returns = [n.value for n in body_nodes
+                   if isinstance(n, ast.Return)]
+        line = fn.lineno
+        name = fn.name
+    payload = args[-1] if args else None
+
+    required: set[str] = set()
+    consumed: set[str] = set()
+    opaque_req = False
+
+    if payload is not None:
+        parents = {}
+        for n in body_nodes:
+            for child in ast.iter_child_nodes(n):
+                parents[id(child)] = n
+        for n in body_nodes:
+            if isinstance(n, ast.Call) \
+                    and _callee_name(n.func) == "require_fields" \
+                    and n.args and isinstance(n.args[0], ast.Name) \
+                    and n.args[0].id == payload:
+                for a in n.args[1:]:
+                    s = _const_str(a)
+                    if s is not None:
+                        required.add(s)
+                        consumed.add(s)
+        for n in body_nodes:
+            if not (isinstance(n, ast.Name) and n.id == payload
+                    and isinstance(n.ctx, ast.Load)):
+                continue
+            p = parents.get(id(n))
+            if isinstance(p, ast.Subscript) and p.value is n:
+                s = _const_str(p.slice)
+                if s is not None:
+                    consumed.add(s)
+                else:
+                    opaque_req = True     # payload[var]: key unknowable
+            elif isinstance(p, ast.Attribute) and p.value is n:
+                if p.attr in ("get", "pop"):
+                    gp = parents.get(id(p))
+                    s = _const_str(gp.args[0]) \
+                        if isinstance(gp, ast.Call) and gp.args else None
+                    if s is not None:
+                        consumed.add(s)
+                    else:
+                        opaque_req = True
+                else:
+                    # .items()/.keys()/iteration: reads everything
+                    opaque_req = True
+            elif isinstance(p, ast.Compare) and n in p.comparators:
+                s = _const_str(p.left)
+                if s is not None and len(p.ops) == 1 \
+                        and isinstance(p.ops[0], (ast.In, ast.NotIn)):
+                    consumed.add(s)
+                elif not all(isinstance(op, (ast.Is, ast.IsNot, ast.Eq,
+                                             ast.NotEq))
+                             for op in p.ops):
+                    opaque_req = True
+            elif isinstance(p, ast.Compare) and p.left is n:
+                pass                      # payload is None / == x: truthiness
+            elif isinstance(p, ast.Call) and n in p.args \
+                    and _callee_name(p.func) in _SAFE_CALLEES:
+                pass
+            elif isinstance(p, _TRUTHY_PARENTS) or isinstance(p, ast.Expr):
+                pass
+            else:
+                opaque_req = True         # escapes: aliased / passed on
+
+    # Reply field sets, one per return path. A handler with no returns
+    # replies None (an empty field set).
+    single_assign: dict[str, ast.expr] = {}
+    assign_counts: dict[str, int] = {}
+    aug_keys: dict[str, set] = {}
+    for n in body_nodes:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t = n.targets[0]
+            if isinstance(t, ast.Name):
+                assign_counts[t.id] = assign_counts.get(t.id, 0) + 1
+                single_assign[t.id] = n.value
+            elif isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name):
+                s = _const_str(t.slice)
+                if s is not None:
+                    aug_keys.setdefault(t.value.id, set()).add(s)
+                else:
+                    assign_counts[t.value.id] = 99   # dynamic key: opaque
+
+    def reply_fields(expr) -> frozenset | None:
+        if expr is None or (isinstance(expr, ast.Constant)
+                            and expr.value is None):
+            return frozenset()
+        if isinstance(expr, ast.Dict):
+            keys = []
+            for k in expr.keys:
+                s = _const_str(k) if k is not None else None
+                if s is None:
+                    return None
+                keys.append(s)
+            return frozenset(keys)
+        if isinstance(expr, ast.Name) \
+                and assign_counts.get(expr.id) == 1:
+            base = reply_fields(single_assign[expr.id])
+            if base is not None:
+                return base | frozenset(aug_keys.get(expr.id, ()))
+        return None
+
+    replies: list[frozenset] | None = []
+    if not returns:
+        replies = [frozenset()]
+    else:
+        for r in returns:
+            f = reply_fields(r)
+            if f is None:
+                replies = None
+                break
+            replies.append(f)
+
+    return HandlerDef(
+        method=method, path=ctx.path, line=line, func=name,
+        required=frozenset(required),
+        consumed=None if opaque_req else frozenset(consumed),
+        replies=tuple(replies) if replies is not None else None)
+
+
+# --------------------------------------------------------------------------
+# registry extraction (rpc.py / gcs.py)
+
+
+def _extract_registries(index, facts: WireFileFacts) -> None:
+    for assign in index.nodes(ast.Assign):
+        if len(assign.targets) != 1 \
+                or not isinstance(assign.targets[0], ast.Name):
+            continue
+        name = assign.targets[0].id
+        v = assign.value
+        if name == "SESSION_EXEMPT_METHODS":
+            methods: set[str] = set()
+            if isinstance(v, ast.Call) and isinstance(v.args[0] if v.args
+                                                      else None, ast.Set):
+                for e in v.args[0].elts:
+                    s = _const_str(e)
+                    if s is not None:
+                        methods.add(s)
+            facts.session_exempt = (methods, assign.lineno)
+        elif name == "REPLAY_IDEMPOTENT" and isinstance(v, ast.Dict):
+            table: dict[str, str] = {}
+            for k, val in zip(v.keys, v.values):
+                ks = _const_str(k) if k is not None else None
+                if ks is not None:
+                    table[ks] = _const_str(val) or ""
+            facts.replay_idempotent = (table, assign.lineno)
+        elif name == "_MUTATING" and isinstance(v, ast.Dict):
+            for k in v.keys:
+                s = _const_str(k) if k is not None else None
+                if s is not None:
+                    facts.mutating.add(s)
+
+
+# --------------------------------------------------------------------------
+# the W1-W4 program rule
+
+
+class WireRule:
+    """Whole-program wire-contract analysis (W1-W4)."""
+
+    id = "WIRE"
+    title = "RPC wire-contract analysis"
+
+    # -- per-file extraction ----------------------------------------------
+
+    def extract(self, ctx: FileContext) -> WireFileFacts:
+        index = ctx.index
+        facts = WireFileFacts(path=ctx.path)
+        forwarders = _find_forwarders(index)
+        fwd_calls: dict[int, str] = {}   # transparent call node -> method
+
+        def record_call(node, method, kind, payload):
+            fields, pkind = _classify_payload(payload)
+            info = index.info(node)
+            facts.calls.append(CallSite(
+                method=method, path=ctx.path, line=node.lineno,
+                col=node.col_offset, func=info.qualname, kind=kind,
+                fields=fields, payload_kind=pkind))
+
+        for node in index.nodes(ast.Call):
+            rc = _rpc_call(node)
+            if rc is not None:
+                method, kind, payload = rc
+                record_call(node, method, kind, payload)
+                fwd_calls[id(node)] = method
+                continue
+            # forwarder call site: self._call_node(nid, "Method", {...})
+            name = _callee_name(node.func)
+            fwd = forwarders.get(name or "")
+            if fwd is None:
+                continue
+            # Skip the forwarder's own inner dispatch (method is a Name
+            # there, already rejected by _rpc_call's literal check).
+            method, payload = _bind_args(
+                fwd, node, isinstance(node.func, ast.Attribute))
+            if method is None:
+                continue
+            record_call(node, method, "call", payload)
+            if fwd.transparent:
+                fwd_calls[id(node)] = method
+
+        # Reply-field reads: direct subscripts of a call result, and
+        # subscripts of a name bound exactly once to a call result.
+        self._extract_reads(ctx, fwd_calls, facts)
+
+        for method, expr in _handler_tables(index, None):
+            fn = _resolve_handler(expr, index)
+            if fn is None:
+                facts.handlers.append(HandlerDef(
+                    method=method, path=ctx.path,
+                    line=getattr(expr, "lineno", 0),
+                    func="<unresolved>", required=frozenset(),
+                    consumed=None, replies=None))
+            else:
+                facts.handlers.append(_analyze_handler(fn, method, ctx))
+
+        _extract_registries(index, facts)
+        return facts
+
+    def _extract_reads(self, ctx, fwd_calls: dict[int, str],
+                       facts: WireFileFacts) -> None:
+        index = ctx.index
+
+        def method_of(expr) -> str | None:
+            return fwd_calls.get(id(_unwrap(expr)))
+
+        # name -> (method, times assigned) per enclosing function scope
+        bound: dict[tuple[str, str], list] = {}
+        for node in index.nodes(ast.Assign):
+            if len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                scope = index.info(node).qualname
+                key = (scope, node.targets[0].id)
+                entry = bound.setdefault(key, [None, 0])
+                entry[1] += 1
+                m = method_of(node.value)
+                if m is not None:
+                    entry[0] = m
+
+        for node in index.nodes(ast.Subscript):
+            if not isinstance(node.ctx, ast.Load):
+                continue
+            key = _const_str(node.slice)
+            if key is None:
+                continue
+            m = method_of(node.value)
+            if m is None and isinstance(node.value, ast.Name):
+                scope = index.info(node).qualname
+                entry = bound.get((scope, node.value.id))
+                if entry and entry[1] == 1:
+                    m = entry[0]
+            if m is not None:
+                facts.reads.append(ReplyRead(
+                    method=m, key=key, path=ctx.path, line=node.lineno,
+                    col=node.col_offset,
+                    func=index.info(node).qualname))
+
+    # -- whole-program analysis -------------------------------------------
+
+    def analyze(self, all_facts: list[WireFileFacts]) -> list[Violation]:
+        out: list[Violation] = []
+        calls: list[CallSite] = []
+        reads: list[ReplyRead] = []
+        handlers: dict[str, list[HandlerDef]] = {}
+        session_exempt = replay_idem = None
+        mutating: set[str] = set()
+        for f in all_facts:
+            calls.extend(f.calls)
+            reads.extend(f.reads)
+            for h in f.handlers:
+                handlers.setdefault(h.method, []).append(h)
+            if f.session_exempt is not None:
+                session_exempt = (*f.session_exempt, f.path)
+            if f.replay_idempotent is not None:
+                replay_idem = (*f.replay_idempotent, f.path)
+            mutating |= f.mutating
+
+        called: dict[str, list[CallSite]] = {}
+        for c in calls:
+            called.setdefault(c.method, []).append(c)
+
+        self._w1(out, called, handlers)
+        self._w2(out, called, handlers)
+        self._w3(out, reads, handlers)
+        self._w4(out, called, session_exempt, replay_idem, mutating)
+        return out
+
+    def _w1(self, out, called, handlers):
+        for method, sites in sorted(called.items()):
+            if method in handlers or method in WIRE_EXTERNAL:
+                continue
+            for c in sites:
+                out.append(Violation(
+                    rule="W1", path=c.path, line=c.line, col=c.col,
+                    func=c.func,
+                    message=f"call to {method!r} has no registered "
+                            "handler anywhere in the tree — dead or "
+                            "misnamed endpoint"))
+        for method, hs in sorted(handlers.items()):
+            if method in called or method in WIRE_EXTERNAL:
+                continue
+            for h in hs:
+                out.append(Violation(
+                    rule="W1", path=h.path, line=h.line, col=0,
+                    func=h.func,
+                    message=f"handler for {method!r} is never called "
+                            "from anywhere in the tree — dead endpoint "
+                            "(or add an audited wire.WIRE_EXTERNAL "
+                            "entry naming the external caller)"))
+
+    def _w2(self, out, called, handlers):
+        for method, sites in sorted(called.items()):
+            hs = handlers.get(method)
+            if not hs:
+                continue
+            # Fields EVERY same-name handler requires (a method name can
+            # be served by role-specific handlers; only their shared
+            # contract binds every caller).
+            required = frozenset.intersection(*[h.required for h in hs])
+            for c in sites:
+                if c.fields is None:
+                    continue         # opaque payload: can't judge
+                missing = required - c.fields - STAMP_KEYS
+                for f in sorted(missing):
+                    out.append(Violation(
+                        rule="W2", path=c.path, line=c.line, col=c.col,
+                        func=c.func,
+                        message=f"payload for {method!r} omits required "
+                                f"field {f!r} (handler answers Malformed "
+                                "at runtime)"))
+            if any(h.consumed is None for h in hs):
+                continue             # some handler reads opaquely
+            consumed = frozenset().union(*[h.consumed for h in hs])
+            flagged: set[str] = set()
+            for c in sorted(sites, key=lambda c: (c.path, c.line)):
+                if not c.fields:
+                    continue
+                for f in sorted(c.fields - consumed - STAMP_KEYS):
+                    if f in flagged:
+                        continue
+                    flagged.add(f)
+                    out.append(Violation(
+                        rule="W2", path=c.path, line=c.line, col=c.col,
+                        func=c.func,
+                        message=f"field {f!r} sent to {method!r} but no "
+                                "handler ever reads it — drifted or "
+                                "misspelled payload field"))
+
+    def _w3(self, out, reads, handlers):
+        for r in reads:
+            hs = handlers.get(r.method)
+            if not hs or any(h.replies is None for h in hs):
+                continue
+            produced = frozenset().union(
+                *[fs for h in hs for fs in h.replies]) \
+                if any(h.replies for h in hs) else frozenset()
+            if r.key not in produced:
+                where = ", ".join(sorted({f"{h.path}:{h.line}"
+                                          for h in hs}))
+                out.append(Violation(
+                    rule="W3", path=r.path, line=r.line, col=r.col,
+                    func=r.func,
+                    message=f"resp[{r.key!r}] read from {r.method!r} "
+                            "but no handler return path produces that "
+                            f"field (handlers: {where})"))
+
+    def _w4(self, out, called, session_exempt, replay_idem, mutating):
+        exempt, ex_line, ex_path = session_exempt or (set(), 0, "")
+        idem, id_line, id_path = replay_idem or ({}, 0, "")
+        if session_exempt is not None:
+            for m in sorted(exempt - set(idem)):
+                out.append(Violation(
+                    rule="W4", path=ex_path, line=ex_line, col=0,
+                    func="<module>",
+                    message=f"{m!r} is exempt from session stamping but "
+                            "has no audited justification in "
+                            "rpc.REPLAY_IDEMPOTENT — a replayed request "
+                            "will blindly re-execute; audit it or stamp "
+                            "it"))
+        if replay_idem is not None:
+            for m in sorted(set(idem) - exempt):
+                out.append(Violation(
+                    rule="W4", path=id_path, line=id_line, col=0,
+                    func="<module>",
+                    message=f"stale REPLAY_IDEMPOTENT entry {m!r}: the "
+                            "method is session-stamped (reply-cached) "
+                            "now — remove the audit entry so the table "
+                            "keeps meaning 'replayed blindly'"))
+            for m, why in sorted(idem.items()):
+                if not why.strip():
+                    out.append(Violation(
+                        rule="W4", path=id_path, line=id_line, col=0,
+                        func="<module>",
+                        message=f"REPLAY_IDEMPOTENT[{m!r}] has an empty "
+                                "justification — the audit IS the "
+                                "reason; write down why blind replay "
+                                "is safe"))
+        for method in sorted(mutating):
+            for c in called.get(method, ()):
+                if c.payload_kind == "nondict":
+                    out.append(Violation(
+                        rule="W4", path=c.path, line=c.line, col=c.col,
+                        func=c.func,
+                        message=f"side-effecting method {method!r} "
+                                "called with a non-dict payload — the "
+                                "session layer cannot stamp it, so a "
+                                "session replay would execute it twice; "
+                                "wrap the payload in a dict"))
+
+
+# --------------------------------------------------------------------------
+# W5: pjit sharding handoff (train/, serve/llm*)
+
+
+_W5_SCOPE = ("/train/", "serve/llm")
+_JIT_NAMES = ("jit", "pjit")
+_SHARDING_CTORS = ("NamedSharding", "P", "PartitionSpec",
+                   "PositionalSharding")
+
+
+def _in_w5_scope(path: str) -> bool:
+    return any(s in path for s in _W5_SCOPE)
+
+
+def _jit_shardings(call: ast.Call):
+    """(in_shardings_elts, out_shardings_elts) of a jax.jit/pjit call
+    carrying explicit shardings; None otherwise. Single (non-tuple)
+    shardings become one-element lists."""
+    if _callee_name(call.func) not in _JIT_NAMES:
+        return None
+    ins = outs = None
+    for kw in call.keywords:
+        if kw.arg == "in_shardings":
+            ins = kw.value
+        elif kw.arg == "out_shardings":
+            outs = kw.value
+    if ins is None and outs is None:
+        return None
+
+    def elts(v):
+        if v is None:
+            return None
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return list(v.elts)
+        return [v]
+
+    return elts(ins), elts(outs)
+
+
+def _resolve_name(expr, assigns: dict[str, list]):
+    """Follow a Name through its single assignment (one hop)."""
+    if isinstance(expr, ast.Name):
+        entry = assigns.get(expr.id)
+        if entry and entry[1] == 1:
+            return entry[0]
+    return expr
+
+
+def _sharding_cmp(a, b, assigns) -> str:
+    """MATCH / MISMATCH / UNKNOWN for two sharding expressions. Only a
+    provable structural difference is decided — identical resolved
+    expressions MATCH, same-shape sharding constructors differing in a
+    literal argument MISMATCH, anything computed stays UNKNOWN."""
+    if a is None or b is None:
+        return "UNKNOWN"
+    return _cmp_expr(_resolve_name(a, assigns), _resolve_name(b, assigns))
+
+
+def _cmp_expr(x, y) -> str:
+    if ast.dump(x) == ast.dump(y):
+        return "MATCH"
+    if isinstance(x, ast.Constant) and isinstance(y, ast.Constant):
+        return "MISMATCH"                 # differing literals: provable
+    if isinstance(x, ast.Call) and isinstance(y, ast.Call) \
+            and _callee_name(x.func) in _SHARDING_CTORS \
+            and _callee_name(y.func) in _SHARDING_CTORS \
+            and _callee_name(x.func) == _callee_name(y.func) \
+            and not x.keywords and not y.keywords:
+        if len(x.args) != len(y.args):
+            return "MISMATCH"             # P("dp") vs P(): provable
+        verdict = "MATCH"
+        for xa, ya in zip(x.args, y.args):
+            c = _cmp_expr(xa, ya)
+            if c == "UNKNOWN":
+                return "UNKNOWN"          # e.g. mesh vs mesh2: a guess
+            if c == "MISMATCH":
+                verdict = "MISMATCH"
+        return verdict
+    return "UNKNOWN"
+
+
+class ShardingRule:
+    """W5: producer out_shardings vs consumer in_shardings (per file)."""
+
+    id = "W5"
+    title = "pjit sharding handoff mismatch"
+
+    def extract(self, ctx: FileContext):
+        if not _in_w5_scope(ctx.path):
+            return None
+        index = ctx.index
+        violations: list[Violation] = []
+
+        # Per scope: jitted-callable name -> (ins, outs); value name ->
+        # (producer fn name, result index | None); single assignments.
+        for scope_fn in index.nodes(ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Module):
+            jitted: dict[str, tuple] = {}
+            produced: dict[str, tuple] = {}
+            assigns: dict[str, list] = {}
+            body = scope_fn.body if hasattr(scope_fn, "body") else []
+            nodes = [n for stmt in body for n in _scope_walk(stmt)]
+            for n in nodes:
+                if not (isinstance(n, ast.Assign) and len(n.targets) == 1):
+                    continue
+                t, v = n.targets[0], n.value
+                if isinstance(t, ast.Name):
+                    entry = assigns.setdefault(t.id, [v, 0])
+                    entry[0] = v
+                    entry[1] += 1
+                    if isinstance(v, ast.Call):
+                        sh = _jit_shardings(v)
+                        if sh is not None:
+                            jitted[t.id] = sh
+                        elif isinstance(v.func, ast.Name) \
+                                and v.func.id in jitted:
+                            produced[t.id] = (v.func.id, None)
+                elif isinstance(t, ast.Tuple) and isinstance(v, ast.Call) \
+                        and isinstance(v.func, ast.Name) \
+                        and v.func.id in jitted:
+                    for i, e in enumerate(t.elts):
+                        if isinstance(e, ast.Name):
+                            produced[e.id] = (v.func.id, i)
+
+            for n in nodes:
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)
+                        and n.func.id in jitted):
+                    continue
+                ins, _ = jitted[n.func.id]
+                if ins is None:
+                    continue
+                for argpos, arg in enumerate(n.args):
+                    if not isinstance(arg, ast.Name) \
+                            or arg.id not in produced:
+                        continue
+                    pname, out_idx = produced[arg.id]
+                    _, outs = jitted[pname]
+                    if outs is None:
+                        continue
+                    out_expr = None
+                    if out_idx is None and len(outs) == 1:
+                        out_expr = outs[0]
+                    elif out_idx is not None and out_idx < len(outs):
+                        out_expr = outs[out_idx]
+                    in_expr = ins[argpos] if argpos < len(ins) else None
+                    if _sharding_cmp(out_expr, in_expr,
+                                     assigns) == "MISMATCH":
+                        violations.append(Violation(
+                            rule="W5", path=ctx.path, line=n.lineno,
+                            col=n.col_offset,
+                            func=index.info(n).qualname,
+                            message=f"{pname}'s out_shardings for this "
+                                    f"value mismatch {n.func.id}'s "
+                                    f"in_shardings[{argpos}] — XLA will "
+                                    "silently reshard on every step; "
+                                    "align the producer's out_shardings "
+                                    "with the consumer"))
+        return violations or None
+
+    def analyze(self, all_facts: list) -> list[Violation]:
+        out: list[Violation] = []
+        for v in all_facts:
+            out.extend(v)
+        return out
+
+
+ALL_PROGRAM_RULES = [WireRule(), ShardingRule()]
+
+WIRE_RULE_DOCS = {
+    "W1": "dead or misnamed endpoint (call without handler / handler "
+          "without caller)",
+    "W2": "request payload drift (required field never sent / sent "
+          "field never read)",
+    "W3": "reply drift (consumer subscripts a field no handler return "
+          "path produces)",
+    "W4": "replay safety (stamping exemptions must be audited "
+          "idempotent; side effects must be stampable)",
+    "W5": "pjit sharding handoff mismatch (implicit reshard between "
+          "stages)",
+}
+
+
+# --------------------------------------------------------------------------
+# wire-contract emission (docs/wire_contract.{md,json})
+
+
+CONTRACT_VERSION = 1
+
+
+def build_contract(all_facts: list[WireFileFacts]) -> dict:
+    """The extracted method -> (request fields, reply fields, replay
+    class) table. Deterministic (sorted) so the tier-1 staleness gate
+    can regenerate-and-diff. This JSON is the protocol spec a native
+    control-plane server must honor (ROADMAP item 1)."""
+    handlers: dict[str, list[HandlerDef]] = {}
+    callers: dict[str, int] = {}
+    session_exempt: set[str] = set()
+    replay_idem: dict[str, str] = {}
+    mutating: set[str] = set()
+    for f in all_facts:
+        for h in f.handlers:
+            handlers.setdefault(h.method, []).append(h)
+        for c in f.calls:
+            callers[c.method] = callers.get(c.method, 0) + 1
+        if f.session_exempt is not None:
+            session_exempt |= f.session_exempt[0]
+        if f.replay_idempotent is not None:
+            replay_idem.update(f.replay_idempotent[0])
+        mutating |= f.mutating
+
+    methods: dict[str, dict] = {}
+    for method in sorted(set(handlers) | set(callers)):
+        hs = handlers.get(method, [])
+        entry: dict = {
+            "handlers": sorted({f"{h.path}:{h.func}" for h in hs}),
+            "callers": callers.get(method, 0),
+        }
+        if hs:
+            entry["required_fields"] = sorted(
+                frozenset.intersection(*[h.required for h in hs]))
+            if any(h.consumed is None for h in hs):
+                entry["request_fields"] = "opaque"
+            else:
+                entry["request_fields"] = sorted(
+                    frozenset().union(*[h.consumed for h in hs]))
+            if any(h.replies is None for h in hs):
+                entry["reply_fields"] = "opaque"
+            else:
+                entry["reply_fields"] = sorted(frozenset().union(
+                    *[fs for h in hs for fs in h.replies], frozenset()))
+        if method in session_exempt:
+            entry["replay"] = "idempotent-exempt"
+            entry["replay_justification"] = replay_idem.get(method, "")
+        else:
+            entry["replay"] = "cached"
+        if method in mutating:
+            entry["mutating"] = True
+        if method in WIRE_EXTERNAL:
+            entry["external"] = WIRE_EXTERNAL[method]
+        methods[method] = entry
+
+    return {
+        "version": CONTRACT_VERSION,
+        "generator": "python -m ray_tpu._private.lint --emit-contract",
+        "methods": methods,
+    }
+
+
+def contract_markdown(contract: dict) -> str:
+    """Human-readable rendering of build_contract()'s table."""
+    lines = [
+        "# RPC wire contract",
+        "",
+        "Generated by `python -m ray_tpu._private.lint --emit-contract "
+        "docs/` from the graftwire whole-program pass — do not edit by "
+        "hand (a tier-1 test regenerates and diffs this file). The",
+        "machine-readable form is `wire_contract.json`; it is the "
+        "protocol spec a native control-plane server must honor",
+        "(ROADMAP item 1): every method whose replay class is `cached` "
+        "must go through a SessionManager reply cache; every",
+        "`idempotent-exempt` method carries its audited justification "
+        "in `rpc.REPLAY_IDEMPOTENT`.",
+        "",
+        "Field sets are extracted statically: `opaque` means a payload "
+        "or reply flows through code the analyzer refuses to guess",
+        "about (escaped name, helper-built dict), not that the method "
+        "has no fields.",
+        "",
+        "| Method | Handlers | Callers | Required fields | "
+        "Request fields | Reply fields | Replay | Mutating |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+
+    def fmt(v):
+        if v is None:
+            return ""
+        if v == "opaque":
+            return "*opaque*"
+        if isinstance(v, list):
+            return ", ".join(f"`{x}`" for x in v) if v else "—"
+        return str(v)
+
+    for method, e in contract["methods"].items():
+        handlers = "<br>".join(e["handlers"]) if e["handlers"] \
+            else "*(none — external)*" if "external" in e else "*(none)*"
+        replay = e["replay"]
+        if e.get("replay_justification"):
+            replay += f" — {e['replay_justification']}"
+        lines.append(
+            f"| `{method}` | {handlers} | {e['callers']} | "
+            f"{fmt(e.get('required_fields'))} | "
+            f"{fmt(e.get('request_fields'))} | "
+            f"{fmt(e.get('reply_fields'))} | {replay} | "
+            f"{'yes' if e.get('mutating') else ''} |")
+
+    externals = [(m, e["external"]) for m, e in contract["methods"].items()
+                 if "external" in e]
+    if externals:
+        lines += ["", "## Audited external endpoints", ""]
+        for m, why in externals:
+            lines.append(f"- `{m}` — {why}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def generate_contract(paths: list[str]) -> dict:
+    """Run the wire extraction over `paths` and build the contract."""
+    from ray_tpu._private.lint.engine import (_iter_py_files,
+                                              _load_and_check)
+
+    rule = WireRule()
+    facts = []
+    for path in _iter_py_files(paths):
+        res = _load_and_check(path, [], [rule])
+        if rule.id in res.facts:
+            facts.append(res.facts[rule.id])
+    return build_contract(facts)

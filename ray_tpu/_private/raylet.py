@@ -433,14 +433,11 @@ class Raylet:
             "FreeObjects": self.handle_free_objects,
             "MakeRoom": self.handle_make_room,
             "EnsureRuntimeEnv": self.handle_ensure_runtime_env,
-            "GetNodeInfo": self.handle_get_node_info,
             "NodeStoreInfo": self.handle_node_store_info,
-            "ReportWorkerDeath": self.handle_report_worker_death,
             "WorkerBlocked": self.handle_worker_blocked,
             "WorkerUnblocked": self.handle_worker_unblocked,
             # peer-raylet-facing
             "FetchChunk": self.handle_fetch_chunk,
-            "ObjectInfo": self.handle_object_info,
             # gcs-facing
             "CreateActor": self.handle_create_actor,
             "KillActorWorker": self.handle_kill_actor_worker,
@@ -1947,19 +1944,6 @@ class Raylet:
 
     # ---------- objects ----------
 
-    async def handle_object_info(self, conn, payload):
-        require_fields(payload, "object_id", method="handle_object_info")
-        oid = ObjectID.from_hex(payload["object_id"])
-        got = self.store.get_buffer(oid)
-        if got is None and await self._restore_spilled(oid):
-            got = self.store.get_buffer(oid)
-        if got is None:
-            return {"found": False}
-        meta, data = got
-        size = len(data)
-        self.store.release(oid)
-        return {"found": True, "meta_size": len(meta), "data_size": size}
-
     async def handle_fetch_chunk(self, conn, payload):
         """Serve a chunk of a local object to a peer raylet (reference:
         push_manager.h:30 streams chunks over the ObjectManager service)."""
@@ -2189,22 +2173,6 @@ class Raylet:
             return {"found": False}
         return {"found": True, "host": info.get("host"),
                 "store_path": info.get("store_path", "")}
-
-    async def handle_get_node_info(self, conn, payload):
-        return {"node_id": self.node_id, "store_path": self.store_path,
-                "host": self.host, "port": self.port,
-                "total_resources": self.total_resources,
-                "available_resources": self.available,
-                "num_workers": len(self.workers),
-                "labels": self.labels}
-
-    async def handle_report_worker_death(self, conn, payload):
-        require_fields(payload, "worker_id",
-                       method="handle_report_worker_death")
-        w = self.workers.get(payload["worker_id"])
-        if w is not None:
-            await self._on_worker_death(w, payload.get("reason", "reported"))
-        return {"ok": True}
 
     async def handle_drain(self, conn, payload):
         """Start graceful evacuation (reference: node_manager.cc:1940

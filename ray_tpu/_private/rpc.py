@@ -79,6 +79,26 @@ SESSION_EXEMPT_METHODS = frozenset({
     "Subscribe", "Publish",
 })
 
+# Audited idempotence registry: method -> why a blind replay (no reply
+# cache) is safe. Every SESSION_EXEMPT method MUST appear here with a
+# justification, and every entry here must still be exempt — graftwire
+# W4 cross-checks both directions, so exempting a method from stamping
+# without writing down WHY (or leaving a stale audit entry behind after
+# un-exempting one) fails the lint gate. This is the replay-class column
+# of docs/wire_contract.md and part of the native-server spec
+# (ROADMAP item 1): a C++ SessionManager must cache replies for every
+# method NOT in this table.
+REPLAY_IDEMPOTENT = {
+    "KVPut": "last-write-wins: replaying the same (key, value) is a no-op",
+    "KVGet": "pure read",
+    "KVDel": "deleting an already-deleted key is a no-op",
+    "KVExists": "pure read",
+    "KVKeys": "pure read",
+    "Subscribe": "set-add: re-subscribing the same conn/channel is a no-op",
+    "Publish": "fanout is at-most-once per live subscriber by design; "
+               "duplicate delivery is the documented pubsub contract",
+}
+
 _session_stats = {
     "reconnects_total": 0,          # successful socket re-establishes
     "replayed_requests_total": 0,   # requests re-sent after a reconnect

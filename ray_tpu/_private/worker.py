@@ -451,7 +451,6 @@ class CoreWorker:
 
     async def _async_init(self):
         self.server = rpc.RpcServer({
-            "PushTask": self._handle_push_task,
             "PushTaskBatch": self._handle_push_task_batch,
             "ActorCall": self._handle_actor_call,
             "ActorSeqSkip": self._handle_actor_seq_skip,
@@ -466,8 +465,6 @@ class CoreWorker:
             "DeviceObjectEvacuate": self._handle_device_object_evacuate,
             "DeviceObjectRepin": self._handle_device_object_repin,
             "DrainNotice": self._handle_drain_notice,
-            "CancelTask": self._handle_cancel_task,
-            "Exit": self._handle_exit,
             "Ping": lambda conn, p: {"ok": True},
             "DumpStack": self._handle_dump_stack,
             "DebugTasks": self._handle_debug_tasks,
@@ -2857,13 +2854,6 @@ class CoreWorker:
 
     # ---------- execution (worker side) ----------
 
-    async def _handle_push_task(self, conn, payload):
-        require_fields(payload, "spec", method="_handle_push_task")
-        spec = TaskSpec.from_wire(payload["spec"])
-        fut = asyncio.get_running_loop().create_future()
-        self._exec_enqueue((spec, fut))
-        return await fut
-
     async def _handle_push_task_batch(self, conn, payload):
         """Notify sink: execute a batch of task specs sequentially,
         STREAMING each completion back as a TaskDone notify (coalesced by
@@ -2900,13 +2890,6 @@ class CoreWorker:
             supervised_task(
                 conn.notify("TaskDone", {"results": results}),
                 name="notify-task-done", ignore=(rpc.ConnectionLost,))
-
-    async def _handle_cancel_task(self, conn, payload):
-        return {"ok": False, "reason": "running-task cancel not supported yet"}
-
-    async def _handle_exit(self, conn, payload):
-        self.loop.call_soon(lambda: os._exit(0))
-        return {"ok": True}
 
     async def _handle_profile(self, conn, payload):
         """Statistical CPU profile of THIS worker for `duration_s`

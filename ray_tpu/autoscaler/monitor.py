@@ -85,6 +85,8 @@ class Monitor:
             logger.error("node %s could not be drained (%s); terminating "
                          "UNDRAINED — running work will be recovered the "
                          "expensive way", node_id[:8], resp.get("error"))
+            self._notify_node_dead(node_id, "terminated undrained by "
+                                            "autoscaler (drain failed)")
             return False
         from ray_tpu._private.common import wait_for_drained
 
@@ -105,7 +107,22 @@ class Monitor:
         logger.warning("node %s did not reach DRAINED within its "
                        "deadline (%s); terminating anyway", node_id[:8],
                        outcome)
+        self._notify_node_dead(node_id, "terminated mid-drain by "
+                                        "autoscaler (deadline expired)")
         return False
+
+    def _notify_node_dead(self, node_id: str, reason: str) -> None:
+        """Hand the GCS a death certificate for a node the provider is
+        about to terminate undrained. Without it the GCS only notices
+        via heartbeat grace (tens of seconds) — actors and lineage on
+        the node sit unrecovered the whole time. Best-effort: if the
+        notify fails, the heartbeat path still converges."""
+        try:
+            self._call_async(self._conn.call(
+                "NotifyNodeDead", {"node_id": node_id, "reason": reason}))
+        except Exception as e:
+            logger.warning("NotifyNodeDead for %s failed (%s); GCS will "
+                           "fall back to heartbeat expiry", node_id[:8], e)
 
     def run(self, interval_s: float = 5.0):
         self.autoscaler.start(interval_s=interval_s)

@@ -337,6 +337,13 @@ class ClientContext:
                          {"name": name, "namespace": namespace})
         return ClientActorHandle(resp["actor_id"], resp["class_name"], self)
 
+    def gcs_call(self, method: str, payload: dict | None = None) -> dict:
+        """Proxy one GCS RPC through the client server (the transport
+        behind the ray_tpu.util.state API in client mode: the proxy's
+        in-cluster CoreWorker issues the call and relays the reply)."""
+        return self._rpc("ClientGcsCall",
+                         {"method": method, "payload": payload})
+
     def nodes(self) -> list[dict]:
         return self._rpc("ClientClusterInfo", {})["nodes"]
 

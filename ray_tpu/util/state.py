@@ -9,27 +9,40 @@ from __future__ import annotations
 
 from collections import Counter
 
-from ray_tpu._private.api_internal import get_core_worker
+from ray_tpu._private.api_internal import (
+    _client_fallback, core_worker_or_none, get_core_worker)
+
+
+def _gcs_call(method: str, payload: dict | None = None) -> dict:
+    """One GCS RPC, from wherever this process sits: through the local
+    CoreWorker's session when there is one, else proxied over the
+    client connection's ClientGcsCall passthrough (reference: the state
+    API works under ray://). Raylet fan-outs (_per_node_call) stay
+    core-worker-only — a client machine cannot dial raylets directly."""
+    cw = core_worker_or_none()
+    if cw is not None:
+        return cw._run(cw.gcs.call(method, payload or {}))
+    ctx = _client_fallback()
+    if ctx is not None:
+        return ctx.gcs_call(method, payload or {})
+    get_core_worker()  # raises the canonical "not initialized" error
+    raise AssertionError("unreachable")
 
 
 def list_nodes() -> list[dict]:
-    cw = get_core_worker()
-    return cw._run(cw.gcs.call("GetAllNodes", {}))["nodes"]
+    return _gcs_call("GetAllNodes")["nodes"]
 
 
 def list_actors() -> list[dict]:
-    cw = get_core_worker()
-    return cw._run(cw.gcs.call("ListActors", {}))["actors"]
+    return _gcs_call("ListActors")["actors"]
 
 
 def list_jobs() -> list[dict]:
-    cw = get_core_worker()
-    return cw._run(cw.gcs.call("ListJobs", {}))["jobs"]
+    return _gcs_call("ListJobs")["jobs"]
 
 
 def list_placement_groups() -> list[dict]:
-    cw = get_core_worker()
-    return cw._run(cw.gcs.call("ListPlacementGroups", {}))["placement_groups"]
+    return _gcs_call("ListPlacementGroups")["placement_groups"]
 
 
 # Ordered lifecycle ladder (reference: gcs.proto TaskStatus). Owner-side
@@ -46,8 +59,7 @@ def list_tasks(limit: int = 1000) -> list[dict]:
     Events for one task arrive from several processes (owner, executor,
     GCS), so "latest" is by timestamp with the ladder rank as the
     tie-break, not by arrival order."""
-    cw = get_core_worker()
-    events = cw._run(cw.gcs.call("ListTaskEvents", {"limit": limit * 8}))["events"]
+    events = _gcs_call("ListTaskEvents", {"limit": limit * 8})["events"]
     latest: dict[str, dict] = {}
     for e in events:
         cur = latest.get(e["task_id"])
@@ -70,6 +82,15 @@ def profile_workers(duration_s: float = 2.0) -> list[dict]:
     per worker."""
     return _per_node_call("NodeProfile", payload={"duration_s": duration_s},
                           timeout=duration_s + 30)
+
+
+def debug_tasks(node_id: str | None = None) -> list[dict]:
+    """Per-worker submission-state dump: owned pending tasks and lease
+    slots from every worker, plus each raylet's lease table — the
+    debug_state.txt analog (reference: node_manager.cc DumpDebugState).
+    This is the tool that diagnosed the nested-fanout wedge; `node_id`
+    narrows the fan-out to one raylet."""
+    return _per_node_call("NodeDebugTasks", node_id=node_id, timeout=30)
 
 
 def node_stats(node_id: str | None = None) -> list[dict]:
@@ -189,8 +210,7 @@ def summarize_objects() -> dict:
 
 
 def cluster_status() -> dict:
-    cw = get_core_worker()
-    out = cw._run(cw.gcs.call("GetClusterStatus", {}))
+    out = _gcs_call("GetClusterStatus")
     # Elastic-training counters: fold the published ray_tpu_train_*
     # gauges (trainer drivers push running totals) into the status blob
     # so `ray_tpu status` shows resize/steps-lost health next to the
@@ -298,9 +318,7 @@ def summarize_task_latency(limit: int = 200000,
     actor tasks (no lease stages) and failed tasks mix freely with the
     plain-task ladder."""
     if events is None:
-        cw = get_core_worker()
-        events = cw._run(cw.gcs.call(
-            "ListTaskEvents", {"limit": limit}))["events"]
+        events = _gcs_call("ListTaskEvents", {"limit": limit})["events"]
     # (min, max) stamp per (task, state): pre-execution stages pair the
     # FIRST pass's stamps (what the submission experienced); the
     # execution stage pairs the terminal stamp with the LAST RUNNING at

@@ -75,6 +75,35 @@ def test_fake_provider_slice_labels(ray_start_cluster_head):
     assert {l["tpu-worker-id"] for l in labels} == {"0", "1"}
 
 
+def test_monitor_notifies_gcs_when_terminating_undrained():
+    """When a drain fails (or times out) the autoscaler terminates the
+    node anyway — the monitor must hand the GCS a NotifyNodeDead death
+    certificate so failover starts immediately instead of waiting out
+    heartbeat grace."""
+    from ray_tpu.autoscaler.monitor import Monitor
+
+    calls = []
+
+    class FakeConn:
+        def call(self, method, payload, **kw):
+            calls.append((method, payload))
+            if method == "DrainNode":
+                return {"ok": False, "error": "raylet wedged"}
+            return {"ok": True}
+
+    mon = object.__new__(Monitor)
+    mon._conn = FakeConn()
+    mon._call_async = lambda resp, timeout=30.0: resp
+
+    assert mon.drain_node("deadbeef" * 8, reason="idle") is False
+    drains = [c for c in calls if c[0] == "DrainNode"]
+    assert len(drains) == 2  # retried once before escalating
+    notifies = [c for c in calls if c[0] == "NotifyNodeDead"]
+    assert len(notifies) == 1
+    assert notifies[0][1]["node_id"] == "deadbeef" * 8
+    assert "drain failed" in notifies[0][1]["reason"]
+
+
 def test_gcp_tpu_provider_commands():
     """The gcloud argv surfaces are the provider contract (no cloud in
     tests); reference: gcp/tpu_command_runner.py --worker=all fan-out."""

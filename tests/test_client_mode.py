@@ -108,6 +108,25 @@ def test_client_wait_and_cluster_info(client):
     assert len(ray_tpu.nodes()) == 1
 
 
+def test_client_state_api_via_gcs_passthrough(client):
+    """The ray_tpu.util.state read APIs work under client:// — routed
+    through the proxy's ClientGcsCall passthrough instead of a local
+    CoreWorker GCS session."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    assert ray_tpu.get(one.remote()) == 1
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    assert len(state.list_jobs()) >= 1
+    status = state.cluster_status()
+    assert status["nodes"] and "uptime_s" in status
+
+
 def test_cpp_client_end_to_end(client_cluster):
     """Build (if needed) and run the C++ frontend against the proxy."""
     host, port = client_cluster

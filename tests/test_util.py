@@ -202,6 +202,41 @@ def test_worker_logs_stream_to_driver(ray_start_regular, capfd):
     assert line.startswith("(pid=")
 
 
+def test_debug_tasks_api(ray_start_regular):
+    """state.debug_tasks() — the public face of the raylet's
+    NodeDebugTasks dump (per-worker pending tasks + lease slots)."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def t():
+        return 1
+
+    ray_tpu.get([t.remote() for _ in range(3)])
+    nodes = state.debug_tasks()
+    assert len(nodes) == 1
+    assert "leases" in nodes[0] and "workers" in nodes[0], nodes[0]
+    assert any(w.get("slots") is not None or "pending" in w
+               for w in nodes[0]["workers"]), nodes[0]
+
+
+def test_state_gcs_call_client_fallback(monkeypatch):
+    """With no CoreWorker, the state API's GCS reads route through the
+    client connection's ClientGcsCall passthrough."""
+    from ray_tpu.util import state
+
+    recorded = {}
+
+    class FakeCtx:
+        def gcs_call(self, method, payload=None):
+            recorded["call"] = (method, payload)
+            return {"nodes": [{"node_id": "n1", "alive": True}]}
+
+    monkeypatch.setattr(state, "core_worker_or_none", lambda: None)
+    monkeypatch.setattr(state, "_client_fallback", lambda: FakeCtx())
+    assert state.list_nodes() == [{"node_id": "n1", "alive": True}]
+    assert recorded["call"] == ("GetAllNodes", {})
+
+
 def test_dump_stacks_across_workers(ray_start_regular):
     """`ray stack` analog: every live worker reports its thread frames."""
     import time
