@@ -1,12 +1,22 @@
 PYTHON ?= python
 
-.PHONY: lint contract test native
+.PHONY: lint contract test native gen gen-check
 
 # graftlint + graftwire gate: per-file rules R1-R6 and the whole-program
-# wire pass W1-W5 over the whole package. Exits non-zero on any new
-# violation (the checked-in baseline is empty, so: on any violation).
-lint:
+# wire pass W1-W5 over the whole package, plus the graftgen G1 pass
+# (generated-code fences + regenerate-and-diff). Exits non-zero on any
+# new violation (the checked-in baseline is empty, so: on any violation).
+lint: gen-check
 	$(PYTHON) -m ray_tpu._private.lint --jobs 8
+
+# graftgen: regenerate src/generated/contract_gen.h from
+# docs/wire_contract.json (validators, dispatch table, SessionManager).
+# The output is CHECKED IN; gen-check (and tier-1) fail when it drifts.
+gen:
+	$(PYTHON) -m ray_tpu._private.lint.gen
+
+gen-check:
+	$(PYTHON) -m ray_tpu._private.lint.gen --check
 
 # Regenerate the extracted wire contract (docs/wire_contract.{md,json}).
 # A tier-1 test regenerates and diffs these, so run this after changing

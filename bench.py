@@ -182,6 +182,7 @@ def _replay_live_capture() -> int | None:
 
 _DEVICE_HANDOFF_MODE = "--device-handoff" in sys.argv[1:]
 _SERVE_DISAGG_MODE = "--serve-disagg" in sys.argv[1:]
+_ACTOR_CHURN_MODE = "--actor-churn" in sys.argv[1:]
 
 if os.environ.get("RAY_TPU_BENCH_CHILD") == "1":
     import jax  # hermetic CPU child: axon site already stripped
@@ -190,7 +191,8 @@ elif _probe_accelerator() is not None:
 else:
     # Training-capture replay only applies to the MFU bench; a handoff
     # or serve run must produce its own (cpu-backend) capture instead.
-    rc = None if (_DEVICE_HANDOFF_MODE or _SERVE_DISAGG_MODE) \
+    rc = None if (_DEVICE_HANDOFF_MODE or _SERVE_DISAGG_MODE
+                  or _ACTOR_CHURN_MODE) \
         else _replay_live_capture()
     if rc is not None:
         sys.exit(rc)
@@ -584,9 +586,307 @@ def serve_disagg_main():
     return 0
 
 
+def actor_churn_main():
+    """Actor-churn microbench (ISSUE 18 bench satellite): the native
+    control plane's two hot state machines, end-to-end over real
+    sockets with ZERO Python in the hot path.
+
+    Phase A — actor creations/s: a raw-socket driver pipelines stamped
+    RegisterActor frames at a real GcsServer (RAY_TPU_NATIVE_CONTROL=1)
+    whose node is a sim-mode native lease plane acting as the mock
+    raylet, so the full RegisterActor -> CreateActor -> ActorReady
+    ladder runs C++-to-C++. Target: >=1000 creations/s (the Python
+    control plane measures ~26/s on this ladder).
+
+    Phase B — lease-grant p99: sequential RequestWorkerLease round
+    trips against a native lease plane backed by a real raylet_core.
+
+    Phase C — grant/return task cycles at full pipeline WHILE a second
+    driver churns actors concurrently: the 10k tasks/s floor must hold
+    under churn.
+
+    Emits ONE health-stamped JSON line and writes BENCH_ACTOR_CHURN.json.
+    """
+    import asyncio
+    import socket
+    import tempfile
+    import threading
+
+    os.environ["RAY_TPU_NATIVE_CONTROL"] = "1"
+    from ray_tpu._private import native_fastpath, rpc
+    from ray_tpu._private.bench_health import make_stamp
+    from ray_tpu._private.native_lease_plane import RayletLeasePlane
+    from ray_tpu._private.native_raylet_core import RayletResourceCore
+
+    if not native_fastpath.available():
+        print(json.dumps({
+            "metric": "actor_churn_creations_per_s", "value": 0.0,
+            "unit": "actors/s", "vs_baseline": 0.0,
+            "extra": {"error": "native fastpath unavailable"}}))
+        return 0
+
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.gcs import GcsServer
+
+    n_actors = int(os.environ.get("RAY_TPU_BENCH_CHURN_N", "2000"))
+    n_lat = int(os.environ.get("RAY_TPU_BENCH_CHURN_LAT_N", "500"))
+    task_secs = float(os.environ.get("RAY_TPU_BENCH_CHURN_TASK_S", "2.0"))
+    probe_before = _health_probe()
+
+    def req(seq, method, payload):
+        body = rpc.pack([rpc.MSG_REQUEST, seq, method, payload])
+        return len(body).to_bytes(4, "big") + body
+
+    def read_frame(f):
+        hdr = f.read(4)
+        if len(hdr) != 4:
+            raise RuntimeError("bench: connection closed mid-frame")
+        body = f.read(int.from_bytes(hdr, "big"))
+        env = rpc.unpack(body)
+        if env[0] == rpc.MSG_ERROR:
+            raise RuntimeError(f"bench: server error: {env[3]!r}")
+        return env
+
+    def churn(host, port, sid, prefix, n, window=256):
+        """Pipelined stamped RegisterActor stream; returns ack count."""
+        sk = socket.create_connection((host, port), timeout=30)
+        try:
+            sk.settimeout(30)
+            f = sk.makefile("rb")
+            next_send, acked = 0, 0
+            while acked < n:
+                while next_send < n and next_send - acked < window:
+                    i = next_send
+                    sk.sendall(req(i + 1, "RegisterActor", {
+                        "actor_id": f"{prefix}{i}", "spec": b"s",
+                        "max_restarts": 0, "_session": sid,
+                        "_rseq": i + 1, "_acked": 0}))
+                    next_send += 1
+                env = read_frame(f)
+                assert env[3].get("ok"), env
+                acked += 1
+            return acked
+        finally:
+            sk.close()
+
+    # ---- GCS on a background loop; heartbeat timeout effectively off
+    # (this measures the plane, not failure detection) ----
+    cfg = Config()
+    cfg.num_heartbeats_timeout = 10**6
+    loop = asyncio.new_event_loop()
+    loop_thread = threading.Thread(target=loop.run_forever, daemon=True)
+    loop_thread.start()
+    gcs = GcsServer(config=cfg, persistence_path=os.path.join(
+        tempfile.mkdtemp(prefix="bench-churn-"), "gcs_state"))
+    host, port = asyncio.run_coroutine_threadsafe(
+        gcs.start(), loop).result(timeout=60)
+    assert gcs._actor_plane is not None, \
+        "actor plane must install for the churn bench"
+
+    # ---- mock raylet: sim-mode lease plane on a client pump ----
+    rpump = native_fastpath.FastPump()
+    sim = RayletLeasePlane(rpump, inject_token=9)
+    sim.set_sim(True)
+    sim.install()
+    conn_id = rpump.connect(host, port)
+    node_id = "benchnode" + "0" * 23
+    rpump.send(conn_id, rpc.pack(
+        [rpc.MSG_REQUEST, 1, "RegisterNode", {
+            "host": "127.0.0.1", "node_id": node_id, "raylet_port": 47001,
+            "total_resources": {"CPU": 10000.0},
+            "_session": "bench-raylet", "_rseq": 1, "_acked": 0}])[:])
+    deadline = time.time() + 30
+    registered = False
+    while time.time() < deadline and not registered:
+        ev = rpump.next(1.0)
+        if ev and ev[0] == native_fastpath.EV_FRAME:
+            env = rpc.unpack(ev[2])
+            registered = env[1] == 1 and env[3].get("ok")
+    assert registered, "mock raylet failed to register its node"
+
+    error = None
+    creations_per_s = 0.0
+    lat_ms = []
+    tasks_per_s = 0.0
+    churn2_done = 0
+    handled = fallthrough = deduped = 0
+    try:
+        # ---- phase A: actor creations/s over the full native ladder ----
+        t0 = time.perf_counter()
+        churn(host, port, "bench-drv", "ba", n_actors)
+        # Acks cover registration; the ladder is done when RegisterActor
+        # AND ActorReady were both handled natively for every actor.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            handled, _, _ = gcs._actor_plane.counters()
+            if handled >= 2 * n_actors:
+                break
+            rpump.drain()
+            time.sleep(0.001)
+        wall_a = time.perf_counter() - t0
+        handled, fallthrough, deduped = gcs._actor_plane.counters()
+        assert handled >= 2 * n_actors, \
+            f"ladder stalled: handled={handled} want>={2 * n_actors}"
+        creations_per_s = n_actors / wall_a
+
+        # ---- dedicated raylet for lease phases ----
+        lpump = native_fastpath.FastPump()
+        rcore = RayletResourceCore({"CPU": 64.0})
+        plane = RayletLeasePlane(lpump, inject_token=7, rcore=rcore)
+        plane.set_node(node_id)
+        plane.set_gate(True)
+        plane.install()
+        lport = lpump.listen("127.0.0.1", 0)
+        workers = {f"w{i}": ("127.0.0.1", 21000 + i, 22000 + i)
+                   for i in range(48)}
+        for wid, (whost, wport, wfp) in workers.items():
+            plane.push(wid, whost, wport, wfp)
+
+        lsk = socket.create_connection(("127.0.0.1", lport), timeout=30)
+        lsk.settimeout(30)
+        lf = lsk.makefile("rb")
+        lease_shape = {"resources": {"CPU": 1.0}, "strategy": None,
+                       "placement_group": "", "pg_bundle_index": -1,
+                       "hops": 0}
+        rseq = [0]
+
+        def lease_req(payload):
+            rseq[0] += 1
+            stamped = dict(payload)
+            stamped.update({"_session": "bench-lease", "_rseq": rseq[0],
+                            "_acked": 0})
+            return req(rseq[0], "RequestWorkerLease"
+                       if "resources" in payload else "ReturnWorker",
+                       stamped)
+
+        # ---- phase B: sequential grant round trips -> p50/p99 ----
+        for _ in range(n_lat):
+            t = time.perf_counter()
+            lsk.sendall(lease_req(lease_shape))
+            grant = read_frame(lf)[3]
+            lat_ms.append((time.perf_counter() - t) * 1e3)
+            assert grant.get("granted"), grant
+            lsk.sendall(lease_req({"lease_id": grant["lease_id"],
+                                   "kill": False}))
+            read_frame(lf)
+            w = grant["worker_id"]
+            plane.push(w, *workers[w])
+
+        # ---- phase C: pipelined grant/return cycles under churn ----
+        churn_err = []
+
+        def churn2():
+            try:
+                n = churn(host, port, "bench-drv2", "bc", n_actors)
+            except Exception as e:  # surfaced below
+                churn_err.append(e)
+                n = 0
+            return n
+
+        churn_thread = threading.Thread(target=churn2, daemon=True)
+        churn_thread.start()
+        batch = 32
+        cycles = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < task_secs:
+            grants = []
+            for _ in range(batch):
+                lsk.sendall(lease_req(lease_shape))
+            for _ in range(batch):
+                g = read_frame(lf)[3]
+                assert g.get("granted"), g
+                grants.append((g["lease_id"], g["worker_id"]))
+            for lease_id, _ in grants:
+                lsk.sendall(lease_req({"lease_id": lease_id,
+                                       "kill": False}))
+            for _ in range(batch):
+                read_frame(lf)
+            for _, wid in grants:
+                plane.push(wid, *workers[wid])
+            cycles += batch
+        tasks_per_s = cycles / (time.perf_counter() - t0)
+        churn_thread.join(timeout=120)
+        if churn_err:
+            raise churn_err[0]
+        churn2_done = n_actors
+
+        # Wait for the churn2 ladders to finish (ActorReady lags the
+        # last RegisterActor ack) so the reported totals cover BOTH
+        # churn phases, then re-sample.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            handled, fallthrough, deduped = gcs._actor_plane.counters()
+            if handled >= 2 * (n_actors + churn2_done):
+                break
+            rpump.drain()
+            time.sleep(0.001)
+
+        assert plane.proto_errors() == 0
+        assert gcs._actor_plane.proto_errors() == 0
+        lsk.close()
+        plane.close()
+        lpump.close()
+        rcore.close()
+    except Exception as e:
+        error = f"{type(e).__name__}: {e}"
+    finally:
+        sim.close()
+        rpump.close()
+        try:
+            asyncio.run_coroutine_threadsafe(gcs.stop(), loop).result(30)
+        except Exception:
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+        loop_thread.join(timeout=10)
+
+    probe_after = _health_probe()
+    health = make_stamp(probe_before, probe_after, jax.default_backend())
+    lat_sorted = sorted(lat_ms) or [0.0]
+
+    def pct(p):
+        return lat_sorted[min(len(lat_sorted) - 1,
+                              int(p * len(lat_sorted)))]
+
+    rec = {
+        "metric": "actor_churn_creations_per_s",
+        "value": round(creations_per_s, 1),
+        "unit": "actors/s",
+        # North star: >=1000 native actor creations/s (~40x the ~26/s
+        # Python control-plane ladder).
+        "vs_baseline": round(creations_per_s / 1000.0, 2),
+        "extra": {
+            "health": health,
+            "backend": jax.default_backend(),
+            "actors_created": n_actors,
+            "lease_grant_p50_ms": round(pct(0.50), 4),
+            "lease_grant_p99_ms": round(pct(0.99), 4),
+            "lease_grants_timed": len(lat_ms),
+            "tasks_per_s_under_churn": round(tasks_per_s, 1),
+            "tasks_floor": 10000,
+            "concurrent_churn_actors": churn2_done,
+            "native_handled_total": handled,
+            "native_fallthrough_total": fallthrough,
+            "deduped_requests_total": deduped,
+        }}
+    if error is not None:
+        rec["extra"]["error"] = error
+    print(json.dumps(rec))
+    # Smoke runs (tiny N) set RAY_TPU_BENCH_CHURN_ARTIFACT=0 so they
+    # never clobber a full-scale capture.
+    if error is None and os.environ.get(
+            "RAY_TPU_BENCH_CHURN_ARTIFACT", "1") != "0":
+        with open(os.path.join(_REPO_ROOT, "BENCH_ACTOR_CHURN.json"),
+                  "w") as f:
+            json.dump(rec, f, indent=2)
+            f.write("\n")
+    return 0 if error is None else 1
+
+
 if __name__ == "__main__":
     if _DEVICE_HANDOFF_MODE:
         sys.exit(device_handoff_main())
     if _SERVE_DISAGG_MODE:
         sys.exit(serve_disagg_main())
+    if _ACTOR_CHURN_MODE:
+        sys.exit(actor_churn_main())
     main()

@@ -31,7 +31,8 @@ from typing import Awaitable, Callable
 from ray_tpu._private import rpc
 from ray_tpu._private.common import supervised_task
 from ray_tpu._private.event_stats import EventLoopStats
-from ray_tpu._private.native_fastpath import (EV_ACCEPT, EV_CLOSE, EV_FRAME)
+from ray_tpu._private.native_fastpath import (EV_ACCEPT, EV_CLOSE, EV_FRAME,
+                                              EV_INJECT)
 from ray_tpu._private.rpc import (MSG_ERROR, MSG_NOTIFY, MSG_REQUEST,
                                   MSG_RESPONSE, ConnectionLost, RpcError,
                                   pack, unpack)
@@ -130,6 +131,10 @@ class FastRpcServer:
         # loop thread sees the hook before any frame arrives.
         self.service_factory = None
         self.native_service = None
+        # EV_INJECT consumer: callable(token, body) for events a native
+        # service pushes into the pump FIFO (fpump_inject) to mirror
+        # natively-handled control decisions back into Python state.
+        self.inject_handler = None
         # Per-handler dispatch latency + drain batch stats (analogue of
         # the reference's event_stats.h around its asio loop posts).
         self.stats = EventLoopStats(name)
@@ -196,6 +201,14 @@ class FastRpcServer:
             if conn is not None:
                 self.connections.discard(conn)
                 conn._shutdown()
+        elif kind == EV_INJECT:
+            # conn_id slot carries the inject token, not a connection.
+            if self.inject_handler is not None:
+                try:
+                    self.inject_handler(conn_id, body)
+                except Exception:
+                    logger.exception("%s: inject handler failed",
+                                     self.name)
 
     def _on_frame(self, conn: FastConn, body: bytes) -> None:
         try:

@@ -75,6 +75,29 @@ NODE_DEAD = "DEAD"
 
 DRAIN_REASONS = ("preemption", "idle", "manual")
 
+# EV_INJECT token the native actor plane stamps on its mirror events
+# (arrives in the conn_id slot — see fast_rpc.FastRpcServer.inject_handler).
+_ACTOR_PLANE_TOKEN = 1
+
+
+class _NativeServiceStack:
+    """The pump's single native_service slot when two in-pump services
+    are chained (actor plane → KV/pubsub). close() tears down front to
+    back — the plane holds chain pointers into the KV service, so it
+    must die first (both only after the pump loop thread is joined)."""
+
+    def __init__(self, plane, svc):
+        self._plane = plane
+        self._svc = svc
+
+    def close(self) -> None:
+        if self._plane is not None:
+            self._plane.close()
+            self._plane = None
+        if self._svc is not None:
+            self._svc.close()
+            self._svc = None
+
 
 class GcsServer:
     def __init__(self, config: Config | None = None,
@@ -143,6 +166,12 @@ class GcsServer:
         # reach Python. Installed by _native_service_factory at server
         # start; None on the asyncio fallback.
         self._native_svc = None
+        # Native actor plane (src/gcs_actor.cc, RAY_TPU_NATIVE_CONTROL=1):
+        # the RegisterActor→CreateActor→ActorReady ladder for the simple
+        # hot shape runs on the pump thread; Python mirrors state off
+        # EV_INJECT events (_on_native_inject) and keeps every routed
+        # shape (named/PG/strategy/resource actors).
+        self._actor_plane = None
         self._pending_native_kv: list = []   # (key_hex, blob) restore rows
         self._native_appends_seen = 0
         self._native_walfails_seen = 0
@@ -270,15 +299,18 @@ class GcsServer:
         return addr
 
     def _native_service_factory(self, pump):
-        """Install the native KV/pubsub service into the daemon pump
-        (called by FastRpcServer.start between pump creation and
-        listen). Any failure falls back to the Python handlers,
+        """Install the native in-pump services (called by
+        FastRpcServer.start between pump creation and listen): the
+        KV/pubsub service, and — under RAY_TPU_NATIVE_CONTROL=1 — the
+        actor plane chained in FRONT of it (both share the single
+        fpump_set_service slot; unowned frames flow plane → KV service
+        → Python). Any failure falls back to the Python handlers,
         re-homing kv rows that _load_state stashed for the native
         side."""
         from ray_tpu._private import native_gcs_service
 
+        svc = None
         if native_gcs_service.available():
-            svc = None
             try:
                 svc = native_gcs_service.GcsNativeService(pump, self._store)
                 for key_hex, blob in self._pending_native_kv:
@@ -291,7 +323,6 @@ class GcsServer:
                 self._native_svc = svc
                 logger.info(
                     "native GCS service active (KV + pubsub in-pump)")
-                return svc
             except Exception:
                 logger.exception("native GCS service failed to install; "
                                  "Python handles KV/pubsub")
@@ -304,12 +335,165 @@ class GcsServer:
                         svc.close()
                     except Exception:
                         logger.exception("native GCS service close failed")
-        # Fallback: re-home any rows _load_state stashed for the native
-        # side into the Python tables.
-        for key_hex, blob in self._pending_native_kv:
-            self._restore_kv_row(key_hex, blob)
-        self._pending_native_kv = []
-        return None
+                svc = None
+        if svc is None:
+            # Fallback: re-home any rows _load_state stashed for the
+            # native side into the Python tables.
+            for key_hex, blob in self._pending_native_kv:
+                self._restore_kv_row(key_hex, blob)
+            self._pending_native_kv = []
+        stack = self._install_actor_plane(pump, svc)
+        if stack is not None:
+            return stack
+        return svc
+
+    def _install_actor_plane(self, pump, svc):
+        """Chain the native actor plane ahead of the KV service. Returns
+        the combined service stack (close() tears down both in order) or
+        None when the plane is unavailable / failed to install — in
+        which case the KV service's own hook (if any) stays active."""
+        from ray_tpu._private import native_actor_plane
+
+        if not native_actor_plane.available():
+            return None
+        plane = None
+        try:
+            plane = native_actor_plane.GcsActorPlane(
+                pump, inject_token=_ACTOR_PLANE_TOKEN)
+            if svc is not None:
+                plane.chain(svc.frame_addr(), svc.close_addr(), svc._h)
+            # install() replaces the KV service's pump hook — the plane
+            # forwards everything it doesn't own down the chain, so
+            # this must be the LAST step (a half-wired plane must never
+            # answer frames).
+            plane.install()
+            self._server.inject_handler = self._on_native_inject
+            self._actor_plane = plane
+            logger.info("native control plane active (actor ladder "
+                        "in-pump, graftgen validators + reply cache)")
+            return _NativeServiceStack(plane, svc)
+        except Exception:
+            logger.exception("native actor plane failed to install; "
+                             "Python handles the actor ladder")
+            if plane is not None:
+                try:
+                    plane.close()
+                except Exception:
+                    logger.exception("native actor plane close failed")
+            return None
+
+    # ---------- native actor plane mirror ----------
+    # The plane decides on the pump thread and narrates every decision
+    # through EV_INJECT ([event, payload] msgpack bodies); Python applies
+    # them to the authoritative tables in arrival order. Mirror handlers
+    # mutate state before their first await, so interleaving with RPC
+    # handlers cannot reorder the per-actor ladder.
+
+    def _on_native_inject(self, token, body):
+        if token != _ACTOR_PLANE_TOKEN:
+            return
+        try:
+            event, payload = rpc.unpack(body)
+        except Exception:
+            logger.exception("native actor plane: bad inject event")
+            return
+        supervised_task(self._apply_native_actor_event(event, payload),
+                        name=f"native-actor-{event}")
+
+    async def _apply_native_actor_event(self, event: str, payload):
+        if event == "registered":
+            # payload is the original RegisterActor payload (the plane
+            # only owns nameless, strategy-less, resource-less actors).
+            for stamp in (rpc._SID_KEY, rpc._RSEQ_KEY, rpc._ACK_KEY):
+                payload.pop(stamp, None)
+            actor_id = payload["actor_id"]
+            self.actors[actor_id] = {
+                "actor_id": actor_id,
+                "job_id": payload.get("job_id", ""),
+                "name": "",
+                "namespace": payload.get("namespace") or "default",
+                "class_name": payload.get("class_name", ""),
+                "state": ACTOR_PENDING,
+                "spec": payload["spec"],
+                "resources": {},
+                "max_restarts": payload.get("max_restarts", 0),
+                "restarts": 0,
+                "node_id": None,
+                "address": None,
+                "detached": payload.get("detached", False),
+                "owner": payload.get("owner"),
+                "death_cause": None,
+                "strategy": None,
+                "placement_group": "",
+                "pg_bundle_index": -1,
+                "native": True,
+            }
+            self.mark_dirty(("actors",))
+            self._record_task_event(
+                self._creation_task_id(actor_id, payload["spec"]),
+                payload.get("class_name", ""), "CREATE_REGISTERED",
+                job_id=payload.get("job_id", ""), actor_id=actor_id)
+            return
+        actor_id = payload.get("actor_id", "")
+        a = self.actors.get(actor_id)
+        if a is None:
+            return
+        if event == "scheduled":
+            node_id = payload["node_id"]
+            a["node_id"] = node_id
+            self.mark_dirty(("actors",))
+            # Same transient placement debit as _schedule_actor: the
+            # plane charges CPU:1 so bursts fan out; the next heartbeat
+            # restores ground truth.
+            node = self.nodes.get(node_id)
+            if node is not None:
+                subtract_resources(node.available_resources, {"CPU": 1.0})
+            if self.native_sched is not None:
+                self.native_sched.debit_node(node_id, {"CPU": 1.0})
+            self._record_task_event(
+                self._creation_task_id(actor_id, a["spec"]),
+                a["class_name"], "CREATE_SCHEDULED",
+                job_id=a.get("job_id", ""), actor_id=actor_id,
+                target_node=node_id)
+        elif event == "ready":
+            a["state"] = ACTOR_ALIVE
+            a["address"] = payload.get("address")
+            a["restarts"] = payload.get("restarts", a["restarts"])
+            self.mark_dirty(("actors",))
+            self._record_task_event(
+                self._creation_task_id(actor_id, a["spec"]),
+                a["class_name"], "CREATE_READY",
+                job_id=a.get("job_id", ""), actor_id=actor_id)
+            await self.publish("ACTOR", {
+                "actor_id": actor_id, "state": ACTOR_ALIVE,
+                "address": a["address"], "restarts": a["restarts"]})
+        elif event == "restarting":
+            a["restarts"] = payload.get("restarts", a["restarts"] + 1)
+            a["state"] = ACTOR_RESTARTING
+            a["address"] = None
+            self.mark_dirty(("actors",))
+            await self.publish("ACTOR", {
+                "actor_id": actor_id, "state": ACTOR_RESTARTING,
+                "reason": payload.get("reason", "")})
+        elif event == "dead":
+            a.pop("native", None)
+            a["state"] = ACTOR_DEAD
+            a["address"] = None
+            a["death_cause"] = payload.get("reason", "")
+            self.mark_dirty(("actors",))
+            from ray_tpu.util import events
+
+            events.record("WARNING", "gcs", "actor dead",
+                          actor_id=actor_id)
+            await self.publish("ACTOR", {
+                "actor_id": actor_id, "state": ACTOR_DEAD,
+                "reason": payload.get("reason", "")})
+        elif event == "orphaned":
+            # The plane found no feasible node and handed the actor back
+            # for good (its record is gone; the mirror keeps the restart
+            # count). Python's scheduler takes over with its retry loop.
+            a.pop("native", None)
+            supervised_task(self._schedule_actor(actor_id))
 
     def _restore_kv_row(self, key_hex: str, blob: bytes) -> None:
         """Restore one persisted kv row into the Python tables. The
@@ -323,7 +507,8 @@ class GcsServer:
         self._row_sizes[("kv", key_hex)] = len(blob)
 
     async def stop(self):
-        self._native_svc = None  # server stop destroys the service
+        self._native_svc = None  # server stop destroys the service stack
+        self._actor_plane = None
         if self._health_task:
             self._health_task.cancel()
         if getattr(self, "_persist_task", None):
@@ -735,6 +920,7 @@ class GcsServer:
         )
         self.nodes[info.node_id] = info
         self.node_conns[info.node_id] = conn
+        self._plane_node_up(info.node_id, conn)
         self._touch("nodes", info.node_id)
         if hasattr(self, "_restored_unregistered"):
             self._restored_unregistered.discard(info.node_id)
@@ -778,6 +964,7 @@ class GcsServer:
             events.record("INFO", "gcs", "suspect node reconnected",
                           node_id=node.node_id)
         self.node_conns[node.node_id] = conn
+        self._plane_node_up(node.node_id, conn)
         self._touch("nodes", node.node_id)
         if self.native_sched is not None:
             self.native_sched.update_node(
@@ -791,6 +978,17 @@ class GcsServer:
             "node": node.to_wire()})
         return {"ok": True, "config": self.config.to_json(),
                 "reconnected": True}
+
+    def _plane_node_up(self, node_id: str, conn) -> None:
+        """Tell the native actor plane a raylet conn (re)bound, so it
+        can (re)send any in-flight CreateActors over the fresh socket
+        with their ORIGINAL (sid, rseq) — the raylet's reply cache
+        makes the replay at-most-once."""
+        if self._actor_plane is not None and hasattr(conn, "_conn_id"):
+            try:
+                self._actor_plane.node_up(node_id, conn._conn_id)
+            except Exception:
+                logger.exception("native actor plane node_up failed")
 
     async def _call_node(self, node_id: str, method: str, payload=None, *,
                          timeout: float | None = None,
@@ -1161,6 +1359,15 @@ class GcsServer:
         node.alive = False
         node.state = NODE_DEAD if not drained else NODE_DRAINED
         node.available_resources = {}
+        if self._actor_plane is not None:
+            # The plane fails over its own in-flight creates (restart
+            # bookkeeping + reschedule, narrated via inject events) —
+            # BEFORE the loop below, whose skip of native PENDING actors
+            # relies on the plane owning them.
+            try:
+                self._actor_plane.node_down(node_id)
+            except Exception:
+                logger.exception("native actor plane node_down failed")
         self.node_conns.pop(node_id, None)
         self._node_call_sessions.pop(node_id, None)
         if self.native_sched is not None:
@@ -1189,6 +1396,12 @@ class GcsServer:
         # left goes through the normal path with a drain-flavored cause.
         for actor_id, a in list(self.actors.items()):
             if a.get("node_id") == node_id and a["state"] in (ACTOR_ALIVE, ACTOR_PENDING):
+                if a.get("native") and a["state"] == ACTOR_PENDING:
+                    # In-flight native create: the node_down call above
+                    # already failed it over inside the plane (restart
+                    # consumed there); running the Python path too would
+                    # double-count the restart.
+                    continue
                 await self._on_actor_worker_death(
                     actor_id,
                     f"node {node_id[:8]} drained and removed" if drained
@@ -1462,6 +1675,15 @@ class GcsServer:
         a = self.actors.get(actor_id)
         if a is None or a["state"] == ACTOR_DEAD:
             return
+        if a.pop("native", None) and self._actor_plane is not None:
+            # Python takes over this actor's lifecycle (post-create
+            # death, kill, node failure of an ALIVE actor): the plane
+            # must drop its record or a later node event would make it
+            # act on a ghost.
+            try:
+                self._actor_plane.actor_forget(actor_id)
+            except Exception:
+                logger.exception("native actor plane forget failed")
         can_restart = (not intended) and (
             a["max_restarts"] == -1 or a["restarts"] < a["max_restarts"])
         logger.info("actor %s worker died (%s), restart=%s (%d/%s)",
@@ -1818,6 +2040,22 @@ class GcsServer:
             "suspect_nodes": len([n for n in self.nodes.values()
                                   if n.state == NODE_SUSPECT]),
             "rpc_sessions": rpc.session_stats(),
+            "native_control": self._native_control_stats(),
+        }
+
+    def _native_control_stats(self):
+        if self._actor_plane is None:
+            return None
+        handled, fallthrough, deduped = self._actor_plane.counters()
+        return {
+            "handled_total": handled,
+            # Frames the plane looked at but routed to Python (complex
+            # shapes, transient no-node states, unknown actors).
+            "native_fallthrough_total": fallthrough,
+            "deduped_requests_total": deduped,
+            "actors": self._actor_plane.actor_count(),
+            "sessions": self._actor_plane.session_count(),
+            "proto_errors": self._actor_plane.proto_errors(),
         }
 
     async def handle_get_event_loop_stats(self, conn, payload):
@@ -1838,6 +2076,7 @@ class GcsServer:
             }
         else:
             out["native"] = None
+        out["native_control"] = self._native_control_stats()
         return out
 
     async def handle_get_config(self, conn, payload):

@@ -113,6 +113,20 @@ def main(argv: list[str] | None = None) -> int:
               f"across {report.files_checked} files) -> {args.baseline}")
         return 0
 
+    # graftgen G1 pass: generated-artifact fences (hand-edit detection),
+    # contract <-> replay-registry parity, and regenerate-and-diff
+    # staleness of src/generated/. Never baselined — generated code is
+    # either byte-fresh or the gate fails.
+    gen_errors: list[str] = []
+    try:
+        from ray_tpu._private.lint import gen as gen_mod
+
+        gen_errors = gen_mod.lint_generated()
+    except Exception as e:
+        gen_errors = [f"G1 graftgen pass crashed: {e}"]
+    for err in gen_errors:
+        print(f"graftgen: {err}")
+
     base = {} if args.no_baseline else baseline_mod.load_baseline(args.baseline)
     new = baseline_mod.regressions(report.violations, base)
 
@@ -130,6 +144,11 @@ def main(argv: list[str] | None = None) -> int:
     if new:
         print("graftlint: FAIL — fix the violations above or (only for "
               "pre-existing debt) run --update-baseline", file=sys.stderr)
+        return 1
+    if gen_errors:
+        print("graftlint: FAIL — graftgen violations above (run `make gen` "
+              "to regenerate; never hand-edit inside generated fences)",
+              file=sys.stderr)
         return 1
     return 0
 

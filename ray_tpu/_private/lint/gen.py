@@ -1,0 +1,579 @@
+"""graftgen: contract-driven C++ codegen for the native control plane.
+
+Reads docs/wire_contract.json (emitted by the graftwire pass, `make
+contract`) and generates `src/generated/contract_gen.h`:
+
+  - per-method required-field tables + a generic msgpack frame validator
+    (`contractgen::ValidateRequired`) mirroring `common.require_fields`
+    — a short frame answers Malformed, never a KeyError-style crash;
+  - the method dispatch/metadata table (`contractgen::kMethods`, sorted
+    for binary search): replay class (`cached` vs `idempotent-exempt`)
+    and mutating flag straight from the contract;
+  - a native `contractgen::SessionManager`: the (sid, rseq) reply cache
+    with rpc.SessionManager's exact semantics (pending waiters, evict
+    oldest-done at 512 entries stopping at a pending head, ack pruning,
+    900s idle TTL swept every 60s), plus a python-routed mark so a
+    partially-migrated method instance keeps routing to the same side
+    across replays (split-brain guard, see src/gcs_actor.cc).
+
+The generated header is CHECKED IN and gated two ways:
+
+  - `make gen` / `--check`: regenerate-and-diff (stale output fails) —
+    wired into `make lint` and the tier-1 test tests/test_graftgen.py;
+  - a content-sha256 stamp inside the `// graftgen: generated` fences:
+    hand-edits inside the fences break the stamp and fail graftlint
+    (lint_generated(), run by `python -m ray_tpu._private.lint`).
+
+Gen-time registry parity (hard error, not a lint warning): the session
+layer's SESSION_EXEMPT_METHODS / REPLAY_IDEMPOTENT registries and the
+GCS _MUTATING table must EXACTLY match the contract's replay classes
+and mutating flags — codegen from a contract that disagrees with the
+live registries would bake the drift into C++.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PKG = os.path.dirname(os.path.dirname(_HERE))          # ray_tpu/
+REPO_ROOT = os.path.dirname(_PKG)
+
+CONTRACT_PATH = os.path.join(REPO_ROOT, "docs", "wire_contract.json")
+GENERATED_DIR = os.path.join(REPO_ROOT, "src", "generated")
+GENERATED_HEADER = os.path.join(GENERATED_DIR, "contract_gen.h")
+
+FENCE_BEGIN = "// graftgen: generated (begin)"
+FENCE_END = "// graftgen: generated (end)"
+_STAMP_PREFIX = "// graftgen: content-sha256="
+
+# Session stamp keys (rpc._SID_KEY etc.) — the validator must treat them
+# as wire-level metadata, never as application fields.
+_STAMP_KEYS = ("_session", "_rseq", "_acked")
+
+
+# ---------------------------------------------------------------------------
+# registry parity (satellite: hard codegen error on drift)
+# ---------------------------------------------------------------------------
+
+
+def _ast_registries():
+    """AST-extract the three replay registries without importing the
+    daemon modules (imports would drag in the full runtime)."""
+    rpc_path = os.path.join(_PKG, "_private", "rpc.py")
+    gcs_path = os.path.join(_PKG, "_private", "gcs.py")
+    exempt: set[str] | None = None
+    idem: dict[str, str] | None = None
+    mutating: set[str] | None = None
+
+    def _str_elts(node) -> set[str] | None:
+        if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+            out = set()
+            for e in node.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)):
+                    return None
+                out.add(e.value)
+            return out
+        return None
+
+    with open(rpc_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=rpc_path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        name = getattr(node.targets[0], "id", None)
+        if name == "SESSION_EXEMPT_METHODS":
+            v = node.value
+            if isinstance(v, ast.Call):       # frozenset({...})
+                v = v.args[0] if v.args else None
+            exempt = _str_elts(v) if v is not None else None
+        elif name == "REPLAY_IDEMPOTENT" and isinstance(node.value, ast.Dict):
+            idem = {}
+            for k, val in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    try:
+                        idem[k.value] = str(ast.literal_eval(val))
+                    except Exception:
+                        idem[k.value] = ""
+
+    with open(gcs_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=gcs_path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and getattr(node.targets[0], "attr", None) is None \
+                and getattr(node.targets[0], "id", None) == "_MUTATING" \
+                and isinstance(node.value, ast.Dict):
+            mutating = {k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+        # class-level `_MUTATING = {...}` parses as Assign with Name
+        # target inside the ClassDef body — covered above.
+    return exempt, idem, mutating
+
+
+def cross_check(contract: dict) -> list[str]:
+    """Registry parity errors (empty list == clean). Every mismatch
+    between the contract's replay classes / mutating flags and the live
+    rpc.py + gcs.py registries is a HARD gen error."""
+    errors: list[str] = []
+    methods = contract.get("methods", {})
+    exempt, idem, mutating = _ast_registries()
+    if exempt is None or idem is None or mutating is None:
+        return ["graftgen: failed to AST-extract the replay registries "
+                "from rpc.py/gcs.py — refusing to generate blind"]
+    contract_exempt = {m for m, e in methods.items()
+                       if e.get("replay") == "idempotent-exempt"}
+    for m in sorted(contract_exempt - exempt):
+        errors.append(
+            f"graftgen: contract says {m!r} is idempotent-exempt but "
+            "rpc.SESSION_EXEMPT_METHODS does not list it — regenerate "
+            "the contract (`make contract`) or fix the registry")
+    for m in sorted(exempt - contract_exempt):
+        errors.append(
+            f"graftgen: rpc.SESSION_EXEMPT_METHODS lists {m!r} but the "
+            "contract replay class is not idempotent-exempt — stale "
+            "docs/wire_contract.json? run `make contract`")
+    for m in sorted(exempt.symmetric_difference(idem)):
+        errors.append(
+            f"graftgen: SESSION_EXEMPT_METHODS and REPLAY_IDEMPOTENT "
+            f"disagree about {m!r} — every exemption needs an audited "
+            "justification (and no stale entries)")
+    for m, why in sorted(idem.items()):
+        if not why.strip():
+            errors.append(
+                f"graftgen: REPLAY_IDEMPOTENT[{m!r}] justification is "
+                "empty — write down why blind replay is safe")
+    contract_mutating = {m for m, e in methods.items() if e.get("mutating")}
+    for m in sorted(contract_mutating.symmetric_difference(mutating)):
+        errors.append(
+            f"graftgen: GCS _MUTATING and the contract's mutating flag "
+            f"disagree about {m!r} — a native handler generated from "
+            "this contract would skip (or force) WAL write-through")
+    for m, e in sorted(methods.items()):
+        if e.get("replay") not in ("cached", "idempotent-exempt"):
+            errors.append(
+                f"graftgen: unknown replay class {e.get('replay')!r} for "
+                f"{m!r} — the native SessionManager only knows cached "
+                "and idempotent-exempt")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# code emission
+# ---------------------------------------------------------------------------
+
+
+def _c_str(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def _emit_body(contract: dict) -> str:
+    methods = contract["methods"]
+    names = sorted(methods)
+    out: list[str] = []
+    w = out.append
+    w("#pragma once")
+    w("")
+    w("// Native control-plane contract tables generated from")
+    w("// docs/wire_contract.json: per-method required-field validators,")
+    w("// the replay-class/mutating dispatch table, and the (sid, rseq)")
+    w("// reply cache mirroring rpc.SessionManager exactly.")
+    w("")
+    w("#include <stdint.h>")
+    w("#include <string.h>")
+    w("")
+    w("#include <chrono>")
+    w("#include <functional>")
+    w("#include <list>")
+    w("#include <string>")
+    w("#include <string_view>")
+    w("#include <unordered_map>")
+    w("#include <unordered_set>")
+    w("#include <utility>")
+    w("#include <vector>")
+    w("")
+    w('#include "../msgpack_lite.h"')
+    w("")
+    w("namespace contractgen {")
+    w("")
+    w("enum ReplayClass : uint8_t {")
+    w("  kReplayCached = 0,        // dedup via the (sid, rseq) reply cache")
+    w("  kReplayExempt = 1,        // audited idempotent: blind replay safe")
+    w("};")
+    w("")
+    w("struct MethodInfo {")
+    w("  const char* name;")
+    w("  ReplayClass replay;")
+    w("  bool mutating;            // GCS persistence write-through required")
+    w("  const char* const* required;")
+    w("  uint32_t n_required;")
+    w("};")
+    w("")
+    w("namespace detail {")
+    for name in names:
+        req = methods[name].get("required_fields") or []
+        if isinstance(req, str):    # "opaque" request shape: no checks
+            req = []
+        if req:
+            fields = ", ".join(_c_str(r) for r in req)
+            w(f"inline const char* const kReq_{name}[] = {{{fields}}};")
+    w("}  // namespace detail")
+    w("")
+    w("// Sorted by strcmp(name) for binary search (FindMethod).")
+    w("inline const MethodInfo kMethods[] = {")
+    for name in names:
+        e = methods[name]
+        req = e.get("required_fields") or []
+        if isinstance(req, str):
+            req = []
+        replay = ("kReplayExempt" if e.get("replay") == "idempotent-exempt"
+                  else "kReplayCached")
+        mut = "true" if e.get("mutating") else "false"
+        arr = f"detail::kReq_{name}" if req else "nullptr"
+        w(f"    {{{_c_str(name)}, {replay}, {mut}, {arr}, {len(req)}}},")
+    w("};")
+    w(f"inline constexpr uint32_t kNumMethods = {len(names)};")
+    w("")
+    w("inline const MethodInfo* FindMethod(std::string_view name) {")
+    w("  uint32_t lo = 0, hi = kNumMethods;")
+    w("  while (lo < hi) {")
+    w("    uint32_t mid = (lo + hi) / 2;")
+    w("    const MethodInfo& m = kMethods[mid];")
+    w("    int c = name.compare(m.name);")
+    w("    if (c == 0) return &m;")
+    w("    if (c < 0) hi = mid; else lo = mid + 1;")
+    w("  }")
+    w("  return nullptr;")
+    w("}")
+    w("")
+    w("// Mirror of common.require_fields over a raw msgpack payload:")
+    w("// payload must be a map carrying every required field. Session")
+    w("// stamp keys (_session/_rseq/_acked) are wire metadata, not")
+    w("// application fields. Truncated/garbage payloads fail closed.")
+    w("// On failure *missing names the first absent field (or the map")
+    w("// complaint), for the Malformed error text.")
+    w("inline bool ValidateRequired(const MethodInfo& m, mplite::View v,")
+    w("                             const char** missing) {")
+    w("  *missing = nullptr;")
+    w("  uint32_t n_pairs;")
+    w("  if (!mplite::read_map(v, &n_pairs)) {")
+    w('    *missing = "payload must be a map";')
+    w("    return false;")
+    w("  }")
+    w("  uint64_t seen = 0;  // bit i => m.required[i] present")
+    w("  for (uint32_t i = 0; i < n_pairs; i++) {")
+    w("    std::string_view key;")
+    w("    if (!mplite::read_str(v, &key)) {")
+    w('      *missing = "unreadable map key";')
+    w("      return false;")
+    w("    }")
+    w("    for (uint32_t r = 0; r < m.n_required && r < 64; r++) {")
+    w("      if (key == m.required[r]) seen |= (1ull << r);")
+    w("    }")
+    w("    if (!mplite::skip(v)) {")
+    w('      *missing = "truncated value";')
+    w("      return false;")
+    w("    }")
+    w("  }")
+    w("  for (uint32_t r = 0; r < m.n_required && r < 64; r++) {")
+    w("    if (!(seen & (1ull << r))) {")
+    w("      *missing = m.required[r];")
+    w("      return false;")
+    w("    }")
+    w("  }")
+    w("  return true;")
+    w("}")
+    w("")
+    w("inline bool IsStampKey(std::string_view key) {")
+    stamp = " || ".join(f'key == "{k}"' for k in _STAMP_KEYS)
+    w(f"  return {stamp};")
+    w("}")
+    w("")
+    w("// ---------------------------------------------------------------")
+    w("// SessionManager: server-side (session_id, rseq) -> reply cache.")
+    w("// Exact C++ mirror of rpc.SessionManager (PR-10 semantics):")
+    w("//   - begin() inserts a pending entry; duplicates either answer")
+    w("//     from cache or attach a waiter to the in-flight execution;")
+    w("//   - eviction pops the oldest DONE entry past max_replies and")
+    w("//     STOPS at a pending head (never break at-most-once);")
+    w("//   - ack(upto) prunes done entries <= upto;")
+    w("//   - sessions idle past ttl are swept at most every 60s.")
+    w("// Plus one native-plane extension with the same lifetime rules:")
+    w("// python-routed marks, so a method instance that fell through to")
+    w("// Python keeps falling through on replay (split-brain guard).")
+    w("// NOT thread-safe: callers serialize (the planes run it on the")
+    w("// pump loop thread only).")
+    w("// ---------------------------------------------------------------")
+    w("class SessionManager {")
+    w(" public:")
+    w("  using ReplyFn = std::function<void(int kind, const std::string&)>;")
+    w("")
+    w("  enum ProbeResult {")
+    w("    kProbeMiss = 0,      // no entry: caller may execute natively")
+    w("    kProbeAnswered = 1,  // duplicate: answered (or waiter attached)")
+    w("    kProbeRouted = 2,    // python-routed: caller must fall through")
+    w("  };")
+    w("")
+    w("  explicit SessionManager(uint32_t max_replies = 512,")
+    w("                          double ttl_s = 900.0)")
+    w("      : max_replies_(max_replies), ttl_s_(ttl_s) {}")
+    w("")
+    w("  // Consult the cache WITHOUT creating an entry. Touches the")
+    w("  // session clock and runs the sweep, exactly like begin().")
+    w("  ProbeResult Probe(const std::string& sid, int64_t rseq,")
+    w("                    const ReplyFn& reply_fn) {")
+    w("    double now = Now();")
+    w("    MaybeSweep(now);")
+    w("    Session& sess = sessions_[sid];")
+    w("    sess.last_seen = now;")
+    w("    if (sess.routed.count(rseq)) return kProbeRouted;")
+    w("    auto it = sess.replies.find(rseq);")
+    w("    if (it == sess.replies.end()) return kProbeMiss;")
+    w("    deduped_requests_total++;")
+    w("    Entry& e = it->second;")
+    w("    if (e.done) {")
+    w("      reply_fn(e.kind, e.value);")
+    w("    } else {")
+    w("      e.waiters.push_back(reply_fn);")
+    w("    }")
+    w("    return kProbeAnswered;")
+    w("  }")
+    w("")
+    w("  // Insert the pending entry for an execution this caller has")
+    w("  // committed to (Probe returned kProbeMiss). Mirrors the")
+    w("  // insert + eviction half of rpc.SessionManager.begin().")
+    w("  void Begin(const std::string& sid, int64_t rseq) {")
+    w("    double now = Now();")
+    w("    Session& sess = sessions_[sid];")
+    w("    sess.last_seen = now;")
+    w("    sess.order.push_back(rseq);")
+    w("    sess.replies.emplace(rseq, Entry{});")
+    w("    while (sess.replies.size() > max_replies_) {")
+    w("      int64_t oldest = sess.order.front();")
+    w("      auto oit = sess.replies.find(oldest);")
+    w("      if (oit == sess.replies.end()) {  // already ack-pruned")
+    w("        sess.order.pop_front();")
+    w("        continue;")
+    w("      }")
+    w("      if (!oit->second.done) break;  // pending head: stop")
+    w("      sess.replies.erase(oit);")
+    w("      sess.order.pop_front();")
+    w("    }")
+    w("  }")
+    w("")
+    w("  void Finish(const std::string& sid, int64_t rseq, int kind,")
+    w("              std::string value) {")
+    w("    auto sit = sessions_.find(sid);")
+    w("    if (sit == sessions_.end()) return;")
+    w("    auto it = sit->second.replies.find(rseq);")
+    w("    if (it == sit->second.replies.end()) return;")
+    w("    Entry& e = it->second;")
+    w("    std::vector<ReplyFn> waiters;")
+    w("    waiters.swap(e.waiters);")
+    w("    e.done = true;")
+    w("    e.kind = kind;")
+    w("    e.value = std::move(value);")
+    w("    for (auto& fn : waiters) fn(e.kind, e.value);")
+    w("  }")
+    w("")
+    w("  void Ack(const std::string& sid, int64_t upto) {")
+    w("    auto sit = sessions_.find(sid);")
+    w("    if (sit == sessions_.end()) return;")
+    w("    Session& sess = sit->second;")
+    w("    for (auto it = sess.replies.begin(); it != sess.replies.end();) {")
+    w("      if (it->first <= upto && it->second.done) {")
+    w("        it = sess.replies.erase(it);")
+    w("      } else {")
+    w("        ++it;")
+    w("      }")
+    w("    }")
+    w("    for (auto it = sess.routed.begin(); it != sess.routed.end();) {")
+    w("      if (*it <= upto) it = sess.routed.erase(it); else ++it;")
+    w("    }")
+    w("  }")
+    w("")
+    w("  // Native-plane extension: remember that this (sid, rseq) was")
+    w("  // handed to Python, so replays keep routing there.")
+    w("  void MarkRouted(const std::string& sid, int64_t rseq) {")
+    w("    Session& sess = sessions_[sid];")
+    w("    sess.last_seen = Now();")
+    w("    sess.routed.insert(rseq);")
+    w("  }")
+    w("")
+    w("  uint64_t deduped_requests_total = 0;")
+    w("  size_t session_count() const { return sessions_.size(); }")
+    w("")
+    w("  // Test hook: advance the virtual clock (sweep/TTL behavior).")
+    w("  void AdvanceClockForTest(double dt_s) { skew_s_ += dt_s; }")
+    w("")
+    w(" private:")
+    w("  struct Entry {")
+    w("    bool done = false;")
+    w("    int kind = 0;")
+    w("    std::string value;")
+    w("    std::vector<ReplyFn> waiters;")
+    w("  };")
+    w("  struct Session {")
+    w("    double last_seen = 0.0;")
+    w("    std::list<int64_t> order;                 // insertion order")
+    w("    std::unordered_map<int64_t, Entry> replies;")
+    w("    std::unordered_set<int64_t> routed;")
+    w("  };")
+    w("")
+    w("  double Now() const {")
+    w("    using clock = std::chrono::steady_clock;")
+    w("    return std::chrono::duration<double>(")
+    w("               clock::now().time_since_epoch())")
+    w("               .count() +")
+    w("           skew_s_;")
+    w("  }")
+    w("")
+    w("  void MaybeSweep(double now) {")
+    w("    if (now - last_sweep_ < 60.0) return;")
+    w("    last_sweep_ = now;")
+    w("    for (auto it = sessions_.begin(); it != sessions_.end();) {")
+    w("      if (now - it->second.last_seen > ttl_s_) {")
+    w("        it = sessions_.erase(it);")
+    w("      } else {")
+    w("        ++it;")
+    w("      }")
+    w("    }")
+    w("  }")
+    w("")
+    w("  uint32_t max_replies_;")
+    w("  double ttl_s_;")
+    w("  double last_sweep_ = 0.0;")
+    w("  double skew_s_ = 0.0;")
+    w("  std::unordered_map<std::string, Session> sessions_;")
+    w("};")
+    w("")
+    w("}  // namespace contractgen")
+    return "\n".join(out) + "\n"
+
+
+def generate(contract: dict) -> str:
+    """Full generated-file text (fences + content hash + body)."""
+    body = (FENCE_BEGIN + "\n" + _emit_body(contract) + FENCE_END + "\n")
+    digest = hashlib.sha256(body.encode()).hexdigest()
+    gen = contract.get("generator", "graftwire")
+    head = (
+        "// graftgen: generated from docs/wire_contract.json — DO NOT EDIT\n"
+        "// graftgen: regenerate with `make gen` "
+        "(python -m ray_tpu._private.lint.gen)\n"
+        f"// graftgen: contract generator: {gen}\n"
+        f"{_STAMP_PREFIX}{digest}\n")
+    return head + body
+
+
+def load_contract(path: str = CONTRACT_PATH) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# gates: regenerate-and-diff + fence hash (the graftlint G1 rule)
+# ---------------------------------------------------------------------------
+
+
+def _fence_errors(path: str, text: str) -> list[str]:
+    """Validate the content-sha256 stamp of one generated file."""
+    rel = os.path.relpath(path, REPO_ROOT)
+    stamp = None
+    for line in text.splitlines():
+        if line.startswith(_STAMP_PREFIX):
+            stamp = line[len(_STAMP_PREFIX):].strip()
+            break
+    begin = text.find(FENCE_BEGIN)
+    end = text.find(FENCE_END)
+    if stamp is None or begin < 0 or end < 0:
+        return [f"{rel}:1:0: G1 [graftgen] generated file is missing its "
+                "content-sha256 stamp or fences — regenerate with "
+                "`make gen`, never hand-write generated files"]
+    body = text[begin:end + len(FENCE_END)] + "\n"
+    digest = hashlib.sha256(body.encode()).hexdigest()
+    if digest != stamp:
+        return [f"{rel}:1:0: G1 [graftgen] content inside the "
+                "`// graftgen: generated` fences was edited by hand "
+                "(sha256 mismatch) — edit the generator "
+                "(ray_tpu/_private/lint/gen.py) and run `make gen`"]
+    return []
+
+
+def lint_generated(repo_root: str = REPO_ROOT) -> list[str]:
+    """The graftlint G1 rule + the regenerate-and-diff gate, as error
+    strings (empty == clean). Run by `python -m ray_tpu._private.lint`."""
+    errors: list[str] = []
+    src = os.path.join(repo_root, "src")
+    for dirpath, dirnames, filenames in os.walk(src):
+        dirnames[:] = [d for d in dirnames if not d.startswith("build")]
+        for fn in sorted(filenames):
+            if not fn.endswith((".h", ".cc")):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            if FENCE_BEGIN in text or _STAMP_PREFIX in text:
+                errors.extend(_fence_errors(path, text))
+    contract_path = os.path.join(repo_root, "docs", "wire_contract.json")
+    header = os.path.join(repo_root, "src", "generated", "contract_gen.h")
+    if os.path.exists(contract_path):
+        contract = load_contract(contract_path)
+        reg_errors = cross_check(contract)
+        errors.extend(reg_errors)
+        if not reg_errors:
+            fresh = generate(contract)
+            try:
+                with open(header, encoding="utf-8") as f:
+                    checked_in = f.read()
+            except OSError:
+                checked_in = ""
+            if fresh != checked_in:
+                rel = os.path.relpath(header, repo_root)
+                errors.append(
+                    f"{rel}:1:0: G1 [graftgen] generated header is stale "
+                    "against docs/wire_contract.json — run `make gen`")
+    return errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    check_only = "--check" in argv
+    contract = load_contract()
+    errors = cross_check(contract)
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        print("graftgen: REGISTRY PARITY FAILURE — refusing to generate "
+              "from a contract that disagrees with the live replay "
+              "registries", file=sys.stderr)
+        return 2
+    text = generate(contract)
+    if check_only:
+        problems = lint_generated()
+        for p in problems:
+            print(p, file=sys.stderr)
+        if problems:
+            print("graftgen: FAIL (stale or hand-edited generated code)",
+                  file=sys.stderr)
+            return 3
+        print(f"graftgen: OK ({len(contract['methods'])} methods, "
+              f"{GENERATED_HEADER} is fresh)", file=sys.stderr)
+        return 0
+    os.makedirs(GENERATED_DIR, exist_ok=True)
+    with open(GENERATED_HEADER, "w", encoding="utf-8") as f:
+        f.write(text)
+    print(f"graftgen: {len(contract['methods'])} methods -> "
+          f"{GENERATED_HEADER}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

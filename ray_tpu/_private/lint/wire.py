@@ -1096,6 +1096,21 @@ def contract_markdown(contract: dict) -> str:
         "about (escaped name, helper-built dict), not that the method "
         "has no fields.",
         "",
+        "**Generated artifacts.** This contract is the single source "
+        "for the native control plane: `make gen` (graftgen,",
+        "`python -m ray_tpu._private.lint.gen`) compiles "
+        "`wire_contract.json` into `src/generated/contract_gen.h` — "
+        "per-method",
+        "required-field validators, the method dispatch table, and the "
+        "native SessionManager replay classes consumed by",
+        "`src/gcs_actor.cc` and `src/raylet_lease.cc`. The header is "
+        "checked in and gated the same way as this file:",
+        "`make gen` refuses to run when the contract disagrees with "
+        "the live `SESSION_EXEMPT_METHODS` / `REPLAY_IDEMPOTENT` /",
+        "GCS `_MUTATING` registries, tier-1 regenerates and diffs it, "
+        "and graftlint rejects hand-edits inside the",
+        "`// graftgen: generated` fences.",
+        "",
         "| Method | Handlers | Callers | Required fields | "
         "Request fields | Reply fields | Replay | Mutating |",
         "|---|---|---|---|---|---|---|---|",

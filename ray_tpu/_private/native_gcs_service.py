@@ -104,10 +104,16 @@ class GcsNativeService:
         if not self._h:
             raise OSError("gsvc_create failed")
 
+    def frame_addr(self) -> int:
+        return _addr(self._lib.gsvc_on_frame)
+
+    def close_addr(self) -> int:
+        return _addr(self._lib.gsvc_on_close)
+
     def install(self) -> None:
         """Point the pump's in-loop hook at this service (pre-listen)."""
-        self._pump.set_service(_addr(self._lib.gsvc_on_frame),
-                               _addr(self._lib.gsvc_on_close), self._h)
+        self._pump.set_service(self.frame_addr(), self.close_addr(),
+                               self._h)
 
     def close(self) -> None:
         if self._h:

@@ -1,0 +1,191 @@
+"""ctypes binding for the native raylet lease plane (src/raylet_lease.cc).
+
+RequestWorkerLease grants and ReturnWorker releases for the simple hot
+shape (no strategy, no placement group, node not draining, FIFO gate
+open, idle worker pooled) execute on the raylet pump's epoll thread,
+booking resources through the SAME raylet_core the Python raylet uses
+so the two grant paths can never double-book.  Worker identity is
+arbitrated by the plane's idle-worker mirror: Python pushes idle
+workers in (push) and must claim() before assigning one itself.
+
+Everything else — queueing, spillback, worker spawn, placement groups —
+falls through per-method to the Python handlers (counted).  Gated by
+RAY_TPU_NATIVE_CONTROL=1.
+
+Sim mode turns the plane into a native CreateActor responder with full
+(sid, rseq) reply-cache semantics — the mock raylet for
+`bench.py --actor-churn` and the Python<->native differential replay
+test.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from ray_tpu._private.native_build import ensure_built
+
+_lib = None
+_lib_lock = threading.Lock()
+
+EV_LEASE_GRANTED = "lease_granted"
+EV_WORKER_RETURNED = "worker_returned"
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = ensure_built(
+            "raylet_lease.cc", "libtpurlease.so",
+            dep_names=("msgpack_lite.h", "generated/contract_gen.h"))
+        lib = ctypes.CDLL(path)
+        lib.rlease_create.restype = ctypes.c_void_p
+        lib.rlease_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
+        lib.rlease_destroy.argtypes = [ctypes.c_void_p]
+        lib.rlease_chain.argtypes = [ctypes.c_void_p] * 4
+        lib.rlease_set_node.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rlease_set_gate.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rlease_set_draining.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rlease_set_sim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rlease_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int64]
+        lib.rlease_claim.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rlease_claim.restype = ctypes.c_int
+        lib.rlease_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rlease_idle_count.argtypes = [ctypes.c_void_p]
+        lib.rlease_idle_count.restype = ctypes.c_int64
+        lib.rlease_session_count.argtypes = [ctypes.c_void_p]
+        lib.rlease_session_count.restype = ctypes.c_int64
+        lib.rlease_counters.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint64),
+                                        ctypes.POINTER(ctypes.c_uint64),
+                                        ctypes.POINTER(ctypes.c_uint64)]
+        lib.rlease_proto_errors.argtypes = [ctypes.c_void_p]
+        lib.rlease_proto_errors.restype = ctypes.c_uint64
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    if os.environ.get("RAY_TPU_NATIVE_CONTROL", "0") not in (
+            "1", "true", "yes"):
+        return False
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def _addr(fn) -> int:
+    return ctypes.cast(fn, ctypes.c_void_p).value
+
+
+class RayletLeasePlane:
+    """Owns one native lease-plane instance for a raylet pump."""
+
+    def __init__(self, pump, inject_token: int, rcore=None):
+        """pump: native_fastpath.FastPump (pre-listen). inject_token:
+        token carried by this plane's EV_INJECT events. rcore: a
+        native_raylet_core.RayletCore whose try_acquire/release entry
+        points book the resources (None => sim/bench mode, grants are
+        resource-unchecked)."""
+        lib = _load()
+        self._lib = lib
+        self._pump = pump
+        from ray_tpu._private import native_fastpath
+
+        fplib = native_fastpath._load()
+        if rcore is not None:
+            acquire_addr = _addr(rcore._lib.rcore_try_acquire)
+            release_addr = _addr(rcore._lib.rcore_release)
+            rcore_h = rcore._h
+        else:
+            acquire_addr = release_addr = rcore_h = None
+        self._h = ctypes.c_void_p(lib.rlease_create(
+            _addr(fplib.fpump_send), _addr(fplib.fpump_inject),
+            pump._h, inject_token, acquire_addr, release_addr, rcore_h))
+        if not self._h:
+            raise OSError("rlease_create failed")
+
+    def frame_addr(self) -> int:
+        return _addr(self._lib.rlease_on_frame)
+
+    def close_addr(self) -> int:
+        return _addr(self._lib.rlease_on_close)
+
+    def handle(self):
+        return self._h
+
+    def chain(self, next_frame_addr, next_close_addr, next_ctx) -> None:
+        self._lib.rlease_chain(self._h, next_frame_addr,
+                               next_close_addr, next_ctx)
+
+    def install(self) -> None:
+        self._pump.set_service(self.frame_addr(), self.close_addr(),
+                               self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rlease_destroy(self._h)
+            self._h = None
+
+    def set_node(self, node_id: str) -> None:
+        if self._h:
+            self._lib.rlease_set_node(self._h, node_id.encode())
+
+    def set_gate(self, open_: bool) -> None:
+        if self._h:
+            self._lib.rlease_set_gate(self._h, 1 if open_ else 0)
+
+    def set_draining(self, draining: bool) -> None:
+        if self._h:
+            self._lib.rlease_set_draining(self._h, 1 if draining else 0)
+
+    def set_sim(self, sim: bool) -> None:
+        if self._h:
+            self._lib.rlease_set_sim(self._h, 1 if sim else 0)
+
+    def push(self, worker_id: str, host: str, port: int,
+             fp_port: int) -> None:
+        if self._h:
+            self._lib.rlease_push(self._h, worker_id.encode(),
+                                  host.encode(), port, fp_port)
+
+    def claim(self, worker_id: str) -> bool:
+        """True = worker was pooled here and is now the caller's."""
+        if not self._h:
+            return True
+        return bool(self._lib.rlease_claim(self._h, worker_id.encode()))
+
+    def remove(self, worker_id: str) -> None:
+        if self._h:
+            self._lib.rlease_remove(self._h, worker_id.encode())
+
+    def idle_count(self) -> int:
+        return self._lib.rlease_idle_count(self._h) if self._h else 0
+
+    def session_count(self) -> int:
+        return self._lib.rlease_session_count(self._h) if self._h else 0
+
+    def proto_errors(self) -> int:
+        return self._lib.rlease_proto_errors(self._h) if self._h else 0
+
+    def counters(self) -> tuple[int, int, int]:
+        """(frames handled natively, fallthroughs to Python, deduped)."""
+        if not self._h:
+            return 0, 0, 0
+        handled = ctypes.c_uint64()
+        fallthrough = ctypes.c_uint64()
+        deduped = ctypes.c_uint64()
+        self._lib.rlease_counters(self._h, ctypes.byref(handled),
+                                  ctypes.byref(fallthrough),
+                                  ctypes.byref(deduped))
+        return handled.value, fallthrough.value, deduped.value

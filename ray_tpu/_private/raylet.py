@@ -43,6 +43,47 @@ from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFullErro
 
 logger = logging.getLogger(__name__)
 
+# EV_INJECT token the native lease plane stamps on its mirror events
+# (arrives in the conn_id slot; see fast_rpc.FastRpcServer.inject_handler).
+_LEASE_PLANE_TOKEN = 2
+
+
+class _GateDeque(deque):
+    """pending_leases with a change hook: the native lease plane's FIFO
+    fairness gate must close the instant anything queues (a fresh request
+    granted natively ahead of the queue would reintroduce the
+    grant/return carousel starvation) and reopen when it drains."""
+
+    def __init__(self, on_change):
+        super().__init__()
+        self._on_change = on_change
+
+    def append(self, item):
+        super().append(item)
+        self._on_change()
+
+    def appendleft(self, item):
+        super().appendleft(item)
+        self._on_change()
+
+    def popleft(self):
+        item = super().popleft()
+        self._on_change()
+        return item
+
+    def pop(self):
+        item = super().pop()
+        self._on_change()
+        return item
+
+    def remove(self, item):
+        super().remove(item)
+        self._on_change()
+
+    def clear(self):
+        super().clear()
+        self._on_change()
+
 
 def _cgroup_memory_fraction() -> float:
     """Usage fraction of the enclosing cgroup limit (v2 then v1), or 0.0
@@ -352,7 +393,7 @@ class Raylet:
         self.workers: dict[str, WorkerHandle] = {}
         self._log_tails: dict[str, Raylet._LogTail] = {}
         self.idle_workers: deque[WorkerHandle] = deque()
-        self.pending_leases: deque = deque()
+        self.pending_leases: deque = _GateDeque(self._sync_lease_gate)
         self.cluster_view: dict = {}
         self.gcs_conn: rpc.Connection | None = None
         # Native-pump server when available (src/fastpath.cc): the
@@ -363,6 +404,17 @@ class Raylet:
 
         self.server = make_server(self._handlers(),
                                   name=f"raylet-{self.node_id[:8]}")
+        # Native lease plane (src/raylet_lease.cc, RAY_TPU_NATIVE_CONTROL=1):
+        # simple-shape RequestWorkerLease grants and native-lease returns
+        # run on the pump thread against the SAME rcore; Python mirrors
+        # bookkeeping off EV_INJECT events and arbitrates worker identity
+        # through the plane's pool (push/claim). Installed by
+        # _native_service_factory at server start.
+        self._lease_plane = None
+        from ray_tpu._private.fast_rpc import FastRpcServer
+
+        if isinstance(self.server, FastRpcServer):
+            self.server.service_factory = self._native_service_factory
         self.host = "127.0.0.1"
         self.port: int | None = None
         self.draining = False
@@ -455,6 +507,109 @@ class Raylet:
             "WorkerStats": self.handle_worker_stats,
             "NodeDeviceObjects": self.handle_node_device_objects,
         }
+
+    # ---------- native lease plane ----------
+
+    def _native_service_factory(self, pump):
+        """Install the native lease plane into the raylet pump (called
+        by FastRpcServer.start between pump creation and listen). Any
+        failure falls back to the Python lease handlers — and the
+        half-constructed plane is destroyed, never left installed."""
+        from ray_tpu._private import native_lease_plane
+
+        if not native_lease_plane.available():
+            return None
+        plane = None
+        try:
+            plane = native_lease_plane.RayletLeasePlane(
+                pump, inject_token=_LEASE_PLANE_TOKEN, rcore=self.rcore)
+            plane.set_node(self.node_id)
+            # install() is the LAST step: a half-wired plane must never
+            # answer frames (close-on-failure below stays safe because
+            # the pump hook was never pointed at it).
+            plane.install()
+            self.server.inject_handler = self._on_native_inject
+            self._lease_plane = plane
+            logger.info("native lease plane active (grant/return in-pump)")
+            return plane
+        except Exception:
+            logger.exception("native lease plane failed to install; "
+                             "Python handles leases")
+            if plane is not None:
+                try:
+                    plane.close()
+                except Exception:
+                    logger.exception("native lease plane close failed")
+            return None
+
+    def _sync_lease_gate(self):
+        plane = getattr(self, "_lease_plane", None)
+        if plane is not None:
+            plane.set_gate(not self.pending_leases)
+
+    def _pool_worker(self, w: WorkerHandle) -> None:
+        """Land a worker in the idle pool — and mirror it into the
+        native plane's grant pool. Every idle_workers entry must exist
+        in the mirror, or the claim arbitration in _take_idle_worker
+        would treat it as natively-granted and skip it forever."""
+        w.idle_since = time.monotonic()
+        self.idle_workers.append(w)
+        if self._lease_plane is not None:
+            self._lease_plane.push(w.worker_id, w.address[0],
+                                   w.address[1], getattr(w, "fp_port", 0))
+
+    def _take_idle_worker(self) -> WorkerHandle | None:
+        """Pop an idle worker Python is allowed to use. claim() is the
+        arbitration point with the pump thread: a worker the native
+        plane already granted fails the claim and is skipped (its
+        lease_granted event is in flight)."""
+        while self.idle_workers:
+            w = self.idle_workers.popleft()
+            if self._lease_plane is not None and \
+                    not self._lease_plane.claim(w.worker_id):
+                continue
+            return w
+        return None
+
+    def _unpool_worker(self, w: WorkerHandle) -> None:
+        if self._lease_plane is not None:
+            self._lease_plane.remove(w.worker_id)
+
+    def _on_native_inject(self, token, body):
+        if token != _LEASE_PLANE_TOKEN:
+            return
+        try:
+            event, payload = rpc.unpack(body)
+        except Exception:
+            logger.exception("native lease plane: bad inject event")
+            return
+        w = self.workers.get(payload.get("worker_id", ""))
+        if event == "lease_granted":
+            self._num_leases_granted += 1
+            if w is not None:
+                try:
+                    self.idle_workers.remove(w)
+                except ValueError:
+                    pass
+                w.leased = True
+                w.leased_at = time.monotonic()
+                w.lease_id = payload["lease_id"]
+                w.lease_resources = {}
+                w.lease_pg = None
+        elif event == "worker_returned":
+            # The plane already released the rcore lease; only the
+            # Python-side worker bookkeeping happens here.
+            if w is not None:
+                w.blocked = False
+                w.leased = False
+                w.lease_id = None
+                w.lease_resources = {}
+                w.lease_pg = None
+                if payload.get("kill"):
+                    self._kill_worker(w)
+                else:
+                    self._pool_worker(w)
+            self._pump_pending_leases()
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self.host, self.port = await self.server.start(host, port)
@@ -592,6 +747,10 @@ class Raylet:
             await zygote.aclose()
         if getattr(self, "transfer_server", None) is not None:
             await asyncio.to_thread(self.transfer_server.stop)
+        # server.stop() joins the pump thread, then destroys the native
+        # lease plane — which must precede rcore.close() below (the
+        # plane books resources through rcore's entry points).
+        self._lease_plane = None
         await self.server.stop()
         if self.gcs_conn:
             await self.gcs_conn.close()
@@ -762,10 +921,16 @@ class Raylet:
             soft = self._idle_soft_limit()
             while len(self.idle_workers) > soft:
                 w = self.idle_workers.popleft()
+                if self._lease_plane is not None and \
+                        not self._lease_plane.claim(w.worker_id):
+                    continue  # native grant in flight: not actually idle
                 self._kill_worker(w)
             for w in list(self.idle_workers):
                 if now - w.idle_since > 60.0 and len(self.idle_workers) > 1:
                     self.idle_workers.remove(w)
+                    if self._lease_plane is not None and \
+                            not self._lease_plane.claim(w.worker_id):
+                        continue
                     self._kill_worker(w)
 
     async def _memory_monitor_loop(self):
@@ -814,6 +979,7 @@ class Raylet:
                       worker_id=w.worker_id, actor_id=w.actor_id)
         w.dead = True
         self.workers.pop(w.worker_id, None)
+        self._unpool_worker(w)
         if w in self.idle_workers:
             self.idle_workers.remove(w)
         if w.leased:
@@ -1053,6 +1219,7 @@ class Raylet:
     def _kill_worker(self, w: WorkerHandle):
         w.dead = True
         self.workers.pop(w.worker_id, None)
+        self._unpool_worker(w)
         try:
             w.proc.kill()
         except Exception:
@@ -1074,15 +1241,16 @@ class Raylet:
             self._on_worker_death(w, "worker connection lost")))
         w.registered.set()
         if not w.leased and w.actor_id is None and not w.reserved:
-            w.idle_since = time.monotonic()
-            self.idle_workers.append(w)
+            self._pool_worker(w)
         self._pump_pending_leases()
         return {"ok": True, "pooled": True, "store_path": self.store_path,
                 "node_id": self.node_id}
 
     async def _get_ready_worker(self) -> WorkerHandle | None:
-        while self.idle_workers:
-            w = self.idle_workers.popleft()
+        while True:
+            w = self._take_idle_worker()
+            if w is None:
+                break
             if not w.dead and w.proc.poll() is None:
                 return w
         w = self._spawn_worker()
@@ -1122,6 +1290,8 @@ class Raylet:
             w.reserved = False
         if w in self.idle_workers:
             self.idle_workers.remove(w)
+            if self._lease_plane is not None:
+                self._lease_plane.claim(w.worker_id)
         return w
 
     # ---------- leases / scheduling ----------
@@ -1596,8 +1766,7 @@ class Raylet:
                 if payload.get("kill"):
                     self._kill_worker(w)
                 else:
-                    w.idle_since = time.monotonic()
-                    self.idle_workers.append(w)
+                    self._pool_worker(w)
                 break
         self._pump_pending_leases()
         return {"ok": True}
@@ -1667,8 +1836,7 @@ class Raylet:
                     for w in self.workers.values():
                         if w.lease_id == lease_id:
                             self._release_lease_resources(w)
-                            w.idle_since = time.monotonic()
-                            self.idle_workers.append(w)
+                            self._pool_worker(w)
                             break
                     else:
                         self.rcore.release(lease_id)
@@ -2196,6 +2364,8 @@ class Raylet:
             return {"ok": True, "draining": True,
                     "already": True, "reason": self.drain_reason}
         self.draining = True
+        if self._lease_plane is not None:
+            self._lease_plane.set_draining(True)
         self.drain_reason = reason
         self.drain_deadline_s = deadline_s
         self._drain_deadline_mono = time.monotonic() + deadline_s
@@ -2479,6 +2649,22 @@ class Raylet:
             # session flaps, replays, server-side dedup hits) — surfaced
             # as ray_tpu_rpc_* gauges in util/metrics.
             "rpc_sessions": rpc.session_stats(),
+            "native_control": self._native_control_stats(),
+        }
+
+    def _native_control_stats(self):
+        if self._lease_plane is None:
+            return None
+        handled, fallthrough, deduped = self._lease_plane.counters()
+        return {
+            "handled_total": handled,
+            # Frames the plane looked at but routed to Python (complex
+            # shapes, closed FIFO gate, empty pool, unknown leases).
+            "native_fallthrough_total": fallthrough,
+            "deduped_requests_total": deduped,
+            "idle_mirror": self._lease_plane.idle_count(),
+            "sessions": self._lease_plane.session_count(),
+            "proto_errors": self._lease_plane.proto_errors(),
         }
 
     async def handle_get_event_loop_stats(self, conn, payload):

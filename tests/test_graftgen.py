@@ -1,0 +1,348 @@
+"""graftgen tier-1 gates (issue 18).
+
+Three layers:
+
+  1. Regenerate-and-diff: `src/generated/contract_gen.h` must be byte-
+     identical to what gen.py emits from docs/wire_contract.json, and
+     emission must be deterministic.  This is the "generated output is
+     checked in" contract — drift fails tier-1, not just `make lint`.
+  2. The G1 gate itself: registry-parity hard errors (contract replay
+     class / mutating flag vs rpc.SESSION_EXEMPT_METHODS /
+     REPLAY_IDEMPOTENT / GCS _MUTATING), hand-edit detection inside the
+     `// graftgen: generated` fences (content-sha256 stamp), and
+     staleness against a modified contract — all exercised on throwaway
+     repo roots so the real tree stays untouched.
+  3. The Python<->native differential replay test: the same stamped
+     (sid, rseq) CreateActor frame is sent, then replayed byte-for-byte,
+     against BOTH the asyncio rpc.RpcServer and the native lease plane
+     in sim mode.  Each server must answer the replay from its reply
+     cache byte-identically to its original response, execute exactly
+     once, and the two servers' response frames must match each other
+     byte-for-byte — the generated SessionManager honoring rpc.py's
+     replay classes exactly is the tentpole's core safety claim.
+"""
+
+import asyncio
+import copy
+import socket
+import struct
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private import rpc
+from ray_tpu._private.lint import gen
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# 1. regenerate-and-diff byte stability
+# ---------------------------------------------------------------------------
+
+
+def test_generated_header_is_byte_fresh():
+    """The checked-in header equals a fresh generation, byte for byte."""
+    contract = gen.load_contract()
+    fresh = gen.generate(contract)
+    with open(gen.GENERATED_HEADER, encoding="utf-8") as f:
+        checked_in = f.read()
+    assert fresh == checked_in, (
+        "src/generated/contract_gen.h is stale against "
+        "docs/wire_contract.json — run `make gen`")
+
+
+def test_generation_is_deterministic():
+    contract = gen.load_contract()
+    assert gen.generate(contract) == gen.generate(gen.load_contract())
+
+
+def test_gen_check_cli():
+    """`python -m ray_tpu._private.lint.gen --check` (the `make gen-check`
+    / `make lint` prerequisite) passes on the committed tree."""
+    res = subprocess.run(
+        [sys.executable, "-m", "ray_tpu._private.lint.gen", "--check"],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "graftgen: OK" in res.stderr
+
+
+def test_generated_header_shape():
+    """Structural spot-checks: fences, stamp, and the tables the native
+    planes compile against."""
+    with open(gen.GENERATED_HEADER, encoding="utf-8") as f:
+        text = f.read()
+    assert gen.FENCE_BEGIN in text and gen.FENCE_END in text
+    assert "// graftgen: content-sha256=" in text
+    contract = gen.load_contract()
+    assert f"kNumMethods = {len(contract['methods'])}" in text
+    # Replay classes straight from the contract.
+    assert '{"KVPut", kReplayExempt' in text
+    assert '{"RegisterActor", kReplayCached, true' in text
+    # Required-field table mirrors common.require_fields call sites.
+    req = contract["methods"]["RegisterActor"]["required_fields"]
+    assert req, "RegisterActor lost its required fields in the contract"
+    for field in req:
+        assert f'"{field}"' in text
+
+
+# ---------------------------------------------------------------------------
+# 2. the G1 gate: registry parity, hand-edit fences, staleness
+# ---------------------------------------------------------------------------
+
+
+def test_cross_check_clean_on_live_tree():
+    assert gen.cross_check(gen.load_contract()) == []
+
+
+def test_cross_check_rejects_replay_class_flip_to_exempt():
+    """A contract claiming a cached method is idempotent-exempt (without
+    the registry agreeing) is a hard gen error — codegen would bake
+    blind-replay into C++ for a non-idempotent method."""
+    bad = copy.deepcopy(gen.load_contract())
+    assert bad["methods"]["RegisterActor"]["replay"] == "cached"
+    bad["methods"]["RegisterActor"]["replay"] = "idempotent-exempt"
+    errors = gen.cross_check(bad)
+    assert any("RegisterActor" in e and "SESSION_EXEMPT_METHODS" in e
+               for e in errors), errors
+
+
+def test_cross_check_rejects_dropped_exemption():
+    bad = copy.deepcopy(gen.load_contract())
+    assert bad["methods"]["KVPut"]["replay"] == "idempotent-exempt"
+    bad["methods"]["KVPut"]["replay"] = "cached"
+    errors = gen.cross_check(bad)
+    assert any("KVPut" in e for e in errors), errors
+
+
+def test_cross_check_rejects_mutating_flip():
+    bad = copy.deepcopy(gen.load_contract())
+    orig = bool(bad["methods"]["RegisterActor"].get("mutating"))
+    bad["methods"]["RegisterActor"]["mutating"] = not orig
+    errors = gen.cross_check(bad)
+    assert any("RegisterActor" in e and "mutating" in e
+               for e in errors), errors
+
+
+def test_cross_check_rejects_unknown_replay_class():
+    bad = copy.deepcopy(gen.load_contract())
+    bad["methods"]["GetActorInfo"]["replay"] = "best-effort"
+    errors = gen.cross_check(bad)
+    assert any("unknown replay class" in e for e in errors), errors
+
+
+def _tmp_tree(tmp_path, header_text, contract=None):
+    """Build a throwaway repo root for lint_generated()."""
+    gen_dir = tmp_path / "src" / "generated"
+    gen_dir.mkdir(parents=True)
+    (gen_dir / "contract_gen.h").write_text(header_text, encoding="utf-8")
+    if contract is not None:
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        import json
+
+        (docs / "wire_contract.json").write_text(
+            json.dumps(contract, indent=2, sort_keys=True) + "\n",
+            encoding="utf-8")
+    return str(tmp_path)
+
+
+def test_fence_hand_edit_is_detected(tmp_path):
+    """One byte edited inside the generated fences breaks the sha256
+    stamp: the graftlint G1 rule that forbids hand-edits."""
+    with open(gen.GENERATED_HEADER, encoding="utf-8") as f:
+        text = f.read()
+    edited = text.replace("kReplayCached = 0", "kReplayCached = 7")
+    assert edited != text
+    errors = gen.lint_generated(_tmp_tree(tmp_path, edited))
+    assert any("edited by hand" in e and "sha256" in e
+               for e in errors), errors
+
+
+def test_missing_stamp_is_detected(tmp_path):
+    with open(gen.GENERATED_HEADER, encoding="utf-8") as f:
+        lines = f.read().splitlines(keepends=True)
+    stripped = "".join(l for l in lines
+                       if not l.startswith("// graftgen: content-sha256="))
+    errors = gen.lint_generated(_tmp_tree(tmp_path, stripped))
+    assert any("missing its content-sha256 stamp" in e
+               for e in errors), errors
+
+
+def test_stale_header_is_detected(tmp_path):
+    """A header generated from YESTERDAY'S contract fails the
+    regenerate-and-diff gate once the contract moves (here: a required
+    field added to RegisterActor) even though the stamp is internally
+    consistent."""
+    old = copy.deepcopy(gen.load_contract())
+    old["methods"]["RegisterActor"]["required_fields"] = list(
+        old["methods"]["RegisterActor"]["required_fields"]) + ["extra"]
+    stale_header = gen.generate(old)
+    # The stamp itself is fine — only the diff against the (unmodified)
+    # contract catches it.
+    root = _tmp_tree(tmp_path, stale_header, contract=gen.load_contract())
+    errors = gen.lint_generated(root)
+    assert not any("edited by hand" in e for e in errors), errors
+    assert any("stale" in e for e in errors), errors
+
+
+def test_clean_tree_lints_clean(tmp_path):
+    with open(gen.GENERATED_HEADER, encoding="utf-8") as f:
+        text = f.read()
+    root = _tmp_tree(tmp_path, text, contract=gen.load_contract())
+    assert gen.lint_generated(root) == []
+
+
+# ---------------------------------------------------------------------------
+# 3. Python <-> native differential replay
+# ---------------------------------------------------------------------------
+
+def _native_available():
+    try:
+        from ray_tpu._private import native_fastpath
+
+        return native_fastpath.available()
+    except Exception:
+        return False
+
+
+def _frame(body: bytes) -> bytes:
+    return struct.pack(">I", len(body)) + body
+
+
+def _create_actor_frame(seq: int, sid: str, rseq: int) -> bytes:
+    """One stamped CreateActor request, bytes fixed across both servers
+    and across the original send and the replay."""
+    payload = {
+        "actor_id": "diff-actor-1",
+        "spec": b"\x01spec-bytes",
+        "_session": sid,
+        "_rseq": rseq,
+        "_acked": 0,
+    }
+    return _frame(rpc.pack([rpc.MSG_REQUEST, seq, "CreateActor", payload]))
+
+
+async def _python_exchange(frames: list[bytes], n_responses: int):
+    """Send raw frames to a live rpc.RpcServer; return the raw response
+    bodies (length prefix stripped) in arrival order."""
+    calls = {"n": 0}
+
+    def create_actor(conn, payload):
+        calls["n"] += 1
+        return {"ok": True}
+
+    server = rpc.RpcServer({"CreateActor": create_actor}, name="diff-py")
+    host, port = await server.start()
+    try:
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            for f in frames:
+                writer.write(f)
+            await writer.drain()
+            out = []
+            for _ in range(n_responses):
+                hdr = await asyncio.wait_for(reader.readexactly(4), 10)
+                (n,) = struct.unpack(">I", hdr)
+                out.append(await asyncio.wait_for(reader.readexactly(n), 10))
+            return out, calls["n"]
+        finally:
+            writer.close()
+    finally:
+        await server.stop()
+
+
+def _native_exchange(frames: list[bytes], n_responses: int):
+    """Same exchange against the native lease plane (sim mode) riding a
+    real FastPump.  The plane emits its own outbound ActorReady REQUEST
+    (seq >= 1<<40) interleaved with responses — filtered out here, as
+    fast_rpc does in production."""
+    from ray_tpu._private import native_fastpath
+    from ray_tpu._private.native_lease_plane import RayletLeasePlane
+
+    pump = native_fastpath.FastPump()
+    plane = RayletLeasePlane(pump, inject_token=3)
+    try:
+        plane.set_sim(True)
+        plane.install()
+        port = pump.listen("127.0.0.1", 0)
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as sk:
+            sk.settimeout(10)
+            for f in frames:
+                sk.sendall(f)
+            out = []
+            while len(out) < n_responses:
+                hdr = b""
+                while len(hdr) < 4:
+                    hdr += sk.recv(4 - len(hdr))
+                (n,) = struct.unpack(">I", hdr)
+                body = b""
+                while len(body) < n:
+                    body += sk.recv(n - len(body))
+                env = rpc.unpack(body)
+                if env[0] == rpc.MSG_REQUEST:
+                    continue  # the plane's own ActorReady ladder step
+                out.append(body)
+        handled, fallthrough, deduped = plane.counters()
+        return out, handled, deduped
+    finally:
+        plane.close()
+        pump.close()
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native fastpath unavailable")
+def test_differential_replay_python_vs_native():
+    """Replay the SAME (sid, rseq) CreateActor frame against both
+    servers: each answers the replay byte-identically from its reply
+    cache, executes once, and the two implementations' response frames
+    are byte-identical to each other."""
+    seq, rseq = 11, 1
+    py_frame = _create_actor_frame(seq, "diff-sess-py", rseq)
+    nat_frame = _create_actor_frame(seq, "diff-sess-nat", rseq)
+
+    py_before = rpc.session_stats()["deduped_requests_total"]
+    py_out, py_calls = run(_python_exchange([py_frame, py_frame], 2))
+    py_deduped = rpc.session_stats()["deduped_requests_total"] - py_before
+
+    nat_out, nat_handled, nat_deduped = _native_exchange(
+        [nat_frame, nat_frame], 2)
+
+    # Within each server: the replay is answered byte-identically.
+    assert py_out[0] == py_out[1]
+    assert nat_out[0] == nat_out[1]
+    # At-most-once on both sides.
+    assert py_calls == 1
+    assert py_deduped == 1
+    assert nat_handled == 1
+    assert nat_deduped == 1
+    # Across servers: identical envelope + result bytes (the sid differs
+    # only inside the REQUEST; responses carry none of it).
+    assert py_out[0] == nat_out[0], (
+        f"python={py_out[0]!r} native={nat_out[0]!r}")
+    env = rpc.unpack(py_out[0])
+    assert env == [rpc.MSG_RESPONSE, seq, "CreateActor", {"ok": True}]
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native fastpath unavailable")
+def test_differential_distinct_rseq_executes_twice():
+    """Control for the replay test: bumping rseq (a genuinely new call
+    from the same session) executes on both sides — the caches key on
+    (sid, rseq), not on the socket or wire seq."""
+    f1 = _create_actor_frame(21, "diff2-py", 1)
+    f2 = _create_actor_frame(22, "diff2-py", 2)
+    py_out, py_calls = run(_python_exchange([f1, f2], 2))
+    assert py_calls == 2
+    assert rpc.unpack(py_out[0])[1] == 21
+    assert rpc.unpack(py_out[1])[1] == 22
+
+    n1 = _create_actor_frame(21, "diff2-nat", 1)
+    n2 = _create_actor_frame(22, "diff2-nat", 2)
+    nat_out, nat_handled, nat_deduped = _native_exchange([n1, n2], 2)
+    assert nat_handled == 2
+    assert nat_deduped == 0
+    assert {rpc.unpack(b)[1] for b in nat_out} == {21, 22}

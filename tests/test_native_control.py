@@ -1,0 +1,323 @@
+"""Native control plane (graftgen, issue 18) e2e tests.
+
+Under RAY_TPU_NATIVE_CONTROL=1 the GCS installs the actor plane
+(src/gcs_actor.cc) and every raylet installs the lease plane
+(src/raylet_lease.cc) into their fastpath pumps: the hot actor-creation
+ladder (RegisterActor -> CreateActor -> ActorReady) and the hot lease
+grant/return execute on the C++ loop threads, while Python stays the
+policy/IO shell — named actors, placement groups, empty worker pools
+and every other complex shape fall through per-method to the Python
+handlers.
+
+These tests drive a REAL GcsServer (pump transport) with real
+rpc.connect_session clients acting as driver and raylet, then the full
+stack through ray_tpu.init, asserting (a) the ladder end-state matches
+the Python path (actor ALIVE, address mirrored), (b) the frames really
+were handled natively (plane counters, stats surface), and (c) the
+fallthrough shapes still work.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu._private.gcs import ACTOR_ALIVE, GcsServer
+
+
+def _native_control_available() -> bool:
+    try:
+        from ray_tpu._private import (native_actor_plane, native_fastpath,
+                                      native_lease_plane)
+
+        if not native_fastpath.available():
+            return False
+        native_actor_plane._load()
+        native_lease_plane._load()
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_control_available(),
+    reason="native control plane unavailable")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _wait_for(predicate, timeout=10.0, what="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        if predicate():
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.02)
+
+
+NODE_ID = "aa" * 16
+
+
+async def _fake_raylet(host, port):
+    """A connect_session client that registers a node and answers the
+    plane's CreateActor ladder: reply ok, then (once the test releases
+    it) call ActorReady — the exact raylet-side protocol."""
+    created = asyncio.Event()
+    create_payloads = []
+    sess_box = {}
+
+    def on_create(conn, payload):
+        create_payloads.append(payload)
+        created.set()
+        return {"ok": True}
+
+    sess = await rpc.connect_session(host, port,
+                                     handlers={"CreateActor": on_create},
+                                     name="fake-raylet")
+    sess_box["sess"] = sess
+    r = await sess.call("RegisterNode", {
+        "host": "127.0.0.1", "node_id": NODE_ID, "raylet_port": 47001,
+        "total_resources": {"CPU": 4.0}})
+    assert r["ok"]
+    return sess, created, create_payloads
+
+
+def test_actor_ladder_native(tmp_path, monkeypatch):
+    """RegisterActor for a simple (nameless) actor runs the native
+    ladder: driver acked from C++, CreateActor reaches the raylet with
+    the spec bytes intact, ActorReady flips the Python mirror to ALIVE
+    — and the Python RegisterActor handler never runs."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+
+    async def main():
+        gcs = GcsServer(persistence_path=str(tmp_path / "gcs_state"))
+        host, port = await gcs.start()
+        try:
+            assert gcs._actor_plane is not None, \
+                "actor plane should install under RAY_TPU_NATIVE_CONTROL=1"
+            raylet, created, create_payloads = await _fake_raylet(host, port)
+
+            driver = await rpc.connect_session(host, port, name="driver")
+            r = await driver.call("RegisterActor", {
+                "actor_id": "nat-a1", "spec": b"\x01spec-bytes",
+                "max_restarts": 0, "class_name": "Counter",
+                "job_id": "job-1"})
+            assert r["ok"]
+
+            await asyncio.wait_for(created.wait(), 10)
+            assert create_payloads[0]["actor_id"] == "nat-a1"
+            assert create_payloads[0]["spec"] == b"\x01spec-bytes"
+
+            # Python mirrored the registration off the inject events.
+            await _wait_for(lambda: "nat-a1" in gcs.actors,
+                            what="actor mirror")
+            assert gcs.actors["nat-a1"]["native"] is True
+
+            # ActorReady completes the ladder natively.
+            await raylet.call("ActorReady", {
+                "actor_id": "nat-a1", "address": ["127.0.0.1", 47002]})
+            await _wait_for(
+                lambda: gcs.actors["nat-a1"]["state"] == ACTOR_ALIVE,
+                what="actor ALIVE")
+            a = gcs.actors["nat-a1"]
+            assert a["node_id"] == NODE_ID
+            assert a["address"] == ["127.0.0.1", 47002]
+
+            # The frames were handled in C++ (RegisterActor + ActorReady
+            # at minimum) and surfaced through GetClusterStatus.
+            handled, fallthrough, deduped = gcs._actor_plane.counters()
+            assert handled >= 2
+            assert gcs._actor_plane.proto_errors() == 0
+            status = await driver.call("GetClusterStatus", {})
+            nc = status["native_control"]
+            assert nc["handled_total"] >= 2
+            assert "native_fallthrough_total" in nc
+            assert nc["actors"] >= 1
+
+            # GetActorInfo (a Python handler) answers from the mirror.
+            info = await driver.call("GetActorInfo",
+                                     {"actor_id": "nat-a1"})
+            assert info["state"] == ACTOR_ALIVE
+
+            await driver.close()
+            await raylet.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_named_actor_falls_through_to_python(tmp_path, monkeypatch):
+    """A NAMED actor is a complex shape the plane does not own: the
+    frame must fall through (counted) and the Python handler must still
+    complete the registration."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+
+    async def main():
+        gcs = GcsServer(persistence_path=str(tmp_path / "gcs_state"))
+        host, port = await gcs.start()
+        try:
+            raylet, created, create_payloads = await _fake_raylet(host, port)
+            driver = await rpc.connect_session(host, port, name="driver")
+
+            _, fb_before, _ = gcs._actor_plane.counters()
+            r = await driver.call("RegisterActor", {
+                "actor_id": "named-b1", "spec": b"\x02spec",
+                "max_restarts": 0, "class_name": "Named",
+                "name": "bob", "namespace": "default", "job_id": "job-1"})
+            assert r["ok"]
+            _, fb_after, _ = gcs._actor_plane.counters()
+            assert fb_after > fb_before, \
+                "named RegisterActor should fall through to Python"
+            # The PYTHON path registered it (no native flag).
+            await _wait_for(lambda: "named-b1" in gcs.actors,
+                            what="python-side registration")
+            assert not gcs.actors["named-b1"].get("native")
+
+            await driver.close()
+            await raylet.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_malformed_register_actor_errors_natively(tmp_path, monkeypatch):
+    """A RegisterActor missing a generated-validator required field
+    ("spec") must come back as a Malformed RpcError from C++ — not
+    crash the plane, not silently pass through."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+
+    async def main():
+        gcs = GcsServer(persistence_path=str(tmp_path / "gcs_state"))
+        host, port = await gcs.start()
+        try:
+            raylet, _, _ = await _fake_raylet(host, port)
+            driver = await rpc.connect_session(host, port, name="driver")
+            with pytest.raises(rpc.RpcError, match="malformed"):
+                await driver.call("RegisterActor", {"actor_id": "no-spec"})
+            assert gcs._actor_plane.proto_errors() == 1
+            # The plane still works afterwards.
+            r = await driver.call("RegisterActor", {
+                "actor_id": "ok-after", "spec": b"\x03s",
+                "max_restarts": 0})
+            assert r["ok"]
+            await driver.close()
+            await raylet.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_replay_dedup_across_session(tmp_path, monkeypatch):
+    """The same (sid, rseq) RegisterActor replayed over a FRESH socket
+    (session rebind, what a reconnect does) must be answered from the
+    native reply cache — at-most-once across rebinds."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+
+    async def main():
+        gcs = GcsServer(persistence_path=str(tmp_path / "gcs_state"))
+        host, port = await gcs.start()
+        try:
+            raylet, created, _ = await _fake_raylet(host, port)
+            driver = await rpc.connect_session(host, port, name="driver")
+            assert (await driver.call("RegisterActor", {
+                "actor_id": "dup-a1", "spec": b"\x04s",
+                "max_restarts": 0}))["ok"]
+            await asyncio.wait_for(created.wait(), 10)
+
+            # Kill the driver's socket; the session layer replays over a
+            # new connection on the next call after reconnecting — but
+            # here we replay the SAME stamped request by hand to pin the
+            # server side: same sid, same rseq, fresh socket.
+            sid = driver.session_id
+            frame = rpc.pack([rpc.MSG_REQUEST, 99, "RegisterActor", {
+                "actor_id": "dup-a1", "spec": b"\x04s", "max_restarts": 0,
+                "_session": sid, "_rseq": 1, "_acked": 0}])
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(len(frame).to_bytes(4, "big") + frame)
+            await writer.drain()
+            hdr = await asyncio.wait_for(reader.readexactly(4), 10)
+            resp = rpc.unpack(await asyncio.wait_for(
+                reader.readexactly(int.from_bytes(hdr, "big")), 10))
+            assert resp[0] == rpc.MSG_RESPONSE and resp[3]["ok"]
+            writer.close()
+
+            handled, _, deduped = gcs._actor_plane.counters()
+            assert deduped >= 1, "replay must hit the native reply cache"
+            # Exactly one CreateActor ever reached the raylet.
+            await asyncio.sleep(0.2)
+            assert gcs._actor_plane.actor_count() == 1
+
+            await driver.close()
+            await raylet.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_full_stack_native_control(monkeypatch):
+    """ray_tpu.init under RAY_TPU_NATIVE_CONTROL=1: tasks and actors
+    (plain + named) behave exactly as under the Python control plane,
+    and both daemons report an installed plane that saw the traffic."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+    from ray_tpu._private.config import Config
+
+    cfg = Config()
+    cfg.health_check_period_s = 0.2
+    cfg.num_heartbeats_timeout = 5
+    cfg.worker_lease_timeout_s = 10.0
+    cfg.object_store_memory = 64 * 1024 * 1024
+    ray_tpu.init(num_cpus=2, config=cfg)
+    try:
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        assert ray_tpu.get([double.remote(i) for i in range(8)]) == \
+            [i * 2 for i in range(8)]
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.inc.remote()) == 1
+        assert ray_tpu.get(c.inc.remote()) == 2
+
+        named = Counter.options(name="nc-named").remote()
+        assert ray_tpu.get(named.inc.remote()) == 1
+
+        # More plain tasks after workers exist: the idle-worker pool is
+        # populated, so the lease plane gets grantable shapes.
+        assert ray_tpu.get([double.remote(i) for i in range(8)]) == \
+            [i * 2 for i in range(8)]
+
+        cw = ray_tpu._private.api_internal.get_core_worker()
+        status = cw._run(cw.gcs.call("GetClusterStatus", {}))
+        nc = status["native_control"]
+        assert nc is not None, "GCS actor plane not installed"
+        # Two RegisterActors flowed through the plane's frame hook —
+        # handled natively or routed, never invisible.
+        assert nc["handled_total"] + nc["native_fallthrough_total"] >= 2
+        assert nc["proto_errors"] == 0
+
+        state = cw._run(cw.raylet.call("GetState", {}))
+        rnc = state["native_control"]
+        assert rnc is not None, "raylet lease plane not installed"
+        assert rnc["handled_total"] + rnc["native_fallthrough_total"] >= 1
+        assert rnc["proto_errors"] == 0
+    finally:
+        ray_tpu.shutdown()

@@ -38,6 +38,11 @@ def test_cpp_unit_tests():
     assert "raylet_core_test: all passed" in res.stdout
     assert "gcs_store_test: all passed" in res.stdout
     assert "gcs_service_test: all OK" in res.stdout
+    # Native control plane (graftgen, issue 18): the actor-creation
+    # ladder and the lease grant/return state machines, including the
+    # per-validator malformed-frame fuzz over contractgen::kMethods.
+    assert "gcs_actor_test: all OK" in res.stdout
+    assert "raylet_lease_test: all OK" in res.stdout
 
 
 @pytest.mark.slow
