@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: lint contract test native gen gen-check
+.PHONY: lint contract test native gen gen-check soak-smoke
 
 # graftlint + graftwire gate: per-file rules R1-R6 and the whole-program
 # wire pass W1-W5 over the whole package, plus the graftgen G1 pass
@@ -30,3 +30,13 @@ test:
 # Native (C++) unit tests; see src/Makefile for sanitizer knobs.
 native:
 	$(MAKE) -C src test
+
+# Tier-1-safe short control-plane chaos soak (ISSUE 19): NetChaos flaps
+# + a node preemption against the default-on native control plane, at
+# smoke scale (<60s, CPU). The full-scale soak is
+# `python bench.py --control-soak` with the default env.
+soak-smoke:
+	JAX_PLATFORMS=cpu RAY_TPU_JAX_PLATFORM=cpu RAY_TPU_BENCH_CHILD=1 \
+	RAY_TPU_SOAK_N=40 RAY_TPU_SOAK_TASK_S=0.5 RAY_TPU_SOAK_FLAPS=1 \
+	RAY_TPU_SOAK_FLOOR=2000 RAY_TPU_BENCH_SOAK_ARTIFACT=0 \
+	$(PYTHON) bench.py --control-soak

@@ -183,6 +183,7 @@ def _replay_live_capture() -> int | None:
 _DEVICE_HANDOFF_MODE = "--device-handoff" in sys.argv[1:]
 _SERVE_DISAGG_MODE = "--serve-disagg" in sys.argv[1:]
 _ACTOR_CHURN_MODE = "--actor-churn" in sys.argv[1:]
+_CONTROL_SOAK_MODE = "--control-soak" in sys.argv[1:]
 
 if os.environ.get("RAY_TPU_BENCH_CHILD") == "1":
     import jax  # hermetic CPU child: axon site already stripped
@@ -192,7 +193,7 @@ else:
     # Training-capture replay only applies to the MFU bench; a handoff
     # or serve run must produce its own (cpu-backend) capture instead.
     rc = None if (_DEVICE_HANDOFF_MODE or _SERVE_DISAGG_MODE
-                  or _ACTOR_CHURN_MODE) \
+                  or _ACTOR_CHURN_MODE or _CONTROL_SOAK_MODE) \
         else _replay_live_capture()
     if rc is not None:
         sys.exit(rc)
@@ -882,6 +883,409 @@ def actor_churn_main():
     return 0 if error is None else 1
 
 
+def control_soak_main():
+    """Control-plane chaos soak (ISSUE 19 tentpole): certify the
+    default-on native control plane under the faults it now owns.
+
+    A real GcsServer (native actor plane installed) serves two fake
+    raylets; node2's link runs through a seeded NetChaos proxy. The
+    soak drives two waves of actor churn:
+
+      Wave 1 (flap leg)    — NetChaos flaps node2's link while actors
+                             churn: in-flight creates park on SUSPECT,
+                             replay after re-registration, and the
+                             raylet reply caches dedup — no forks.
+      Wave 2 (preempt leg) — node2 is preempted mid-wave (NodePreempter
+                             kill path: raylet gone, then the death
+                             certificate via NotifyNodeDead) while a
+                             native lease plane sustains pipelined
+                             grant/return cycles; every orphaned
+                             creation fails over to the survivor.
+
+    Hard assertions (non-zero exit on any violation):
+      * every churned actor ends ALIVE (zero lost),
+      * per-actor executions <= 1 + restarts (zero forked/duplicated),
+      * node2 recorded >= 1 suspect recovery (the flaps really bit),
+      * grant/return cycles/s >= floor (RAY_TPU_SOAK_FLOOR, def 10000),
+      * zero proto errors, zero divergence-breaker trips.
+
+    Emits ONE health-stamped JSON line; writes BENCH_CONTROL_SOAK.json
+    unless RAY_TPU_BENCH_SOAK_ARTIFACT=0 (smoke runs).
+    """
+    import asyncio
+    import socket
+    import tempfile
+    import threading
+
+    os.environ["RAY_TPU_NATIVE_CONTROL"] = "1"
+    from ray_tpu._private import native_fastpath, rpc
+    from ray_tpu._private.bench_health import make_stamp
+    from ray_tpu._private.native_lease_plane import RayletLeasePlane
+    from ray_tpu._private.native_raylet_core import RayletResourceCore
+    from ray_tpu.test_utils import NetChaos
+
+    if not native_fastpath.available():
+        print(json.dumps({
+            "metric": "control_soak_cycles_per_s", "value": 0.0,
+            "unit": "cycles/s", "vs_baseline": 0.0,
+            "extra": {"error": "native fastpath unavailable"}}))
+        return 0
+
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.gcs import ACTOR_ALIVE, GcsServer
+
+    n_wave = int(os.environ.get("RAY_TPU_SOAK_N", "400"))
+    task_secs = float(os.environ.get("RAY_TPU_SOAK_TASK_S", "2.0"))
+    n_flaps = int(os.environ.get("RAY_TPU_SOAK_FLAPS", "3"))
+    floor = float(os.environ.get("RAY_TPU_SOAK_FLOOR", "10000"))
+    probe_before = _health_probe()
+
+    def req(seq, method, payload):
+        body = rpc.pack([rpc.MSG_REQUEST, seq, method, payload])
+        return len(body).to_bytes(4, "big") + body
+
+    def read_frame(f):
+        hdr = f.read(4)
+        if len(hdr) != 4:
+            raise RuntimeError("soak: connection closed mid-frame")
+        body = f.read(int.from_bytes(hdr, "big"))
+        env = rpc.unpack(body)
+        if env[0] == rpc.MSG_ERROR:
+            raise RuntimeError(f"soak: server error: {env[3]!r}")
+        return env
+
+    def churn(host, port, sid, prefix, n, window=64):
+        """Pipelined stamped RegisterActor stream (max_restarts=1: one
+        failover budget per actor for the preemption leg)."""
+        sk = socket.create_connection((host, port), timeout=30)
+        try:
+            sk.settimeout(60)
+            f = sk.makefile("rb")
+            next_send, acked = 0, 0
+            while acked < n:
+                while next_send < n and next_send - acked < window:
+                    i = next_send
+                    sk.sendall(req(i + 1, "RegisterActor", {
+                        "actor_id": f"{prefix}{i}", "spec": b"s",
+                        "max_restarts": 1, "_session": sid,
+                        "_rseq": i + 1, "_acked": 0}))
+                    next_send += 1
+                env = read_frame(f)
+                assert env[3].get("ok"), env
+                acked += 1
+            return acked
+        finally:
+            sk.close()
+
+    def rpc_once(host, port, method, payload):
+        sk = socket.create_connection((host, port), timeout=30)
+        try:
+            p = dict(payload)
+            p.update({"_session": f"soak-{method}", "_rseq": 1,
+                      "_acked": 0})
+            sk.sendall(req(1, method, p))
+            sk.settimeout(30)
+            return read_frame(sk.makefile("rb"))[3]
+        finally:
+            sk.close()
+
+    # ---- GCS on a background loop; heartbeat policing effectively off
+    # so every fault in this soak is explicitly injected ----
+    cfg = Config()
+    cfg.num_heartbeats_timeout = 10**6
+    loop = asyncio.new_event_loop()
+    loop_thread = threading.Thread(target=loop.run_forever, daemon=True)
+    loop_thread.start()
+    gcs = GcsServer(config=cfg, persistence_path=os.path.join(
+        tempfile.mkdtemp(prefix="bench-soak-"), "gcs_state"))
+    host, port = asyncio.run_coroutine_threadsafe(
+        gcs.start(), loop).result(timeout=60)
+    assert gcs._actor_plane is not None, \
+        "actor plane must install for the control soak"
+
+    chaos = NetChaos(seed=19).start()
+    n1, n2 = "f1" * 16, "f2" * 16
+    execs = {}  # actor_id -> real CreateActor executions (both nodes)
+    boxes = {}  # node_id -> {"sess": session, "dead": bool}
+
+    async def fake_raylet(rhost, rport, node_id):
+        """connect_session raylet: counts CreateActor executions and
+        auto-ActorReadys, re-registers on every rebind (the real
+        raylet's _gcs_handshake)."""
+        box = {"sess": None, "dead": False}
+        reg = {"host": "127.0.0.1", "node_id": node_id,
+               "raylet_port": 47001,
+               "total_resources": {"CPU": 100000.0}}
+
+        def on_create(conn, payload):
+            aid = payload["actor_id"]
+            execs[aid] = execs.get(aid, 0) + 1
+
+            async def ready():
+                try:
+                    await box["sess"].call("ActorReady", {
+                        "actor_id": aid,
+                        "address": ["127.0.0.1", 47002]})
+                except Exception:
+                    pass  # session died (kill leg): failover re-drives
+            if not box["dead"]:
+                asyncio.get_running_loop().create_task(ready())
+            return {"ok": True}
+
+        async def handshake(conn):
+            await conn.call("RegisterNode", reg, timeout=10)
+
+        sess = await rpc.connect_session(
+            rhost, rport, handlers={"CreateActor": on_create},
+            name=f"soak-raylet-{node_id[:2]}", on_reconnect=handshake)
+        box["sess"] = sess
+        r = await sess.call("RegisterNode", reg)
+        assert r["ok"]
+        boxes[node_id] = box
+
+    phost, pport = chaos.link("n2", host, port)
+    asyncio.run_coroutine_threadsafe(
+        fake_raylet(host, port, n1), loop).result(30)
+    asyncio.run_coroutine_threadsafe(
+        fake_raylet(phost, pport, n2), loop).result(30)
+
+    error = None
+    cycles_per_s = 0.0
+    alive = lost = forked = 0
+    suspect_recoveries = flaps_done = 0
+    handled = fallthrough = deduped = 0
+    stale_epoch = proto = degraded = trips = 0
+    lsk = plane = lpump = rcore = None
+    all_ids = [f"s1-{i}" for i in range(n_wave)] + \
+              [f"s2-{i}" for i in range(n_wave)]
+    try:
+        # ---- wave 1: churn while NetChaos flaps node2's link ----
+        chaos_err = []
+
+        def flapper():
+            nonlocal flaps_done
+            try:
+                for _ in range(n_flaps):
+                    time.sleep(0.15)
+                    chaos.flap("n2", 0.35)
+                    flaps_done += 1
+                    time.sleep(0.25)
+            except Exception as e:
+                chaos_err.append(e)
+
+        flap_thread = threading.Thread(target=flapper, daemon=True)
+        flap_thread.start()
+        churn(host, port, "soak-w1", "s1-", n_wave)
+        flap_thread.join(timeout=120)
+        if chaos_err:
+            raise chaos_err[0]
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if all(gcs.actors.get(a, {}).get("state") == ACTOR_ALIVE
+                   for a in all_ids[:n_wave]):
+                break
+            time.sleep(0.05)
+        # The flaps must have bitten: SUSPECT promotion on conn loss,
+        # recovery on re-registration.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            suspect_recoveries = gcs.nodes[n2].suspect_recoveries
+            if suspect_recoveries >= 1:
+                break
+            time.sleep(0.05)
+
+        # ---- wave 2: preempt node2 mid-churn while a native lease
+        # plane sustains pipelined grant/return cycles ----
+        kill_err = []
+
+        def preempt_n2():
+            try:
+                # NodePreempter's kill path: the raylet process goes
+                # away first, then the death certificate lands.
+                box = boxes[n2]
+                box["dead"] = True
+                asyncio.run_coroutine_threadsafe(
+                    box["sess"].close(), loop).result(15)
+                rpc_once(host, port, "NotifyNodeDead",
+                         {"node_id": n2, "reason": "soak preemption"})
+            except Exception as e:
+                kill_err.append(e)
+
+        churn_err = []
+
+        def churn2():
+            try:
+                churn(host, port, "soak-w2", "s2-", n_wave)
+            except Exception as e:
+                churn_err.append(e)
+
+        churn_thread = threading.Thread(target=churn2, daemon=True)
+        churn_thread.start()
+        killer = threading.Timer(0.2, preempt_n2)
+        killer.start()
+
+        lpump = native_fastpath.FastPump()
+        rcore = RayletResourceCore({"CPU": 64.0})
+        plane = RayletLeasePlane(lpump, inject_token=7, rcore=rcore)
+        plane.set_node("soaklease" + "0" * 23)
+        plane.set_gate(True)
+        plane.install()
+        lport = lpump.listen("127.0.0.1", 0)
+        workers = {f"w{i}": ("127.0.0.1", 21000 + i, 22000 + i)
+                   for i in range(48)}
+        for wid, waddr in workers.items():
+            plane.push(wid, *waddr)
+        lsk = socket.create_connection(("127.0.0.1", lport), timeout=30)
+        lsk.settimeout(30)
+        lf = lsk.makefile("rb")
+        lease_shape = {"resources": {"CPU": 1.0}, "strategy": None,
+                       "placement_group": "", "pg_bundle_index": -1,
+                       "hops": 0}
+        rseq = [0]
+
+        def lease_req(payload):
+            rseq[0] += 1
+            stamped = dict(payload)
+            stamped.update({"_session": "soak-lease", "_rseq": rseq[0],
+                            "_acked": 0})
+            return req(rseq[0], "RequestWorkerLease"
+                       if "resources" in payload else "ReturnWorker",
+                       stamped)
+
+        batch = 32
+        cycles = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < task_secs:
+            grants = []
+            for _ in range(batch):
+                lsk.sendall(lease_req(lease_shape))
+            for _ in range(batch):
+                g = read_frame(lf)[3]
+                assert g.get("granted"), g
+                grants.append((g["lease_id"], g["worker_id"]))
+            for lease_id, _ in grants:
+                lsk.sendall(lease_req({"lease_id": lease_id,
+                                       "kill": False}))
+            for _ in range(batch):
+                read_frame(lf)
+            for _, wid in grants:
+                plane.push(wid, *workers[wid])
+            cycles += batch
+        cycles_per_s = cycles / (time.perf_counter() - t0)
+
+        churn_thread.join(timeout=120)
+        killer.join(timeout=60)
+        if churn_err:
+            raise churn_err[0]
+        if kill_err:
+            raise kill_err[0]
+
+        # ---- settle: every actor from both waves must end ALIVE ----
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            alive = sum(
+                1 for a in all_ids
+                if gcs.actors.get(a, {}).get("state") == ACTOR_ALIVE)
+            if alive == len(all_ids):
+                break
+            time.sleep(0.05)
+
+        lost = len(all_ids) - alive
+        forked = sum(
+            1 for a in all_ids
+            if execs.get(a, 0) >
+            1 + gcs.actors.get(a, {}).get("restarts", 0))
+        handled, fallthrough, deduped = gcs._actor_plane.counters()
+        stale_epoch = gcs._actor_plane.stale_epoch_total()
+        proto = gcs._actor_plane.proto_errors()
+        degraded = gcs._actor_plane.degraded_total()
+        trips = gcs._native_divergence_trips
+        assert plane.proto_errors() == 0
+
+        violations = []
+        if lost:
+            violations.append(f"{lost} actor(s) not ALIVE (lost)")
+        if forked:
+            violations.append(f"{forked} actor(s) forked/duplicated")
+        if suspect_recoveries < 1:
+            violations.append("no suspect recovery recorded")
+        if cycles_per_s < floor:
+            violations.append(
+                f"cycles/s {cycles_per_s:.0f} under floor {floor:.0f}")
+        if proto:
+            violations.append(f"{proto} proto error(s)")
+        if trips or gcs._native_degraded_reason:
+            violations.append("divergence breaker tripped: "
+                              + gcs._native_degraded_reason)
+        if violations:
+            raise AssertionError("; ".join(violations))
+    except Exception as e:
+        error = f"{type(e).__name__}: {e}"
+    finally:
+        for closer in (lambda: lsk.close(), lambda: plane.close(),
+                       lambda: lpump.close(), lambda: rcore.close()):
+            try:
+                closer()
+            except Exception:
+                pass
+        for box in boxes.values():
+            try:
+                if box.get("sess") is not None:
+                    asyncio.run_coroutine_threadsafe(
+                        box["sess"].close(), loop).result(10)
+            except Exception:
+                pass
+        try:
+            asyncio.run_coroutine_threadsafe(gcs.stop(), loop).result(30)
+        except Exception:
+            pass
+        loop.call_soon_threadsafe(loop.stop)
+        loop_thread.join(timeout=10)
+        chaos.stop()
+
+    probe_after = _health_probe()
+    health = make_stamp(probe_before, probe_after, jax.default_backend())
+    rec = {
+        "metric": "control_soak_cycles_per_s",
+        "value": round(cycles_per_s, 1),
+        "unit": "cycles/s",
+        # North star: the 10k grant/return cycles/s floor holds while
+        # the control plane rides out flaps and a preemption.
+        "vs_baseline": round(cycles_per_s / floor, 2) if floor else 0.0,
+        "extra": {
+            "health": health,
+            "backend": jax.default_backend(),
+            "actors_churned": len(all_ids),
+            "actors_alive": alive,
+            "lost": lost,
+            "forked": forked,
+            "suspect_recoveries": suspect_recoveries,
+            "flaps": flaps_done,
+            "preempted_node": n2[:8],
+            "cycles_floor": floor,
+            "executions_total": sum(execs.values()),
+            "native_handled_total": handled,
+            "native_fallthrough_total": fallthrough,
+            "deduped_requests_total": deduped,
+            "stale_epoch_rejections_total": stale_epoch,
+            "native_degraded_total": degraded,
+            "divergence_trips_total": trips,
+        }}
+    if error is not None:
+        rec["extra"]["error"] = error
+    print(json.dumps(rec))
+    # Smoke runs set RAY_TPU_BENCH_SOAK_ARTIFACT=0 so they never
+    # clobber a full-scale capture.
+    if error is None and os.environ.get(
+            "RAY_TPU_BENCH_SOAK_ARTIFACT", "1") != "0":
+        with open(os.path.join(_REPO_ROOT, "BENCH_CONTROL_SOAK.json"),
+                  "w") as f:
+            json.dump(rec, f, indent=2)
+            f.write("\n")
+    return 0 if error is None else 1
+
+
 if __name__ == "__main__":
     if _DEVICE_HANDOFF_MODE:
         sys.exit(device_handoff_main())
@@ -889,4 +1293,6 @@ if __name__ == "__main__":
         sys.exit(serve_disagg_main())
     if _ACTOR_CHURN_MODE:
         sys.exit(actor_churn_main())
+    if _CONTROL_SOAK_MODE:
+        sys.exit(control_soak_main())
     main()

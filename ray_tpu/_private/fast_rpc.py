@@ -264,6 +264,7 @@ class FastRpcServer:
         else:
             self.stats.record_handler(method, time.perf_counter() - t0)
             if record is not None:
+                result = rpc._stamp_reply(result)
                 record(MSG_RESPONSE, result)
             if seq is not None:
                 self._send(conn._conn_id,
@@ -284,6 +285,7 @@ class FastRpcServer:
             self.stats.set_queue_depth(max(0, len(self._inflight) - 1))
         self.stats.record_handler(method, time.perf_counter() - t0)
         if record is not None:
+            result = rpc._stamp_reply(result)
             record(MSG_RESPONSE, result)
         if seq is not None:
             self._send(conn._conn_id, [MSG_RESPONSE, seq, method, result])

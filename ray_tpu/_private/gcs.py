@@ -75,6 +75,21 @@ NODE_DEAD = "DEAD"
 
 DRAIN_REASONS = ("preemption", "idle", "manual")
 
+# GCS string rungs → native_policy.NODE_* ints for the actor plane's
+# fault-aware ladder view. DRAINED maps onto the draining rung: both
+# exclude the node from new native placements without killing it.
+_PLANE_NODE_STATES = {
+    NODE_ALIVE: 0,
+    NODE_SUSPECT: 1,
+    NODE_DRAINING: 2,
+    NODE_DRAINED: 2,
+    NODE_DEAD: 3,
+}
+
+
+def _plane_node_state(state: str) -> int:
+    return _PLANE_NODE_STATES.get(state, 1)
+
 # EV_INJECT token the native actor plane stamps on its mirror events
 # (arrives in the conn_id slot — see fast_rpc.FastRpcServer.inject_handler).
 _ACTOR_PLANE_TOKEN = 1
@@ -172,10 +187,21 @@ class GcsServer:
         # EV_INJECT events (_on_native_inject) and keeps every routed
         # shape (named/PG/strategy/resource actors).
         self._actor_plane = None
+        # Divergence breaker bookkeeping (issue 19): once the mirror
+        # audit trips, owned methods degrade to the Python handlers and
+        # stay degraded (re-arming needs an operator restart — the
+        # divergence root cause must be understood, not retried).
+        self._native_degraded_reason = ""
+        self._native_divergence_trips = 0
+        self._audit_proto_seen = 0
+        # Actor ids whose re-kick _load_state deferred to the native
+        # plane's rehydration; re-kicked via Python if install fails.
+        self._native_rekick_deferred: list = []
         self._pending_native_kv: list = []   # (key_hex, blob) restore rows
         self._native_appends_seen = 0
         self._native_walfails_seen = 0
         self._health_task: asyncio.Task | None = None
+        self._aux_tasks: list = []  # audit + restored-node reaper
         self._actor_seq = 0
         self.start_time = time.time()
         # Native C++ scheduling core (src/scheduler.cc). Mirrors the node
@@ -290,11 +316,14 @@ class GcsServer:
         addr = await self._server.start(host, port)
         self._health_task = supervised_task(self._health_check_loop(),
                                             name="gcs-health-loop")
+        if self._actor_plane is not None:
+            self._aux_tasks.append(supervised_task(
+                self._native_audit_loop(), name="gcs-native-audit"))
         if self.persistence_path:
             self._persist_task = supervised_task(self._persist_loop(),
                                                  name="gcs-persist-loop")
-            supervised_task(self._reap_restored_nodes(),
-                            name="gcs-reap-restored")
+            self._aux_tasks.append(supervised_task(
+                self._reap_restored_nodes(), name="gcs-reap-restored"))
         logger.info("GCS listening on %s:%s", *addr)
         return addr
 
@@ -355,6 +384,7 @@ class GcsServer:
         from ray_tpu._private import native_actor_plane
 
         if not native_actor_plane.available():
+            self._rekick_deferred_native_actors()
             return None
         plane = None
         try:
@@ -362,6 +392,32 @@ class GcsServer:
                 pump, inject_token=_ACTOR_PLANE_TOKEN)
             if svc is not None:
                 plane.chain(svc.frame_addr(), svc.close_addr(), svc._h)
+            # Crash rehydration (before install(), so the first frame the
+            # plane answers already sees the replayed world): stamp the
+            # server incarnation epoch — a replayed request from before
+            # the restart carries the old epoch and, with the reply cache
+            # gone, must be rejected as stale rather than wrongly deduped
+            # or silently re-executed — then replay the persisted node
+            # table and every native-owned actor row. Restored nodes are
+            # not up (no conn yet); re-registration re-drives parked
+            # PENDING actors via the plane's node_up path.
+            plane.set_epoch(rpc._server_sessions.epoch)
+            for nid, node in self.nodes.items():
+                plane.restore_node(nid, _plane_node_state(node.state))
+            for aid, a in self.actors.items():
+                if not a.get("native"):
+                    continue
+                if a["state"] == ACTOR_ALIVE:
+                    pstate = "ALIVE"
+                elif a["state"] in (ACTOR_PENDING, ACTOR_RESTARTING):
+                    pstate = "PENDING"
+                else:
+                    continue
+                plane.restore_actor(
+                    aid, pstate, a.get("restarts", 0),
+                    a.get("max_restarts", 0), a.get("node_id") or "",
+                    rpc.pack(a["spec"]))
+            self._native_rekick_deferred = []
             # install() replaces the KV service's pump hook — the plane
             # forwards everything it doesn't own down the chain, so
             # this must be the LAST step (a half-wired plane must never
@@ -380,7 +436,23 @@ class GcsServer:
                     plane.close()
                 except Exception:
                     logger.exception("native actor plane close failed")
+            self._rekick_deferred_native_actors()
             return None
+
+    def _rekick_deferred_native_actors(self) -> None:
+        """_load_state deferred these re-kicks to the plane's
+        rehydration; with no plane, Python's scheduler owns them."""
+        deferred, self._native_rekick_deferred = (
+            self._native_rekick_deferred, [])
+        for actor_id in deferred:
+            a = self.actors.get(actor_id)
+            if a is None or a["state"] not in (ACTOR_PENDING,
+                                               ACTOR_RESTARTING):
+                continue
+            a.pop("native", None)
+            asyncio.get_event_loop().call_later(
+                1.0, lambda aid=actor_id: supervised_task(
+                    self._schedule_actor(aid)))
 
     # ---------- native actor plane mirror ----------
     # The plane decides on the pump thread and narrates every decision
@@ -513,6 +585,9 @@ class GcsServer:
             self._health_task.cancel()
         if getattr(self, "_persist_task", None):
             self._persist_task.cancel()
+        for t in self._aux_tasks:
+            t.cancel()
+        self._aux_tasks = []
         # Server (and its pump loop thread, which may be running native
         # KV write-throughs) must be fully stopped BEFORE the store is
         # flushed and closed.
@@ -765,8 +840,17 @@ class GcsServer:
         self._restored_unregistered = {
             nid for nid, n in self.nodes.items() if not n.alive}
         # Re-kick scheduling that died with the previous process.
+        # Native-owned actors are deferred: the plane's rehydration
+        # (restore_actor + re-drive on node re-registration) replays
+        # them with at-most-once semantics; a Python re-kick here would
+        # race it and fork the creation. If the plane then fails to
+        # install, _rekick_deferred_native_actors hands them back.
+        native_planned = self._native_actor_planned()
         for aid, a in self.actors.items():
             if a["state"] in (ACTOR_PENDING, ACTOR_RESTARTING):
+                if native_planned and a.get("native"):
+                    self._native_rekick_deferred.append(aid)
+                    continue
                 asyncio.get_event_loop().call_later(
                     1.0, lambda aid=aid: supervised_task(
                         self._schedule_actor(aid)))
@@ -875,6 +959,15 @@ class GcsServer:
 
         return native_gcs_service.available()
 
+    def _native_actor_planned(self) -> bool:
+        from ray_tpu._private.fast_rpc import FastRpcServer
+
+        if not isinstance(self._server, FastRpcServer):
+            return False
+        from ray_tpu._private import native_actor_plane
+
+        return native_actor_plane.available()
+
     async def publish(self, channel: str, message):
         if self._native_svc is not None:
             # One ctypes call, N native sends — and no packing at all
@@ -965,6 +1058,10 @@ class GcsServer:
                           node_id=node.node_id)
         self.node_conns[node.node_id] = conn
         self._plane_node_up(node.node_id, conn)
+        if node.state != NODE_ALIVE:
+            # node_up resets the plane's rung to ALIVE; restore the real
+            # one (e.g. a DRAINING node that flapped stays unpickable).
+            self._plane_node_state_notify(node.node_id, node.state)
         self._touch("nodes", node.node_id)
         if self.native_sched is not None:
             self.native_sched.update_node(
@@ -989,6 +1086,16 @@ class GcsServer:
                 self._actor_plane.node_up(node_id, conn._conn_id)
             except Exception:
                 logger.exception("native actor plane node_up failed")
+
+    def _plane_node_state_notify(self, node_id: str, state: str) -> None:
+        """Mirror a death/drain-ladder rung into the native plane so
+        native picks and re-drives honor SUSPECT/DRAINING exclusions."""
+        if self._actor_plane is not None:
+            try:
+                self._actor_plane.node_state(node_id,
+                                             _plane_node_state(state))
+            except Exception:
+                logger.exception("native actor plane node_state failed")
 
     async def _call_node(self, node_id: str, method: str, payload=None, *,
                          timeout: float | None = None,
@@ -1161,6 +1268,7 @@ class GcsServer:
         node.drain_deadline_s = deadline_s
         node.drain_stats.setdefault("started_at", time.time())
         self._touch("nodes", node_id)
+        self._plane_node_state_notify(node_id, NODE_DRAINING)
         # Placement mirror: stop picking the node for new actors/PGs
         # (the data plane keeps treating it as alive — objects are still
         # being pulled off it).
@@ -1175,6 +1283,7 @@ class GcsServer:
             node.state = NODE_ALIVE
             node.drain_reason = ""
             self._touch("nodes", node_id)
+            self._plane_node_state_notify(node_id, NODE_ALIVE)
             if self.native_sched is not None:
                 self.native_sched.update_node(
                     node_id, available=node.available_resources,
@@ -1278,6 +1387,7 @@ class GcsServer:
             return {"ok": False, "error": f"unknown node {node_id[:12]}"}
         self._note_relocations(payload.get("relocations") or {})
         node.state = NODE_DRAINED
+        self._plane_node_state_notify(node_id, NODE_DRAINED)
         stats = dict(payload.get("stats") or {})
         # Merge: migrated_actors is GCS-side accounting, the rest is the
         # raylet's evacuation report.
@@ -1335,6 +1445,9 @@ class GcsServer:
         node.pre_suspect_state = node.state
         node.state = NODE_SUSPECT
         node.suspect_since_s = time.time()
+        # The plane parks (not forks) any in-flight create aimed at a
+        # SUSPECT node: re-driven on reconnection, failed over on DEAD.
+        self._plane_node_state_notify(node_id, NODE_SUSPECT)
         self.node_conns.pop(node_id, None)
         if self.native_sched is not None:
             self.native_sched.update_node(node_id, available={}, alive=False)
@@ -2046,17 +2159,100 @@ class GcsServer:
     def _native_control_stats(self):
         if self._actor_plane is None:
             return None
-        handled, fallthrough, deduped = self._actor_plane.counters()
+        plane = self._actor_plane
+        handled, fallthrough, deduped = plane.counters()
+        methods = {}
+        for m in ("RegisterActor", "ActorReady"):
+            mh, mr, md = plane.method_stats(m)
+            methods[m] = {"handled": mh, "routed": mr, "degraded": md}
         return {
             "handled_total": handled,
             # Frames the plane looked at but routed to Python (complex
             # shapes, transient no-node states, unknown actors).
             "native_fallthrough_total": fallthrough,
             "deduped_requests_total": deduped,
-            "actors": self._actor_plane.actor_count(),
-            "sessions": self._actor_plane.session_count(),
-            "proto_errors": self._actor_plane.proto_errors(),
+            "actors": plane.actor_count(),
+            "sessions": plane.session_count(),
+            "proto_errors": plane.proto_errors(),
+            # Replayed pre-restart frames rejected by the epoch handshake
+            # (clients re-issue; never wrongly deduped against the lost
+            # reply cache).
+            "stale_epoch_rejections_total": plane.stale_epoch_total(),
+            # Frames the divergence breaker pushed back to Python.
+            "native_degraded_total": plane.degraded_total(),
+            "divergence_trips_total": self._native_divergence_trips,
+            "degraded_reason": self._native_degraded_reason,
+            "methods": methods,
         }
+
+    # ---------- native mirror audit (divergence breaker) ----------
+
+    async def _native_audit_loop(self):
+        """Periodically compare the Python mirror with the native
+        plane's tables. Two consecutive mismatched sweeps (in-flight
+        ladders make single-sweep skew normal) or a proto-error burst
+        trips the breaker: the plane's owned methods degrade to the
+        Python handlers (counted native_degraded_total) and stay there —
+        re-arming needs an operator restart, because a real divergence
+        must be understood, not retried."""
+        period = max(1.0, self.config.health_check_period_s)
+        prev_mismatch = ""
+        while True:
+            await asyncio.sleep(period)
+            plane = self._actor_plane
+            if plane is None or self._native_degraded_reason:
+                return
+            try:
+                proto = plane.proto_errors()
+                burst = proto - self._audit_proto_seen >= 10
+                self._audit_proto_seen = proto
+                mismatch = self._native_mirror_mismatch(plane)
+                if burst:
+                    self._trip_native_breaker(
+                        f"proto-error burst ({proto} total)")
+                elif mismatch and prev_mismatch:
+                    self._trip_native_breaker(mismatch)
+                prev_mismatch = mismatch
+            except Exception:
+                logger.exception("native mirror audit sweep failed")
+
+    def _native_mirror_mismatch(self, plane) -> str:
+        """One audit sweep; returns a divergence description or ''."""
+        py_native = {aid: a for aid, a in self.actors.items()
+                     if a.get("native") and a["state"] != ACTOR_DEAD}
+        n_plane = plane.actor_count()
+        if n_plane != len(py_native):
+            return (f"actor-count divergence: plane={n_plane} "
+                    f"mirror={len(py_native)}")
+        for aid, a in py_native.items():
+            pstate = plane.actor_state(aid)
+            if pstate is None:
+                return f"actor {aid[:8]} missing from native plane"
+            # ALIVE in the mirror comes only from the plane's own ready
+            # event, so the plane must agree; PENDING/RESTARTING can
+            # legitimately lag one event behind.
+            if a["state"] == ACTOR_ALIVE and pstate != "ALIVE":
+                return (f"actor {aid[:8]} state divergence: "
+                        f"plane={pstate} mirror=ALIVE")
+        return ""
+
+    def _trip_native_breaker(self, reason: str) -> None:
+        plane = self._actor_plane
+        if plane is None or self._native_degraded_reason:
+            return
+        self._native_degraded_reason = reason
+        self._native_divergence_trips += 1
+        for m in ("RegisterActor", "ActorReady"):
+            try:
+                plane.set_degraded(m, True)
+            except Exception:
+                logger.exception("native breaker trip failed for %s", m)
+        logger.error("native control plane DEGRADED to Python: %s",
+                     reason)
+        from ray_tpu.util import events
+
+        events.record("ERROR", "gcs",
+                      f"native control plane degraded: {reason}")
 
     async def handle_get_event_loop_stats(self, conn, payload):
         """Event-loop/RPC dispatch stats for the GCS pump (analogue of

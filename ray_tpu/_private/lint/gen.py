@@ -52,8 +52,12 @@ FENCE_END = "// graftgen: generated (end)"
 _STAMP_PREFIX = "// graftgen: content-sha256="
 
 # Session stamp keys (rpc._SID_KEY etc.) — the validator must treat them
-# as wire-level metadata, never as application fields.
-_STAMP_KEYS = ("_session", "_rseq", "_acked")
+# as wire-level metadata, never as application fields. "_epoch" is the
+# restart-handshake stamp (issue 19): servers advertise their incarnation
+# epoch in stamped replies, clients echo it on REPLAYED frames only, and
+# a replay whose epoch predates the server's current incarnation is
+# rejected deterministically instead of re-executed against a lost cache.
+_STAMP_KEYS = ("_session", "_rseq", "_acked", "_epoch")
 
 
 # ---------------------------------------------------------------------------
@@ -164,6 +168,114 @@ def cross_check(contract: dict) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# G2: native-handler fallthrough-policy parity (issue 19)
+# ---------------------------------------------------------------------------
+
+# Marker the native planes must carry at every owned-method dispatch
+# branch, e.g. `// graftgen: native-handler RegisterActor`. G2 checks
+# the marker set against the declared breaker/fallthrough policy table
+# (native_policy.NATIVE_FALLTHROUGH_POLICY) in BOTH directions, and that
+# every such method exists in the wire contract — so the degradation
+# breaker can never silently miss (or invent) a natively-handled method.
+_NATIVE_HANDLER_MARK = "// graftgen: native-handler "
+
+
+def _ast_native_policy(repo_root: str) -> dict[str, str] | None:
+    """AST-extract NATIVE_FALLTHROUGH_POLICY without importing the
+    runtime. Returns None when the module does not exist (throwaway
+    test trees)."""
+    path = os.path.join(repo_root, "ray_tpu", "_private", "native_policy.py")
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and getattr(node.targets[0], "id", None) == \
+                "NATIVE_FALLTHROUGH_POLICY" \
+                and isinstance(node.value, ast.Dict):
+            out: dict[str, str] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    try:
+                        out[k.value] = str(ast.literal_eval(v))
+                    except Exception:
+                        out[k.value] = ""
+            return out
+    return {}
+
+
+def _native_handler_markers(repo_root: str) -> dict[str, list[str]]:
+    """method -> [file:line ...] for every native-handler marker in the
+    hand-written plane sources."""
+    out: dict[str, list[str]] = {}
+    src = os.path.join(repo_root, "src")
+    if not os.path.isdir(src):
+        return out
+    for fn in sorted(os.listdir(src)):
+        if not fn.endswith(".cc"):
+            continue
+        path = os.path.join(src, fn)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines, 1):
+            idx = line.find(_NATIVE_HANDLER_MARK)
+            if idx < 0:
+                continue
+            method = line[idx + len(_NATIVE_HANDLER_MARK):].strip()
+            out.setdefault(method, []).append(f"src/{fn}:{i}")
+    return out
+
+
+def native_handler_check(repo_root: str = REPO_ROOT,
+                         contract: dict | None = None) -> list[str]:
+    """The G2 gate: every method a native plane owns (marker in the .cc)
+    must carry a declared fallthrough policy, and vice versa, and both
+    must name real contract methods. Empty list == clean."""
+    markers = _native_handler_markers(repo_root)
+    policy = _ast_native_policy(repo_root)
+    if policy is None:
+        if markers:
+            return ["graftgen: G2 native-handler markers found in src/ "
+                    "but ray_tpu/_private/native_policy.py is missing — "
+                    "declare NATIVE_FALLTHROUGH_POLICY for: "
+                    + ", ".join(sorted(markers))]
+        return []
+    errors: list[str] = []
+    if contract is None:
+        cpath = os.path.join(repo_root, "docs", "wire_contract.json")
+        contract = load_contract(cpath) if os.path.exists(cpath) else {}
+    methods = set(contract.get("methods", {}))
+    for m in sorted(set(markers) - set(policy)):
+        errors.append(
+            f"graftgen: G2 {m!r} has a native handler "
+            f"({', '.join(markers[m])}) but no declared fallthrough "
+            "policy in native_policy.NATIVE_FALLTHROUGH_POLICY — the "
+            "degradation breaker would not know how to fall it back")
+    for m in sorted(set(policy) - set(markers)):
+        errors.append(
+            f"graftgen: G2 native_policy.NATIVE_FALLTHROUGH_POLICY "
+            f"declares {m!r} but no `{_NATIVE_HANDLER_MARK.strip()}` "
+            "marker exists in src/*.cc — stale policy entry")
+    for m, why in sorted(policy.items()):
+        if not why.strip():
+            errors.append(
+                f"graftgen: G2 NATIVE_FALLTHROUGH_POLICY[{m!r}] is empty "
+                "— write down the fallthrough/breaker policy")
+    if methods:
+        for m in sorted(set(markers) | set(policy)):
+            if m not in methods:
+                errors.append(
+                    f"graftgen: G2 native handler/policy names {m!r} "
+                    "which is not a wire-contract method — drift against "
+                    "contract_gen.h")
+    return errors
+
+
+# ---------------------------------------------------------------------------
 # code emission
 # ---------------------------------------------------------------------------
 
@@ -253,8 +365,8 @@ def _emit_body(contract: dict) -> str:
     w("")
     w("// Mirror of common.require_fields over a raw msgpack payload:")
     w("// payload must be a map carrying every required field. Session")
-    w("// stamp keys (_session/_rseq/_acked) are wire metadata, not")
-    w("// application fields. Truncated/garbage payloads fail closed.")
+    w("// stamp keys (_session/_rseq/_acked/_epoch) are wire metadata,")
+    w("// not application fields. Truncated/garbage payloads fail closed.")
     w("// On failure *missing names the first absent field (or the map")
     w("// complaint), for the Malformed error text.")
     w("inline bool ValidateRequired(const MethodInfo& m, mplite::View v,")
@@ -303,9 +415,16 @@ def _emit_body(contract: dict) -> str:
     w("//     STOPS at a pending head (never break at-most-once);")
     w("//   - ack(upto) prunes done entries <= upto;")
     w("//   - sessions idle past ttl are swept at most every 60s.")
-    w("// Plus one native-plane extension with the same lifetime rules:")
-    w("// python-routed marks, so a method instance that fell through to")
-    w("// Python keeps falling through on replay (split-brain guard).")
+    w("// Plus two native-plane extensions with the same lifetime rules:")
+    w("//   - python-routed marks, so a method instance that fell through")
+    w("//     to Python keeps falling through on replay (split-brain guard);")
+    w("//   - an incarnation epoch (issue 19 restart semantics): servers")
+    w("//     advertise `epoch` in stamped replies, clients echo it on")
+    w("//     REPLAYED frames only, and Probe answers kProbeStaleEpoch for")
+    w("//     a replay stamped with a different incarnation's epoch whose")
+    w("//     (sid, rseq) is absent — the cache it would have deduped")
+    w("//     against died with the previous incarnation, so the frame is")
+    w("//     rejected deterministically, never silently re-executed.")
     w("// NOT thread-safe: callers serialize (the planes run it on the")
     w("// pump loop thread only).")
     w("// ---------------------------------------------------------------")
@@ -314,9 +433,10 @@ def _emit_body(contract: dict) -> str:
     w("  using ReplyFn = std::function<void(int kind, const std::string&)>;")
     w("")
     w("  enum ProbeResult {")
-    w("    kProbeMiss = 0,      // no entry: caller may execute natively")
-    w("    kProbeAnswered = 1,  // duplicate: answered (or waiter attached)")
-    w("    kProbeRouted = 2,    // python-routed: caller must fall through")
+    w("    kProbeMiss = 0,        // no entry: caller may execute natively")
+    w("    kProbeAnswered = 1,    // duplicate: answered (or waiter attached)")
+    w("    kProbeRouted = 2,      // python-routed: caller must fall through")
+    w("    kProbeStaleEpoch = 3,  // replay from a dead incarnation: reject")
     w("  };")
     w("")
     w("  explicit SessionManager(uint32_t max_replies = 512,")
@@ -325,15 +445,28 @@ def _emit_body(contract: dict) -> str:
     w("")
     w("  // Consult the cache WITHOUT creating an entry. Touches the")
     w("  // session clock and runs the sweep, exactly like begin().")
+    w("  // frame_epoch is the request's _epoch stamp (0 = unstamped: a")
+    w("  // fresh send, or a legacy client). A nonzero stamp that differs")
+    w("  // from this server's epoch marks a replay whose original send")
+    w("  // targeted a previous incarnation; with no cached entry left to")
+    w("  // dedup against, the ONLY deterministic answer is rejection")
+    w("  // (exempt-class methods are never stamped, so they blind-replay")
+    w("  // through the other arm of the contract, as audited).")
     w("  ProbeResult Probe(const std::string& sid, int64_t rseq,")
-    w("                    const ReplyFn& reply_fn) {")
+    w("                    uint64_t frame_epoch, const ReplyFn& reply_fn) {")
     w("    double now = Now();")
     w("    MaybeSweep(now);")
     w("    Session& sess = sessions_[sid];")
     w("    sess.last_seen = now;")
     w("    if (sess.routed.count(rseq)) return kProbeRouted;")
     w("    auto it = sess.replies.find(rseq);")
-    w("    if (it == sess.replies.end()) return kProbeMiss;")
+    w("    if (it == sess.replies.end()) {")
+    w("      if (epoch != 0 && frame_epoch != 0 && frame_epoch != epoch) {")
+    w("        stale_epoch_total++;")
+    w("        return kProbeStaleEpoch;")
+    w("      }")
+    w("      return kProbeMiss;")
+    w("    }")
     w("    deduped_requests_total++;")
     w("    Entry& e = it->second;")
     w("    if (e.done) {")
@@ -406,6 +539,13 @@ def _emit_body(contract: dict) -> str:
     w("  }")
     w("")
     w("  uint64_t deduped_requests_total = 0;")
+    w("  uint64_t stale_epoch_total = 0;")
+    w("  // Incarnation epoch: 0 = unset (epoch checking disabled). Set by")
+    w("  // the owning plane at install time to the SAME value the Python")
+    w("  // dispatcher advertises (rpc._server_sessions.epoch), so the two")
+    w("  // reply caches behind one listener agree about incarnations.")
+    w("  uint64_t epoch = 0;")
+    w("  void SetEpoch(uint64_t e) { epoch = e; }")
     w("  size_t session_count() const { return sessions_.size(); }")
     w("")
     w("  // Test hook: advance the virtual clock (sweep/TTL behavior).")
@@ -522,6 +662,7 @@ def lint_generated(repo_root: str = REPO_ROOT) -> list[str]:
                 continue
             if FENCE_BEGIN in text or _STAMP_PREFIX in text:
                 errors.extend(_fence_errors(path, text))
+    errors.extend(native_handler_check(repo_root))
     contract_path = os.path.join(repo_root, "docs", "wire_contract.json")
     header = os.path.join(repo_root, "src", "generated", "contract_gen.h")
     if os.path.exists(contract_path):
@@ -548,12 +689,14 @@ def main(argv: list[str] | None = None) -> int:
     check_only = "--check" in argv
     contract = load_contract()
     errors = cross_check(contract)
+    errors.extend(native_handler_check(contract=contract))
     if errors:
         for e in errors:
             print(e, file=sys.stderr)
         print("graftgen: REGISTRY PARITY FAILURE — refusing to generate "
               "from a contract that disagrees with the live replay "
-              "registries", file=sys.stderr)
+              "registries or the native-handler policy table",
+              file=sys.stderr)
         return 2
     text = generate(contract)
     if check_only:

@@ -62,6 +62,30 @@ def _load():
         lib.gact_actor_count.restype = ctypes.c_int64
         lib.gact_session_count.argtypes = [ctypes.c_void_p]
         lib.gact_session_count.restype = ctypes.c_int64
+        lib.gact_set_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.gact_stale_epoch_total.argtypes = [ctypes.c_void_p]
+        lib.gact_stale_epoch_total.restype = ctypes.c_uint64
+        lib.gact_node_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_int]
+        lib.gact_set_degraded.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_char_p, ctypes.c_int]
+        lib.gact_degraded_total.argtypes = [ctypes.c_void_p]
+        lib.gact_degraded_total.restype = ctypes.c_uint64
+        lib.gact_method_stats.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_char_p,
+                                          ctypes.POINTER(ctypes.c_uint64),
+                                          ctypes.POINTER(ctypes.c_uint64),
+                                          ctypes.POINTER(ctypes.c_uint64)]
+        lib.gact_restore_actor.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_uint32]
+        lib.gact_restore_node.argtypes = [ctypes.c_void_p,
+                                          ctypes.c_char_p, ctypes.c_int]
+        lib.gact_actor_state.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_uint32]
+        lib.gact_actor_state.restype = ctypes.c_int
         # gact_on_frame / gact_on_close run on the pump loop thread;
         # Python only needs their addresses.
         _lib = lib
@@ -69,7 +93,9 @@ def _load():
 
 
 def available() -> bool:
-    if os.environ.get("RAY_TPU_NATIVE_CONTROL", "0") not in (
+    # Default ON since the chaos-certification pass (issue 19); the
+    # kill switch RAY_TPU_NATIVE_CONTROL=0 restores the Python path.
+    if os.environ.get("RAY_TPU_NATIVE_CONTROL", "1") not in (
             "1", "true", "yes"):
         return False
     try:
@@ -158,3 +184,62 @@ class GcsActorPlane:
                                 ctypes.byref(fallthrough),
                                 ctypes.byref(deduped))
         return handled.value, fallthrough.value, deduped.value
+
+    def set_epoch(self, epoch: int) -> None:
+        """Install the server incarnation epoch (restart handshake)."""
+        if self._h:
+            self._lib.gact_set_epoch(self._h, epoch)
+
+    def stale_epoch_total(self) -> int:
+        return self._lib.gact_stale_epoch_total(self._h) if self._h else 0
+
+    def node_state(self, node_id: str, state: int) -> None:
+        """Mirror a death/drain-ladder rung (native_policy.NODE_*)."""
+        if self._h:
+            self._lib.gact_node_state(self._h, node_id.encode(), state)
+
+    def set_degraded(self, method: str, on: bool) -> None:
+        """Trip (or clear) the divergence breaker for one method."""
+        if self._h:
+            self._lib.gact_set_degraded(self._h, method.encode(),
+                                        1 if on else 0)
+
+    def degraded_total(self) -> int:
+        return self._lib.gact_degraded_total(self._h) if self._h else 0
+
+    def method_stats(self, method: str) -> tuple[int, int, int]:
+        """(handled, routed, degraded) for one owned method."""
+        if not self._h:
+            return 0, 0, 0
+        h = ctypes.c_uint64()
+        r = ctypes.c_uint64()
+        d = ctypes.c_uint64()
+        self._lib.gact_method_stats(self._h, method.encode(),
+                                    ctypes.byref(h), ctypes.byref(r),
+                                    ctypes.byref(d))
+        return h.value, r.value, d.value
+
+    def restore_actor(self, actor_id: str, state: str, restarts: int,
+                      max_restarts: int, node_id: str, spec: bytes,
+                      resources: bytes = b"") -> None:
+        """Replay one persisted actor-table row (crash rehydration)."""
+        if self._h:
+            self._lib.gact_restore_actor(
+                self._h, actor_id.encode(), state.encode(), restarts,
+                max_restarts, (node_id or "").encode(), spec, len(spec),
+                resources, len(resources))
+
+    def restore_node(self, node_id: str, state: int) -> None:
+        """Replay one persisted node-table row (crash rehydration)."""
+        if self._h:
+            self._lib.gact_restore_node(self._h, node_id.encode(), state)
+
+    def actor_state(self, actor_id: str) -> str | None:
+        """Native-side state string for the audit, None if unknown."""
+        if not self._h:
+            return None
+        buf = ctypes.create_string_buffer(32)
+        if self._lib.gact_actor_state(self._h, actor_id.encode(), buf,
+                                      32) != 1:
+            return None
+        return buf.value.decode()

@@ -69,12 +69,33 @@ def _load():
                                         ctypes.POINTER(ctypes.c_uint64)]
         lib.rlease_proto_errors.argtypes = [ctypes.c_void_p]
         lib.rlease_proto_errors.restype = ctypes.c_uint64
+        lib.rlease_set_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rlease_stale_epoch_total.argtypes = [ctypes.c_void_p]
+        lib.rlease_stale_epoch_total.restype = ctypes.c_uint64
+        lib.rlease_set_node_state.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int]
+        lib.rlease_set_degraded.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_char_p, ctypes.c_int]
+        lib.rlease_degraded_total.argtypes = [ctypes.c_void_p]
+        lib.rlease_degraded_total.restype = ctypes.c_uint64
+        lib.rlease_method_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.rlease_restore_lease.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p,
+                                             ctypes.c_char_p]
+        lib.rlease_native_lease_count.argtypes = [ctypes.c_void_p]
+        lib.rlease_native_lease_count.restype = ctypes.c_int64
         _lib = lib
         return lib
 
 
 def available() -> bool:
-    if os.environ.get("RAY_TPU_NATIVE_CONTROL", "0") not in (
+    # Default ON since the chaos-certification pass (issue 19); the
+    # kill switch RAY_TPU_NATIVE_CONTROL=0 restores the Python path.
+    if os.environ.get("RAY_TPU_NATIVE_CONTROL", "1") not in (
             "1", "true", "yes"):
         return False
     try:
@@ -189,3 +210,50 @@ class RayletLeasePlane:
                                   ctypes.byref(fallthrough),
                                   ctypes.byref(deduped))
         return handled.value, fallthrough.value, deduped.value
+
+    def set_epoch(self, epoch: int) -> None:
+        """Install the server incarnation epoch (restart handshake)."""
+        if self._h:
+            self._lib.rlease_set_epoch(self._h, epoch)
+
+    def stale_epoch_total(self) -> int:
+        if not self._h:
+            return 0
+        return self._lib.rlease_stale_epoch_total(self._h)
+
+    def set_node_state(self, state: int) -> None:
+        """Mirror OUR node's GCS ladder rung (native_policy.NODE_*)."""
+        if self._h:
+            self._lib.rlease_set_node_state(self._h, state)
+
+    def set_degraded(self, method: str, on: bool) -> None:
+        """Trip (or clear) the divergence breaker for one method."""
+        if self._h:
+            self._lib.rlease_set_degraded(self._h, method.encode(),
+                                          1 if on else 0)
+
+    def degraded_total(self) -> int:
+        return self._lib.rlease_degraded_total(self._h) if self._h else 0
+
+    def method_stats(self, method: str) -> tuple[int, int, int]:
+        """(handled, routed, degraded) for one owned method."""
+        if not self._h:
+            return 0, 0, 0
+        h = ctypes.c_uint64()
+        r = ctypes.c_uint64()
+        d = ctypes.c_uint64()
+        self._lib.rlease_method_stats(self._h, method.encode(),
+                                      ctypes.byref(h), ctypes.byref(r),
+                                      ctypes.byref(d))
+        return h.value, r.value, d.value
+
+    def restore_lease(self, lease_id: str, worker_id: str) -> None:
+        """Replay one persisted native-lease row (crash rehydration)."""
+        if self._h:
+            self._lib.rlease_restore_lease(self._h, lease_id.encode(),
+                                           worker_id.encode())
+
+    def native_lease_count(self) -> int:
+        if not self._h:
+            return 0
+        return self._lib.rlease_native_lease_count(self._h)

@@ -1,0 +1,49 @@
+"""Declared fallthrough policy for every natively-handled method.
+
+This is the breaker table the divergence audit (issue 19) drives: when
+the native↔Python mirror audit detects divergence (or a proto-error
+burst), the affected methods are degraded — new (sid, rseq) instances
+route to the Python handler (counted `native_degraded_total`) instead of
+being served potentially-wrong native answers.
+
+Keys are wire-contract method names. Every method a native plane owns
+carries a `// graftgen: native-handler <Method>` marker at its dispatch
+branch in src/gcs_actor.cc / src/raylet_lease.cc; the graftgen G2 gate
+cross-checks markers against this table in BOTH directions and against
+docs/wire_contract.json, so the breaker can never drift from
+contract_gen.h: an owned method without a declared policy (or a stale
+entry here) fails `make gen` and tier-1.
+
+Values document HOW the method falls back; the audit uses the key set.
+"""
+
+# method -> fallthrough/breaker policy (human-audited, G2-enforced)
+NATIVE_FALLTHROUGH_POLICY = {
+    "RegisterActor": (
+        "gcs actor plane: complex shapes (name/pg/strategy/get_if_exists/"
+        "non-simple resources) route per-request; breaker degrades ALL "
+        "new registrations to handle_register_actor"),
+    "ActorReady": (
+        "gcs actor plane: unknown-actor frames route per-request; "
+        "breaker degrades to handle_actor_ready (mirror stays "
+        "authoritative)"),
+    "RequestWorkerLease": (
+        "raylet lease plane: complex resources, draining/suspect node, "
+        "closed gate or empty pool route per-request; breaker degrades "
+        "to handle_request_worker_lease"),
+    "ReturnWorker": (
+        "raylet lease plane: non-native leases route per-request; "
+        "breaker degrades to handle_return_worker"),
+    "CreateActor": (
+        "raylet lease plane SIM MODE ONLY (bench/differential tests); "
+        "production raylets route CreateActor to handle_create_actor, "
+        "and the breaker forces that for sim too"),
+}
+
+# Node states mirrored into the native planes' cluster view (issue 19
+# fault-aware scheduling). Values are the wire encoding shared by the
+# Python daemons and the C structs (gcs_actor.cc Node.state).
+NODE_ALIVE = 0
+NODE_SUSPECT = 1
+NODE_DRAINING = 2
+NODE_DEAD = 3

@@ -440,6 +440,12 @@ class Raylet:
         # promote relocated copies to primaries).
         self._pulled_copies: dict[str, str] = {}
         self._tasks: list[asyncio.Task] = []
+        # Divergence breaker bookkeeping (mirrors gcs.py): a tripped
+        # breaker degrades the plane's owned methods to Python for the
+        # life of the process.
+        self._native_degraded_reason = ""
+        self._native_divergence_trips = 0
+        self._audit_proto_seen = 0
         self._lease_seq = 0
         self._num_leases_granted = 0
         self._last_spawn_failure = "worker startup failed"
@@ -524,6 +530,24 @@ class Raylet:
             plane = native_lease_plane.RayletLeasePlane(
                 pump, inject_token=_LEASE_PLANE_TOKEN, rcore=self.rcore)
             plane.set_node(self.node_id)
+            # Restart handshake: stamp the server incarnation epoch so a
+            # stamped request replayed from before a raylet restart (its
+            # reply cache died with the process) is rejected as stale
+            # instead of silently re-executed (a replayed CreateActor
+            # re-run would fork the actor).
+            plane.set_epoch(rpc._server_sessions.epoch)
+            if self.draining:
+                plane.set_draining(True)
+                plane.set_node_state(2)  # native_policy.NODE_DRAINING
+            # Replay the live lease ledger: any natively-granted lease
+            # already in the worker mirror (no-op at boot; keeps the
+            # plane's ReturnWorker ownership exact if the factory ever
+            # runs against live state).
+            native_prefix = f"{self.node_id}-n"
+            for w in self.workers.values():
+                if w.leased and (w.lease_id or "").startswith(
+                        native_prefix):
+                    plane.restore_lease(w.lease_id, w.worker_id)
             # install() is the LAST step: a half-wired plane must never
             # answer frames (close-on-failure below stays safe because
             # the pump hook was never pointed at it).
@@ -718,6 +742,9 @@ class Raylet:
                                            name="heartbeat-loop"))
         self._tasks.append(supervised_task(self._reap_loop(),
                                            name="reap-loop"))
+        if self._lease_plane is not None:
+            self._tasks.append(supervised_task(
+                self._native_audit_loop(), name="native-audit-loop"))
         self._tasks.append(supervised_task(self._log_tail_loop(),
                                            name="log-tail-loop"))
         if self.config.memory_usage_threshold > 0:
@@ -2366,6 +2393,9 @@ class Raylet:
         self.draining = True
         if self._lease_plane is not None:
             self._lease_plane.set_draining(True)
+            # Fault-aware rung for the native grant condition: DRAINING
+            # routes every RequestWorkerLease to Python's drain logic.
+            self._lease_plane.set_node_state(2)  # NODE_DRAINING
         self.drain_reason = reason
         self.drain_deadline_s = deadline_s
         self._drain_deadline_mono = time.monotonic() + deadline_s
@@ -2655,17 +2685,82 @@ class Raylet:
     def _native_control_stats(self):
         if self._lease_plane is None:
             return None
-        handled, fallthrough, deduped = self._lease_plane.counters()
+        plane = self._lease_plane
+        handled, fallthrough, deduped = plane.counters()
+        methods = {}
+        for m in ("RequestWorkerLease", "ReturnWorker", "CreateActor"):
+            mh, mr, md = plane.method_stats(m)
+            methods[m] = {"handled": mh, "routed": mr, "degraded": md}
         return {
             "handled_total": handled,
             # Frames the plane looked at but routed to Python (complex
             # shapes, closed FIFO gate, empty pool, unknown leases).
             "native_fallthrough_total": fallthrough,
             "deduped_requests_total": deduped,
-            "idle_mirror": self._lease_plane.idle_count(),
-            "sessions": self._lease_plane.session_count(),
-            "proto_errors": self._lease_plane.proto_errors(),
+            "idle_mirror": plane.idle_count(),
+            "sessions": plane.session_count(),
+            "proto_errors": plane.proto_errors(),
+            "stale_epoch_rejections_total": plane.stale_epoch_total(),
+            "native_degraded_total": plane.degraded_total(),
+            "divergence_trips_total": self._native_divergence_trips,
+            "degraded_reason": self._native_degraded_reason,
+            "native_leases": plane.native_lease_count(),
+            "methods": methods,
         }
+
+    async def _native_audit_loop(self):
+        """Native↔Python mirror audit (mirrors gcs._native_audit_loop):
+        two consecutive sweeps where the plane's lease ledger disagrees
+        with the worker mirror — or a proto-error burst — trip the
+        breaker and degrade the owned methods to Python for the life of
+        the process (counted native_degraded_total)."""
+        period = max(1.0, self.config.health_check_period_s)
+        native_prefix = f"{self.node_id}-n"
+        prev_mismatch = ""
+        while True:
+            await asyncio.sleep(period)
+            plane = self._lease_plane
+            if plane is None or self._native_degraded_reason:
+                return
+            try:
+                proto = plane.proto_errors()
+                burst = proto - self._audit_proto_seen >= 10
+                self._audit_proto_seen = proto
+                n_plane = plane.native_lease_count()
+                n_mirror = sum(
+                    1 for w in self.workers.values()
+                    if w.leased and (w.lease_id or "").startswith(
+                        native_prefix))
+                mismatch = ""
+                if n_plane != n_mirror:
+                    mismatch = (f"lease-ledger divergence: plane="
+                                f"{n_plane} mirror={n_mirror}")
+                if burst:
+                    self._trip_native_breaker(
+                        f"proto-error burst ({proto} total)")
+                elif mismatch and prev_mismatch:
+                    self._trip_native_breaker(mismatch)
+                prev_mismatch = mismatch
+            except Exception:
+                logger.exception("native mirror audit sweep failed")
+
+    def _trip_native_breaker(self, reason: str) -> None:
+        plane = self._lease_plane
+        if plane is None or self._native_degraded_reason:
+            return
+        self._native_degraded_reason = reason
+        self._native_divergence_trips += 1
+        for m in ("RequestWorkerLease", "ReturnWorker", "CreateActor"):
+            try:
+                plane.set_degraded(m, True)
+            except Exception:
+                logger.exception("native breaker trip failed for %s", m)
+        logger.error("native lease plane DEGRADED to Python: %s", reason)
+        from ray_tpu.util import events
+
+        events.record("ERROR", "raylet",
+                      f"native lease plane degraded: {reason}",
+                      node_id=self.node_id)
 
     async def handle_get_event_loop_stats(self, conn, payload):
         """Per-handler dispatch latency + drain stats for this raylet's

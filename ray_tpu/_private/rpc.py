@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 import traceback
 import uuid
@@ -61,6 +62,27 @@ class ConnectionLost(RpcError):
 _SID_KEY = "_session"
 _RSEQ_KEY = "_rseq"
 _ACK_KEY = "_acked"
+# Restart-handshake stamp (issue 19). Servers advertise their
+# incarnation epoch inside stamped dict replies; clients echo the last
+# learned epoch on REPLAYED sends only. A replay stamped with a dead
+# incarnation's epoch whose (sid, rseq) has no cache entry is rejected
+# deterministically (the cache it would dedup against died with the old
+# server), instead of silently re-executing a mutating request.
+_EPOCH_KEY = "_epoch"
+
+# Deterministic rejection text for a cross-incarnation replay. The
+# native SessionManager path (src/gcs_actor.cc, src/raylet_lease.cc)
+# emits the SAME bytes — the differential replay test pins them equal.
+STALE_EPOCH_ERROR = ("stale session epoch: request may have executed "
+                     "before a server restart and its reply was lost; "
+                     "re-issue")
+
+
+def _new_epoch() -> int:
+    """Nonzero u63 unique per server incarnation (uniqueness is the only
+    requirement — mismatch detection, not ordering)."""
+    return ((int(time.time()) << 20) | (os.getpid() & 0xFFFFF)) \
+        & 0x7FFFFFFFFFFFFFFF or 1
 
 # A reconnected socket must survive this long before the session trusts
 # it: a connection that dies younger CONTINUES the previous redial
@@ -103,6 +125,7 @@ _session_stats = {
     "reconnects_total": 0,          # successful socket re-establishes
     "replayed_requests_total": 0,   # requests re-sent after a reconnect
     "deduped_requests_total": 0,    # server-side replay cache hits
+    "stale_epoch_rejections_total": 0,  # cross-incarnation replays refused
     "sessions_opened": 0,
     "sessions_failed": 0,           # grace window exhausted
 }
@@ -129,8 +152,17 @@ class SessionManager:
                  session_ttl_s: float = 900.0):
         self.max_replies = max_replies_per_session
         self.session_ttl_s = session_ttl_s
+        # Incarnation epoch, advertised in stamped replies and compared
+        # against the _epoch stamp of replayed requests (issue 19).
+        # Overridable for tests; the native planes are installed with
+        # this SAME value so both caches agree about incarnations.
+        self.epoch = _new_epoch()
         self._sessions: dict[str, dict] = {}
         self._last_sweep = 0.0
+
+    def has(self, sid: str, rseq: int) -> bool:
+        sess = self._sessions.get(sid)
+        return sess is not None and rseq in sess["replies"]
 
     def begin(self, sid: str, rseq: int, reply_fn) -> bool:
         now = time.monotonic()
@@ -207,14 +239,34 @@ def _session_intercept(payload, seq, reply_fn):
     sid = payload.pop(_SID_KEY)
     rseq = payload.pop(_RSEQ_KEY, None)
     acked = payload.pop(_ACK_KEY, None)
+    frame_epoch = payload.pop(_EPOCH_KEY, None)
     if acked is not None:
         _server_sessions.ack(sid, acked)
     if rseq is None or seq is None:
         return True, None, payload   # notify / unstamped: no dedup
+    if frame_epoch and frame_epoch != _server_sessions.epoch \
+            and not _server_sessions.has(sid, rseq):
+        # A replay stamped with a DEAD incarnation's epoch and no cache
+        # entry left: the original send may have executed before the
+        # restart. Stamped methods are all cached-class (exempt ones are
+        # never stamped), so the only deterministic answer is rejection
+        # — never a silent re-execution against a lost cache.
+        _session_stats["stale_epoch_rejections_total"] += 1
+        reply_fn(MSG_ERROR, STALE_EPOCH_ERROR)
+        return False, None, payload
     if not _server_sessions.begin(sid, rseq, reply_fn):
         return False, None, payload
     return True, (lambda kind, value:
                   _server_sessions.finish(sid, rseq, kind, value)), payload
+
+
+def _stamp_reply(result):
+    """Advertise the server's incarnation epoch inside a stamped dict
+    reply (the client learns it from here and echoes it on replays).
+    Non-dict (opaque) results pass through unstamped."""
+    if isinstance(result, dict) and _EPOCH_KEY not in result:
+        return {**result, _EPOCH_KEY: _server_sessions.epoch}
+    return result
 
 
 def pack(obj) -> bytes:
@@ -358,6 +410,7 @@ class Connection:
             if self._stats is not None:
                 self._stats.record_handler(method, time.perf_counter() - t0)
             if record is not None:
+                result = _stamp_reply(result)
                 record(MSG_RESPONSE, result)
             if seq is not None:
                 await self._send([MSG_RESPONSE, seq, method, result])
@@ -530,6 +583,7 @@ class ResilientConnection:
         self._close_callbacks: list[Callable[[], None]] = []
         self._rseq = 0
         self._outstanding: set[int] = set()
+        self._server_epoch = 0       # learned from stamped replies
         self._established_at = 0.0   # loop.time() of the last connect
         self._flap_attempts = 0      # backoff carried across quick deaths
         self._flap_started = 0.0     # grace anchor for a quick-death streak
@@ -691,15 +745,25 @@ class ResilientConnection:
                 conn = await self._ensure_connected()
                 if stamped is not None:
                     stamped[_ACK_KEY] = self._acked_watermark()
+                    if sent_once and self._server_epoch:
+                        # Replay: echo the incarnation the ORIGINAL send
+                        # may have executed under, so a restarted server
+                        # (lost reply cache) rejects deterministically
+                        # instead of re-executing. Fresh sends stay
+                        # unstamped — new work is always welcome.
+                        stamped[_EPOCH_KEY] = self._server_epoch
                 if sent_once:
                     _session_stats["replayed_requests_total"] += 1
                 sent_once = True
                 try:
                     att = None if deadline is None \
                         else max(0.01, deadline - loop.time())
-                    return await conn.call(
+                    result = await conn.call(
                         method, stamped if stamped is not None else payload,
                         timeout=att)
+                    if isinstance(result, dict) and _EPOCH_KEY in result:
+                        self._server_epoch = result.pop(_EPOCH_KEY)
+                    return result
                 except ConnectionLost:
                     if self._closed:
                         raise

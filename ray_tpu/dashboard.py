@@ -220,6 +220,11 @@ class _Handler(BaseHTTPRequestHandler):
                 data = state.summarize_actors()
             elif path == "/api/summary/objects":
                 data = state.summarize_objects()
+            elif path == "/api/summary/native_control":
+                # Native control plane health: GCS actor plane + every
+                # raylet lease plane — fallthrough/degraded counters,
+                # stale-epoch rejections, divergence-breaker state.
+                data = state.summarize_native_control()
             elif path == "/api/summary/task_latency":
                 # Per-stage lifecycle latency percentiles (SUBMITTED →
                 # LEASE_* → DISPATCHED → ARGS_FETCHED → RUNNING →

@@ -538,6 +538,59 @@ def core_prometheus_text() -> str:
                    for st in ok if st.get("rpc_sessions")]
         if samples:
             gauge(metric, help_, samples)
+    # Native control plane (default-on): per-daemon fallthrough /
+    # degraded / stale-epoch counters plus the per-method split, so a
+    # tripped divergence breaker — or a quietly fallthrough-heavy
+    # workload — shows on a dashboard, not just in daemon logs.
+    try:
+        planes = []
+        try:
+            nc = _state.cluster_status().get("native_control")
+            if nc:
+                planes.append(("gcs", nc))
+        except Exception:
+            pass
+        for st in ok:
+            rnc = st.get("native_control")
+            if rnc:
+                planes.append(
+                    (f"raylet-{str(st.get('node_id', '?'))[:12]}", rnc))
+        for metric, key, help_ in (
+                ("ray_tpu_native_handled_total", "handled_total",
+                 "frames handled by the native control plane"),
+                ("ray_tpu_native_fallthrough_total",
+                 "native_fallthrough_total",
+                 "owned-method frames routed to the Python handlers "
+                 "(complex shapes, transient states)"),
+                ("ray_tpu_native_degraded_total",
+                 "native_degraded_total",
+                 "frames pushed back to Python by the divergence "
+                 "breaker"),
+                ("ray_tpu_native_stale_epoch_rejections_total",
+                 "stale_epoch_rejections_total",
+                 "pre-restart replays rejected by the session-epoch "
+                 "handshake (the client re-issues)"),
+                ("ray_tpu_native_divergence_trips_total",
+                 "divergence_trips_total",
+                 "times the native<->Python mirror audit tripped the "
+                 "degradation breaker")):
+            samples = [({"daemon": d}, p.get(key, 0)) for d, p in planes]
+            if samples:
+                gauge(metric, help_, samples)
+        for metric, key, help_ in (
+                ("ray_tpu_native_method_handled_total", "handled",
+                 "frames handled natively, per owned method"),
+                ("ray_tpu_native_method_routed_total", "routed",
+                 "frames routed to Python, per owned method"),
+                ("ray_tpu_native_method_degraded_total", "degraded",
+                 "breaker-degraded frames, per owned method")):
+            samples = [({"daemon": d, "method": m}, ms.get(key, 0))
+                       for d, p in planes
+                       for m, ms in (p.get("methods") or {}).items()]
+            if samples:
+                gauge(metric, help_, samples)
+    except Exception:
+        pass
     try:
         actors = _state.summarize_actors()["by_state"]
         gauge("ray_tpu_actors", "actors by state",

@@ -209,6 +209,22 @@ def summarize_objects() -> dict:
     return {"by_state": dict(by_state), "total_bytes": total_bytes}
 
 
+def summarize_native_control() -> dict:
+    """Native control plane health across the cluster: the GCS actor
+    plane's counters (GetClusterStatus) plus every raylet lease
+    plane's (GetState) — handled/fallthrough/degraded totals, the
+    stale-epoch rejection count, divergence-breaker state and the
+    per-method handled/routed/degraded split."""
+    out = {"gcs": _gcs_call("GetClusterStatus").get("native_control"),
+           "raylets": []}
+    for st in node_stats():
+        if "error" in st:
+            continue
+        out["raylets"].append({"node_id": st.get("node_id"),
+                               "native_control": st.get("native_control")})
+    return out
+
+
 def cluster_status() -> dict:
     out = _gcs_call("GetClusterStatus")
     # Elastic-training counters: fold the published ray_tpu_train_*

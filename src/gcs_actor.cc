@@ -81,6 +81,18 @@ typedef void (*ChainCloseFn)(void* ctx, int64_t conn);
 constexpr const char* kStatePending = "PENDING";
 constexpr const char* kStateAlive = "ALIVE";
 
+// Node states mirrored from native_policy.py (death/drain ladder view).
+constexpr int kNodeAlive = 0;
+constexpr int kNodeSuspect = 1;
+constexpr int kNodeDraining = 2;
+constexpr int kNodeDead = 3;
+
+// Deterministic cross-incarnation replay rejection. MUST byte-match
+// rpc.STALE_EPOCH_ERROR — the differential replay test pins them equal.
+constexpr const char* kStaleEpochError =
+    "stale session epoch: request may have executed before a server "
+    "restart and its reply was lost; re-issue";
+
 struct Actor {
   std::string state = kStatePending;
   int64_t restarts = 0;
@@ -105,6 +117,17 @@ struct Node {
   int64_t conn_id = -1;
   bool up = false;
   bool in_ring = false;  // already a member of node_order
+  // Death/drain-ladder state mirrored from gcs.py (issue 19): SUSPECT
+  // and DRAINING nodes are out of new placement; a SUSPECT node's
+  // pending creations stay PARKED (resent on re-register, failed over
+  // only on the explicit node_down promotion) — never forked.
+  int state = kNodeAlive;
+};
+
+struct MethodStats {
+  uint64_t handled = 0;
+  uint64_t routed = 0;    // per-request fallthrough (complex shape etc.)
+  uint64_t degraded = 0;  // breaker-forced fallthrough
 };
 
 struct ActorPlane {
@@ -134,7 +157,12 @@ struct ActorPlane {
 
   uint64_t handled = 0;
   uint64_t fallthrough = 0;  // owned-method frames handed to Python
+  uint64_t degraded = 0;     // breaker-forced fallthroughs
   std::atomic<uint64_t> proto_errors{0};
+
+  // Divergence breaker (issue 19): methods forced back to Python.
+  std::unordered_map<std::string, bool> degraded_methods;
+  std::unordered_map<std::string, MethodStats> method_stats;
 };
 
 double NowS() {
@@ -182,11 +210,18 @@ void Inject2(ActorPlane* s, const char* event,
   s->inject(s->pump, s->inject_token, body.data(), (uint32_t)body.size());
 }
 
-std::string MapOkTrue() {
+// {"ok": true} plus the _epoch advertisement when an incarnation epoch
+// is configured — byte-matching rpc._stamp_reply's key order ("ok"
+// first, "_epoch" appended) so python/native replies stay identical.
+std::string MapOkTrue(const ActorPlane* s) {
   std::string r;
-  mplite::w_map(r, 1);
+  mplite::w_map(r, s->sm.epoch != 0 ? 2 : 1);
   mplite::w_str(r, "ok");
   mplite::w_bool(r, true);
+  if (s->sm.epoch != 0) {
+    mplite::w_str(r, "_epoch");
+    mplite::w_int(r, (int64_t)s->sm.epoch);
+  }
   return r;
 }
 
@@ -209,6 +244,7 @@ struct RegFields {
   int64_t rseq = 0;
   int64_t acked = 0;
   bool have_acked = false;
+  int64_t epoch = 0;  // _epoch replay stamp (0 = fresh send / legacy)
 };
 
 bool ParseFields(View& v, RegFields* f) {
@@ -273,6 +309,8 @@ bool ParseFields(View& v, RegFields* f) {
     } else if (k == "_acked") {
       if (!mplite::read_int(v, &f->acked)) return false;
       f->have_acked = true;
+    } else if (k == "_epoch") {
+      if (!mplite::read_int(v, &f->epoch)) return false;
     } else {
       if (!mplite::skip(v)) return false;
     }
@@ -282,8 +320,10 @@ bool ParseFields(View& v, RegFields* f) {
 
 // ---- scheduling: round-robin over up nodes ----
 
-// Pick the next up node, skipping `not_node` when an alternative exists
-// (draining bounce repick). Caller holds mu. Empty string = none.
+// Pick the next up, ALIVE-state node, skipping `not_node` when an
+// alternative exists (draining bounce repick). SUSPECT and DRAINING
+// nodes are out of new placement — the fault-aware mirror of gcs.py's
+// death/drain ladders (issue 19). Caller holds mu. Empty string = none.
 std::string PickNode(ActorPlane* s, const std::string& not_node) {
   if (s->node_order.empty()) return "";
   for (size_t i = 0; i < s->node_order.size(); i++) {
@@ -291,12 +331,15 @@ std::string PickNode(ActorPlane* s, const std::string& not_node) {
     s->rr++;
     auto it = s->nodes.find(nid);
     if (it == s->nodes.end() || !it->second.up) continue;
+    if (it->second.state != kNodeAlive) continue;
     if (nid == not_node) continue;
     return nid;
   }
-  // Only the excluded node is up (single-node cluster): reuse it.
+  // Only the excluded node is usable (single-node cluster): reuse it.
   auto it = s->nodes.find(not_node);
-  if (it != s->nodes.end() && it->second.up) return not_node;
+  if (it != s->nodes.end() && it->second.up &&
+      it->second.state == kNodeAlive)
+    return not_node;
   return "";
 }
 
@@ -344,16 +387,36 @@ void SendCreate(ActorPlane* s, const std::string& node_id, int64_t rseq) {
             payload);
 }
 
+// True when some known node could become placeable again without any
+// new registration (conn flap, SUSPECT recovery, drain cancel). DEAD
+// nodes never count — with only dead nodes left, parking would strand
+// the actor where orphaning hands it to Python's scheduler.
+bool AnyNodeParkable(ActorPlane* s) {
+  for (const auto& [nid, n] : s->nodes) {
+    (void)nid;
+    if (n.in_ring && n.state != kNodeDead) return true;
+  }
+  return false;
+}
+
 // Begin (or retry) the creation of `actor_id` on a fresh rseq.  Caller
-// holds mu.  On no-node the actor is ORPHANED to Python: the plane
-// forgets it and Python's scheduler takes over the mirror record (which
-// already carries the restart count), so nothing is double-counted.
+// holds mu.  With no usable node but SOME known node (suspect/draining/
+// flapped — states that recover), the actor stays PENDING and PARKED:
+// RedrivePending re-drives it when a node comes back, instead of
+// forking or failing over early (issue 19).  With no node at all the
+// actor is ORPHANED to Python: the plane forgets it and Python's
+// scheduler takes over the mirror record (which already carries the
+// restart count), so nothing is double-counted.
 void Schedule(ActorPlane* s, const std::string& actor_id,
               const std::string& not_node) {
   auto ait = s->actors.find(actor_id);
   if (ait == s->actors.end()) return;
   std::string node_id = PickNode(s, not_node);
   if (node_id.empty()) {
+    if (AnyNodeParkable(s)) {
+      ait->second.node_id.clear();  // parked: redriven on node recovery
+      return;
+    }
     std::string ev;
     mplite::w_map(ev, 1);
     mplite::w_str(ev, "actor_id");
@@ -416,6 +479,26 @@ void CreateFailed(ActorPlane* s, const std::string& actor_id,
     s->actors.erase(ait);
     Inject2(s, "dead", ev);
   }
+}
+
+// Re-drive every parked PENDING actor (no creation in flight anywhere):
+// rehydrated actors waiting for their first node, and actors parked by
+// an all-nodes-unusable window. Caller holds mu.
+void RedrivePending(ActorPlane* s) {
+  std::unordered_map<std::string, bool> inflight;
+  for (const auto& [nid, ns] : s->node_sess) {
+    (void)nid;
+    for (const auto& [rseq, pc] : ns.outstanding) {
+      (void)rseq;
+      inflight[pc.actor_id] = true;
+    }
+  }
+  std::vector<std::string> parked;
+  for (const auto& [aid, a] : s->actors) {
+    if (a.state == kStatePending && !inflight.count(aid))
+      parked.push_back(aid);
+  }
+  for (const std::string& aid : parked) Schedule(s, aid, "");
 }
 
 // One claimed CreateActor response (or error).  Caller holds mu.
@@ -512,6 +595,9 @@ void gact_node_up(void* h, const char* node_id, int64_t conn_id) {
   if (n.conn_id >= 0) s->conn_node.erase(n.conn_id);
   n.conn_id = conn_id;
   n.up = true;
+  // A (re-)registering node is alive; if the GCS restored a richer
+  // ladder state (e.g. still DRAINING), gact_node_state follows.
+  n.state = kNodeAlive;
   s->conn_node[conn_id] = nid;
   if (!n.in_ring) {
     n.in_ring = true;
@@ -524,6 +610,22 @@ void gact_node_up(void* h, const char* node_id, int64_t conn_id) {
       rseqs.push_back(rseq);
     for (int64_t rseq : rseqs) SendCreate(s, nid, rseq);
   }
+  // Rehydrated / parked PENDING actors get their (re)drive now that a
+  // node is placeable — the crash-rehydration re-kick (issue 19).
+  RedrivePending(s);
+}
+
+// Mirror one rung of the death/drain ladder into the native node view.
+// SUSPECT parks (new placement skips the node; outstanding creations
+// wait for re-register or node_down), DRAINING stops new placement,
+// ALIVE (suspect recovery / drain cancel) re-drives parked actors.
+void gact_node_state(void* h, const char* node_id, int state) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->nodes.find(node_id);
+  if (it == s->nodes.end()) return;
+  it->second.state = state;
+  if (state == kNodeAlive) RedrivePending(s);
 }
 
 // Node declared dead: fail its pending creations through the restart
@@ -537,6 +639,7 @@ void gact_node_down(void* h, const char* node_id) {
     if (it->second.conn_id >= 0) s->conn_node.erase(it->second.conn_id);
     it->second.up = false;
     it->second.conn_id = -1;
+    it->second.state = kNodeDead;
   }
   auto sit = s->node_sess.find(nid);
   if (sit == s->node_sess.end()) return;
@@ -587,6 +690,95 @@ int64_t gact_session_count(void* h) {
   auto* s = static_cast<ActorPlane*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   return (int64_t)s->sm.session_count();
+}
+
+// Install the server incarnation epoch (rpc._server_sessions.epoch) so
+// native replies advertise the same value Python stamps and replays
+// from dead incarnations are rejected identically on both paths.
+void gact_set_epoch(void* h, uint64_t epoch) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->sm.SetEpoch(epoch);
+}
+
+uint64_t gact_stale_epoch_total(void* h) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->sm.stale_epoch_total;
+}
+
+// Divergence breaker control: on!=0 degrades `method` (every new
+// request routes to Python); on==0 re-arms the native handler.
+void gact_set_degraded(void* h, const char* method, int on) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->degraded_methods[std::string(method)] = (on != 0);
+}
+
+uint64_t gact_degraded_total(void* h) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->degraded;
+}
+
+void gact_method_stats(void* h, const char* method, uint64_t* handled,
+                       uint64_t* routed, uint64_t* degraded) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  const MethodStats& ms = s->method_stats[std::string(method)];
+  *handled = ms.handled;
+  *routed = ms.routed;
+  *degraded = ms.degraded;
+}
+
+// Crash rehydration (issue 19): replay one persisted actor-table row
+// into the plane BEFORE install/chaining. No scheduling happens here —
+// restored PENDING actors are parked and re-driven by RedrivePending
+// when their first node (re-)registers, so a restore against an empty
+// cluster cannot orphan everything back to Python in a thundering herd.
+void gact_restore_actor(void* h, const char* actor_id, const char* state,
+                        int64_t restarts, int64_t max_restarts,
+                        const char* node_id, const char* spec,
+                        uint32_t spec_len, const char* resources,
+                        uint32_t res_len) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Actor& a = s->actors[std::string(actor_id)];
+  a.state = state;
+  a.restarts = restarts;
+  a.max_restarts = max_restarts;
+  a.node_id = node_id;
+  a.spec_raw.assign(spec, spec_len);
+  a.resources_raw.assign(resources, res_len);
+}
+
+// Rehydrate one persisted node-table row (down, ladder state as saved);
+// the node joins the ring now so AnyNodeParkable sees it, and becomes
+// placeable when it re-registers (gact_node_up) within the grace window.
+void gact_restore_node(void* h, const char* node_id, int state) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string nid(node_id);
+  Node& n = s->nodes[nid];
+  n.up = false;
+  n.conn_id = -1;
+  n.state = state;
+  if (!n.in_ring && state != kNodeDead) {
+    n.in_ring = true;
+    s->node_order.push_back(nid);
+  }
+}
+
+// Audit probe: copy the native-side state string for `actor_id` into
+// buf (NUL-terminated). Returns 1 if known, 0 if not in the mirror.
+int gact_actor_state(void* h, const char* actor_id, char* buf,
+                     uint32_t cap) {
+  auto* s = static_cast<ActorPlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->actors.find(std::string(actor_id));
+  if (it == s->actors.end()) return 0;
+  snprintf(buf, cap, "%s", it->second.state.c_str());
+  return 1;
 }
 
 void gact_on_close(void* h, int64_t conn_id) {
@@ -663,19 +855,46 @@ int gact_on_frame(void* h, int64_t conn_id, const char* data,
   std::string sid(f.sid);
   if (f.stamped) {
     if (f.have_acked) s->sm.Ack(sid, f.acked);
-    auto pr = s->sm.Probe(sid, f.rseq, reply_fn);
+    auto pr = s->sm.Probe(sid, f.rseq, (uint64_t)f.epoch, reply_fn);
     if (pr == contractgen::SessionManager::kProbeAnswered) return 1;
     if (pr == contractgen::SessionManager::kProbeRouted) {
       s->fallthrough++;
       return 0;  // stamps intact: Python's cache owns this (sid, rseq)
     }
+    if (pr == contractgen::SessionManager::kProbeStaleEpoch) {
+      // Replay from a pre-restart incarnation whose cached reply died
+      // with the old process: deterministic rejection (never blind
+      // re-execution, never a wrong dedupe) — byte-matching Python's
+      // STALE_EPOCH_ERROR so the differential replay test pins both.
+      std::string err;
+      mplite::w_str(err, kStaleEpochError);
+      if (msg_type == kMsgRequest)
+        SendFrame(s, conn_id, kMsgError, seq, method, err);
+      return 1;
+    }
   }
 
+  // Divergence breaker: a degraded method routes every NEW (sid, rseq)
+  // to Python until the audit clears it. Replays of natively-answered
+  // requests were already served from the cache by Probe above.
+  {
+    auto dit = s->degraded_methods.find(reply_method);
+    if (dit != s->degraded_methods.end() && dit->second) {
+      if (f.stamped) s->sm.MarkRouted(sid, f.rseq);
+      s->fallthrough++;
+      s->degraded++;
+      s->method_stats[reply_method].degraded++;
+      return 0;
+    }
+  }
+
+  // graftgen: native-handler RegisterActor
   if (method == "RegisterActor") {
     if (f.complex_shape || !f.resources_simple) {
       // Named / PG / strategy / resource-shaped: Python policy shell.
       if (f.stamped) s->sm.MarkRouted(sid, f.rseq);
       s->fallthrough++;
+      s->method_stats[reply_method].routed++;
       return 0;
     }
     if (s->node_order.empty()) {
@@ -684,6 +903,7 @@ int gact_on_frame(void* h, int64_t conn_id, const char* data,
       // a second time natively (split-brain guard).
       if (f.stamped) s->sm.MarkRouted(sid, f.rseq);
       s->fallthrough++;
+      s->method_stats[reply_method].routed++;
       return 0;
     }
     std::string actor_id(f.actor_id);
@@ -693,9 +913,10 @@ int gact_on_frame(void* h, int64_t conn_id, const char* data,
     a.max_restarts = f.max_restarts;
     a.spec_raw.assign(f.spec_raw.data(), f.spec_raw.size());
     a.resources_raw.assign(f.resources_raw.data(), f.resources_raw.size());
-    std::string result = MapOkTrue();
+    std::string result = MapOkTrue(s);
     if (f.stamped) s->sm.Begin(sid, f.rseq);
     s->handled++;
+    s->method_stats[reply_method].handled++;
     // Mirror event BEFORE the reply: Python persistence must see the
     // record in-order with any follow-up events for the same actor.
     std::string payload_raw((const char*)v.p + v.off, v.n - v.off);
@@ -707,6 +928,7 @@ int gact_on_frame(void* h, int64_t conn_id, const char* data,
     return 1;
   }
 
+  // graftgen: native-handler ActorReady
   // ActorReady: the raylet reports the actor's worker is serving.
   auto ait = s->actors.find(std::string(f.actor_id));
   if (ait == s->actors.end()) {
@@ -715,12 +937,14 @@ int gact_on_frame(void* h, int64_t conn_id, const char* data,
     // routed mark so replays stay on the Python side.
     if (f.stamped) s->sm.MarkRouted(sid, f.rseq);
     s->fallthrough++;
+    s->method_stats[reply_method].routed++;
     return 0;
   }
   ait->second.state = kStateAlive;
-  std::string result = MapOkTrue();
+  std::string result = MapOkTrue(s);
   if (f.stamped) s->sm.Begin(sid, f.rseq);
   s->handled++;
+  s->method_stats[reply_method].handled++;
   {
     std::string ev;
     mplite::w_map(ev, 3);

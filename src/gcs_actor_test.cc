@@ -48,6 +48,20 @@ void gact_counters(void* h, uint64_t* handled, uint64_t* fallthrough,
 uint64_t gact_proto_errors(void* h);
 int64_t gact_actor_count(void* h);
 int64_t gact_session_count(void* h);
+void gact_set_epoch(void* h, uint64_t epoch);
+uint64_t gact_stale_epoch_total(void* h);
+void gact_node_state(void* h, const char* node_id, int state);
+void gact_set_degraded(void* h, const char* method, int on);
+uint64_t gact_degraded_total(void* h);
+void gact_method_stats(void* h, const char* method, uint64_t* handled,
+                       uint64_t* routed, uint64_t* degraded);
+void gact_restore_actor(void* h, const char* actor_id, const char* state,
+                        int64_t restarts, int64_t max_restarts,
+                        const char* node_id, const char* spec,
+                        uint32_t spec_len, const char* resources,
+                        uint32_t res_len);
+void gact_restore_node(void* h, const char* node_id, int state);
+int gact_actor_state(void* h, const char* actor_id, char* buf, uint32_t cap);
 void gact_on_close(void* h, int64_t conn_id);
 int gact_on_frame(void* h, int64_t conn_id, const char* data, uint32_t len);
 }
@@ -695,6 +709,149 @@ void TestChaining() {
   gact_destroy(plane);
 }
 
+// ---- issue 19: epoch handshake, rehydration, parking, breaker ----
+//
+// CountingSend-only (no pump): drive gact_on_frame directly and decode
+// what the plane tried to send.
+
+std::string StampedRegister(const char* actor_id, const char* sid,
+                            int64_t rseq, int64_t epoch) {
+  std::string spec;
+  mplite::w_map(spec, 1);
+  mplite::w_str(spec, "cls");
+  mplite::w_str(spec, "Foo");
+  std::string p;
+  mplite::w_map(p, epoch != 0 ? 7 : 6);
+  mplite::w_str(p, "actor_id");
+  mplite::w_str(p, actor_id);
+  mplite::w_str(p, "spec");
+  mplite::w_raw(p, spec);
+  mplite::w_str(p, "max_restarts");
+  mplite::w_int(p, 0);
+  mplite::w_str(p, "_session");
+  mplite::w_str(p, sid);
+  mplite::w_str(p, "_rseq");
+  mplite::w_int(p, rseq);
+  mplite::w_str(p, "_acked");
+  mplite::w_int(p, rseq - 1);
+  if (epoch != 0) {
+    mplite::w_str(p, "_epoch");
+    mplite::w_int(p, epoch);
+  }
+  return PackFrame(0, 31, "RegisterActor", p);
+}
+
+void TestEpochRestoreDegraded() {
+  void* plane = gact_create((void*)&CountingSend, (void*)&CountingInject,
+                            nullptr, 1);
+  gact_set_epoch(plane, 42);
+  gact_node_up(plane, "node-A", 5);
+
+  // Fresh stamped request (no _epoch): executes; the reply advertises
+  // the incarnation epoch after "ok" (rpc._stamp_reply key order).
+  g_sent = 0;
+  std::string reg = StampedRegister("e1", "drv-e", 1, 0);
+  CHECK(gact_on_frame(plane, 9, reg.data(), (uint32_t)reg.size()) == 1);
+  CHECK(g_sent >= 1);
+  std::string expect;
+  mplite::w_map(expect, 2);
+  mplite::w_str(expect, "ok");
+  mplite::w_bool(expect, true);
+  mplite::w_str(expect, "_epoch");
+  mplite::w_int(expect, 42);
+  // First send is the driver reply (the CreateActor went to conn 5 via
+  // the same counting stub afterwards).
+  int64_t msg_type, seq;
+  std::string method, payload;
+  // g_last_sent holds the LAST frame (CreateActor out); re-send the
+  // replay to observe the cached driver reply deterministically.
+  std::string replay = StampedRegister("e1", "drv-e", 1, 42);
+  CHECK(gact_on_frame(plane, 9, replay.data(), (uint32_t)replay.size()) == 1);
+  CHECK(DecodeEnvelope(g_last_sent, &msg_type, &seq, &method, &payload));
+  CHECK(msg_type == 1 && method == "RegisterActor");
+  CHECK(payload == expect);
+  CHECK(gact_stale_epoch_total(plane) == 0);
+
+  // Replay stamped with a DEAD incarnation's epoch and no cache entry:
+  // deterministic rejection, never blind re-execution.
+  std::string stale = StampedRegister("e2", "drv-e", 7, 41);
+  CHECK(gact_on_frame(plane, 9, stale.data(), (uint32_t)stale.size()) == 1);
+  CHECK(gact_stale_epoch_total(plane) == 1);
+  std::string etext;
+  CHECK(DecodeError(g_last_sent, &seq, &etext));
+  CHECK(etext.find("stale session epoch") == 0);
+  CHECK(gact_actor_count(plane) == 1);  // e2 was NOT created
+
+  // Breaker: degraded method routes new requests to Python (return 0),
+  // counted per-method; re-arm restores native handling.
+  gact_set_degraded(plane, "RegisterActor", 1);
+  std::string reg3 = StampedRegister("e3", "drv-e", 3, 0);
+  CHECK(gact_on_frame(plane, 9, reg3.data(), (uint32_t)reg3.size()) == 0);
+  CHECK(gact_degraded_total(plane) == 1);
+  uint64_t mh, mr, md;
+  gact_method_stats(plane, "RegisterActor", &mh, &mr, &md);
+  CHECK(mh == 1 && md == 1);
+  gact_set_degraded(plane, "RegisterActor", 0);
+  std::string reg4 = StampedRegister("e4", "drv-e", 4, 0);
+  CHECK(gact_on_frame(plane, 9, reg4.data(), (uint32_t)reg4.size()) == 1);
+  gact_method_stats(plane, "RegisterActor", &mh, &mr, &md);
+  CHECK(mh == 2 && md == 1);
+
+  // Fault-aware parking: node SUSPECT -> a new creation PARKS (stays
+  // PENDING, nothing sent to the node) instead of forking or orphaning;
+  // recovery to ALIVE re-drives it.
+  gact_node_state(plane, "node-A", /*SUSPECT=*/1);
+  g_sent = 0;
+  std::string reg5 = StampedRegister("e5", "drv-e", 5, 0);
+  CHECK(gact_on_frame(plane, 9, reg5.data(), (uint32_t)reg5.size()) == 1);
+  char state_buf[16];
+  CHECK(gact_actor_state(plane, "e5", state_buf, sizeof state_buf) == 1);
+  CHECK(strcmp(state_buf, "PENDING") == 0);
+  CHECK(g_sent == 1);  // ONLY the driver ack; no CreateActor went out
+  gact_node_state(plane, "node-A", /*ALIVE=*/0);
+  CHECK(DecodeEnvelope(g_last_sent, &msg_type, &seq, &method, &payload));
+  CHECK(msg_type == 0 && method == "CreateActor");
+  FlatMap cm;
+  CHECK(ParseFlatMap(payload, &cm));
+  CHECK(cm.str("actor_id") == "e5");
+  gact_destroy(plane);
+
+  // Crash rehydration: a NEW plane (restart) restores the persisted
+  // tables; the re-registering node triggers the parked re-drive with
+  // the restored spec bytes.
+  void* p2 = gact_create((void*)&CountingSend, (void*)&CountingInject,
+                         nullptr, 1);
+  gact_set_epoch(p2, 43);
+  std::string spec;
+  mplite::w_map(spec, 1);
+  mplite::w_str(spec, "cls");
+  mplite::w_str(spec, "Restored");
+  gact_restore_node(p2, "node-A", /*SUSPECT=*/1);
+  gact_restore_actor(p2, "r1", "PENDING", 2, 5, "", spec.data(),
+                     (uint32_t)spec.size(), "", 0);
+  gact_restore_actor(p2, "r2", "ALIVE", 0, 1, "node-A", spec.data(),
+                     (uint32_t)spec.size(), "", 0);
+  CHECK(gact_actor_count(p2) == 2);
+  g_sent = 0;
+  gact_node_up(p2, "node-A", 6);
+  // r1 (PENDING, parked) was re-driven: exactly one CreateActor out.
+  CHECK(g_sent == 1);
+  CHECK(DecodeEnvelope(g_last_sent, &msg_type, &seq, &method, &payload));
+  CHECK(method == "CreateActor");
+  FlatMap rm;
+  CHECK(ParseFlatMap(payload, &rm));
+  CHECK(rm.str("actor_id") == "r1");
+  CHECK(rm.raw("spec") == spec);
+  // r2 (ALIVE) was restored untouched.
+  CHECK(gact_actor_state(p2, "r2", state_buf, sizeof state_buf) == 1);
+  CHECK(strcmp(state_buf, "ALIVE") == 0);
+  // A pre-restart replay against the restored plane: stale epoch.
+  std::string old = StampedRegister("e9", "drv-e", 9, 42);
+  CHECK(gact_on_frame(p2, 9, old.data(), (uint32_t)old.size()) == 1);
+  CHECK(gact_stale_epoch_total(p2) == 1);
+  gact_destroy(p2);
+}
+
 }  // namespace
 
 int main() {
@@ -702,6 +859,7 @@ int main() {
   TestMalformedFrames();
   TestChaining();
   TestLadderThroughPump();
+  TestEpochRestoreDegraded();
   if (failures == 0) {
     std::printf("gcs_actor_test: all OK\n");
     return 0;

@@ -1,7 +1,7 @@
 // graftgen: generated from docs/wire_contract.json — DO NOT EDIT
 // graftgen: regenerate with `make gen` (python -m ray_tpu._private.lint.gen)
 // graftgen: contract generator: python -m ray_tpu._private.lint --emit-contract
-// graftgen: content-sha256=87d4fe3dd1ab7fdcf3e62e4d2cea1c2b4f10b8fb56344e394baeffe5ac817931
+// graftgen: content-sha256=42eedc0c09fdbe2e379913da1f36aa9a1aae37c3379de1ad9ffddb9b15a5a0a4
 // graftgen: generated (begin)
 #pragma once
 
@@ -211,8 +211,8 @@ inline const MethodInfo* FindMethod(std::string_view name) {
 
 // Mirror of common.require_fields over a raw msgpack payload:
 // payload must be a map carrying every required field. Session
-// stamp keys (_session/_rseq/_acked) are wire metadata, not
-// application fields. Truncated/garbage payloads fail closed.
+// stamp keys (_session/_rseq/_acked/_epoch) are wire metadata,
+// not application fields. Truncated/garbage payloads fail closed.
 // On failure *missing names the first absent field (or the map
 // complaint), for the Malformed error text.
 inline bool ValidateRequired(const MethodInfo& m, mplite::View v,
@@ -248,7 +248,7 @@ inline bool ValidateRequired(const MethodInfo& m, mplite::View v,
 }
 
 inline bool IsStampKey(std::string_view key) {
-  return key == "_session" || key == "_rseq" || key == "_acked";
+  return key == "_session" || key == "_rseq" || key == "_acked" || key == "_epoch";
 }
 
 // ---------------------------------------------------------------
@@ -260,9 +260,16 @@ inline bool IsStampKey(std::string_view key) {
 //     STOPS at a pending head (never break at-most-once);
 //   - ack(upto) prunes done entries <= upto;
 //   - sessions idle past ttl are swept at most every 60s.
-// Plus one native-plane extension with the same lifetime rules:
-// python-routed marks, so a method instance that fell through to
-// Python keeps falling through on replay (split-brain guard).
+// Plus two native-plane extensions with the same lifetime rules:
+//   - python-routed marks, so a method instance that fell through
+//     to Python keeps falling through on replay (split-brain guard);
+//   - an incarnation epoch (issue 19 restart semantics): servers
+//     advertise `epoch` in stamped replies, clients echo it on
+//     REPLAYED frames only, and Probe answers kProbeStaleEpoch for
+//     a replay stamped with a different incarnation's epoch whose
+//     (sid, rseq) is absent — the cache it would have deduped
+//     against died with the previous incarnation, so the frame is
+//     rejected deterministically, never silently re-executed.
 // NOT thread-safe: callers serialize (the planes run it on the
 // pump loop thread only).
 // ---------------------------------------------------------------
@@ -271,9 +278,10 @@ class SessionManager {
   using ReplyFn = std::function<void(int kind, const std::string&)>;
 
   enum ProbeResult {
-    kProbeMiss = 0,      // no entry: caller may execute natively
-    kProbeAnswered = 1,  // duplicate: answered (or waiter attached)
-    kProbeRouted = 2,    // python-routed: caller must fall through
+    kProbeMiss = 0,        // no entry: caller may execute natively
+    kProbeAnswered = 1,    // duplicate: answered (or waiter attached)
+    kProbeRouted = 2,      // python-routed: caller must fall through
+    kProbeStaleEpoch = 3,  // replay from a dead incarnation: reject
   };
 
   explicit SessionManager(uint32_t max_replies = 512,
@@ -282,15 +290,28 @@ class SessionManager {
 
   // Consult the cache WITHOUT creating an entry. Touches the
   // session clock and runs the sweep, exactly like begin().
+  // frame_epoch is the request's _epoch stamp (0 = unstamped: a
+  // fresh send, or a legacy client). A nonzero stamp that differs
+  // from this server's epoch marks a replay whose original send
+  // targeted a previous incarnation; with no cached entry left to
+  // dedup against, the ONLY deterministic answer is rejection
+  // (exempt-class methods are never stamped, so they blind-replay
+  // through the other arm of the contract, as audited).
   ProbeResult Probe(const std::string& sid, int64_t rseq,
-                    const ReplyFn& reply_fn) {
+                    uint64_t frame_epoch, const ReplyFn& reply_fn) {
     double now = Now();
     MaybeSweep(now);
     Session& sess = sessions_[sid];
     sess.last_seen = now;
     if (sess.routed.count(rseq)) return kProbeRouted;
     auto it = sess.replies.find(rseq);
-    if (it == sess.replies.end()) return kProbeMiss;
+    if (it == sess.replies.end()) {
+      if (epoch != 0 && frame_epoch != 0 && frame_epoch != epoch) {
+        stale_epoch_total++;
+        return kProbeStaleEpoch;
+      }
+      return kProbeMiss;
+    }
     deduped_requests_total++;
     Entry& e = it->second;
     if (e.done) {
@@ -363,6 +384,13 @@ class SessionManager {
   }
 
   uint64_t deduped_requests_total = 0;
+  uint64_t stale_epoch_total = 0;
+  // Incarnation epoch: 0 = unset (epoch checking disabled). Set by
+  // the owning plane at install time to the SAME value the Python
+  // dispatcher advertises (rpc._server_sessions.epoch), so the two
+  // reply caches behind one listener agree about incarnations.
+  uint64_t epoch = 0;
+  void SetEpoch(uint64_t e) { epoch = e; }
   size_t session_count() const { return sessions_.size(); }
 
   // Test hook: advance the virtual clock (sweep/TTL behavior).

@@ -34,6 +34,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -68,6 +69,21 @@ typedef int (*AcquireFn)(void* rcore, const char* lease_id,
                          const char* resources, const char* pg_id,
                          int bundle_index);
 typedef int (*ReleaseFn)(void* rcore, const char* lease_id);
+
+// Node states mirrored from native_policy.py (death/drain ladder view).
+constexpr int kNodeAlive = 0;
+
+// Deterministic cross-incarnation replay rejection. MUST byte-match
+// rpc.STALE_EPOCH_ERROR — the differential replay test pins them equal.
+constexpr const char* kStaleEpochError =
+    "stale session epoch: request may have executed before a server "
+    "restart and its reply was lost; re-issue";
+
+struct MethodStats {
+  uint64_t handled = 0;
+  uint64_t routed = 0;    // per-request fallthrough (complex shape etc.)
+  uint64_t degraded = 0;  // breaker-forced fallthrough
+};
 
 struct Worker {
   std::string worker_id;
@@ -105,7 +121,17 @@ struct LeasePlane {
 
   bool gate_open = true;   // false while Python has queued leases
   bool draining = false;
+  // Ladder state of OUR OWN node as the GCS sees it (issue 19): a
+  // SUSPECT/DRAINING raylet must not keep granting natively — the GCS
+  // may already be failing our leases over, so grants route to the
+  // Python shell (which consults the same drain/death state).
+  int node_state = kNodeAlive;
   bool sim = false;        // CreateActor responder mode
+
+  // Divergence breaker (issue 19): methods forced back to Python.
+  std::unordered_map<std::string, bool> degraded_methods;
+  std::unordered_map<std::string, MethodStats> method_stats;
+  uint64_t degraded = 0;
 
   // Sim-mode outbound ActorReady session (per plane; dedup'd server-side).
   std::string sim_sid;
@@ -180,6 +206,7 @@ struct LeaseFields {
   int64_t rseq = 0;
   int64_t acked = 0;
   bool have_acked = false;
+  int64_t epoch = 0;  // _epoch replay stamp (0 = fresh send / legacy)
 };
 
 bool AppendRes(std::string* out, std::string_view key, double val) {
@@ -285,6 +312,8 @@ bool ParseFields(View& v, LeaseFields* f) {
     } else if (k == "_acked") {
       if (!mplite::read_int(v, &f->acked)) return false;
       f->have_acked = true;
+    } else if (k == "_epoch") {
+      if (!mplite::read_int(v, &f->epoch)) return false;
     } else {
       if (!mplite::skip(v)) return false;
     }
@@ -297,7 +326,7 @@ std::string GrantReply(LeasePlane* s, const std::string& lease_id,
                        const Worker& w, double received_at,
                        double acquired_at, double granted_at) {
   std::string r;
-  mplite::w_map(r, 8);
+  mplite::w_map(r, s->sm.epoch != 0 ? 9 : 8);
   mplite::w_str(r, "granted");
   mplite::w_bool(r, true);
   mplite::w_str(r, "lease_id");
@@ -328,14 +357,25 @@ std::string GrantReply(LeasePlane* s, const std::string& lease_id,
   w_float((acquired_at - received_at) * 1000.0);
   mplite::w_str(r, "worker_attach_ms");
   w_float((granted_at - acquired_at) * 1000.0);
+  if (s->sm.epoch != 0) {
+    mplite::w_str(r, "_epoch");
+    mplite::w_int(r, (int64_t)s->sm.epoch);
+  }
   return r;
 }
 
-std::string MapOkTrue() {
+// {"ok": true} plus the _epoch advertisement when an incarnation epoch
+// is configured — byte-matching rpc._stamp_reply's key order ("ok"
+// first, "_epoch" appended) so python/native replies stay identical.
+std::string MapOkTrue(const LeasePlane* s) {
   std::string r;
-  mplite::w_map(r, 1);
+  mplite::w_map(r, s->sm.epoch != 0 ? 2 : 1);
   mplite::w_str(r, "ok");
   mplite::w_bool(r, true);
+  if (s->sm.epoch != 0) {
+    mplite::w_str(r, "_epoch");
+    mplite::w_int(r, (int64_t)s->sm.epoch);
+  }
   return r;
 }
 
@@ -453,6 +493,77 @@ uint64_t rlease_proto_errors(void* h) {
       std::memory_order_relaxed);
 }
 
+// Install the server incarnation epoch (rpc._server_sessions.epoch) so
+// native replies advertise the same value Python stamps and replays
+// from dead incarnations are rejected identically on both paths.
+void rlease_set_epoch(void* h, uint64_t epoch) {
+  auto* s = static_cast<LeasePlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->sm.SetEpoch(epoch);
+}
+
+uint64_t rlease_stale_epoch_total(void* h) {
+  auto* s = static_cast<LeasePlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->sm.stale_epoch_total;
+}
+
+// Ladder state of our own node as mirrored from the GCS view
+// (native_policy NODE_* encoding); != ALIVE blocks native grants.
+void rlease_set_node_state(void* h, int state) {
+  auto* s = static_cast<LeasePlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->node_state = state;
+}
+
+// Divergence breaker control: on!=0 degrades `method` (every new
+// request routes to Python); on==0 re-arms the native handler.
+void rlease_set_degraded(void* h, const char* method, int on) {
+  auto* s = static_cast<LeasePlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->degraded_methods[std::string(method)] = (on != 0);
+}
+
+uint64_t rlease_degraded_total(void* h) {
+  auto* s = static_cast<LeasePlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->degraded;
+}
+
+void rlease_method_stats(void* h, const char* method, uint64_t* handled,
+                         uint64_t* routed, uint64_t* degraded) {
+  auto* s = static_cast<LeasePlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  const MethodStats& ms = s->method_stats[std::string(method)];
+  *handled = ms.handled;
+  *routed = ms.routed;
+  *degraded = ms.degraded;
+}
+
+// Crash rehydration (issue 19): replay one persisted native-lease-ledger
+// row into the plane BEFORE install. Bumps lease_seq past the restored
+// id's "-n<seq>" suffix so post-restart grants can never collide with a
+// pre-restart lease id. Resource re-acquisition stays Python's job (the
+// caller re-books rcore from its own persisted ledger).
+void rlease_restore_lease(void* h, const char* lease_id,
+                          const char* worker_id) {
+  auto* s = static_cast<LeasePlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string lid(lease_id);
+  s->native_leases[lid] = worker_id;
+  size_t at = lid.rfind("-n");
+  if (at != std::string::npos) {
+    unsigned long long seq = strtoull(lid.c_str() + at + 2, nullptr, 10);
+    if (seq > s->lease_seq) s->lease_seq = seq;
+  }
+}
+
+int64_t rlease_native_lease_count(void* h) {
+  auto* s = static_cast<LeasePlane*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return (int64_t)s->native_leases.size();
+}
+
 void rlease_on_close(void* h, int64_t conn_id) {
   auto* s = static_cast<LeasePlane*>(h);
   if (s->chain_close != nullptr) s->chain_close(s->chain_ctx, conn_id);
@@ -517,22 +628,47 @@ int rlease_on_frame(void* h, int64_t conn_id, const char* data,
   std::string sid(f.sid);
   if (f.stamped) {
     if (f.have_acked) s->sm.Ack(sid, f.acked);
-    auto pr = s->sm.Probe(sid, f.rseq, reply_fn);
+    auto pr = s->sm.Probe(sid, f.rseq, (uint64_t)f.epoch, reply_fn);
     if (pr == contractgen::SessionManager::kProbeAnswered) return 1;
     if (pr == contractgen::SessionManager::kProbeRouted) {
       s->fallthrough++;
       return 0;
     }
+    if (pr == contractgen::SessionManager::kProbeStaleEpoch) {
+      // Replay from a pre-restart incarnation whose cached reply died
+      // with the old process: deterministic rejection, byte-matching
+      // Python's STALE_EPOCH_ERROR (differential test pins both).
+      std::string err;
+      mplite::w_str(err, kStaleEpochError);
+      if (msg_type == kMsgRequest)
+        SendFrame(s, conn_id, kMsgError, seq, method, err);
+      return 1;
+    }
   }
   auto route_to_python = [&]() -> int {
     if (f.stamped) s->sm.MarkRouted(sid, f.rseq);
     s->fallthrough++;
+    s->method_stats[reply_method].routed++;
     return 0;
   };
 
+  // Divergence breaker: a degraded method routes every NEW (sid, rseq)
+  // to Python until the audit clears it (replays already served above).
+  {
+    auto dit = s->degraded_methods.find(reply_method);
+    if (dit != s->degraded_methods.end() && dit->second) {
+      if (f.stamped) s->sm.MarkRouted(sid, f.rseq);
+      s->fallthrough++;
+      s->degraded++;
+      s->method_stats[reply_method].degraded++;
+      return 0;
+    }
+  }
+
+  // graftgen: native-handler RequestWorkerLease
   if (method == "RequestWorkerLease") {
     if (f.complex_shape || !f.resources_ok || s->draining ||
-        !s->gate_open || s->idle.empty())
+        s->node_state != kNodeAlive || !s->gate_open || s->idle.empty())
       return route_to_python();
     double received_at = NowS();
     s->lease_seq++;
@@ -569,6 +705,7 @@ int rlease_on_frame(void* h, int64_t conn_id, const char* data,
         GrantReply(s, lease_id, w, received_at, acquired_at, granted_at);
     if (f.stamped) s->sm.Begin(sid, f.rseq);
     s->handled++;
+    s->method_stats[reply_method].handled++;
     {
       std::string ev;
       mplite::w_map(ev, 2);
@@ -584,6 +721,7 @@ int rlease_on_frame(void* h, int64_t conn_id, const char* data,
     return 1;
   }
 
+  // graftgen: native-handler ReturnWorker
   if (method == "ReturnWorker") {
     std::string lease_id(f.lease_id);
     auto lit = s->native_leases.find(lease_id);
@@ -592,9 +730,10 @@ int rlease_on_frame(void* h, int64_t conn_id, const char* data,
     std::string worker_id = lit->second;
     s->native_leases.erase(lit);
     s->release(s->rcore, lease_id.c_str());
-    std::string result = MapOkTrue();
+    std::string result = MapOkTrue(s);
     if (f.stamped) s->sm.Begin(sid, f.rseq);
     s->handled++;
+    s->method_stats[reply_method].handled++;
     std::string ev;
     mplite::w_map(ev, 3);
     mplite::w_str(ev, "lease_id");
@@ -612,12 +751,14 @@ int rlease_on_frame(void* h, int64_t conn_id, const char* data,
     return 1;
   }
 
+  // graftgen: native-handler CreateActor
   // CreateActor (sim mode): ack {"ok": true} under full session dedup,
   // then fire the ladder's next rung (ActorReady) back at the caller —
   // a mock raylet entirely in native code.
-  std::string result = MapOkTrue();
+  std::string result = MapOkTrue(s);
   if (f.stamped) s->sm.Begin(sid, f.rseq);
   s->handled++;
+  s->method_stats[reply_method].handled++;
   if (msg_type == kMsgRequest)
     SendFrame(s, conn_id, kMsgResponse, seq, method, result);
   if (f.stamped) s->sm.Finish(sid, f.rseq, kMsgResponse, result);

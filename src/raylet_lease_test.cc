@@ -60,6 +60,16 @@ int64_t rlease_session_count(void* h);
 void rlease_counters(void* h, uint64_t* handled, uint64_t* fallthrough,
                      uint64_t* deduped);
 uint64_t rlease_proto_errors(void* h);
+void rlease_set_epoch(void* h, uint64_t epoch);
+uint64_t rlease_stale_epoch_total(void* h);
+void rlease_set_node_state(void* h, int state);
+void rlease_set_degraded(void* h, const char* method, int on);
+uint64_t rlease_degraded_total(void* h);
+void rlease_method_stats(void* h, const char* method, uint64_t* handled,
+                         uint64_t* routed, uint64_t* degraded);
+void rlease_restore_lease(void* h, const char* lease_id,
+                          const char* worker_id);
+int64_t rlease_native_lease_count(void* h);
 void rlease_on_close(void* h, int64_t conn_id);
 int rlease_on_frame(void* h, int64_t conn_id, const char* data,
                     uint32_t len);
@@ -600,6 +610,146 @@ void TestGrantThroughPump() {
   rcore_destroy(rcore);
 }
 
+// ---- issue 19: epoch handshake, ledger rehydration, breaker ----
+
+std::string LeasePayloadEpoch(double cpu, const char* sid, int64_t rseq,
+                              int64_t epoch) {
+  std::string p = LeasePayload(cpu, sid, rseq);
+  // Re-pack with the _epoch stamp appended (map header count +1).
+  std::string out;
+  View v{(const uint8_t*)p.data(), p.size(), 0};
+  uint32_t n;
+  if (!mplite::read_map(v, &n)) return p;
+  mplite::w_map(out, n + 1);
+  out.append(p, v.off, std::string::npos);
+  mplite::w_str(out, "_epoch");
+  mplite::w_int(out, epoch);
+  return out;
+}
+
+void TestEpochRestoreDegraded() {
+  void* rcore = rcore_create("CPU=4");
+  void* plane = rlease_create((void*)&CapSend, (void*)&CapInject, nullptr, 2,
+                              (void*)&rcore_try_acquire,
+                              (void*)&rcore_release, rcore);
+  rlease_set_node(plane, "epnode1234567890");
+  rlease_set_epoch(plane, 42);
+  rlease_push(plane, "w1", "10.0.0.1", 7001, 7101);
+  g_sends.clear();
+
+  // Fresh grant: the reply advertises the incarnation epoch as its
+  // LAST key (rpc._stamp_reply appends after existing keys).
+  std::string req = PackFrame(0, 1, "RequestWorkerLease",
+                              LeasePayload(1, "ecli-1", 1));
+  CHECK(rlease_on_frame(plane, 9, req.data(), (uint32_t)req.size()) == 1);
+  int64_t msg_type, seq;
+  std::string method, payload;
+  CHECK(DecodeEnvelope(g_sends.back(), &msg_type, &seq, &method, &payload));
+  GrantFields g;
+  CHECK(ParseGrant(payload, &g));
+  CHECK(g.granted);
+  {
+    View v{(const uint8_t*)payload.data(), payload.size(), 0};
+    uint32_t n;
+    CHECK(mplite::read_map(v, &n) && n == 9);
+    bool saw_epoch = false;
+    for (uint32_t i = 0; i < n; i++) {
+      std::string_view k;
+      CHECK(mplite::read_str(v, &k));
+      if (k == "_epoch") {
+        int64_t e;
+        CHECK(mplite::read_int(v, &e) && e == 42);
+        saw_epoch = true;
+      } else {
+        CHECK(mplite::skip(v));
+      }
+    }
+    CHECK(saw_epoch);
+  }
+
+  // Same-epoch replay: cached reply, no stale rejection.
+  std::string rep = PackFrame(0, 1, "RequestWorkerLease",
+                              LeasePayloadEpoch(1, "ecli-1", 1, 42));
+  CHECK(rlease_on_frame(plane, 9, rep.data(), (uint32_t)rep.size()) == 1);
+  CHECK(g_sends.back() == g_sends.front());
+  CHECK(rlease_stale_epoch_total(plane) == 0);
+
+  // Dead-incarnation replay with no cache entry: deterministic error.
+  std::string stale = PackFrame(0, 2, "RequestWorkerLease",
+                                LeasePayloadEpoch(1, "ecli-1", 2, 41));
+  CHECK(rlease_on_frame(plane, 9, stale.data(), (uint32_t)stale.size())
+        == 1);
+  CHECK(rlease_stale_epoch_total(plane) == 1);
+  {
+    View v{(const uint8_t*)g_sends.back().data(), g_sends.back().size(), 0};
+    uint32_t alen;
+    int64_t mt, es;
+    std::string_view m, msg;
+    CHECK(mplite::read_array(v, &alen) && alen == 4);
+    CHECK(mplite::read_int(v, &mt) && mt == 2);  // MSG_ERROR
+    CHECK(mplite::read_int(v, &es) && es == 2);
+    CHECK(mplite::read_str(v, &m) && m == "RequestWorkerLease");
+    CHECK(mplite::read_str(v, &msg));
+    CHECK(msg.substr(0, 19) == "stale session epoch");
+  }
+  CHECK(rcore_num_leases(rcore) == 1);  // nothing was granted for it
+
+  // SUSPECT/DRAINING node state (GCS ladder mirror): no native grant.
+  rlease_push(plane, "w2", "10.0.0.1", 7002, 7102);
+  rlease_set_node_state(plane, /*SUSPECT=*/1);
+  std::string req3 = PackFrame(0, 3, "RequestWorkerLease",
+                               LeasePayload(1, "ecli-1", 3));
+  CHECK(rlease_on_frame(plane, 9, req3.data(), (uint32_t)req3.size()) == 0);
+  CHECK(rcore_num_leases(rcore) == 1);
+  rlease_set_node_state(plane, /*ALIVE=*/0);
+
+  // Breaker: degraded RequestWorkerLease routes to Python, counted.
+  rlease_set_degraded(plane, "RequestWorkerLease", 1);
+  std::string req4 = PackFrame(0, 4, "RequestWorkerLease",
+                               LeasePayload(1, "ecli-1", 4));
+  CHECK(rlease_on_frame(plane, 9, req4.data(), (uint32_t)req4.size()) == 0);
+  CHECK(rlease_degraded_total(plane) == 1);
+  uint64_t mh, mr, md;
+  rlease_method_stats(plane, "RequestWorkerLease", &mh, &mr, &md);
+  CHECK(mh == 1 && md == 1);
+  rlease_set_degraded(plane, "RequestWorkerLease", 0);
+  std::string req5 = PackFrame(0, 5, "RequestWorkerLease",
+                               LeasePayload(1, "ecli-1", 5));
+  CHECK(rlease_on_frame(plane, 9, req5.data(), (uint32_t)req5.size()) == 1);
+  rlease_destroy(plane);
+
+  // Ledger rehydration on a NEW plane (raylet restart): the restored
+  // native lease is returnable natively, and lease_seq advanced past
+  // the restored id so new grants cannot collide.
+  void* p2 = rlease_create((void*)&CapSend, (void*)&CapInject, nullptr, 2,
+                           (void*)&rcore_try_acquire,
+                           (void*)&rcore_release, rcore);
+  rlease_set_node(p2, "epnode1234567890");
+  rlease_set_epoch(p2, 43);
+  rlease_restore_lease(p2, "epnode12-n7", "w1");
+  CHECK(rlease_native_lease_count(p2) == 1);
+  // Python re-books rcore from its own ledger on restart.
+  CHECK(rcore_try_acquire(rcore, "epnode12-n7", "CPU=1", "", -1) == 1);
+  rlease_push(p2, "w9", "10.0.0.1", 7009, 7109);
+  g_sends.clear();
+  std::string req6 = PackFrame(0, 6, "RequestWorkerLease",
+                               LeasePayload(1, "rcli-1", 1));
+  CHECK(rlease_on_frame(p2, 9, req6.data(), (uint32_t)req6.size()) == 1);
+  CHECK(DecodeEnvelope(g_sends.back(), &msg_type, &seq, &method, &payload));
+  GrantFields g6;
+  CHECK(ParseGrant(payload, &g6));
+  CHECK(g6.granted && g6.lease_id == "epnode12-n8");  // past restored -n7
+  std::string ret = PackFrame(0, 7, "ReturnWorker",
+                              ReturnPayload("epnode12-n7", false, "rcli-1",
+                                            2));
+  int leases_before = rcore_num_leases(rcore);
+  CHECK(rlease_on_frame(p2, 9, ret.data(), (uint32_t)ret.size()) == 1);
+  CHECK(rcore_num_leases(rcore) == leases_before - 1);
+  CHECK(rlease_native_lease_count(p2) == 1);  // only the new grant left
+  rlease_destroy(p2);
+  rcore_destroy(rcore);
+}
+
 }  // namespace
 
 int main() {
@@ -607,6 +757,7 @@ int main() {
   TestSimCreateActor();
   TestMalformedFrames();
   TestGrantThroughPump();
+  TestEpochRestoreDegraded();
   if (failures == 0) {
     std::printf("raylet_lease_test: all OK\n");
     return 0;

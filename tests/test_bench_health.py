@@ -216,3 +216,39 @@ def test_bench_cli_actor_churn_smoke():
     assert extra["native_fallthrough_total"] == 0
     assert extra["lease_grant_p99_ms"] >= extra["lease_grant_p50_ms"] > 0
     assert extra["tasks_per_s_under_churn"] > 0
+
+
+@pytest.mark.smoke
+def test_bench_cli_control_soak_smoke():
+    """`python bench.py --control-soak` (ISSUE 19) at `make soak-smoke`
+    scale: the default-on native control plane rides out NetChaos link
+    flaps and a node preemption with zero lost and zero
+    forked/duplicated creations, at least one suspect recovery, the
+    grant/return cycle floor held, and the divergence breaker never
+    tripped — the soak itself exits non-zero on any violation."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_JAX_PLATFORM"] = "cpu"
+    env["RAY_TPU_BENCH_CHILD"] = "1"  # skip the probe ladder + re-exec
+    env["RAY_TPU_SOAK_N"] = "40"
+    env["RAY_TPU_SOAK_TASK_S"] = "0.5"
+    env["RAY_TPU_SOAK_FLAPS"] = "1"
+    env["RAY_TPU_SOAK_FLOOR"] = "2000"
+    env["RAY_TPU_BENCH_SOAK_ARTIFACT"] = "0"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--control-soak"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=_REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "control_soak_cycles_per_s"
+    extra = rec["extra"]
+    assert "error" not in extra, extra
+    assert extra["health"]["verdict"] in ("ok", "degraded")
+    assert extra["actors_alive"] == extra["actors_churned"]
+    assert extra["lost"] == 0 and extra["forked"] == 0
+    assert extra["suspect_recoveries"] >= 1
+    assert extra["flaps"] >= 1
+    assert rec["value"] >= extra["cycles_floor"]
+    assert extra["divergence_trips_total"] == 0
+    assert extra["native_degraded_total"] == 0

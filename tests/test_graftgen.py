@@ -213,9 +213,11 @@ def _frame(body: bytes) -> bytes:
     return struct.pack(">I", len(body)) + body
 
 
-def _create_actor_frame(seq: int, sid: str, rseq: int) -> bytes:
+def _create_actor_frame(seq: int, sid: str, rseq: int,
+                        epoch: int | None = None) -> bytes:
     """One stamped CreateActor request, bytes fixed across both servers
-    and across the original send and the replay."""
+    and across the original send and the replay. `epoch` mimics the
+    client echoing a learned incarnation epoch on a REPLAYED send."""
     payload = {
         "actor_id": "diff-actor-1",
         "spec": b"\x01spec-bytes",
@@ -223,6 +225,8 @@ def _create_actor_frame(seq: int, sid: str, rseq: int) -> bytes:
         "_rseq": rseq,
         "_acked": 0,
     }
+    if epoch is not None:
+        payload["_epoch"] = epoch
     return _frame(rpc.pack([rpc.MSG_REQUEST, seq, "CreateActor", payload]))
 
 
@@ -255,7 +259,8 @@ async def _python_exchange(frames: list[bytes], n_responses: int):
         await server.stop()
 
 
-def _native_exchange(frames: list[bytes], n_responses: int):
+def _native_exchange(frames: list[bytes], n_responses: int,
+                     epoch: int | None = None):
     """Same exchange against the native lease plane (sim mode) riding a
     real FastPump.  The plane emits its own outbound ActorReady REQUEST
     (seq >= 1<<40) interleaved with responses — filtered out here, as
@@ -267,6 +272,8 @@ def _native_exchange(frames: list[bytes], n_responses: int):
     plane = RayletLeasePlane(pump, inject_token=3)
     try:
         plane.set_sim(True)
+        if epoch is not None:
+            plane.set_epoch(epoch)
         plane.install()
         port = pump.listen("127.0.0.1", 0)
         with socket.create_connection(("127.0.0.1", port), timeout=10) as sk:
@@ -308,8 +315,13 @@ def test_differential_replay_python_vs_native():
     py_out, py_calls = run(_python_exchange([py_frame, py_frame], 2))
     py_deduped = rpc.session_stats()["deduped_requests_total"] - py_before
 
+    # The Python server advertises its process-wide incarnation epoch in
+    # every stamped reply; the native plane is installed with the SAME
+    # value (gcs/raylet do this at service-factory time), so the reply
+    # bytes stay identical.
+    epoch = rpc._server_sessions.epoch
     nat_out, nat_handled, nat_deduped = _native_exchange(
-        [nat_frame, nat_frame], 2)
+        [nat_frame, nat_frame], 2, epoch=epoch)
 
     # Within each server: the replay is answered byte-identically.
     assert py_out[0] == py_out[1]
@@ -324,7 +336,67 @@ def test_differential_replay_python_vs_native():
     assert py_out[0] == nat_out[0], (
         f"python={py_out[0]!r} native={nat_out[0]!r}")
     env = rpc.unpack(py_out[0])
-    assert env == [rpc.MSG_RESPONSE, seq, "CreateActor", {"ok": True}]
+    assert env == [rpc.MSG_RESPONSE, seq, "CreateActor",
+                   {"ok": True, "_epoch": epoch}]
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native fastpath unavailable")
+def test_differential_replay_across_restart():
+    """A replay that crosses a server restart: the frame carries the
+    DEAD incarnation's epoch and the restarted server's reply cache has
+    no (sid, rseq) entry — both implementations reject it with the SAME
+    stale-epoch error bytes instead of wrongly deduping or silently
+    re-executing. A replay stamped with the LIVE epoch still executes
+    (the restart rehydrated nothing for this sid, so it is new work)."""
+    seq, rseq = 31, 5
+    dead_epoch = rpc._new_epoch() ^ 0x5A5A  # some other incarnation
+
+    # -- Python: fresh SessionManager = restarted process state. --
+    saved = rpc._server_sessions
+    rpc._server_sessions = rpc.SessionManager()
+    try:
+        live_epoch = rpc._server_sessions.epoch
+        assert live_epoch != dead_epoch
+        stale = _create_actor_frame(seq, "restart-py", rseq,
+                                    epoch=dead_epoch)
+        fresh = _create_actor_frame(seq + 1, "restart-py", rseq + 1,
+                                    epoch=live_epoch)
+        py_before = rpc.session_stats()["stale_epoch_rejections_total"]
+        py_out, py_calls = run(_python_exchange([stale, fresh], 2))
+        py_stale = (rpc.session_stats()["stale_epoch_rejections_total"]
+                    - py_before)
+    finally:
+        rpc._server_sessions = saved
+
+    nat_stale_f = _create_actor_frame(seq, "restart-nat", rseq,
+                                      epoch=dead_epoch)
+    nat_fresh_f = _create_actor_frame(seq + 1, "restart-nat", rseq + 1,
+                                      epoch=live_epoch)
+    nat_out, nat_handled, _ = _native_exchange(
+        [nat_stale_f, nat_fresh_f], 2, epoch=live_epoch)
+
+    # The Python rejection rides a scheduled task while the executed
+    # reply sends inline, so arrival order is not FIFO — pair replies by
+    # their wire seq before comparing.
+    py_out.sort(key=lambda b: rpc.unpack(b)[1])
+    nat_out.sort(key=lambda b: rpc.unpack(b)[1])
+
+    # The pre-restart replay executed NOWHERE; the live-epoch one did.
+    assert py_calls == 1
+    assert py_stale == 1
+    assert nat_handled == 1
+    err_py = rpc.unpack(py_out[0])
+    assert err_py[0] == rpc.MSG_ERROR and err_py[1] == seq
+    assert err_py[3] == rpc.STALE_EPOCH_ERROR
+    # Byte-identical rejection and execution across implementations.
+    assert py_out[0] == nat_out[0], (
+        f"python={py_out[0]!r} native={nat_out[0]!r}")
+    assert py_out[1] == nat_out[1], (
+        f"python={py_out[1]!r} native={nat_out[1]!r}")
+    assert rpc.unpack(py_out[1]) == [
+        rpc.MSG_RESPONSE, seq + 1, "CreateActor",
+        {"ok": True, "_epoch": live_epoch}]
 
 
 @pytest.mark.skipif(not _native_available(),

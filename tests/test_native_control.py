@@ -263,6 +263,294 @@ def test_replay_dedup_across_session(tmp_path, monkeypatch):
     run(main())
 
 
+async def _fake_raylet_ex(host, port, node_id, on_create=None,
+                          handlers=None, reconnect_register=False):
+    """Configurable fake raylet: custom CreateActor behavior, extra
+    handlers (e.g. Drain), and optional re-registration on session
+    reconnect (what the real raylet's _gcs_handshake does)."""
+    created = asyncio.Event()
+    create_payloads = []
+
+    def default_create(conn, payload):
+        create_payloads.append(payload)
+        created.set()
+        return {"ok": True}
+
+    table = {"CreateActor": on_create or default_create}
+    table.update(handlers or {})
+    reg_payload = {
+        "host": "127.0.0.1", "node_id": node_id, "raylet_port": 47001,
+        "total_resources": {"CPU": 4.0}}
+
+    async def _handshake(conn):
+        r = await conn.call("RegisterNode", reg_payload, timeout=10)
+        assert r["ok"]
+
+    sess = await rpc.connect_session(
+        host, port, handlers=table, name=f"fake-raylet-{node_id[:4]}",
+        on_reconnect=_handshake if reconnect_register else None)
+    r = await sess.call("RegisterNode", reg_payload)
+    assert r["ok"]
+    return sess, created, create_payloads
+
+
+def test_create_replay_across_netchaos_flap(tmp_path, monkeypatch):
+    """NetChaos flap mid-flight on a native CreateActor: the raylet
+    executes the create but its reply is eaten, the link dies, the
+    session rebinds and re-registers — the plane resends the SAME
+    (sid, rseq) frame and the raylet's reply cache answers it. Exactly
+    one actor, exactly one CreateActor execution."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+    from ray_tpu.test_utils import NetChaos
+
+    async def main():
+        gcs = GcsServer(persistence_path=str(tmp_path / "gcs_state"))
+        host, port = await gcs.start()
+        chaos = NetChaos(seed=7).start()
+        try:
+            phost, pport = chaos.link("gcs", host, port)
+            loop = asyncio.get_event_loop()
+            executions = []
+
+            def on_create(conn, payload):
+                executions.append(payload)
+                if len(executions) == 1:
+                    # Eat the reply, then drop the link shortly after so
+                    # the session redials and the plane replays the
+                    # frame over the rebound connection.
+                    chaos.partition("gcs")
+
+                    def _flap():
+                        chaos.heal("gcs")
+                        chaos.cut("gcs")
+                    loop.call_later(0.3, _flap)
+                return {"ok": True}
+
+            raylet, _, _ = await _fake_raylet_ex(
+                phost, pport, NODE_ID, on_create=on_create,
+                reconnect_register=True)
+            driver = await rpc.connect_session(host, port, name="driver")
+            r = await driver.call("RegisterActor", {
+                "actor_id": "flap-a1", "spec": b"\x05s",
+                "max_restarts": 0, "class_name": "Flap"})
+            assert r["ok"]
+
+            # The flap promotes the node to SUSPECT, the rebind restores
+            # it, and the replayed CreateActor is answered from the
+            # raylet's reply cache — never executed twice.
+            await _wait_for(
+                lambda: gcs.nodes[NODE_ID].suspect_recoveries >= 1,
+                timeout=20, what="suspect recovery")
+            await asyncio.sleep(0.5)  # window for a wrong re-execution
+            assert len(executions) == 1, \
+                f"CreateActor forked: {len(executions)} executions"
+            assert gcs._actor_plane.actor_count() == 1
+
+            await raylet.call("ActorReady", {
+                "actor_id": "flap-a1", "address": ["127.0.0.1", 47002]})
+            await _wait_for(
+                lambda: gcs.actors["flap-a1"]["state"] == ACTOR_ALIVE,
+                what="actor ALIVE after flap")
+            await driver.close()
+            await raylet.close()
+        finally:
+            chaos.stop()
+            await gcs.stop()
+
+    run(main())
+
+
+def test_node_killed_mid_ladder_fails_over(tmp_path, monkeypatch):
+    """The CreateActor target dies mid-ladder (no reply ever): on the
+    death certificate the plane fails the create over to the surviving
+    node — one restart consumed, no fork, no lost actor."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+    node_a, node_b = "bb" * 16, "cc" * 16
+
+    async def main():
+        gcs = GcsServer(persistence_path=str(tmp_path / "gcs_state"))
+        host, port = await gcs.start()
+        try:
+            a_creates, b_creates = [], []
+            got_create = asyncio.Event()
+
+            async def a_create(conn, payload):
+                a_creates.append(payload)
+                got_create.set()
+                await asyncio.Event().wait()  # never replies: dies first
+
+            def b_create(conn, payload):
+                b_creates.append(payload)
+                got_create.set()
+                return {"ok": True}
+
+            ra, _, _ = await _fake_raylet_ex(host, port, node_a,
+                                             on_create=a_create)
+            rb, _, _ = await _fake_raylet_ex(host, port, node_b,
+                                             on_create=b_create)
+            driver = await rpc.connect_session(host, port, name="driver")
+            r = await driver.call("RegisterActor", {
+                "actor_id": "kill-a1", "spec": b"\x06s",
+                "max_restarts": 1, "class_name": "Kill"})
+            assert r["ok"]
+            await asyncio.wait_for(got_create.wait(), 10)
+            first = node_a if a_creates else node_b
+            survivor_sess = rb if first == node_a else ra
+            survivor_creates = b_creates if first == node_a else a_creates
+            got_create.clear()
+
+            # Death certificate for the in-flight target: the plane
+            # fails over (restart bookkeeping) and re-drives the ladder
+            # at the survivor.
+            await driver.call("NotifyNodeDead", {"node_id": first})
+            await asyncio.wait_for(got_create.wait(), 10)
+            assert len(survivor_creates) == 1
+            assert survivor_creates[0]["actor_id"] == "kill-a1"
+
+            await survivor_sess.call("ActorReady", {
+                "actor_id": "kill-a1",
+                "address": ["127.0.0.1", 47003]})
+            await _wait_for(
+                lambda: gcs.actors["kill-a1"]["state"] == ACTOR_ALIVE,
+                what="actor ALIVE on survivor")
+            assert gcs.actors["kill-a1"]["node_id"] != first
+            assert gcs.actors["kill-a1"]["restarts"] == 1
+            assert gcs._actor_plane.actor_count() == 1
+
+            await driver.close()
+            for s in (ra, rb):
+                try:
+                    await s.close()
+                except Exception:
+                    pass
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_draining_node_excluded_from_native_picks(tmp_path, monkeypatch):
+    """Satellite of tests/test_drain.py drain-rejection: once a node is
+    DRAINING, the native ladder must stop picking it — every new native
+    create lands on the other node."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+    node_a, node_b = "dd" * 16, "ee" * 16
+
+    async def main():
+        gcs = GcsServer(persistence_path=str(tmp_path / "gcs_state"))
+        host, port = await gcs.start()
+        try:
+            a_creates, b_creates = [], []
+
+            def mk_create(sink):
+                def h(conn, payload):
+                    sink.append(payload)
+                    return {"ok": True}
+                return h
+
+            def drain_ok(conn, payload):
+                return {"ok": True}
+
+            ra, _, _ = await _fake_raylet_ex(
+                host, port, node_a, on_create=mk_create(a_creates),
+                handlers={"Drain": drain_ok})
+            rb, _, _ = await _fake_raylet_ex(
+                host, port, node_b, on_create=mk_create(b_creates),
+                handlers={"Drain": drain_ok})
+            driver = await rpc.connect_session(host, port, name="driver")
+
+            r = await driver.call("DrainNode", {
+                "node_id": node_a, "reason": "manual",
+                "deadline_s": 30.0})
+            assert r["ok"], r
+
+            for i in range(4):
+                r = await driver.call("RegisterActor", {
+                    "actor_id": f"drain-a{i}", "spec": b"\x07s",
+                    "max_restarts": 0, "class_name": "D"})
+                assert r["ok"]
+            await _wait_for(lambda: len(b_creates) == 4, timeout=10,
+                            what="creates on the non-draining node")
+            assert not a_creates, \
+                "native ladder picked a DRAINING node"
+
+            await driver.close()
+            await ra.close()
+            await rb.close()
+        finally:
+            await gcs.stop()
+
+    run(main())
+
+
+def test_gcs_restart_rehydrates_native_plane(tmp_path, monkeypatch):
+    """Crash rehydration: a restarted GCS replays the persisted node
+    and actor tables into a fresh native plane — the ALIVE actor is
+    ALIVE natively, the in-flight PENDING one is re-driven (exactly one
+    CreateActor) when its node re-registers."""
+    monkeypatch.setenv("RAY_TPU_NATIVE_CONTROL", "1")
+    path = str(tmp_path / "gcs_state")
+
+    async def phase1():
+        gcs = GcsServer(persistence_path=path)
+        host, port = await gcs.start()
+        try:
+            raylet, created, payloads = await _fake_raylet(host, port)
+            driver = await rpc.connect_session(host, port, name="driver")
+            assert (await driver.call("RegisterActor", {
+                "actor_id": "re-alive", "spec": b"\x08alive",
+                "max_restarts": 0}))["ok"]
+            await asyncio.wait_for(created.wait(), 10)
+            await raylet.call("ActorReady", {
+                "actor_id": "re-alive", "address": ["127.0.0.1", 47002]})
+            await _wait_for(
+                lambda: gcs.actors["re-alive"]["state"] == ACTOR_ALIVE,
+                what="actor ALIVE pre-restart")
+            # Second actor: created at the raylet but NEVER ActorReady —
+            # in-flight at "crash" time, restored as PENDING.
+            assert (await driver.call("RegisterActor", {
+                "actor_id": "re-pending", "spec": b"\x09pend",
+                "max_restarts": 0}))["ok"]
+            await _wait_for(lambda: len(payloads) >= 2,
+                            what="second CreateActor")
+            await driver.close()
+            await raylet.close()
+        finally:
+            await gcs.stop()  # final flush + compact
+
+    async def phase2():
+        gcs = GcsServer(persistence_path=path)
+        host, port = await gcs.start()
+        try:
+            plane = gcs._actor_plane
+            assert plane is not None
+            # Rehydrated straight from the snapshot, before any node
+            # re-registered.
+            assert plane.actor_state("re-alive") == "ALIVE"
+            assert plane.actor_state("re-pending") == "PENDING"
+            assert plane.actor_count() == 2
+
+            raylet, created, payloads = await _fake_raylet(host, port)
+            # Node re-registration re-drives ONLY the pending ladder.
+            await asyncio.wait_for(created.wait(), 10)
+            await asyncio.sleep(0.3)
+            assert [p["actor_id"] for p in payloads] == ["re-pending"]
+            assert payloads[0]["spec"] == b"\x09pend"
+            await raylet.call("ActorReady", {
+                "actor_id": "re-pending",
+                "address": ["127.0.0.1", 47005]})
+            await _wait_for(
+                lambda: gcs.actors["re-pending"]["state"] == ACTOR_ALIVE,
+                what="re-driven actor ALIVE")
+            assert gcs.actors["re-alive"]["state"] == ACTOR_ALIVE
+            await raylet.close()
+        finally:
+            await gcs.stop()
+
+    run(phase1())
+    run(phase2())
+
+
 def test_full_stack_native_control(monkeypatch):
     """ray_tpu.init under RAY_TPU_NATIVE_CONTROL=1: tasks and actors
     (plain + named) behave exactly as under the Python control plane,
