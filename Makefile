@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: lint contract test native gen gen-check soak-smoke
+.PHONY: lint contract test native gen gen-check soak-smoke scale-smoke
 
 # graftlint + graftwire gate: per-file rules R1-R6 and the whole-program
 # wire pass W1-W5 over the whole package, plus the graftgen G1 pass
@@ -40,3 +40,15 @@ soak-smoke:
 	RAY_TPU_SOAK_N=40 RAY_TPU_SOAK_TASK_S=0.5 RAY_TPU_SOAK_FLAPS=1 \
 	RAY_TPU_SOAK_FLOOR=2000 RAY_TPU_BENCH_SOAK_ARTIFACT=0 \
 	$(PYTHON) bench.py --control-soak
+
+# Tier-1-safe wide-cluster chaos certification (ISSUE 20) at smoke
+# scale: 16 sim nodes / 2 tenants, flaps + spot kills + one mid-run
+# GCS restart, artifact write gated off. The full-scale gate is
+# `python bench.py --scale-chaos` with the default env (256 nodes,
+# 4 tenants) and writes BENCH_SCALE_CHAOS.json.
+scale-smoke:
+	JAX_PLATFORMS=cpu RAY_TPU_JAX_PLATFORM=cpu RAY_TPU_BENCH_CHILD=1 \
+	RAY_TPU_SCALE_NODES=16 RAY_TPU_SCALE_TENANTS=2 RAY_TPU_SCALE_N=30 \
+	RAY_TPU_SCALE_BACKLOG=1500 RAY_TPU_SCALE_LEASES=600 \
+	RAY_TPU_BENCH_SCALE_ARTIFACT=0 \
+	$(PYTHON) bench.py --scale-chaos

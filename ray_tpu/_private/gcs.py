@@ -28,7 +28,7 @@ import os
 import threading
 import time
 import uuid
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 
 from ray_tpu._private import rpc
 from ray_tpu._private.common import (  # noqa: F401
@@ -114,6 +114,106 @@ class _NativeServiceStack:
             self._svc = None
 
 
+# Per-subscriber fanout queue bound. State channels coalesce
+# latest-wins per entity, so depth only grows with DISTINCT entities in
+# flight; LOGS (no coalesce key) drops oldest past the bound, counted.
+_FANOUT_DEPTH = 256
+
+
+def _fanout_key(channel: str, message):
+    """Coalescing key for the bounded per-subscriber fanout queues.
+
+    State channels (NODE/ACTOR/PG/JOB) are level-triggered — subscribers
+    react to the LATEST state of an entity, not to every edge — so a
+    queue backed up behind a slow subscriber keeps one pending message
+    per entity (latest wins). Returns None for channels whose every
+    message matters (LOGS) or unrecognized shapes: never coalesced,
+    bounded by drop-oldest instead."""
+    if not isinstance(message, dict):
+        return None
+    if channel == "NODE":
+        nid = message.get("node_id") or \
+            (message.get("node") or {}).get("node_id")
+        return ("node", nid) if nid else None
+    if channel == "ACTOR":
+        aid = message.get("actor_id")
+        return ("actor", aid) if aid else None
+    if channel == "PG":
+        pid = message.get("pg_id")
+        return ("pg", pid) if pid else None
+    if channel == "JOB":
+        jid = message.get("job_id")
+        return ("job", jid) if jid else None
+    return None
+
+
+class _SubscriberPump:
+    """One supervised sender per subscriber connection (Python fanout
+    path). publish() enqueues into the bounded coalescing queue and
+    returns immediately; this task alone awaits the subscriber's
+    (possibly stalled) socket, so one dead-slow subscriber can no
+    longer head-of-line block delivery to every other subscriber on
+    the channel. The queue is shared across channels — sends to one
+    conn stay ordered."""
+
+    def __init__(self, conn, stats: dict):
+        self.conn = conn
+        self.stats = stats
+        self._q: OrderedDict = OrderedDict()
+        self._seq = 0
+        self._wake = asyncio.Event()
+        self.closed = False
+        self._task = supervised_task(self._run(), name="gcs-fanout")
+
+    def push(self, channel: str, message) -> None:
+        if self.closed:
+            return
+        key = _fanout_key(channel, message)
+        if key is not None:
+            if key in self._q:
+                # Re-insert at the tail: the stale pending state for
+                # this entity is superseded, ordering follows the
+                # newest write.
+                del self._q[key]
+                self.stats["coalesced"] += 1
+        else:
+            self._seq += 1
+            key = ("#", self._seq)
+        self._q[key] = (channel, message)
+        while len(self._q) > _FANOUT_DEPTH:
+            self._q.popitem(last=False)
+            self.stats["dropped"] += 1
+        self.stats["enqueued"] += 1
+        if len(self._q) > self.stats["max_depth"]:
+            self.stats["max_depth"] = len(self._q)
+        self._wake.set()
+
+    def close(self) -> None:
+        self.closed = True
+        self._q.clear()
+        self._wake.set()
+
+    async def _run(self) -> None:
+        while True:
+            await self._wake.wait()
+            self._wake.clear()
+            if self.closed:
+                return
+            batch = 0
+            while self._q:
+                _, (channel, message) = self._q.popitem(last=False)
+                try:
+                    await self.conn.notify(
+                        "Publish", {"channel": channel, "message": message})
+                except Exception:
+                    self.close()
+                    return
+                batch += 1
+                self.stats["sent"] += 1
+            if batch:
+                self.stats["batches"] += 1
+
+
 class GcsServer:
     def __init__(self, config: Config | None = None,
                  persistence_path: str | None = None):
@@ -167,6 +267,23 @@ class GcsServer:
         self._relocation_order: deque = deque()
         self._relocation_cap = 65536
         self.subscribers: dict[str, set[rpc.Connection]] = defaultdict(set)
+        # Python-fallback fanout: one _SubscriberPump per subscriber
+        # conn + shared counters (also fed by the native fanout path's
+        # batch counter). Surfaced in GetClusterStatus -> status CLI +
+        # /metrics.
+        self._fanout_pumps: dict = {}
+        self._fanout_stats = {"enqueued": 0, "sent": 0, "coalesced": 0,
+                              "dropped": 0, "batches": 0, "max_depth": 0,
+                              "native_batches": 0}
+        # Streaming recovery (issue 20): True while a restarted GCS is
+        # still rehydrating persisted state in the background; flips
+        # False when the recovery stream drains. Grants and answers
+        # begin within the bounded priority prefix, not after the full
+        # table replay.
+        self.recovering = False
+        self._recovery_backlog: deque = deque()
+        self._recovery_stats = {"prefix_rows": 0, "streamed_rows": 0,
+                                "prefix_ms": 0.0, "stream_ms": 0.0}
         # Native-pump server when available (src/fastpath.cc): accept,
         # framing, and sends ride the C++ epoll thread; table mutations
         # stay Python above the loop (reference: gcs_server.h:79 runs on
@@ -324,6 +441,11 @@ class GcsServer:
                                                  name="gcs-persist-loop")
             self._aux_tasks.append(supervised_task(
                 self._reap_restored_nodes(), name="gcs-reap-restored"))
+            if self.recovering:
+                # The priority prefix is live; the rest of the persisted
+                # state rehydrates behind the serving path.
+                self._aux_tasks.append(supervised_task(
+                    self._recovery_stream(), name="gcs-recovery-stream"))
         logger.info("GCS listening on %s:%s", *addr)
         return addr
 
@@ -404,7 +526,7 @@ class GcsServer:
             plane.set_epoch(rpc._server_sessions.epoch)
             for nid, node in self.nodes.items():
                 plane.restore_node(nid, _plane_node_state(node.state))
-            for aid, a in self.actors.items():
+            for aid, a in self._iter_restorable_actors():
                 if not a.get("native"):
                     continue
                 if a["state"] == ACTOR_ALIVE:
@@ -438,6 +560,17 @@ class GcsServer:
                     logger.exception("native actor plane close failed")
             self._rekick_deferred_native_actors()
             return None
+
+    def _iter_restorable_actors(self):
+        """Actor rows for the plane's pre-install rehydration: the
+        prefix-applied tables plus rows still staged on the recovery
+        backlog (decoded at load time). The plane must see the full
+        replayed world before install(); the Python mirror of the
+        backlog rows catches up via _recovery_stream."""
+        yield from self.actors.items()
+        for table, key_hex, _blob, row in self._recovery_backlog:
+            if table == "actors" and row is not None:
+                yield bytes.fromhex(key_hex).decode(), row
 
     def _rekick_deferred_native_actors(self) -> None:
         """_load_state deferred these re-kicks to the plane's
@@ -581,6 +714,9 @@ class GcsServer:
     async def stop(self):
         self._native_svc = None  # server stop destroys the service stack
         self._actor_plane = None
+        for pump in list(self._fanout_pumps.values()):
+            pump.close()
+        self._fanout_pumps.clear()
         if self._health_task:
             self._health_task.cancel()
         if getattr(self, "_persist_task", None):
@@ -769,6 +905,20 @@ class GcsServer:
             return touched
 
     def _load_state(self):
+        """Restore the PRIORITY PREFIX of persisted state synchronously
+        — the bounded set a restarted control plane needs to answer and
+        grant correctly from its first frame — and stage everything
+        else on `_recovery_backlog` for the background recovery stream
+        (issue 20: recovery is a stream, not a snapshot).
+
+        Prefix, in priority order: every node row with live nodes
+        first (placement and heartbeat replies need the full width
+        view — bounded by cluster size, not workload), then in-flight
+        actor creations (PENDING/RESTARTING rows, whose re-kicks must
+        not be lost). The rest — the workload-proportional bulk:
+        settled actors, named-actor index, jobs, placement groups —
+        rehydrates incrementally in _recovery_stream; reads that race
+        the stream fault their rows in via _recovery_faultin."""
         if self._store.num_rows() == 0:
             # A file AT the bare prefix is the pre-WAL single-snapshot
             # format (replaced this round); it is not migrated — surface
@@ -779,6 +929,7 @@ class GcsServer:
                     "store does not migrate it — starting fresh",
                     self.persistence_path)
             return  # first start of this session
+        t0 = time.monotonic()
         native_kv = self._native_kv_planned()
         for key_hex, blob in self._store.scan("kv"):
             if native_kv:
@@ -790,37 +941,12 @@ class GcsServer:
             else:
                 self._restore_kv_row(key_hex, blob)
             self._persisted_bytes += len(blob)
-        for key_hex, blob in self._store.scan("actors"):
-            a = rpc.unpack(blob)
-            a["dead_worker_ids"] = set(a.get("dead_worker_ids", ()))
-            self.actors[bytes.fromhex(key_hex).decode()] = a
-            self._row_hashes[("actors", key_hex)] = hash(blob)
-            self._row_sizes[("actors", key_hex)] = len(blob)
-            self._persisted_bytes += len(blob)
-        for key_hex, blob in self._store.scan("named_actors"):
-            self.named_actors[tuple(rpc.unpack(bytes.fromhex(key_hex)))] = \
-                rpc.unpack(blob)
-            self._row_hashes[("named_actors", key_hex)] = hash(blob)
-            self._row_sizes[("named_actors", key_hex)] = len(blob)
-            self._persisted_bytes += len(blob)
-        for key_hex, blob in self._store.scan("jobs"):
-            self.jobs[bytes.fromhex(key_hex).decode()] = rpc.unpack(blob)
-            self._row_hashes[("jobs", key_hex)] = hash(blob)
-            self._row_sizes[("jobs", key_hex)] = len(blob)
-            self._persisted_bytes += len(blob)
-        for key_hex, blob in self._store.scan("placement_groups"):
-            self.placement_groups[bytes.fromhex(key_hex).decode()] = \
-                rpc.unpack(blob)
-            self._row_hashes[("placement_groups", key_hex)] = hash(blob)
-            self._row_sizes[("placement_groups", key_hex)] = len(blob)
-            self._persisted_bytes += len(blob)
-        snap_nodes = []
-        for key_hex, blob in self._store.scan("nodes"):
-            snap_nodes.append(rpc.unpack(blob))
-            self._row_hashes[("nodes", key_hex)] = hash(blob)
-            self._row_sizes[("nodes", key_hex)] = len(blob)
-            self._persisted_bytes += len(blob)
-        for w in snap_nodes:
+        # Priority 1: the node table, live rungs first.
+        node_rows = [(key_hex, blob, rpc.unpack(blob))
+                     for key_hex, blob in self._store.scan("nodes")]
+        node_rows.sort(key=lambda r: 0 if r[2].get("state", "ALIVE") in (
+            NODE_ALIVE, NODE_SUSPECT, NODE_DRAINING) else 1)
+        for key_hex, blob, w in node_rows:
             info = NodeInfo(
                 node_id=w["node_id"], host=w["host"],
                 raylet_port=w["raylet_port"],
@@ -837,31 +963,124 @@ class GcsServer:
             # entries would mislead placement.
             info.alive = False
             self.nodes[info.node_id] = info
+            self._row_hashes[("nodes", key_hex)] = hash(blob)
+            self._row_sizes[("nodes", key_hex)] = len(blob)
+            self._persisted_bytes += len(blob)
         self._restored_unregistered = {
             nid for nid, n in self.nodes.items() if not n.alive}
-        # Re-kick scheduling that died with the previous process.
-        # Native-owned actors are deferred: the plane's rehydration
-        # (restore_actor + re-drive on node re-registration) replays
-        # them with at-most-once semantics; a Python re-kick here would
-        # race it and fork the creation. If the plane then fails to
-        # install, _rekick_deferred_native_actors hands them back.
+        # Priority 2: in-flight actor creations. Re-kick scheduling that
+        # died with the previous process. Native-owned actors are
+        # deferred: the plane's rehydration (restore_actor + re-drive on
+        # node re-registration) replays them with at-most-once
+        # semantics; a Python re-kick here would race it and fork the
+        # creation. If the plane then fails to install,
+        # _rekick_deferred_native_actors hands them back.
         native_planned = self._native_actor_planned()
-        for aid, a in self.actors.items():
-            if a["state"] in (ACTOR_PENDING, ACTOR_RESTARTING):
-                if native_planned and a.get("native"):
-                    self._native_rekick_deferred.append(aid)
-                    continue
-                asyncio.get_event_loop().call_later(
-                    1.0, lambda aid=aid: supervised_task(
-                        self._schedule_actor(aid)))
-        for pg_id, pg in self.placement_groups.items():
+        backlog: deque = deque()
+        prefix_rows = len(node_rows)
+        for key_hex, blob in self._store.scan("actors"):
+            a = rpc.unpack(blob)
+            a["dead_worker_ids"] = set(a.get("dead_worker_ids", ()))
+            if a["state"] not in (ACTOR_PENDING, ACTOR_RESTARTING):
+                backlog.append(("actors", key_hex, blob, a))
+                continue
+            aid = bytes.fromhex(key_hex).decode()
+            self.actors[aid] = a
+            self._row_hashes[("actors", key_hex)] = hash(blob)
+            self._row_sizes[("actors", key_hex)] = len(blob)
+            self._persisted_bytes += len(blob)
+            prefix_rows += 1
+            if native_planned and a.get("native"):
+                self._native_rekick_deferred.append(aid)
+                continue
+            asyncio.get_event_loop().call_later(
+                1.0, lambda aid=aid: supervised_task(
+                    self._schedule_actor(aid)))
+        # The rest rides the stream (PG_PENDING re-kicks fire as their
+        # rows apply).
+        for table in ("named_actors", "jobs", "placement_groups"):
+            for key_hex, blob in self._store.scan(table):
+                backlog.append((table, key_hex, blob, None))
+        self._recovery_backlog = backlog
+        self.recovering = bool(backlog)
+        self._recovery_stats["prefix_rows"] = prefix_rows
+        self._recovery_stats["prefix_ms"] = (time.monotonic() - t0) * 1e3
+        logger.info("GCS recovery prefix loaded from %s in %.1fms "
+                    "(%d nodes, %d pending actors, %d kv ns; %d rows "
+                    "streaming)", self.persistence_path,
+                    self._recovery_stats["prefix_ms"], len(self.nodes),
+                    len(self.actors), len(self.kv), len(backlog))
+
+    def _apply_recovery_row(self, table, key_hex, blob, row) -> None:
+        """Apply one backlog row to the live tables. A key the running
+        workload already (re)created wins over the snapshot — the
+        stream only fills gaps, it never rolls live state back."""
+        if table == "actors":
+            aid = bytes.fromhex(key_hex).decode()
+            if aid in self.actors:
+                return
+            self.actors[aid] = row
+        elif table == "named_actors":
+            key = tuple(rpc.unpack(bytes.fromhex(key_hex)))
+            if key in self.named_actors:
+                return
+            self.named_actors[key] = rpc.unpack(blob)
+        elif table == "jobs":
+            jid = bytes.fromhex(key_hex).decode()
+            if jid in self.jobs:
+                return
+            self.jobs[jid] = rpc.unpack(blob)
+        elif table == "placement_groups":
+            pid = bytes.fromhex(key_hex).decode()
+            if pid in self.placement_groups:
+                return
+            pg = rpc.unpack(blob)
+            self.placement_groups[pid] = pg
             if pg["state"] == PG_PENDING:
                 asyncio.get_event_loop().call_later(
-                    1.0, lambda p=pg_id: supervised_task(
+                    1.0, lambda p=pid: supervised_task(
                         self._schedule_pg(p)))
-        logger.info("GCS state restored from %s (%d actors, %d kv ns, "
-                    "%d nodes)", self.persistence_path, len(self.actors),
-                    len(self.kv), len(self.nodes))
+        self._row_hashes[(table, key_hex)] = hash(blob)
+        self._row_sizes[(table, key_hex)] = len(blob)
+        self._persisted_bytes += len(blob)
+
+    async def _recovery_stream(self):
+        """Drain the recovery backlog incrementally, yielding to the
+        loop between chunks so answering and granting never wait on the
+        full-table replay. Flips `recovering` off when dry."""
+        t0 = time.monotonic()
+        applied = 0
+        try:
+            while self._recovery_backlog:
+                self._apply_recovery_row(*self._recovery_backlog.popleft())
+                applied += 1
+                if applied % 256 == 0:
+                    await asyncio.sleep(0)
+        finally:
+            self.recovering = False
+            self._recovery_stats["streamed_rows"] += applied
+            self._recovery_stats["stream_ms"] = \
+                (time.monotonic() - t0) * 1e3
+            logger.info("GCS recovery stream drained (%d rows in %.1fms)",
+                        applied, self._recovery_stats["stream_ms"])
+
+    def _recovery_faultin(self, pred) -> None:
+        """Synchronously apply (and drop) backlog rows matching pred —
+        the read-through for lookups racing the recovery stream. O(n)
+        over the remaining backlog, only while `recovering`."""
+        if not self.recovering or not self._recovery_backlog:
+            return
+        keep: deque = deque()
+        faulted = 0
+        while self._recovery_backlog:
+            item = self._recovery_backlog.popleft()
+            if pred(item):
+                self._apply_recovery_row(*item)
+                faulted += 1
+            else:
+                keep.append(item)
+        self._recovery_backlog = keep
+        self._recovery_stats["streamed_rows"] += faulted
 
     async def _reap_restored_nodes(self):
         """Nodes restored from the snapshot that never re-registered are
@@ -976,15 +1195,32 @@ class GcsServer:
                 self._native_svc.fanout(channel, rpc.pack(
                     [rpc.MSG_NOTIFY, 0, "Publish",
                      {"channel": channel, "message": message}]))
+                self._fanout_stats["native_batches"] += 1
             return
+        # Python fallback: enqueue-and-return into per-subscriber
+        # supervised sender pumps. publish() itself never awaits a
+        # subscriber socket — a stalled conn backs up only its own
+        # bounded queue (coalesced latest-wins per entity on state
+        # channels, drop-oldest-counted otherwise).
         dead = []
         for conn in list(self.subscribers.get(channel, ())):
-            try:
-                await conn.notify("Publish", {"channel": channel, "message": message})
-            except Exception:
+            if getattr(conn, "closed", False):
                 dead.append(conn)
+                continue
+            pump = self._fanout_pumps.get(conn)
+            if pump is None or pump.closed:
+                pump = _SubscriberPump(conn, self._fanout_stats)
+                self._fanout_pumps[conn] = pump
+                conn.on_close(lambda c=conn: self._drop_fanout_pump(c))
+            pump.push(channel, message)
         for conn in dead:
             self.subscribers[channel].discard(conn)
+            self._drop_fanout_pump(conn)
+
+    def _drop_fanout_pump(self, conn) -> None:
+        pump = self._fanout_pumps.pop(conn, None)
+        if pump is not None:
+            pump.close()
 
     # ---------- nodes ----------
 
@@ -1828,6 +2064,10 @@ class GcsServer:
 
     async def handle_get_actor_info(self, conn, payload):
         require_fields(payload, "actor_id", method="handle_get_actor_info")
+        if self.recovering and payload["actor_id"] not in self.actors:
+            aid_hex = payload["actor_id"].encode().hex()
+            self._recovery_faultin(
+                lambda it: it[0] == "actors" and it[1] == aid_hex)
         a = self.actors.get(payload["actor_id"])
         if a is None:
             return {"found": False}
@@ -1838,6 +2078,15 @@ class GcsServer:
     async def handle_get_named_actor(self, conn, payload):
         require_fields(payload, "name", method="handle_get_named_actor")
         key = (payload.get("namespace") or "default", payload["name"])
+        if self.recovering and key not in self.named_actors:
+            # The name index and its target row may both still be on
+            # the stream: fault in the index, then the actor it names.
+            self._recovery_faultin(lambda it: it[0] == "named_actors")
+            target = self.named_actors.get(key)
+            if target is not None and target not in self.actors:
+                t_hex = target.encode().hex()
+                self._recovery_faultin(
+                    lambda it: it[0] == "actors" and it[1] == t_hex)
         actor_id = self.named_actors.get(key)
         if actor_id is None or actor_id not in self.actors:
             return {"found": False}
@@ -1847,6 +2096,8 @@ class GcsServer:
                 if isinstance(a["spec"], dict) else None}
 
     async def handle_list_actors(self, conn, payload):
+        if self.recovering:
+            self._recovery_faultin(lambda it: it[0] == "actors")
         return {"actors": [
             {k: a[k] for k in ("actor_id", "job_id", "name", "namespace", "class_name",
                                "state", "node_id", "restarts", "resources")}
@@ -1855,6 +2106,10 @@ class GcsServer:
     async def handle_kill_actor(self, conn, payload):
         require_fields(payload, "actor_id", method="handle_kill_actor")
         actor_id = payload["actor_id"]
+        if self.recovering and actor_id not in self.actors:
+            aid_hex = actor_id.encode().hex()
+            self._recovery_faultin(
+                lambda it: it[0] == "actors" and it[1] == aid_hex)
         a = self.actors.get(actor_id)
         if a is None:
             return {"ok": False}
@@ -1910,6 +2165,10 @@ class GcsServer:
 
     async def handle_finish_job(self, conn, payload):
         require_fields(payload, "job_id", method="handle_finish_job")
+        if self.recovering and payload["job_id"] not in self.jobs:
+            jid_hex = payload["job_id"].encode().hex()
+            self._recovery_faultin(
+                lambda it: it[0] == "jobs" and it[1] == jid_hex)
         job = self.jobs.get(payload["job_id"])
         if job:
             job["status"] = payload.get("status", "SUCCEEDED")
@@ -1923,6 +2182,8 @@ class GcsServer:
         return {"ok": True}
 
     async def handle_list_jobs(self, conn, payload):
+        if self.recovering:
+            self._recovery_faultin(lambda it: it[0] == "jobs")
         return {"jobs": list(self.jobs.values())}
 
     # ---------- placement groups ----------
@@ -2059,8 +2320,15 @@ class GcsServer:
                 return None
         return placement
 
+    def _faultin_pg(self, pg_id: str) -> None:
+        if self.recovering and pg_id not in self.placement_groups:
+            pid_hex = pg_id.encode().hex()
+            self._recovery_faultin(
+                lambda it: it[0] == "placement_groups" and it[1] == pid_hex)
+
     async def handle_remove_pg(self, conn, payload):
         require_fields(payload, "pg_id", method="handle_remove_pg")
+        self._faultin_pg(payload["pg_id"])
         pg = self.placement_groups.get(payload["pg_id"])
         if pg is None:
             return {"ok": False}
@@ -2086,6 +2354,7 @@ class GcsServer:
 
     async def handle_get_pg(self, conn, payload):
         require_fields(payload, "pg_id", method="handle_get_pg")
+        self._faultin_pg(payload["pg_id"])
         pg = self.placement_groups.get(payload["pg_id"])
         if pg is None:
             return {"found": False}
@@ -2095,6 +2364,8 @@ class GcsServer:
                 "strategy": pg["strategy"], "name": pg["name"]}
 
     async def handle_list_pgs(self, conn, payload):
+        if self.recovering:
+            self._recovery_faultin(lambda it: it[0] == "placement_groups")
         return {"placement_groups": [
             {"pg_id": pg["pg_id"], "name": pg["name"], "state": pg["state"],
              "strategy": pg["strategy"],
@@ -2154,6 +2425,10 @@ class GcsServer:
                                   if n.state == NODE_SUSPECT]),
             "rpc_sessions": rpc.session_stats(),
             "native_control": self._native_control_stats(),
+            "fanout": dict(self._fanout_stats),
+            "recovering": self.recovering,
+            "recovery": dict(self._recovery_stats,
+                             backlog_rows=len(self._recovery_backlog)),
         }
 
     def _native_control_stats(self):

@@ -93,17 +93,25 @@ class RayletResourceCore:
                     pg_id: str = "", bundle_index: int = -1) -> bool:
         """True if acquired (recorded under lease_id). False on no-fit
         AND on missing/uncommitted bundle (callers queue either way)."""
+        if not self._h:  # closed: refuse rather than deref a freed pool
+            return False
         return self._lib.rcore_try_acquire(
             self._h, lease_id.encode(), _enc(resources), pg_id.encode(),
             bundle_index) == 1
 
     def release(self, lease_id: str) -> None:
+        if not self._h:
+            return
         self._lib.rcore_release(self._h, lease_id.encode())
 
     def block(self, lease_id: str) -> bool:
+        if not self._h:
+            return False
         return self._lib.rcore_block(self._h, lease_id.encode()) == 1
 
     def unblock(self, lease_id: str) -> bool:
+        if not self._h:
+            return False
         return self._lib.rcore_unblock(self._h, lease_id.encode()) == 1
 
     def pg_prepare(self, pg_id: str, bundle_index: int,

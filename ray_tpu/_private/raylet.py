@@ -26,6 +26,7 @@ import asyncio
 import json
 import logging
 import os
+import random
 import signal
 import socket
 import subprocess
@@ -394,6 +395,16 @@ class Raylet:
         self._log_tails: dict[str, Raylet._LogTail] = {}
         self.idle_workers: deque[WorkerHandle] = deque()
         self.pending_leases: deque = _GateDeque(self._sync_lease_gate)
+        # Per-job fair share (issue 20): the pump visits queued leases
+        # round-robin across job ids (per-job FIFO within a lane), so one
+        # tenant's burst cannot starve peers queued behind it. The
+        # starvation counter records grants that sat queued past the
+        # threshold — 0 is the multi-tenant release-gate invariant.
+        self._lease_rr_last: str = ""
+        self._lease_starvation = 0
+        self._lease_grants_by_job: dict[str, int] = {}
+        self._starvation_threshold_s = float(
+            os.environ.get("RAY_TPU_LEASE_STARVATION_S", "5.0"))
         self.cluster_view: dict = {}
         self.gcs_conn: rpc.Connection | None = None
         # Native-pump server when available (src/fastpath.cc): the
@@ -821,6 +832,14 @@ class Raylet:
 
     async def _heartbeat_loop(self):
         period = min(0.2, self.config.health_check_period_s)
+        # Fixed intervals synchronize across the fleet into periodic
+        # heartbeat bursts (every raylet booted by the same autoscaler
+        # wave ticks in phase), which at 256-node width turns into GCS
+        # tick spikes. Seed per-node so the schedule is deterministic
+        # for a given node id: a randomized initial phase de-correlates
+        # boot waves, +-20% per-tick jitter keeps them de-correlated.
+        hb_rng = random.Random(f"hb:{self.node_id}")
+        await asyncio.sleep(hb_rng.uniform(0.0, period))
         while True:
             try:
                 now = time.monotonic()
@@ -868,7 +887,7 @@ class Raylet:
                 logger.debug("heartbeat deferred (%s); session redialing", e)
             except Exception:
                 logger.debug("heartbeat error", exc_info=True)
-            await asyncio.sleep(period)
+            await asyncio.sleep(period * hb_rng.uniform(0.8, 1.2))
 
     async def _gcs_handshake(self, conn):
         """Re-attach this raylet to the GCS over a fresh (or live) conn:
@@ -1637,6 +1656,7 @@ class Raylet:
         strategy = payload.get("strategy")
         pg_id = payload.get("placement_group", "")
         bundle_index = payload.get("pg_bundle_index", -1)
+        job_id = payload.get("job_id", "")
         if self.draining:
             spill = self._pick_spillback(resources)
             if spill:
@@ -1725,7 +1745,7 @@ class Raylet:
         # Queue until resources free up.
         fut = asyncio.get_running_loop().create_future()
         item = (resources, pg_id, bundle_index, fut, allow_spill,
-                received_at)
+                received_at, job_id)
         self.pending_leases.append(item)
         try:
             return await asyncio.wait_for(fut, self.config.worker_lease_timeout_s)
@@ -1811,8 +1831,27 @@ class Raylet:
         # wire round-trip; per-item reads would be O(queue depth) on the
         # hottest scheduling path), refreshed after successful acquires.
         avail = None
+        # Fair-share visit order: strict FIFO would hand every freed
+        # slot to the head-of-queue tenant, so a 100k-task burst starves
+        # the latency-sensitive job queued behind it. Interleave per-job
+        # FIFO lanes round-robin, rotated so the lane after the last
+        # job served goes first.
+        by_job: dict = {}
         for item in list(self.pending_leases):
-            resources, pg_id, bundle_index, fut, spillable, _received = item
+            by_job.setdefault(item[6], []).append(item)
+        jobs = sorted(by_job)
+        if self._lease_rr_last in by_job:
+            i = jobs.index(self._lease_rr_last)
+            jobs = jobs[i + 1:] + jobs[:i + 1]
+        lanes = [deque(by_job[j]) for j in jobs]
+        visit = []
+        while any(lanes):
+            for lane in lanes:
+                if lane:
+                    visit.append(lane.popleft())
+        for item in visit:
+            (resources, pg_id, bundle_index, fut, spillable, received,
+             job_id) = item
             if fut.done():
                 self.pending_leases.remove(item)
                 continue
@@ -1821,6 +1860,11 @@ class Raylet:
                 self.pending_leases.remove(item)
                 granted.append((lease_id, item))
                 avail = None
+                self._lease_rr_last = job_id
+                self._lease_grants_by_job[job_id] = \
+                    self._lease_grants_by_job.get(job_id, 0) + 1
+                if time.time() - received > self._starvation_threshold_s:
+                    self._lease_starvation += 1
                 continue
             if avail is None:
                 avail = self.available
@@ -1848,7 +1892,7 @@ class Raylet:
                     self.pending_leases.remove(item)
                     fut.set_result({"spillback": spill})
         for lease_id, (resources, pg_id, bundle_index, fut, _sp,
-                       received_at) in granted:
+                       received_at, _job) in granted:
             async def grant(lease_id=lease_id, resources=resources,
                             pg_id=pg_id, bundle_index=bundle_index, fut=fut,
                             received_at=received_at):
@@ -1889,7 +1933,7 @@ class Raylet:
                 # on failure; the raylet must not redirect actor creations.
                 self.pending_leases.append(
                     (resources, pg_id, bundle_index, fut, False,
-                     time.time()))
+                     time.time(), payload.get("job_id", "")))
                 try:
                     grant = await asyncio.wait_for(
                         fut, self.config.worker_lease_timeout_s)
@@ -2452,7 +2496,7 @@ class Raylet:
             # -- 1. queued leases ------------------------------------
             respilled = rejected = 0
             for item in list(self.pending_leases):
-                resources, _pg, _bi, fut, spillable, _received = item
+                resources, _pg, _bi, fut, spillable, _received, _job = item
                 try:
                     self.pending_leases.remove(item)
                 except ValueError:
@@ -2665,6 +2709,11 @@ class Raylet:
             "idle_workers": len(self.idle_workers),
             "pending_leases": len(self.pending_leases),
             "leases_granted": self._num_leases_granted,
+            "lease_fair_share": {
+                "jobs_queued": len({it[6] for it in self.pending_leases}),
+                "grants_by_job": dict(self._lease_grants_by_job),
+                "starvation": self._lease_starvation,
+            },
             "active_leases": self.rcore.num_leases(),
             "pg_bundles": self.rcore.num_bundles(),
             "store": self.store.stats() if self.store else {},

@@ -1939,6 +1939,9 @@ class CoreWorker:
                         "placement_group": spec.placement_group,
                         "pg_bundle_index": spec.pg_bundle_index,
                         "hops": _hop - 1,
+                        # Fair-share lane: the raylet round-robins queued
+                        # leases across job ids under contention.
+                        "job_id": self.job_id,
                     }, timeout=self.config.worker_lease_timeout_s + 10)
                 except (rpc.RpcError, asyncio.TimeoutError, OSError):
                     # The raylet we were negotiating with died (node failure

@@ -266,6 +266,20 @@ class _ChaosLink:
                       "frames_duplicated": 0, "frames_blackholed": 0}
 
 
+def scale_chaos_schedule(seed: int, n_flaps: int) -> dict:
+    """The scale-chaos gate's hostility, as a pure function of the
+    seed: flap (offset, duration) pairs and the two spot-kill offsets,
+    in wave-relative seconds. `bench.py --scale-chaos` records this in
+    its artifact so a certification run can be replayed from its JSON
+    alone."""
+    rng = random.Random(seed)
+    flaps = [(round(rng.uniform(0.05, 0.6), 3),
+              round(rng.uniform(0.2, 0.45), 3))
+             for _ in range(n_flaps)]
+    kills = [round(rng.uniform(0.1, 0.5), 3) for _ in range(2)]
+    return {"seed": seed, "flaps": flaps, "kills": kills}
+
+
 class NetChaos:
     """Seeded, deterministic network fault injector: a frame-aware TCP
     proxy interposed on the repo's length-prefixed msgpack RPC links.

@@ -591,6 +591,42 @@ def core_prometheus_text() -> str:
                 gauge(metric, help_, samples)
     except Exception:
         pass
+    # Pubsub fanout backpressure (issue 20): per-subscriber bounded
+    # coalescing queues on the GCS Python fallback path, plus the
+    # native path's batch count and the streaming-recovery flag.
+    try:
+        cs = _state.cluster_status()
+        fo = cs.get("fanout") or {}
+        for metric, key, help_ in (
+                ("ray_tpu_gcs_fanout_enqueued_total", "enqueued",
+                 "pubsub messages enqueued to subscriber send queues"),
+                ("ray_tpu_gcs_fanout_sent_total", "sent",
+                 "pubsub messages delivered by subscriber sender tasks"),
+                ("ray_tpu_gcs_fanout_coalesced_total", "coalesced",
+                 "queued state messages superseded latest-wins per "
+                 "entity before delivery"),
+                ("ray_tpu_gcs_fanout_dropped_total", "dropped",
+                 "messages dropped oldest-first past the per-subscriber "
+                 "queue bound"),
+                ("ray_tpu_gcs_fanout_batches_total", "batches",
+                 "sender drain cycles on the Python fanout path"),
+                ("ray_tpu_gcs_fanout_native_batches_total",
+                 "native_batches",
+                 "fanout batches handed to the native in-pump service"),
+                ("ray_tpu_gcs_fanout_queue_max_depth", "max_depth",
+                 "high-water mark of any subscriber send queue")):
+            if key in fo:
+                lines.append(f"# HELP {metric} {help_}")
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(f"{metric} {fo[key]}")
+        lines.append("# HELP ray_tpu_gcs_recovering 1 while a restarted "
+                     "GCS is still streaming persisted state in the "
+                     "background (answers/grants already flowing)")
+        lines.append("# TYPE ray_tpu_gcs_recovering gauge")
+        lines.append(
+            f"ray_tpu_gcs_recovering {1 if cs.get('recovering') else 0}")
+    except Exception:
+        pass
     try:
         actors = _state.summarize_actors()["by_state"]
         gauge("ray_tpu_actors", "actors by state",

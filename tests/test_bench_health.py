@@ -252,3 +252,66 @@ def test_bench_cli_control_soak_smoke():
     assert rec["value"] >= extra["cycles_floor"]
     assert extra["divergence_trips_total"] == 0
     assert extra["native_degraded_total"] == 0
+
+
+@pytest.mark.smoke
+def test_bench_cli_scale_chaos_smoke():
+    """`python bench.py --scale-chaos` (ISSUE 20) at `make scale-smoke`
+    scale: a 16-sim-node, 2-tenant hostile run with NetChaos flaps,
+    spot kills in both waves, and ONE mid-run GCS restart. The gate
+    itself exits non-zero on any violation; here we additionally pin
+    the certification envelope fields the artifact must carry."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_JAX_PLATFORM"] = "cpu"
+    env["RAY_TPU_BENCH_CHILD"] = "1"  # skip the probe ladder + re-exec
+    env["RAY_TPU_SCALE_NODES"] = "16"
+    env["RAY_TPU_SCALE_TENANTS"] = "2"
+    env["RAY_TPU_SCALE_N"] = "30"
+    env["RAY_TPU_SCALE_BACKLOG"] = "1500"
+    env["RAY_TPU_SCALE_LEASES"] = "600"
+    env["RAY_TPU_BENCH_SCALE_ARTIFACT"] = "0"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--scale-chaos"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "scale_chaos_lease_p99_ms"
+    extra = rec["extra"]
+    assert "error" not in extra, extra
+    assert extra["health"]["verdict"] in ("ok", "degraded")
+    assert extra["sim_nodes"] == 16 and extra["tenants"] == 2
+    assert extra["lost"] == 0 and extra["forked"] == 0
+    assert extra["suspect_recoveries"] >= 1
+    assert extra["spot_kills"] == 2
+    rec_recovery = extra["recovery"]
+    assert rec_recovery["recovering_observed"] and rec_recovery["recovered"]
+    assert rec_recovery["first_grant_ms"] < rec_recovery["full_replay_ms"]
+    assert rec_recovery["streamed_rows"] >= 1500
+    fairness = extra["fairness"]
+    assert fairness["starvation"] == 0
+    assert fairness["min_ratio"] >= 0.5
+    fanout = extra["fanout"]
+    assert fanout["sent"] + fanout["native_batches"] > 0
+    assert extra["divergence_trips_total"] == 0
+    # Seed reproducibility: the schedule in the artifact is exactly
+    # the pure function of the seed that test_utils exports, so a
+    # certification run can be replayed from its JSON alone.
+    from ray_tpu.test_utils import scale_chaos_schedule
+    sched = extra["chaos_schedule"]
+    expect = scale_chaos_schedule(sched["seed"], len(sched["flaps"]))
+    assert sched == json.loads(json.dumps(expect))  # tuples -> lists
+
+
+def test_scale_chaos_schedule_seed_reproducible():
+    """Same seed, same hostility — byte-identical schedules; a
+    different seed must actually move the chaos."""
+    from ray_tpu.test_utils import scale_chaos_schedule
+    a = scale_chaos_schedule(20, 4)
+    b = scale_chaos_schedule(20, 4)
+    assert a == b
+    assert len(a["flaps"]) == 4 and len(a["kills"]) == 2
+    for off, dur in a["flaps"]:
+        assert 0.05 <= off <= 0.6 and 0.2 <= dur <= 0.45
+    assert scale_chaos_schedule(21, 4) != a
